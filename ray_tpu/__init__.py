@@ -1,0 +1,253 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+The capability surface of Ray (tasks, actors, objects, placement groups, and
+the Data/Train/Tune/Serve/RLlib libraries) re-designed TPU-first: JAX/XLA is
+the compute substrate, device meshes + ICI collectives are the data plane, and
+the distributed runtime orchestrates between meshes.
+
+Public core API parity (reference: ``python/ray/__init__.py``):
+``init/shutdown/is_initialized/remote/get/put/wait/kill/cancel/
+get_actor/method/nodes/cluster_resources/available_resources/timeline``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker as _worker_mod
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.node import Node
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle, method, exit_actor
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "exit_actor", "nodes",
+    "cluster_resources", "available_resources", "ObjectRef", "ActorHandle",
+    "get_runtime_context", "exceptions", "timeline", "__version__",
+]
+
+_init_lock = threading.Lock()
+_global_node: Optional[Node] = None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    labels: Optional[Dict[str, str]] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    _node: Optional[Node] = None,
+    **_kwargs,
+) -> "ClientContext":
+    """Start (or connect to) a cluster and attach this process as a driver.
+
+    - ``init()`` boots a local head + agent (reference: worker.py:1225).
+    - ``init(address="host:port")`` connects to an existing head by starting a
+      local agent joined to it.
+    - ``init(_node=...)`` attaches to an already-running Node (tests).
+    """
+    global _global_node
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return ClientContext(_worker_mod.global_worker)
+            raise RuntimeError("ray_tpu.init() called twice")
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        if num_tpus is not None:
+            res["TPU"] = float(num_tpus)
+        if _node is not None:
+            node = _node
+        elif address is None or address == "local":
+            node = Node(head=True, resources=res or None, labels=labels,
+                        object_store_memory=object_store_memory)
+            node.start()
+            _global_node = node
+        else:
+            host, _, port = address.partition(":")
+            node = Node(head=False, head_host=host or "127.0.0.1",
+                        head_port=int(port), resources=res or None, labels=labels,
+                        object_store_memory=object_store_memory)
+            node.start()
+            _global_node = node
+        w = _worker_mod.Worker()
+        w.namespace = namespace
+        w.connect(node.agent_unix_path, mode=_worker_mod.Worker.MODE_DRIVER)
+        atexit.register(shutdown)
+        return ClientContext(w)
+
+
+def shutdown() -> None:
+    global _global_node
+    w = _worker_mod.global_worker
+    if w is not None:
+        try:
+            w.flush_task_events()
+        except Exception:
+            pass
+        w.disconnect()
+    if _global_node is not None:
+        _global_node.stop(cleanup_session=True)
+        _global_node = None
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def is_initialized() -> bool:
+    return _worker_mod.global_worker is not None and _worker_mod.global_worker.connected
+
+
+def _require_worker() -> _worker_mod.Worker:
+    w = _worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+def remote(*args, **options):
+    """``@ray_tpu.remote`` for functions and classes
+    (reference: python/ray/_private/worker.py remote)."""
+    if len(args) == 1 and not options and (inspect.isfunction(args[0]) or
+                                           inspect.isclass(args[0])):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def deco(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    return deco
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+) -> Any:
+    w = _require_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError("ray_tpu.get() expects an ObjectRef or a list of them")
+    return w.get(list(refs), timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    w = _require_worker()
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed")
+    return w.put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    w = _require_worker()
+    refs = list(refs)
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return w.wait(refs, num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    w = _require_worker()
+    w.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    w = _require_worker()
+    w.cancel_task(ref, force=force)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    w = _require_worker()
+    actor_id, view = w.get_named_actor(name, namespace)
+    return ActorHandle(actor_id, view.get("class_name", "Actor"))
+
+
+def nodes() -> List[Dict]:
+    w = _require_worker()
+    return w._acall(w.head.call("ListNodes", {}))
+
+
+def cluster_resources() -> Dict[str, float]:
+    from ray_tpu._private.resources import ResourceSet
+
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if not n["alive"]:
+            continue
+        for k, v in ResourceSet.from_wire(n["resources_total"]).to_dict().items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    from ray_tpu._private.resources import ResourceSet
+
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if not n["alive"]:
+            continue
+        for k, v in ResourceSet.from_wire(n["resources_available"]).to_dict().items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def timeline() -> List[Dict]:
+    """Chrome-trace-style task events (reference: python/ray/_private/state.py:924)."""
+    w = _require_worker()
+    w.flush_task_events()
+    time.sleep(0.05)
+    events = w._acall(w.head.call("ListTaskEvents", {"limit": 100000}))
+    out = []
+    for e in events:
+        out.append({
+            "cat": "task", "name": e.get("name"), "ph": "i",
+            "ts": e.get("time", 0) * 1e6, "pid": e.get("node_id", "")[:8],
+            "args": e,
+        })
+    return out
+
+
+class ClientContext:
+    def __init__(self, worker):
+        self._worker = worker
+        self.address_info = {
+            "node_id": worker.node_id,
+            "session_dir": getattr(_global_node, "session_dir", ""),
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        shutdown()
+
+    def disconnect(self):
+        shutdown()

@@ -1,0 +1,11 @@
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+from ray_tpu._private.accelerators.nvidia_gpu import NvidiaGPUAcceleratorManager
+
+
+def get_all_accelerator_managers():
+    return {"TPU": TPUAcceleratorManager, "GPU": NvidiaGPUAcceleratorManager}
+
+
+def get_accelerator_manager(resource_name: str):
+    return get_all_accelerator_managers().get(resource_name)

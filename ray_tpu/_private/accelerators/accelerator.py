@@ -1,0 +1,41 @@
+"""Pluggable accelerator manager interface.
+
+Parity with the reference ABC (reference:
+``python/ray/_private/accelerators/accelerator.py``): each accelerator family
+provides detection, request validation, and per-process visibility env vars;
+the node agent consults these when advertising resources and granting leases.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class AcceleratorManager:
+    @staticmethod
+    def get_resource_name() -> str:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        return None
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple:
+        return (True, None)
+
+    @staticmethod
+    def set_visible_accelerator_ids(ids: List[int]) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        return {}

@@ -1,0 +1,36 @@
+"""NVIDIA GPU manager (parity stub; reference:
+``python/ray/_private/accelerators/nvidia_gpu.py``). TPU is the first-class
+accelerator in this framework; GPU detection keeps API parity for mixed
+clusters."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+
+
+class NvidiaGPUAcceleratorManager(AcceleratorManager):
+    @staticmethod
+    def get_resource_name() -> str:
+        return "GPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "CUDA_VISIBLE_DEVICES"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        if "RAY_TPU_NUM_GPUS" in os.environ:
+            return int(os.environ["RAY_TPU_NUM_GPUS"])
+        try:
+            import glob
+
+            return len(glob.glob("/proc/driver/nvidia/gpus/*"))
+        except OSError:
+            return 0
+
+    @staticmethod
+    def set_visible_accelerator_ids(ids: List[int]) -> None:
+        os.environ["CUDA_VISIBLE_DEVICES"] = ",".join(str(i) for i in ids)

@@ -1,0 +1,834 @@
+"""Per-node agent (raylet analog).
+
+Parity with the reference raylet (reference: ``src/ray/raylet/node_manager.h``,
+``worker_pool.h``, ``local_task_manager.h``): one agent per node owning the
+worker pool (spawn/lease/kill), the local resource accounting + lease-based
+scheduler with spillback (reference: ``cluster_task_manager.cc:44``,
+``hybrid_scheduling_policy.h:50``), the shared-memory store accounting
+(reference: plasma + ``local_object_manager.h``), placement-group bundle
+reservations (reference: ``placement_group_resource_manager.h``), and the
+node-to-node object transfer plane (reference: ``object_manager.h:117``
+Push/Pull chunking).
+
+One asyncio process. Local clients (driver, workers) connect over a unix
+socket; remote agents and spilled-back submitters connect over TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import StoreDirectory
+from ray_tpu._private.protocol import AsyncRpcClient, Connection, RpcServer
+from ray_tpu._private.resources import NodeResources, ResourceSet
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn: Optional[Connection] = None  # registration connection
+        self.direct_addr: Optional[Dict] = None  # {"host","port","unix"} for PushTask
+        self.registered = asyncio.Event()
+        self.leased_to: Optional[str] = None  # lease id
+        self.assigned_resources: Optional[ResourceSet] = None
+        self.is_actor = False
+        self.actor_id: Optional[str] = None
+        self.spawn_time = time.monotonic()
+        self.idle_since = time.monotonic()
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class ConnectionPool:
+    """Cached async clients to remote endpoints, keyed by (host, port)."""
+
+    def __init__(self):
+        self._clients: Dict[Tuple[str, int], AsyncRpcClient] = {}
+        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+
+    async def get(self, host: str, port: int) -> AsyncRpcClient:
+        key = (host, port)
+        client = self._clients.get(key)
+        if client and client.connected:
+            return client
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            client = self._clients.get(key)
+            if client and client.connected:
+                return client
+            client = AsyncRpcClient()
+            await client.connect_tcp(host, port)
+            self._clients[key] = client
+            return client
+
+    def drop(self, host: str, port: int) -> None:
+        client = self._clients.pop((host, port), None)
+        if client:
+            client.close()
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        node_id: str,
+        session_dir: str,
+        store_dir: str,
+        head_host: str,
+        head_port: int,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.head_host = head_host
+        self.head_port = head_port
+        self.unix_path = os.path.join(session_dir, "sockets", f"agent-{node_id[:12]}.sock")
+        os.makedirs(os.path.dirname(self.unix_path), exist_ok=True)
+        self.store = StoreDirectory(store_dir, capacity=object_store_memory)
+        self.store_dir = store_dir
+        accel_ids: Dict[str, list] = {}
+        for name in ("TPU", "GPU"):
+            if resources.get(name):
+                accel_ids[name] = list(range(int(resources[name])))
+        self.resources = NodeResources(ResourceSet(resources), labels, accel_ids)
+        self.server = RpcServer("agent")
+        self.tcp_port = 0
+        self.head = AsyncRpcClient()
+        self.pool = ConnectionPool()
+        self.cluster_view: Dict[str, Dict] = {}
+
+        # worker pool state
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.idle_workers: List[WorkerHandle] = []
+        self.leases: Dict[str, WorkerHandle] = {}
+        self.max_workers = int(resources.get("CPU", 1)) or 1
+        if CONFIG.num_workers_soft_limit:
+            self.max_workers = CONFIG.num_workers_soft_limit
+        self._starting_workers = 0
+        self._lease_counter = 0
+        self._pending_leases: List[Dict] = []  # queued lease requests
+
+        # object plane
+        self._object_waits: Dict[str, List[asyncio.Future]] = {}
+        self._pulls_inflight: Dict[str, asyncio.Task] = {}
+
+        # placement groups: (pg_id, bundle_index) -> reserved ResourceSet
+        self._pg_bundles: Dict[Tuple[str, int], ResourceSet] = {}
+        self._pg_available: Dict[Tuple[str, int], ResourceSet] = {}
+
+        self._resources_dirty = True
+        self._register_routes()
+
+    # ------------------------------------------------------------------ boot
+    async def start(self) -> None:
+        await self.server.start_unix(self.unix_path)
+        self.tcp_port = await self.server.start_tcp("0.0.0.0", 0)
+        self.server.set_disconnect_handler(self._on_disconnect)
+        await self.head.connect_tcp(self.head_host, self.head_port)
+        self.head.set_push_handler(self._on_head_push)
+        reply = await self.head.call(
+            "RegisterNode",
+            {
+                "node_id": self.node_id,
+                "addr": {"host": "127.0.0.1", "port": self.tcp_port},
+                "resources": self.resources.to_wire(),
+            },
+        )
+        CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
+        self.cluster_view = reply.get("cluster_view", {})
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._resource_report_loop())
+        loop.create_task(self._worker_reaper_loop())
+        if CONFIG.prestart_workers:
+            loop.create_task(self._prestart())
+
+    def _register_routes(self) -> None:
+        r = self.server.add_handler
+        # local clients
+        r("RegisterClient", self._register_client)
+        r("RequestWorkerLease", self._request_worker_lease)
+        r("ReturnWorker", self._return_worker)
+        r("ObjectSealed", self._object_sealed)
+        r("WaitObjects", self._wait_objects)
+        r("FreeObjects", self._free_objects)
+        r("PinObject", self._pin_object)
+        r("UnpinObject", self._unpin_object)
+        r("GetStoreStats", self._get_store_stats)
+        r("GetNodeInfo", self._get_node_info)
+        r("RestoreSpilled", self._restore_spilled)
+        # remote agents
+        r("FetchObjectMeta", self._fetch_object_meta)
+        r("FetchObjectChunk", self._fetch_object_chunk)
+
+    async def _prestart(self) -> None:
+        for _ in range(min(self.max_workers, int(self.resources.total.get("CPU")) or 1)):
+            if len(self.workers) + self._starting_workers >= self.max_workers:
+                break
+            self._spawn_worker()
+
+    # ------------------------------------------------------------ head link
+    async def _on_head_push(self, method: str, payload: Any) -> None:
+        if method == "ClusterView":
+            self.cluster_view = payload
+            await self._drain_pending_leases()
+        elif method == "StartActor":
+            await self._start_actor(payload)
+        elif method == "KillActorWorker":
+            self._kill_actor_worker(payload["actor_id"])
+        elif method == "PreparePGBundle":
+            ok = self._prepare_pg_bundle(payload)
+            await self.head.call(
+                "Publish",
+                {"channel": payload["reply_channel"], "message": {"ok": ok}},
+            )
+        elif method == "ReturnPGBundle":
+            self._return_pg_bundle(payload)
+        elif method == "Pub":
+            pass
+        elif method == "Drain":
+            pass
+
+    async def _resource_report_loop(self) -> None:
+        period = max(CONFIG.gossip_period_ms, 50) / 1000
+        while True:
+            await asyncio.sleep(period)
+            if self._resources_dirty:
+                self._resources_dirty = False
+                try:
+                    await self.head.call(
+                        "UpdateResources",
+                        {"node_id": self.node_id, "resources": self.resources.to_wire()},
+                    )
+                except Exception:
+                    pass
+            else:
+                # heartbeat
+                try:
+                    await self.head.call(
+                        "UpdateResources",
+                        {"node_id": self.node_id, "resources": self.resources.to_wire()},
+                    )
+                except Exception:
+                    pass
+
+    # ---------------------------------------------------------- worker pool
+    def _spawn_worker(self, actor_spec: Optional[Dict] = None) -> WorkerHandle:
+        worker_id = os.urandom(16).hex()
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.out"), "ab")
+        err = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.err"), "ab")
+        env = dict(os.environ)
+        env.update(
+            {
+                "RAY_TPU_WORKER_ID": worker_id,
+                "RAY_TPU_AGENT_SOCK": self.unix_path,
+                "RAY_TPU_NODE_ID": self.node_id,
+                "RAY_TPU_SESSION_DIR": self.session_dir,
+                "RAY_TPU_STORE_DIR": self.store_dir,
+                "RAY_TPU_HEAD_ADDR": f"{self.head_host}:{self.head_port}",
+            }
+        )
+        # Workers must not grab the TPU runtime by default; tasks that request
+        # TPU resources get chip visibility through their lease's instance ids.
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_process"],
+            env=env,
+            stdout=out,
+            stderr=err,
+            start_new_session=True,
+        )
+        out.close()
+        err.close()
+        handle = WorkerHandle(worker_id, proc)
+        self.workers[worker_id] = handle
+        self._starting_workers += 1
+        return handle
+
+    async def _register_client(self, conn: Connection, p: Dict) -> Dict:
+        role = p.get("role")
+        conn.meta["role"] = role
+        if role == "worker":
+            worker_id = p["worker_id"]
+            handle = self.workers.get(worker_id)
+            if handle is None:
+                # Worker we didn't spawn (e.g. driver-embedded); track anyway.
+                handle = WorkerHandle(worker_id, proc=_ForeignProc(p.get("pid", 0)))
+                self.workers[worker_id] = handle
+            else:
+                self._starting_workers = max(0, self._starting_workers - 1)
+            handle.conn = conn
+            handle.direct_addr = p["direct_addr"]
+            handle.registered.set()
+            conn.meta["worker_id"] = worker_id
+            if not handle.is_actor and handle.leased_to is None:
+                handle.idle_since = time.monotonic()
+                self.idle_workers.append(handle)
+                await self._drain_pending_leases()
+        return {
+            "node_id": self.node_id,
+            "head_addr": {"host": self.head_host, "port": self.head_port},
+            "store_dir": self.store_dir,
+            "cluster_config": CONFIG.snapshot(),
+        }
+
+    async def _on_disconnect(self, conn: Connection) -> None:
+        worker_id = conn.meta.get("worker_id")
+        if worker_id:
+            handle = self.workers.get(worker_id)
+            if handle:
+                await self._handle_worker_exit(handle, "connection closed")
+
+    async def _handle_worker_exit(self, handle: WorkerHandle, reason: str) -> None:
+        self.workers.pop(handle.worker_id, None)
+        if handle in self.idle_workers:
+            self.idle_workers.remove(handle)
+        if handle.leased_to:
+            self._release_lease(handle.leased_to, handle)
+        if handle.is_actor and handle.actor_id:
+            try:
+                await self.head.call(
+                    "ActorDied", {"actor_id": handle.actor_id, "reason": reason}
+                )
+            except Exception:
+                pass
+        if handle.alive:
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+
+    async def _worker_reaper_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            for handle in list(self.workers.values()):
+                if not handle.alive:
+                    await self._handle_worker_exit(
+                        handle, f"worker process exited (code {handle.proc.poll()})"
+                    )
+            # Kill workers idle beyond the cap to reclaim memory.
+            cutoff = time.monotonic() - CONFIG.idle_worker_killing_time_ms / 1000
+            while len(self.idle_workers) > self.max_workers:
+                victim = self.idle_workers[0]
+                if victim.idle_since < cutoff:
+                    self.idle_workers.pop(0)
+                    victim.proc.terminate()
+                else:
+                    break
+
+    # ------------------------------------------------------------- leasing
+    async def _request_worker_lease(self, conn: Connection, p: Dict) -> Dict:
+        """Grant a worker lease, queue it, or reply with a spillback target.
+
+        The hybrid policy (reference: hybrid_scheduling_policy.h:50): run
+        locally while local utilization is below the spread threshold or no
+        remote node is better; otherwise spill to the least-utilized feasible
+        remote node.
+        """
+        request = ResourceSet.from_wire(p.get("resources", {}))
+        pg = p.get("pg")  # [pg_id, bundle_index] or None
+        if not p.get("spilled_once"):
+            target = self._maybe_spillback(request, p)
+            if target is not None:
+                return {"spillback": target}
+        fut = asyncio.get_running_loop().create_future()
+        req = {"resources": request, "p": p, "fut": fut, "pg": pg}
+        self._pending_leases.append(req)
+        await self._drain_pending_leases()
+        return await fut
+
+    def _maybe_spillback(self, request: ResourceSet, p: Dict) -> Optional[Dict]:
+        strategy = p.get("scheduling_strategy") or {}
+        if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
+            target_node = strategy.get("node_id")
+            if target_node and target_node != self.node_id:
+                view = self.cluster_view.get(target_node)
+                if view:
+                    return {"node_id": target_node, "addr": view["addr"]}
+            return None
+        if p.get("pg"):
+            return None  # PG leases run where the bundle lives; caller targeted us
+        spread = isinstance(strategy, dict) and strategy.get("type") == "spread"
+        local_feasible = request.feasible_on(self.resources.total)
+        local_fits = request.fits(self.resources.available)
+        local_util = self.resources.utilization()
+        if (
+            local_feasible
+            and local_fits
+            and not spread
+            and local_util < CONFIG.scheduler_spread_threshold
+        ):
+            return None
+        # Consider remote nodes from the gossip view.
+        best = None
+        best_util = None
+        for node_id, view in self.cluster_view.items():
+            if node_id == self.node_id or not view.get("alive", True):
+                continue
+            nr = NodeResources.from_wire(view["resources"])
+            if not request.feasible_on(nr.total):
+                continue
+            if not request.fits(nr.available):
+                continue
+            util = nr.utilization()
+            if best is None or util < best_util:
+                best, best_util = (node_id, view["addr"]), util
+        if best is None:
+            return None
+        if not local_feasible or not local_fits:
+            return {"node_id": best[0], "addr": best[1]}
+        if spread or local_util >= CONFIG.scheduler_spread_threshold:
+            if best_util < local_util:
+                return {"node_id": best[0], "addr": best[1]}
+        return None
+
+    async def _drain_pending_leases(self) -> None:
+        made_progress = True
+        while made_progress and self._pending_leases:
+            made_progress = False
+            for req in list(self._pending_leases):
+                if await self._try_grant(req):
+                    self._pending_leases.remove(req)
+                    made_progress = True
+                    continue
+                # A queued request that this node can never (or not soon)
+                # satisfy gets re-evaluated for spillback as the gossip view
+                # evolves — otherwise a request that arrived before the view
+                # caught up would wedge here forever.
+                p = req["p"]
+                if not p.get("spilled_once"):
+                    target = self._maybe_spillback(req["resources"], p)
+                    if target is not None and not req["fut"].done():
+                        req["fut"].set_result({"spillback": target})
+                        self._pending_leases.remove(req)
+                        made_progress = True
+
+    async def _try_grant(self, req: Dict) -> bool:
+        request: ResourceSet = req["resources"]
+        pg = req.get("pg")
+        if pg:
+            key = (pg[0], pg[1])
+            pool = self._pg_available.get(key)
+            if pool is None or not request.fits(pool):
+                return False
+        elif not request.fits(self.resources.available):
+            return False
+        worker = self._pop_idle_worker()
+        if worker is None:
+            if len(self.workers) + self._starting_workers < self.max_workers + 8:
+                self._spawn_worker()
+            return False
+        # allocate resources
+        assigned_instances: Dict[str, list] = {}
+        if pg:
+            self._pg_available[(pg[0], pg[1])].subtract(request)
+        else:
+            assigned_instances = self.resources.allocate(request, owner=worker.worker_id) or {}
+            self._resources_dirty = True
+        self._lease_counter += 1
+        lease_id = f"{self.node_id[:8]}-{self._lease_counter}"
+        worker.leased_to = lease_id
+        worker.assigned_resources = request
+        self.leases[lease_id] = worker
+        worker.meta_pg = pg
+        fut: asyncio.Future = req["fut"]
+        if not fut.done():
+            fut.set_result(
+                {
+                    "grant": {
+                        "lease_id": lease_id,
+                        "worker_id": worker.worker_id,
+                        "addr": worker.direct_addr,
+                        "node_id": self.node_id,
+                        "assigned_instances": assigned_instances,
+                    }
+                }
+            )
+        else:
+            self._release_lease(lease_id, worker)
+            self.idle_workers.append(worker)
+        return True
+
+    def _pop_idle_worker(self) -> Optional[WorkerHandle]:
+        while self.idle_workers:
+            w = self.idle_workers.pop()
+            if w.alive and w.registered.is_set():
+                return w
+        return None
+
+    async def _return_worker(self, conn: Connection, p: Dict) -> bool:
+        lease_id = p["lease_id"]
+        worker = self.leases.get(lease_id)
+        if worker is None:
+            return False
+        self._release_lease(lease_id, worker)
+        if p.get("worker_exiting") or not worker.alive:
+            return True
+        worker.idle_since = time.monotonic()
+        self.idle_workers.append(worker)
+        await self._drain_pending_leases()
+        return True
+
+    def _release_lease(self, lease_id: str, worker: WorkerHandle) -> None:
+        self.leases.pop(lease_id, None)
+        if worker.assigned_resources is not None:
+            pg = getattr(worker, "meta_pg", None)
+            if pg:
+                pool = self._pg_available.get((pg[0], pg[1]))
+                if pool is not None:
+                    pool.add(worker.assigned_resources)
+            else:
+                self.resources.release(worker.assigned_resources, owner=worker.worker_id)
+                self._resources_dirty = True
+        worker.assigned_resources = None
+        worker.leased_to = None
+        worker.meta_pg = None
+
+    # ---------------------------------------------------------------- actors
+    async def _start_actor(self, p: Dict) -> None:
+        spec = p["spec"]
+        request = ResourceSet.from_wire(spec.get("resources", {}))
+        pg = spec.get("pg")
+        if pg:
+            key = (pg[0], pg[1])
+            pool = self._pg_available.get(key)
+            if pool is None or not request.fits(pool):
+                await self.head.call(
+                    "ActorDied",
+                    {"actor_id": p["actor_id"], "reason": "pg bundle unavailable"},
+                )
+                return
+            pool.subtract(request)
+            assigned = {}
+        else:
+            deadline = time.monotonic() + CONFIG.actor_creation_timeout_ms / 1000
+            while not request.fits(self.resources.available):
+                if time.monotonic() > deadline:
+                    await self.head.call(
+                        "ActorDied",
+                        {"actor_id": p["actor_id"],
+                         "reason": "timed out waiting for actor resources"},
+                    )
+                    return
+                await asyncio.sleep(0.1)
+            assigned = self.resources.allocate(request, owner=p["actor_id"]) or {}
+            self._resources_dirty = True
+        handle = self._spawn_worker()
+        handle.is_actor = True
+        handle.actor_id = p["actor_id"]
+        handle.assigned_resources = None  # released via actor-death path below
+
+        async def finish():
+            try:
+                await asyncio.wait_for(handle.registered.wait(),
+                                       CONFIG.worker_register_timeout_s)
+            except asyncio.TimeoutError:
+                await self.head.call(
+                    "ActorDied",
+                    {"actor_id": p["actor_id"], "reason": "worker failed to start"},
+                )
+                return
+            await handle.conn.push(
+                "BecomeActor",
+                {"spec": spec, "actor_id": p["actor_id"],
+                 "assigned_instances": assigned},
+            )
+
+        asyncio.get_running_loop().create_task(finish())
+
+        # Hold the resources until the actor dies.
+        async def watch_release():
+            while handle.alive:
+                await asyncio.sleep(0.5)
+            if pg:
+                pool = self._pg_available.get((pg[0], pg[1]))
+                if pool is not None:
+                    pool.add(request)
+            else:
+                self.resources.release(request, owner=p["actor_id"])
+                self._resources_dirty = True
+
+        asyncio.get_running_loop().create_task(watch_release())
+
+    def _kill_actor_worker(self, actor_id: str) -> None:
+        for handle in self.workers.values():
+            if handle.actor_id == actor_id:
+                try:
+                    handle.proc.terminate()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------ placement groups
+    def _prepare_pg_bundle(self, p: Dict) -> bool:
+        key = (p["pg_id"], p["bundle_index"])
+        if key in self._pg_bundles:
+            return True
+        request = ResourceSet.from_wire(p["resources"])
+        if self.resources.allocate(request) is None:
+            return False
+        self._pg_bundles[key] = request
+        self._pg_available[key] = request.copy()
+        self._resources_dirty = True
+        return True
+
+    def _return_pg_bundle(self, p: Dict) -> None:
+        key = (p["pg_id"], p["bundle_index"])
+        request = self._pg_bundles.pop(key, None)
+        self._pg_available.pop(key, None)
+        if request is not None:
+            self.resources.release(request)
+            self._resources_dirty = True
+
+    # --------------------------------------------------------- object plane
+    async def _object_sealed(self, conn: Connection, p: Dict) -> None:
+        hex_id = p["object_id"]
+        self.store.on_sealed(hex_id, p["size"])
+        for fut in self._object_waits.pop(hex_id, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    async def _wait_objects(self, conn: Connection, p: Dict) -> Dict:
+        """Wait until num_returns of the ids are local, pulling remotes.
+
+        p: {ids: [hex], owners: {hex: owner_addr}, num_returns, timeout_ms}
+        """
+        ids: List[str] = p["ids"]
+        owners: Dict[str, Dict] = p.get("owners", {})
+        num_returns = p.get("num_returns", len(ids))
+        timeout_ms = p.get("timeout_ms")
+        futs = {}
+        for hex_id in ids:
+            if self.store.contains(hex_id):
+                continue
+            fut = asyncio.get_running_loop().create_future()
+            self._object_waits.setdefault(hex_id, []).append(fut)
+            futs[hex_id] = fut
+            owner = owners.get(hex_id)
+            if owner and hex_id not in self._pulls_inflight:
+                self._pulls_inflight[hex_id] = asyncio.get_running_loop().create_task(
+                    self._pull_object(hex_id, owner)
+                )
+
+        def ready_count() -> int:
+            return sum(1 for h in ids if self.store.contains(h))
+
+        deadline = None if timeout_ms is None else time.monotonic() + timeout_ms / 1000
+        while ready_count() < num_returns:
+            pending = [f for f in futs.values() if not f.done()]
+            if not pending:
+                break
+            wait_timeout = None
+            if deadline is not None:
+                wait_timeout = deadline - time.monotonic()
+                if wait_timeout <= 0:
+                    break
+            done, _ = await asyncio.wait(
+                pending, timeout=wait_timeout, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not done:
+                break
+        ready = [h for h in ids if self.store.contains(h)]
+        not_ready = [h for h in ids if h not in set(ready)]
+        return {"ready": ready, "not_ready": not_ready}
+
+    async def _pull_object(self, hex_id: str, owner: Dict) -> None:
+        """Owner-directed pull (reference: pull_manager.h + ownership-based
+        object directory): ask the owner where the object lives, then fetch
+        chunks from that node's agent, or the inline value from the owner."""
+        try:
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                if self.store.contains(hex_id):
+                    return
+                try:
+                    client = await self.pool.get(owner["host"], owner["port"])
+                    loc = await client.call(
+                        "LocateObject", {"object_id": hex_id}, timeout=15
+                    )
+                except Exception:
+                    await asyncio.sleep(0.2)
+                    continue
+                if loc is None:
+                    await asyncio.sleep(0.1)
+                    continue
+                if loc.get("inline") is not None:
+                    data = loc["inline"]
+                    self.store.client.put_bytes(ObjectID.from_hex(hex_id), data)
+                    self.store.on_sealed(hex_id, len(data))
+                    self._notify_sealed(hex_id)
+                    return
+                for node_addr in loc.get("locations", []):
+                    if (
+                        node_addr.get("host") == "127.0.0.1"
+                        and node_addr.get("port") == self.tcp_port
+                    ):
+                        continue
+                    if await self._fetch_from_node(hex_id, node_addr):
+                        self._notify_sealed(hex_id)
+                        # Tell the owner we now hold a copy.
+                        try:
+                            await client.push(
+                                "ObjectLocationAdded",
+                                {"object_id": hex_id,
+                                 "addr": {"host": "127.0.0.1", "port": self.tcp_port}},
+                            )
+                        except Exception:
+                            pass
+                        return
+                await asyncio.sleep(0.2)
+        finally:
+            self._pulls_inflight.pop(hex_id, None)
+
+    def _notify_sealed(self, hex_id: str) -> None:
+        for fut in self._object_waits.pop(hex_id, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    async def _fetch_from_node(self, hex_id: str, addr: Dict) -> bool:
+        try:
+            client = await self.pool.get(addr["host"], addr["port"])
+            meta = await client.call("FetchObjectMeta", {"object_id": hex_id}, timeout=15)
+            if not meta or not meta.get("exists"):
+                return False
+            size = meta["size"]
+            oid = ObjectID.from_hex(hex_id)
+            view, handle = self.store.client.create(oid, size)
+            try:
+                chunk = CONFIG.object_chunk_size_bytes
+                off = 0
+                while off < size:
+                    n = min(chunk, size - off)
+                    data = await client.call(
+                        "FetchObjectChunk",
+                        {"object_id": hex_id, "offset": off, "length": n},
+                        timeout=60,
+                    )
+                    if data is None:
+                        raise IOError("remote chunk missing")
+                    view[off : off + len(data)] = data
+                    off += len(data)
+                self.store.client.seal(oid, handle)
+                self.store.on_sealed(hex_id, size)
+                return True
+            except Exception:
+                self.store.client.abort(handle)
+                return False
+        except Exception:
+            return False
+
+    async def _fetch_object_meta(self, conn: Connection, p: Dict) -> Dict:
+        hex_id = p["object_id"]
+        view = self.store.read_maybe_spilled(hex_id)
+        if view is None:
+            return {"exists": False}
+        return {"exists": True, "size": len(view)}
+
+    async def _fetch_object_chunk(self, conn: Connection, p: Dict) -> Optional[bytes]:
+        view = self.store.read_maybe_spilled(p["object_id"])
+        if view is None:
+            return None
+        off, length = p["offset"], p["length"]
+        return bytes(view[off : off + length])
+
+    async def _free_objects(self, conn: Connection, p: Dict) -> None:
+        for hex_id in p["ids"]:
+            self.store.delete(hex_id)
+
+    async def _pin_object(self, conn: Connection, p: Dict) -> None:
+        self.store.pin(p["object_id"])
+
+    async def _unpin_object(self, conn: Connection, p: Dict) -> None:
+        self.store.unpin(p["object_id"])
+
+    async def _restore_spilled(self, conn: Connection, p: Dict) -> bool:
+        return self.store.restore(p["object_id"])
+
+    async def _get_store_stats(self, conn: Connection, p) -> Dict:
+        return self.store.stats()
+
+    async def _get_node_info(self, conn: Connection, p) -> Dict:
+        return {
+            "node_id": self.node_id,
+            "tcp_port": self.tcp_port,
+            "resources_total": self.resources.total.to_wire(),
+            "resources_available": self.resources.available.to_wire(),
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "cluster_view": self.cluster_view,
+        }
+
+
+class _ForeignProc:
+    """Stand-in Popen for worker processes the agent didn't spawn."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def poll(self):
+        if not self.pid:
+            return None
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            return 1
+
+    def terminate(self):
+        if self.pid:
+            try:
+                os.kill(self.pid, 15)
+            except OSError:
+                pass
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--store-dir", required=True)
+    parser.add_argument("--head-host", required=True)
+    parser.add_argument("--head-port", type=int, required=True)
+    parser.add_argument("--resources", required=True)  # json
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--ready-file", default="")
+    args = parser.parse_args()
+
+    async def run():
+        agent = NodeAgent(
+            node_id=args.node_id,
+            session_dir=args.session_dir,
+            store_dir=args.store_dir,
+            head_host=args.head_host,
+            head_port=args.head_port,
+            resources=json.loads(args.resources),
+            labels=json.loads(args.labels),
+            object_store_memory=args.object_store_memory or None,
+        )
+        await agent.start()
+        if args.ready_file:
+            with open(args.ready_file, "w") as f:
+                f.write(json.dumps({"unix_path": agent.unix_path,
+                                    "tcp_port": agent.tcp_port}))
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Runtime configuration flags.
+
+Mirrors the behavior of the reference's 218-flag x-macro config table
+(reference: ``src/ray/common/ray_config_def.h``): every flag has a typed
+default, is overridable per-process via a ``RAY_TPU_<name>`` environment
+variable, and the head node can broadcast a config dict that seeds freshly
+started nodes so the whole cluster agrees on tunables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_DEFS: Dict[str, Any] = {}
+
+
+def _flag(name: str, default: Any) -> None:
+    _DEFS[name] = default
+
+
+# --- scheduling -------------------------------------------------------------
+_flag("scheduler_spread_threshold", 0.5)  # hybrid policy: prefer local below this load
+_flag("scheduler_top_k_fraction", 0.2)
+_flag("max_pending_lease_requests_per_scheduling_category", 10)
+_flag("worker_lease_timeout_ms", 30_000)
+_flag("actor_creation_timeout_ms", 120_000)
+
+# --- object store -----------------------------------------------------------
+_flag("object_store_memory_bytes", 0)  # 0 = auto (30% of system memory)
+_flag("object_store_full_delay_ms", 100)
+_flag("object_spilling_threshold", 0.8)
+_flag("object_spilling_dir", "")  # "" = <session dir>/spill
+_flag("min_spilling_size_bytes", 1024 * 1024)
+_flag("object_chunk_size_bytes", 5 * 1024 * 1024)  # cross-node transfer chunking
+_flag("inline_object_max_size_bytes", 100 * 1024)  # small returns ride the RPC reply
+
+# --- workers ----------------------------------------------------------------
+_flag("num_workers_soft_limit", 0)  # 0 = num_cpus
+_flag("worker_register_timeout_s", 60)
+_flag("idle_worker_killing_time_ms", 600_000)
+_flag("prestart_workers", True)
+
+# --- fault tolerance --------------------------------------------------------
+_flag("task_max_retries_default", 3)
+_flag("actor_max_restarts_default", 0)
+_flag("health_check_period_ms", 3_000)
+_flag("health_check_failure_threshold", 5)
+_flag("max_lineage_bytes", 64 * 1024 * 1024)
+
+# --- control plane ----------------------------------------------------------
+_flag("gossip_period_ms", 100)  # resource-view sync cadence (ray_syncer analog)
+_flag("pubsub_poll_timeout_s", 30)
+_flag("kv_namespace_default", "default")
+_flag("metrics_report_interval_ms", 5_000)
+_flag("task_event_buffer_max", 100_000)
+
+# --- TPU --------------------------------------------------------------------
+_flag("tpu_chips_per_host_default", 4)
+_flag("tpu_premap_device_buffers", True)
+_flag("xla_collective_timeout_s", 300)
+
+# --- logging / debug --------------------------------------------------------
+_flag("event_stats", False)
+_flag("log_to_driver", True)
+_flag("debug_state_dump_period_ms", 0)  # 0 = disabled
+
+
+class _Config:
+    """Flag accessor: attribute access returns the effective value
+    (env override > cluster broadcast > default)."""
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in _DEFS:
+            raise AttributeError(f"unknown config flag: {name}")
+        env_key = f"RAY_TPU_{name}"
+        if env_key in os.environ:
+            return _coerce(os.environ[env_key], _DEFS[name])
+        if name in self._overrides:
+            return self._overrides[name]
+        return _DEFS[name]
+
+    def apply_cluster_config(self, cfg: Dict[str, Any]) -> None:
+        """Apply the head-broadcast config dict (lower priority than env)."""
+        for k, v in cfg.items():
+            if k in _DEFS:
+                self._overrides[k] = v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in _DEFS}
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+
+def _coerce(raw: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+CONFIG = _Config()

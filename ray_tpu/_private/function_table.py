@@ -1,0 +1,64 @@
+"""Function shipping: descriptors, export and worker-side cache.
+
+Parity with the reference's function manager (reference:
+``python/ray/_private/function_manager.py`` + ``GcsFunctionManager``): remote
+functions are cloudpickled once, identified by content hash, inlined in the
+task spec when small, exported through the head KV when large, and cached by
+executing workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ray_tpu._private import serialization as ser
+
+INLINE_FUNCTION_MAX = 16 * 1024
+_KV_NS = "funcs"
+
+import weakref
+
+_export_lock = threading.Lock()
+# Keyed by the function object itself (weakly): an id()-keyed cache would
+# alias a new function that reuses a collected function's address.
+_descriptor_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_function_cache: Dict[bytes, Callable] = {}
+
+
+def function_descriptor(function: Callable, worker) -> Tuple[bytes, Optional[bytes], str]:
+    """Returns (function_id, inline_blob_or_None, name); exports to KV if big."""
+    try:
+        cached = _descriptor_cache.get(function)
+    except TypeError:
+        cached = None
+    if cached is not None:
+        return cached
+    blob = ser.dumps(function)
+    fid = hashlib.sha1(blob).digest()[:16]
+    name = getattr(function, "__qualname__", getattr(function, "__name__", "fn"))
+    if len(blob) <= INLINE_FUNCTION_MAX:
+        result = (fid, blob, name)
+    else:
+        with _export_lock:
+            worker.kv().put(fid, blob, overwrite=False, namespace=_KV_NS)
+        result = (fid, None, name)
+    try:
+        _descriptor_cache[function] = result
+    except TypeError:
+        pass  # non-weakref-able callables are re-pickled each call
+    return result
+
+
+def load_function(fid: bytes, blob: Optional[bytes], worker) -> Callable:
+    fn = _function_cache.get(fid)
+    if fn is not None:
+        return fn
+    if blob is None:
+        blob = worker.kv().get(fid, namespace=_KV_NS)
+        if blob is None:
+            raise RuntimeError(f"function {fid.hex()} not found in function table")
+    fn = ser.loads(blob)
+    _function_cache[fid] = fn
+    return fn

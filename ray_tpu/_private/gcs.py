@@ -1,0 +1,592 @@
+"""Head-node control plane (GCS analog).
+
+Parity with the reference's GCS server (reference:
+``src/ray/gcs/gcs_server/gcs_server.h``): node membership + health
+(GcsNodeManager / GcsHealthCheckManager), actor registry + scheduling
+(GcsActorManager/GcsActorScheduler), placement groups
+(GcsPlacementGroupManager), internal KV (GcsInternalKVManager), job table
+(GcsJobManager), pubsub, and an aggregated cluster resource view
+(GcsResourceManager) that is gossiped back to node agents for spillback
+decisions (ray_syncer analog).
+
+One asyncio process, TCP. State is in-memory; a periodic JSON snapshot to
+disk provides warm-restart durability (the RedisStoreClient analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.protocol import Connection, RpcServer
+from ray_tpu._private.resources import NodeResources, ResourceSet
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, addr: Dict, resources: NodeResources,
+                 conn: Connection):
+        self.node_id = node_id
+        self.addr = addr  # {"host":..., "port":...} of the agent's TCP server
+        self.resources = resources
+        self.conn = conn
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.labels = resources.labels
+
+
+class ActorInfo:
+    def __init__(self, actor_id: str, spec_wire: Dict, name: str, namespace: str,
+                 max_restarts: int, owner_conn: Optional[Connection]):
+        self.actor_id = actor_id
+        self.spec_wire = spec_wire
+        self.name = name
+        self.namespace = namespace
+        self.state = ACTOR_PENDING
+        self.node_id: Optional[str] = None
+        self.addr: Optional[Dict] = None  # worker's direct call address
+        self.max_restarts = max_restarts
+        self.num_restarts = 0
+        self.death_cause = ""
+        self.owner_conn = owner_conn
+        self.detached = bool(spec_wire.get("detached"))
+        self.class_name = spec_wire.get("class_name", "")
+        self.pid: int = 0
+
+    def public_view(self) -> Dict:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "node_id": self.node_id,
+            "addr": self.addr,
+            "name": self.name,
+            "namespace": self.namespace,
+            "class_name": self.class_name,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "pid": self.pid,
+        }
+
+
+class HeadServer:
+    """The cluster brain. All state lives here; agents and drivers connect in."""
+
+    def __init__(self, session_dir: str, port: int = 0):
+        self.session_dir = session_dir
+        self.port = port
+        self.server = RpcServer("head")
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> key -> value
+        self.jobs: Dict[str, Dict] = {}
+        self.placement_groups: Dict[str, Dict] = {}
+        self.subscribers: Dict[str, set] = {}  # channel -> set[Connection]
+        self.task_events: List[Dict] = []  # ring buffer of task state transitions
+        self.cluster_config = CONFIG.snapshot()
+        self._pg_counter = 0
+        self._register_routes()
+
+    # ------------------------------------------------------------------ boot
+    async def start(self) -> int:
+        self.port = await self.server.start_tcp("0.0.0.0", self.port)
+        self.server.set_disconnect_handler(self._on_disconnect)
+        asyncio.get_running_loop().create_task(self._health_check_loop())
+        asyncio.get_running_loop().create_task(self._broadcast_loop())
+        return self.port
+
+    def _register_routes(self) -> None:
+        r = self.server.add_handler
+        r("RegisterNode", self._register_node)
+        r("UpdateResources", self._update_resources)
+        r("GetClusterView", self._get_cluster_view)
+        r("RegisterDriver", self._register_driver)
+        r("KvPut", self._kv_put)
+        r("KvGet", self._kv_get)
+        r("KvDel", self._kv_del)
+        r("KvKeys", self._kv_keys)
+        r("KvExists", self._kv_exists)
+        r("CreateActor", self._create_actor)
+        r("ActorReady", self._actor_ready)
+        r("ActorDied", self._actor_died)
+        r("GetActor", self._get_actor)
+        r("GetNamedActor", self._get_named_actor)
+        r("ListActors", self._list_actors)
+        r("KillActor", self._kill_actor)
+        r("ListNodes", self._list_nodes)
+        r("Subscribe", self._subscribe)
+        r("Publish", self._publish)
+        r("CreatePlacementGroup", self._create_placement_group)
+        r("RemovePlacementGroup", self._remove_placement_group)
+        r("GetPlacementGroup", self._get_placement_group)
+        r("ListPlacementGroups", self._list_placement_groups)
+        r("ReportTaskEvents", self._report_task_events)
+        r("ListTaskEvents", self._list_task_events)
+        r("RegisterJob", self._register_job)
+        r("ListJobs", self._list_jobs)
+        r("DrainNode", self._drain_node)
+
+    # ------------------------------------------------------ node membership
+    async def _register_node(self, conn: Connection, p: Dict) -> Dict:
+        node_id = p["node_id"]
+        info = NodeInfo(node_id, p["addr"], NodeResources.from_wire(p["resources"]), conn)
+        self.nodes[node_id] = info
+        conn.meta["node_id"] = node_id
+        conn.meta["role"] = "agent"
+        await self._publish_event("node", {"event": "added", "node_id": node_id,
+                                           "addr": p["addr"]})
+        return {"cluster_config": self.cluster_config,
+                "cluster_view": self._cluster_view()}
+
+    async def _register_driver(self, conn: Connection, p: Dict) -> Dict:
+        conn.meta["role"] = "driver"
+        conn.meta["job_id"] = p.get("job_id")
+        self.jobs[p.get("job_id", "")] = {
+            "job_id": p.get("job_id"), "start_time": time.time(), "state": "RUNNING",
+            "entrypoint": p.get("entrypoint", ""),
+        }
+        return {"cluster_config": self.cluster_config,
+                "cluster_view": self._cluster_view()}
+
+    async def _update_resources(self, conn: Connection, p: Dict) -> None:
+        node = self.nodes.get(p["node_id"])
+        if node:
+            node.resources = NodeResources.from_wire(p["resources"])
+            node.last_heartbeat = time.monotonic()
+
+    def _cluster_view(self) -> Dict:
+        return {
+            nid: {"addr": n.addr, "resources": n.resources.to_wire(),
+                  "alive": n.alive}
+            for nid, n in self.nodes.items() if n.alive
+        }
+
+    async def _get_cluster_view(self, conn: Connection, p) -> Dict:
+        return self._cluster_view()
+
+    async def _list_nodes(self, conn: Connection, p) -> List[Dict]:
+        return [
+            {"node_id": nid, "addr": n.addr, "alive": n.alive,
+             "resources_total": n.resources.total.to_wire(),
+             "resources_available": n.resources.available.to_wire(),
+             "labels": n.labels}
+            for nid, n in self.nodes.items()
+        ]
+
+    async def _drain_node(self, conn: Connection, p: Dict) -> Dict:
+        node = self.nodes.get(p["node_id"])
+        if node and node.alive:
+            await node.conn.push("Drain", {})
+        return {"ok": True}
+
+    async def _health_check_loop(self) -> None:
+        period = CONFIG.health_check_period_ms / 1000
+        threshold = CONFIG.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > period * threshold:
+                    await self._mark_node_dead(node, "health check timeout")
+
+    async def _mark_node_dead(self, node: NodeInfo, reason: str) -> None:
+        if not node.alive:
+            return
+        node.alive = False
+        await self._publish_event(
+            "node", {"event": "removed", "node_id": node.node_id, "reason": reason}
+        )
+        # Every actor on that node dies with it.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in (
+                ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
+            ):
+                await self._handle_actor_failure(actor, f"node died: {reason}")
+
+    async def _broadcast_loop(self) -> None:
+        """Gossip the cluster resource view to all agents (ray_syncer analog)."""
+        period = max(CONFIG.gossip_period_ms, 50) / 1000
+        while True:
+            await asyncio.sleep(period)
+            view = self._cluster_view()
+            for node in list(self.nodes.values()):
+                if node.alive:
+                    await node.conn.push("ClusterView", view)
+
+    async def _on_disconnect(self, conn: Connection) -> None:
+        node_id = conn.meta.get("node_id")
+        if node_id and node_id in self.nodes:
+            await self._mark_node_dead(self.nodes[node_id], "agent disconnected")
+        if conn.meta.get("role") == "driver":
+            job_id = conn.meta.get("job_id")
+            if job_id in self.jobs:
+                self.jobs[job_id]["state"] = "FINISHED"
+            # Non-detached actors owned by this driver die with it.
+            for actor in list(self.actors.values()):
+                if actor.owner_conn is conn and not actor.detached and actor.state != ACTOR_DEAD:
+                    await self._kill_actor_internal(actor, "owner driver exited")
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+
+    # ------------------------------------------------------------------- kv
+    async def _kv_put(self, conn, p) -> bool:
+        ns = self.kv.setdefault(p.get("ns", "default"), {})
+        key = p["key"]
+        if p.get("overwrite", True) or key not in ns:
+            ns[key] = p["value"]
+            return True
+        return False
+
+    async def _kv_get(self, conn, p):
+        return self.kv.get(p.get("ns", "default"), {}).get(p["key"])
+
+    async def _kv_del(self, conn, p) -> int:
+        ns = self.kv.get(p.get("ns", "default"), {})
+        if p.get("prefix"):
+            keys = [k for k in ns if k.startswith(p["key"])]
+            for k in keys:
+                del ns[k]
+            return len(keys)
+        return 1 if ns.pop(p["key"], None) is not None else 0
+
+    async def _kv_keys(self, conn, p) -> List[bytes]:
+        ns = self.kv.get(p.get("ns", "default"), {})
+        prefix = p.get("prefix", b"")
+        return [k for k in ns if k.startswith(prefix)]
+
+    async def _kv_exists(self, conn, p) -> bool:
+        return p["key"] in self.kv.get(p.get("ns", "default"), {})
+
+    # --------------------------------------------------------------- actors
+    async def _create_actor(self, conn: Connection, p: Dict) -> Dict:
+        spec = p["spec"]
+        actor_id = p["actor_id"]
+        name = p.get("name", "")
+        namespace = p.get("namespace", "default")
+        if name:
+            existing_id = self.named_actors.get((namespace, name))
+            if existing_id:
+                existing = self.actors.get(existing_id)
+                if existing and existing.state != ACTOR_DEAD:
+                    if p.get("get_if_exists"):
+                        return {"existing": existing.public_view()}
+                    raise ValueError(f"actor name '{name}' already taken")
+        info = ActorInfo(actor_id, spec, name, namespace,
+                         p.get("max_restarts", 0), conn)
+        self.actors[actor_id] = info
+        if name:
+            self.named_actors[(namespace, name)] = actor_id
+        ok = await self._schedule_actor(info)
+        if not ok:
+            # No feasible node right now; keep PENDING and retry when nodes join
+            asyncio.get_running_loop().create_task(self._retry_schedule(info))
+        return {"actor_id": actor_id, "state": info.state}
+
+    async def _schedule_actor(self, info: ActorInfo) -> bool:
+        """Pick the least-utilized feasible node (GcsActorScheduler analog)."""
+        request = ResourceSet.from_wire(info.spec_wire.get("resources", {}))
+        strategy = info.spec_wire.get("scheduling_strategy")
+        candidates = []
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            if strategy and strategy.get("type") == "node_affinity":
+                if node.node_id != strategy.get("node_id"):
+                    continue
+            if request.feasible_on(node.resources.total):
+                candidates.append(node)
+        if not candidates:
+            return False
+        fits = [n for n in candidates if request.fits(n.resources.available)]
+        pool = fits or candidates
+        pool.sort(key=lambda n: n.resources.utilization())
+        node = pool[0]
+        info.node_id = node.node_id
+        try:
+            await node.conn.push("StartActor", {"spec": info.spec_wire,
+                                                "actor_id": info.actor_id})
+        except Exception:
+            return False
+        return True
+
+    async def _retry_schedule(self, info: ActorInfo) -> None:
+        deadline = time.monotonic() + CONFIG.actor_creation_timeout_ms / 1000
+        while time.monotonic() < deadline:
+            await asyncio.sleep(1.0)
+            if info.state != ACTOR_PENDING and info.state != ACTOR_RESTARTING:
+                return
+            if await self._schedule_actor(info):
+                return
+        if info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+            await self._handle_actor_death(info, "no feasible node for actor resources")
+
+    async def _actor_ready(self, conn: Connection, p: Dict) -> None:
+        info = self.actors.get(p["actor_id"])
+        if not info:
+            return
+        info.state = ACTOR_ALIVE
+        info.addr = p["addr"]
+        info.pid = p.get("pid", 0)
+        info.node_id = conn.meta.get("node_id", info.node_id)
+        await self._publish_event("actor", info.public_view())
+
+    async def _actor_died(self, conn: Connection, p: Dict) -> None:
+        info = self.actors.get(p["actor_id"])
+        if not info or info.state == ACTOR_DEAD:
+            return
+        await self._handle_actor_failure(info, p.get("reason", "worker died"))
+
+    async def _handle_actor_failure(self, info: ActorInfo, reason: str) -> None:
+        if info.num_restarts < info.max_restarts or info.max_restarts == -1:
+            info.num_restarts += 1
+            info.state = ACTOR_RESTARTING
+            info.addr = None
+            await self._publish_event("actor", info.public_view())
+            if not await self._schedule_actor(info):
+                asyncio.get_running_loop().create_task(self._retry_schedule(info))
+        else:
+            await self._handle_actor_death(info, reason)
+
+    async def _handle_actor_death(self, info: ActorInfo, reason: str) -> None:
+        info.state = ACTOR_DEAD
+        info.death_cause = reason
+        info.addr = None
+        if (info.namespace, info.name) in self.named_actors:
+            if self.named_actors[(info.namespace, info.name)] == info.actor_id:
+                del self.named_actors[(info.namespace, info.name)]
+        await self._publish_event("actor", info.public_view())
+
+    async def _get_actor(self, conn, p) -> Optional[Dict]:
+        info = self.actors.get(p["actor_id"])
+        return info.public_view() if info else None
+
+    async def _get_named_actor(self, conn, p) -> Optional[Dict]:
+        actor_id = self.named_actors.get((p.get("namespace", "default"), p["name"]))
+        if actor_id is None:
+            return None
+        return self.actors[actor_id].public_view()
+
+    async def _list_actors(self, conn, p) -> List[Dict]:
+        return [a.public_view() for a in self.actors.values()]
+
+    async def _kill_actor(self, conn, p) -> Dict:
+        info = self.actors.get(p["actor_id"])
+        if not info:
+            return {"ok": False}
+        if p.get("no_restart", True):
+            info.max_restarts = info.num_restarts  # suppress further restarts
+        await self._kill_actor_internal(info, "ray_tpu.kill")
+        return {"ok": True}
+
+    async def _kill_actor_internal(self, info: ActorInfo, reason: str) -> None:
+        node = self.nodes.get(info.node_id) if info.node_id else None
+        if node and node.alive:
+            await node.conn.push("KillActorWorker", {"actor_id": info.actor_id})
+        await self._handle_actor_death(info, reason)
+
+    # --------------------------------------------------------------- pubsub
+    async def _subscribe(self, conn: Connection, p) -> bool:
+        for channel in p["channels"]:
+            self.subscribers.setdefault(channel, set()).add(conn)
+        return True
+
+    async def _publish(self, conn: Connection, p) -> int:
+        return await self._publish_event(p["channel"], p["message"])
+
+    async def _publish_event(self, channel: str, message: Any) -> int:
+        subs = self.subscribers.get(channel, set())
+        n = 0
+        for conn in list(subs):
+            if conn.closed:
+                subs.discard(conn)
+                continue
+            await conn.push("Pub", {"channel": channel, "message": message})
+            n += 1
+        return n
+
+    # ------------------------------------------------------ placement groups
+    async def _create_placement_group(self, conn: Connection, p: Dict) -> Dict:
+        """Reserve bundles across nodes with the requested strategy.
+
+        2-phase (prepare on agents, rollback on failure) like the reference's
+        PG protocol (reference: node_manager.proto:385-392 Prepare/Commit).
+        """
+        pg_id = p["pg_id"]
+        bundles = [ResourceSet.from_wire(b) for b in p["bundles"]]
+        strategy = p.get("strategy", "PACK")
+        placement = self._place_bundles(bundles, strategy)
+        if placement is None:
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "state": "PENDING", "bundles": p["bundles"],
+                "strategy": strategy, "placement": None, "name": p.get("name", ""),
+            }
+            return {"state": "PENDING"}
+        prepared = []
+        ok = True
+        for idx, (bundle, node_id) in enumerate(zip(bundles, placement)):
+            node = self.nodes[node_id]
+            try:
+                resp = await asyncio.wait_for(
+                    self._agent_call(node, "PreparePGBundle",
+                                     {"pg_id": pg_id, "bundle_index": idx,
+                                      "resources": bundle.to_wire()}),
+                    timeout=10,
+                )
+                if resp and resp.get("ok"):
+                    prepared.append((node, idx, bundle))
+                else:
+                    ok = False
+                    break
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for node, idx, bundle in prepared:
+                await node.conn.push("ReturnPGBundle",
+                                     {"pg_id": pg_id, "bundle_index": idx})
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "state": "PENDING", "bundles": p["bundles"],
+                "strategy": strategy, "placement": None, "name": p.get("name", ""),
+            }
+            return {"state": "PENDING"}
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "state": "CREATED", "bundles": p["bundles"],
+            "strategy": strategy, "placement": placement, "name": p.get("name", ""),
+        }
+        return {"state": "CREATED", "placement": placement}
+
+    def _place_bundles(self, bundles: List[ResourceSet], strategy: str
+                       ) -> Optional[List[str]]:
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        placement: List[str] = []
+        # Work on copies of availability so multi-bundle accounting is correct.
+        avail = {n.node_id: n.resources.available.copy() for n in alive}
+        if strategy in ("STRICT_PACK",):
+            for n in alive:
+                trial = avail[n.node_id].copy()
+                if all(trial.subtract(b) for b in bundles):
+                    return [n.node_id] * len(bundles)
+            return None
+        if strategy in ("STRICT_SPREAD",):
+            used = set()
+            for b in bundles:
+                cand = [n for n in alive
+                        if n.node_id not in used and b.fits(avail[n.node_id])]
+                if not cand:
+                    return None
+                cand.sort(key=lambda n: n.resources.utilization())
+                placement.append(cand[0].node_id)
+                used.add(cand[0].node_id)
+                avail[cand[0].node_id].subtract(b)
+            return placement
+        # PACK / SPREAD: best-effort
+        prefer_pack = strategy == "PACK"
+        for b in bundles:
+            cand = [n for n in alive if b.fits(avail[n.node_id])]
+            if not cand:
+                return None
+            if prefer_pack and placement:
+                same = [n for n in cand if n.node_id == placement[-1]]
+                if same:
+                    cand = same
+            elif not prefer_pack:
+                cand.sort(key=lambda n: placement.count(n.node_id))
+            placement.append(cand[0].node_id)
+            avail[cand[0].node_id].subtract(b)
+        return placement
+
+    async def _agent_call(self, node: NodeInfo, method: str, payload: Dict):
+        """Request/response to an agent over its persistent connection."""
+        fut = asyncio.get_running_loop().create_future()
+        key = f"__agent_reply__{id(fut)}"
+        self.kv.setdefault("__internal__", {})
+
+        # Use an ephemeral reply channel over pubsub semantics: the agent
+        # replies by calling "Publish" on channel `key`.
+        def cleanup(_):
+            self.subscribers.pop(key, None)
+
+        class _FutConn:
+            closed = False
+
+            async def push(self_inner, method_inner, p_inner):
+                if not fut.done():
+                    fut.set_result(p_inner["message"])
+
+        self.subscribers[key] = {_FutConn()}
+        fut.add_done_callback(cleanup)
+        await node.conn.push(method, {**payload, "reply_channel": key})
+        return await fut
+
+    async def _remove_placement_group(self, conn, p) -> Dict:
+        pg = self.placement_groups.get(p["pg_id"])
+        if not pg:
+            return {"ok": False}
+        if pg.get("placement"):
+            for idx, node_id in enumerate(pg["placement"]):
+                node = self.nodes.get(node_id)
+                if node and node.alive:
+                    await node.conn.push("ReturnPGBundle",
+                                         {"pg_id": p["pg_id"], "bundle_index": idx})
+        pg["state"] = "REMOVED"
+        return {"ok": True}
+
+    async def _get_placement_group(self, conn, p) -> Optional[Dict]:
+        return self.placement_groups.get(p["pg_id"])
+
+    async def _list_placement_groups(self, conn, p) -> List[Dict]:
+        return list(self.placement_groups.values())
+
+    # ----------------------------------------------------------- task events
+    async def _report_task_events(self, conn, p) -> None:
+        self.task_events.extend(p["events"])
+        cap = CONFIG.task_event_buffer_max
+        if len(self.task_events) > cap:
+            self.task_events = self.task_events[-cap:]
+
+    async def _list_task_events(self, conn, p) -> List[Dict]:
+        limit = p.get("limit", 1000)
+        events = self.task_events
+        if p.get("job_id"):
+            events = [e for e in events if e.get("job_id") == p["job_id"]]
+        return events[-limit:]
+
+    # ----------------------------------------------------------------- jobs
+    async def _register_job(self, conn, p) -> None:
+        self.jobs[p["job_id"]] = p
+
+    async def _list_jobs(self, conn, p) -> List[Dict]:
+        return list(self.jobs.values())
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    async def run():
+        head = HeadServer(args.session_dir, args.port)
+        port = await head.start()
+        # Parent discovers the bound port through this file.
+        with open(os.path.join(args.session_dir, "head_port"), "w") as f:
+            f.write(str(port))
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""In-process memory store for small objects.
+
+Parity with the reference's core-worker memory store (reference:
+``src/ray/core_worker/store_provider/memory_store/memory_store.h``): small
+task returns and errors skip shared memory entirely and resolve ``get``/
+``wait`` directly in the owner process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _Entry:
+    __slots__ = ("data", "is_exception")
+
+    def __init__(self, data: bytes, is_exception: bool):
+        self.data = data
+        self.is_exception = is_exception
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[bytes, _Entry] = {}
+        self._cv = threading.Condition(self._lock)
+
+    def put(self, object_id: bytes, data: bytes, is_exception: bool = False) -> None:
+        with self._cv:
+            self._objects[object_id] = _Entry(data, is_exception)
+            self._cv.notify_all()
+
+    def contains(self, object_id: bytes) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get(self, object_id: bytes) -> Optional[Tuple[bytes, bool]]:
+        with self._lock:
+            e = self._objects.get(object_id)
+            return (e.data, e.is_exception) if e else None
+
+    def delete(self, object_id: bytes) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def wait(
+        self, object_ids: List[bytes], num_returns: int, timeout: Optional[float]
+    ) -> Tuple[List[bytes], List[bytes]]:
+        """Block until num_returns of object_ids are present (or timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [oid for oid in object_ids if oid in self._objects]
+                if len(ready) >= num_returns:
+                    ready = ready[:num_returns]
+                    remaining = [oid for oid in object_ids if oid not in set(ready)]
+                    return ready, remaining
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        remaining = [oid for oid in object_ids if oid not in set(ready)]
+                        return ready, remaining
+                    self._cv.wait(left)
+                else:
+                    self._cv.wait()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

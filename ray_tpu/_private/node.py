@@ -1,0 +1,195 @@
+"""Node bootstrap: start/stop the head and agent processes.
+
+Parity with the reference's node services (reference:
+``python/ray/_private/node.py`` + ``services.py``): ``ray_tpu.init()`` on a
+head node spawns the head control-plane process and a node agent, creates the
+session directory tree (sockets/, logs/, store/), and connects the driver;
+worker nodes spawn only an agent pointed at an existing head.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional
+
+from ray_tpu._private.ids import NodeID
+
+
+def _detect_resources() -> Dict[str, float]:
+    import psutil
+
+    resources: Dict[str, float] = {
+        "CPU": float(os.cpu_count() or 1),
+        "memory": float(psutil.virtual_memory().total),
+    }
+    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+    num_tpu = TPUAcceleratorManager.get_current_node_num_accelerators()
+    if num_tpu:
+        resources["TPU"] = float(num_tpu)
+        for name, qty in TPUAcceleratorManager.get_current_node_additional_resources().items():
+            resources[name] = qty
+    return resources
+
+
+def default_session_root() -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, "ray_tpu")
+
+
+class Node:
+    """Manages the subprocesses backing one node of the cluster."""
+
+    def __init__(
+        self,
+        head: bool = True,
+        head_host: str = "127.0.0.1",
+        head_port: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: Optional[int] = None,
+        session_dir: Optional[str] = None,
+        node_name: str = "",
+    ):
+        self.is_head = head
+        self.node_id = NodeID.from_random().hex()
+        self.head_host = head_host
+        self.head_port = head_port
+        if session_dir is None:
+            session_name = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
+            session_dir = os.path.join(default_session_root(), session_name)
+        self.session_dir = session_dir
+        os.makedirs(os.path.join(session_dir, "sockets"), exist_ok=True)
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        self.store_dir = os.path.join(session_dir, "store", self.node_id[:12])
+        os.makedirs(self.store_dir, exist_ok=True)
+        merged = _detect_resources()
+        if resources:
+            merged.update(resources)
+        self.resources = merged
+        self.labels = dict(labels or {})
+        if node_name:
+            self.labels["node_name"] = node_name
+        self.object_store_memory = object_store_memory
+        self.head_proc: Optional[subprocess.Popen] = None
+        self.agent_proc: Optional[subprocess.Popen] = None
+        self.agent_unix_path = ""
+        self.agent_tcp_port = 0
+
+    # ------------------------------------------------------------------ up
+    def start(self) -> None:
+        if self.is_head:
+            self._start_head()
+        self._start_agent()
+
+    def _start_head(self) -> None:
+        log = open(os.path.join(self.session_dir, "logs", "head.log"), "ab")
+        self.head_proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.gcs",
+                "--session-dir", self.session_dir,
+                "--port", str(self.head_port),
+            ],
+            stdout=log,
+            stderr=log,
+            start_new_session=True,
+        )
+        log.close()
+        port_file = os.path.join(self.session_dir, "head_port")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(port_file):
+                with open(port_file) as f:
+                    content = f.read().strip()
+                if content:
+                    self.head_port = int(content)
+                    return
+            if self.head_proc.poll() is not None:
+                raise RuntimeError(
+                    "head process exited during startup; see "
+                    f"{self.session_dir}/logs/head.log"
+                )
+            time.sleep(0.02)
+        raise TimeoutError("head process did not report its port")
+
+    def _start_agent(self) -> None:
+        ready_file = os.path.join(
+            self.session_dir, f"agent-ready-{self.node_id[:12]}.json"
+        )
+        log = open(
+            os.path.join(self.session_dir, "logs", f"agent-{self.node_id[:12]}.log"),
+            "ab",
+        )
+        self.agent_proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.agent",
+                "--node-id", self.node_id,
+                "--session-dir", self.session_dir,
+                "--store-dir", self.store_dir,
+                "--head-host", self.head_host,
+                "--head-port", str(self.head_port),
+                "--resources", json.dumps(self.resources),
+                "--labels", json.dumps(self.labels),
+                "--object-store-memory", str(self.object_store_memory or 0),
+                "--ready-file", ready_file,
+            ],
+            stdout=log,
+            stderr=log,
+            start_new_session=True,
+        )
+        log.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(ready_file):
+                try:
+                    with open(ready_file) as f:
+                        info = json.load(f)
+                    self.agent_unix_path = info["unix_path"]
+                    self.agent_tcp_port = info["tcp_port"]
+                    return
+                except (json.JSONDecodeError, KeyError):
+                    pass
+            if self.agent_proc.poll() is not None:
+                raise RuntimeError(
+                    "agent process exited during startup; see "
+                    f"{self.session_dir}/logs/agent-{self.node_id[:12]}.log"
+                )
+            time.sleep(0.02)
+        raise TimeoutError("agent did not become ready")
+
+    # ---------------------------------------------------------------- down
+    def stop(self, cleanup_session: bool = False) -> None:
+        for proc in (self.agent_proc, self.head_proc):
+            if proc is not None and proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    try:
+                        proc.terminate()
+                    except Exception:
+                        pass
+        deadline = time.monotonic() + 3
+        for proc in (self.agent_proc, self.head_proc):
+            if proc is None:
+                continue
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+        if cleanup_session:
+            shutil.rmtree(self.session_dir, ignore_errors=True)

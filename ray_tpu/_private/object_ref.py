@@ -1,0 +1,116 @@
+"""ObjectRef — a first-class future naming an immutable object.
+
+Parity with the reference's ObjectRef (reference: ``python/ray/_raylet.pyx``
+ObjectRef + ``src/ray/core_worker/reference_count.h``): the ref carries its
+owner's address so any holder can resolve value/locations without a central
+directory; serializing a ref into a task argument registers a borrow with the
+owner; ``__del__`` decrements the owner's local count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_addr", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_addr: Optional[Dict] = None,
+                 _register: bool = True):
+        self._id = object_id
+        self._owner_addr = owner_addr or {}
+        self._registered = False
+        if _register:
+            w = _get_worker()
+            if w is not None:
+                w.reference_counter.add_local_ref(self)
+                self._registered = True
+
+    # -- identity ------------------------------------------------------------
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def owner_addr(self) -> Dict:
+        return self._owner_addr
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    # -- lifecycle -----------------------------------------------------------
+    def __del__(self):
+        try:
+            if self._registered:
+                w = _get_worker()
+                if w is not None:
+                    w.reference_counter.remove_local_ref(self)
+        except BaseException:
+            pass  # interpreter teardown
+
+    def __reduce__(self):
+        w = _get_worker()
+        if w is not None:
+            w.reference_counter.on_ref_serialized(self)
+        return (_rebuild_ref, (self._id.binary(), self._owner_addr))
+
+    # -- sugar ---------------------------------------------------------------
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        w = _get_worker()
+
+        def poll():
+            try:
+                fut.set_result(w.get([self], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+
+        threading.Thread(target=poll, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        """Await support inside async actors."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        w = _get_worker()
+
+        def blocking():
+            return w.get([self], timeout=None)[0]
+
+        return loop.run_in_executor(None, blocking).__await__()
+
+
+def _rebuild_ref(binary: bytes, owner_addr: Dict) -> "ObjectRef":
+    ref = ObjectRef(ObjectID(binary), owner_addr, _register=False)
+    w = _get_worker()
+    if w is not None:
+        w.reference_counter.on_ref_deserialized(ref)
+        ref._registered = True
+    return ref
+
+
+def _get_worker():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker

@@ -1,0 +1,393 @@
+"""Control-plane RPC fabric: length-prefixed msgpack over unix/TCP sockets.
+
+This is the role gRPC plays in the reference (reference: ``src/ray/rpc/``
+GrpcServer/GrpcClient and the 22 .proto contracts) — here the wire format is
+msgpack frames and the server side is a single asyncio event loop per process,
+matching the reference's single-threaded asio io_context discipline
+(reference: ``src/ray/common/asio/instrumented_io_context.h``).
+
+Frame:    <u32 little-endian length><msgpack payload>
+Request:  {"m": method, "i": req_id, "p": payload}
+Reply:    {"r": req_id, "p": payload}  or  {"r": req_id, "e": [type, msg]}
+Push:     {"m": method, "i": 0, "p": payload}     (one-way, no reply)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+_HDR = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+def pack(msg: Any) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _HDR.pack(len(body)) + body
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Async server (runs inside agents / head)
+# ---------------------------------------------------------------------------
+
+
+class Connection:
+    """One accepted connection on the server side."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.meta: Dict[str, Any] = {}  # handshake info (worker id, role, ...)
+        self.closed = False
+        self._send_lock = asyncio.Lock()
+
+    async def send(self, msg: Any) -> None:
+        if self.closed:
+            return
+        async with self._send_lock:
+            try:
+                self.writer.write(pack(msg))
+                await self.writer.drain()
+            except (ConnectionError, RuntimeError):
+                self.closed = True
+
+    async def push(self, method: str, payload: Any) -> None:
+        await self.send({"m": method, "i": 0, "p": payload})
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+Handler = Callable[[Connection, Any], Awaitable[Any]]
+
+
+class RpcServer:
+    """Asyncio msgpack-RPC server. Handlers are async callables; returning a
+    value sends a reply, raising sends an error reply."""
+
+    def __init__(self, name: str = "server"):
+        self.name = name
+        self._handlers: Dict[str, Handler] = {}
+        self._on_disconnect: Optional[Callable[[Connection], Awaitable[None]]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set = set()
+
+    def route(self, method: str):
+        def deco(fn: Handler):
+            self._handlers[method] = fn
+            return fn
+
+        return deco
+
+    def add_handler(self, method: str, fn: Handler) -> None:
+        self._handlers[method] = fn
+
+    def set_disconnect_handler(self, fn) -> None:
+        self._on_disconnect = fn
+
+    async def start_unix(self, path: str) -> None:
+        self._server = await asyncio.start_unix_server(self._accept, path=path)
+
+    async def start_tcp(self, host: str, port: int) -> int:
+        self._server = await asyncio.start_server(self._accept, host=host, port=port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+        for conn in list(self.connections):
+            conn.close()
+
+    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = Connection(reader, writer)
+        self.connections.add(conn)
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (length,) = _HDR.unpack(hdr)
+                body = await reader.readexactly(length)
+                msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
+                asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            conn.closed = True
+            self.connections.discard(conn)
+            if self._on_disconnect:
+                try:
+                    await self._on_disconnect(conn)
+                except Exception:
+                    pass
+            conn.close()
+
+    async def _dispatch(self, conn: Connection, msg: Dict) -> None:
+        method, req_id, payload = msg.get("m"), msg.get("i", 0), msg.get("p")
+        handler = self._handlers.get(method)
+        if handler is None:
+            if req_id:
+                await conn.send({"r": req_id, "e": ["NoSuchMethod", str(method)]})
+            return
+        try:
+            result = await handler(conn, payload)
+            if req_id:
+                await conn.send({"r": req_id, "p": result})
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            if req_id:
+                await conn.send({"r": req_id, "e": [type(e).__name__, str(e)]})
+
+
+# ---------------------------------------------------------------------------
+# Async client (agent ↔ agent / agent ↔ head)
+# ---------------------------------------------------------------------------
+
+
+class AsyncRpcClient:
+    def __init__(self):
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._push_handler: Optional[Callable[[str, Any], Awaitable[None]]] = None
+        self._read_task: Optional[asyncio.Task] = None
+        self._send_lock = asyncio.Lock()
+        self.connected = False
+
+    async def connect_tcp(self, host: str, port: int) -> None:
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+        self._start()
+
+    async def connect_unix(self, path: str) -> None:
+        self._reader, self._writer = await asyncio.open_unix_connection(path)
+        self._start()
+
+    def _start(self):
+        self.connected = True
+        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    def set_push_handler(self, fn) -> None:
+        self._push_handler = fn
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self._reader.readexactly(4)
+                (length,) = _HDR.unpack(hdr)
+                body = await self._reader.readexactly(length)
+                msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
+                if "r" in msg:
+                    fut = self._pending.pop(msg["r"], None)
+                    if fut and not fut.done():
+                        if "e" in msg:
+                            fut.set_exception(RpcError(f"{msg['e'][0]}: {msg['e'][1]}"))
+                        else:
+                            fut.set_result(msg.get("p"))
+                elif self._push_handler:
+                    asyncio.get_running_loop().create_task(
+                        self._push_handler(msg.get("m"), msg.get("p"))
+                    )
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            self.connected = False
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost("connection lost"))
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any, timeout: Optional[float] = None) -> Any:
+        self._next_id += 1
+        req_id = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            async with self._send_lock:
+                self._writer.write(pack({"m": method, "i": req_id, "p": payload}))
+                await self._writer.drain()
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(req_id, None)
+
+    async def push(self, method: str, payload: Any) -> None:
+        async with self._send_lock:
+            self._writer.write(pack({"m": method, "i": 0, "p": payload}))
+            await self._writer.drain()
+
+    def close(self) -> None:
+        self.connected = False
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Sync client (driver / worker main threads)
+# ---------------------------------------------------------------------------
+
+
+class SyncRpcClient:
+    """Blocking RPC client with a background reader thread so server pushes
+    (pubsub, object-ready notifications) are delivered while the main thread
+    blocks in a call."""
+
+    def __init__(self, push_handler: Optional[Callable[[str, Any], None]] = None):
+        self._sock: Optional[socket.socket] = None
+        self._pending: Dict[int, "_SyncFuture"] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._push_handler = push_handler
+        self._reader_thread: Optional[threading.Thread] = None
+        self.connected = False
+
+    def connect_unix(self, path: str, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(path)
+                break
+            except (ConnectionRefusedError, FileNotFoundError):
+                s.close()
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"could not connect to {path}")
+                time.sleep(0.05)
+        self._finish_connect(s)
+
+    def connect_tcp(self, host: str, port: int, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"could not connect to {host}:{port}")
+                time.sleep(0.05)
+        s.settimeout(None)
+        self._finish_connect(s)
+
+    def _finish_connect(self, s: socket.socket) -> None:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) if s.family != socket.AF_UNIX else None
+        self._sock = s
+        self.connected = True
+        self._reader_thread = threading.Thread(
+            target=self._read_loop, daemon=True, name="rpc-reader"
+        )
+        self._reader_thread.start()
+
+    def _read_loop(self):
+        try:
+            buf = b""
+            while True:
+                need = 4
+                while len(buf) < need:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        raise ConnectionLost("eof")
+                    buf += chunk
+                (length,) = _HDR.unpack(buf[:4])
+                need = 4 + length
+                while len(buf) < need:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        raise ConnectionLost("eof")
+                    buf += chunk
+                msg = msgpack.unpackb(buf[4:need], raw=False, strict_map_key=False)
+                buf = buf[need:]
+                if "r" in msg:
+                    with self._lock:
+                        fut = self._pending.pop(msg["r"], None)
+                    if fut:
+                        if "e" in msg:
+                            fut.set_error(RpcError(f"{msg['e'][0]}: {msg['e'][1]}"))
+                        else:
+                            fut.set_result(msg.get("p"))
+                elif self._push_handler:
+                    try:
+                        self._push_handler(msg.get("m"), msg.get("p"))
+                    except Exception:
+                        pass
+        except (ConnectionLost, OSError):
+            self.connected = False
+            with self._lock:
+                for fut in self._pending.values():
+                    fut.set_error(ConnectionLost("connection lost"))
+                self._pending.clear()
+
+    def call(self, method: str, payload: Any, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            self._next_id += 1
+            req_id = self._next_id
+            fut = _SyncFuture()
+            self._pending[req_id] = fut
+        try:
+            data = pack({"m": method, "i": req_id, "p": payload})
+            with self._send_lock:
+                self._sock.sendall(data)
+            return fut.result(timeout)
+        finally:
+            with self._lock:
+                self._pending.pop(req_id, None)
+
+    def push(self, method: str, payload: Any) -> None:
+        data = pack({"m": method, "i": 0, "p": payload})
+        with self._send_lock:
+            self._sock.sendall(data)
+
+    def close(self) -> None:
+        self.connected = False
+        if self._sock:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class _SyncFuture:
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def set_error(self, err):
+        self._error = err
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc call timed out")
+        if self._error:
+            raise self._error
+        return self._result

@@ -1,0 +1,104 @@
+"""Task specifications — the unit handed from submitter to executor.
+
+Parity with the reference's ``TaskSpecification`` (reference:
+``src/ray/common/task/task_spec.h``): function identity, serialized args with
+by-value / by-reference entries, return count, resource request, retry policy,
+actor linkage and scheduling strategy — all in one msgpack-able record that
+crosses the wire as-is.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+NORMAL_TASK = 0
+ACTOR_CREATION_TASK = 1
+ACTOR_TASK = 2
+
+# Argument entries on the wire:
+#   ("v", bytes)                       — serialized value (may embed nested refs)
+#   ("r", object_id_bytes, owner_addr) — pass-by-reference, fetch before run
+
+
+class TaskSpec:
+    __slots__ = (
+        "task_id", "job_id", "task_type", "function_id", "function_blob",
+        "function_name", "args", "kwargs", "num_returns", "resources",
+        "max_retries", "retry_exceptions", "owner_addr", "actor_id",
+        "actor_method", "seq", "scheduling_strategy", "placement_group_id",
+        "placement_group_bundle_index", "max_concurrency", "namespace",
+        "actor_name", "max_restarts", "runtime_env", "label_selector",
+    )
+
+    def __init__(
+        self,
+        task_id: bytes,
+        job_id: bytes,
+        task_type: int,
+        function_id: bytes,
+        function_name: str,
+        args: List[Tuple],
+        kwargs: Dict[str, Tuple],
+        num_returns: int,
+        resources: Dict[str, int],
+        owner_addr: Dict[str, Any],
+        function_blob: Optional[bytes] = None,
+        max_retries: int = 0,
+        retry_exceptions: bool = False,
+        actor_id: Optional[bytes] = None,
+        actor_method: str = "",
+        seq: int = 0,
+        scheduling_strategy: Optional[Any] = None,
+        placement_group_id: Optional[bytes] = None,
+        placement_group_bundle_index: int = -1,
+        max_concurrency: int = 1,
+        namespace: str = "",
+        actor_name: str = "",
+        max_restarts: int = 0,
+        runtime_env: Optional[Dict] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ):
+        self.task_id = task_id
+        self.job_id = job_id
+        self.task_type = task_type
+        self.function_id = function_id
+        self.function_blob = function_blob
+        self.function_name = function_name
+        self.args = args
+        self.kwargs = kwargs
+        self.num_returns = num_returns
+        self.resources = resources
+        self.max_retries = max_retries
+        self.retry_exceptions = retry_exceptions
+        self.owner_addr = owner_addr
+        self.actor_id = actor_id
+        self.actor_method = actor_method
+        self.seq = seq
+        self.scheduling_strategy = scheduling_strategy
+        self.placement_group_id = placement_group_id
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.max_concurrency = max_concurrency
+        self.namespace = namespace
+        self.actor_name = actor_name
+        self.max_restarts = max_restarts
+        self.runtime_env = runtime_env
+        self.label_selector = label_selector
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    @classmethod
+    def from_wire(cls, wire: Dict[str, Any]) -> "TaskSpec":
+        return cls(**wire)
+
+    def scheduling_key(self) -> Tuple:
+        """Tasks with the same key can reuse the same leased worker
+        (reference: direct_task_transport.h SchedulingKey)."""
+        return (
+            tuple(sorted(self.resources.items())),
+            self.placement_group_id,
+            repr(self.scheduling_strategy),
+            tuple(sorted((self.runtime_env or {}).items(), key=lambda kv: kv[0]))
+            if self.runtime_env
+            else None,
+        )

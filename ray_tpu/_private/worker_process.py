@@ -1,0 +1,284 @@
+"""Worker process entrypoint + task executor.
+
+Parity with the reference's worker-side execution path (reference:
+``python/ray/_raylet.pyx:1647`` execute_task +
+``src/ray/core_worker/transport/`` scheduling queues): the worker registers
+with its node agent, listens for direct PushTask RPCs from owners, executes
+normal tasks serially, orders actor tasks per-caller by sequence number
+(ActorSchedulingQueue analog), runs async actor methods on the event loop with
+a concurrency cap, and writes large returns straight to the node's shm store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import sys
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.function_table import load_function
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef, _rebuild_ref
+from ray_tpu._private.task_spec import ACTOR_TASK, NORMAL_TASK, TaskSpec
+from ray_tpu._private.worker import EXC, VAL, Worker
+from ray_tpu.exceptions import RayTaskError
+
+
+class Executor:
+    def __init__(self, worker: Worker):
+        self.worker = worker
+        self._task_pool = ThreadPoolExecutor(max_workers=1,
+                                             thread_name_prefix="task-exec")
+        self._actor_pool: Optional[ThreadPoolExecutor] = None
+        self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._actor_cls = None
+        self._actor_id: Optional[ActorID] = None
+        self._max_concurrency = 1
+        # Per-caller-connection execution chains. TCP delivers one caller's
+        # pushes in submission order; chaining on the connection preserves
+        # that order through execution and is naturally restart-safe (a
+        # reconnecting caller starts a fresh chain) — the role the seq-based
+        # ActorSchedulingQueue plays in the reference.
+        self._chain_tail: Dict[int, asyncio.Future] = {}
+
+    # ------------------------------------------------------------- dispatch
+    async def handle_push_task(self, conn, wire: Dict) -> Dict:
+        if not self.worker.ready_event.is_set():
+            await self.worker.ready_event.wait()
+        spec = TaskSpec.from_wire({k: wire[k] for k in TaskSpec.__slots__ if k in wire})
+        assigned = wire.get("assigned_instances") or {}
+        if spec.task_type == ACTOR_TASK and self._max_concurrency == 1:
+            return await self._ordered_actor_task(conn, spec)
+        return await self._execute_async(spec, assigned)
+
+    async def _ordered_actor_task(self, conn, spec: TaskSpec) -> Dict:
+        key = id(conn)
+        prev = self._chain_tail.get(key)
+        done = asyncio.get_running_loop().create_future()
+        self._chain_tail[key] = done
+        if prev is not None:
+            await prev
+        try:
+            return await self._execute_async(spec, {})
+        finally:
+            done.set_result(None)
+            if self._chain_tail.get(key) is done:
+                del self._chain_tail[key]
+
+    async def _execute_async(self, spec: TaskSpec, assigned: Dict) -> Dict:
+        method = None
+        is_async = False
+        if spec.task_type == ACTOR_TASK:
+            method = getattr(self.worker.actor_instance, spec.actor_method, None)
+            is_async = method is not None and inspect.iscoroutinefunction(method)
+        if is_async:
+            if self._actor_sem is None:
+                self._actor_sem = asyncio.Semaphore(self._max_concurrency)
+            async with self._actor_sem:
+                return await self._run_async_method(spec, method)
+        pool = self._actor_pool if spec.task_type == ACTOR_TASK and self._actor_pool \
+            else self._task_pool
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(pool, self._execute_sync, spec, assigned)
+
+    # ------------------------------------------------------------ execution
+    def _resolve_args(self, spec: TaskSpec):
+        args = [self._materialize(entry) for entry in spec.args]
+        kwargs = {k: self._materialize(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _materialize(self, entry) -> Any:
+        kind = entry[0]
+        if kind in ("v", "iv"):
+            return self.worker.serialization_context.deserialize(memoryview(entry[1]))
+        if kind == "r":
+            ref = _rebuild_ref(bytes(entry[1]), entry[2])
+            return self.worker._get_one(ref, timeout=None)
+        raise ValueError(f"bad arg entry kind {kind}")
+
+    def _execute_sync(self, spec: TaskSpec, assigned: Dict) -> Dict:
+        _apply_accelerator_env(assigned)
+        ctx = self.worker.current_task_info
+        ctx.task_id = TaskID(spec.task_id)
+        ctx.task_name = spec.function_name
+        start = time.time()
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if spec.task_type == ACTOR_TASK:
+                fn = getattr(self.worker.actor_instance, spec.actor_method)
+                result = fn(*args, **kwargs)
+            else:
+                fn = load_function(spec.function_id, spec.function_blob, self.worker)
+                result = fn(*args, **kwargs)
+            return self._package_returns(spec, result)
+        except SystemExit:
+            raise
+        except BaseException as e:  # noqa: BLE001 — user errors cross the wire
+            err = RayTaskError.from_exception(e, spec.function_name)
+            data = self.worker._serialize_value(err).to_bytes()
+            return {
+                "error": True,
+                "returns": [
+                    {"inline": data, "is_exception": True}
+                    for _ in range(spec.num_returns)
+                ],
+            }
+        finally:
+            ctx.task_id = None
+            ctx.task_name = None
+
+    async def _run_async_method(self, spec: TaskSpec, method) -> Dict:
+        loop = asyncio.get_running_loop()
+        try:
+            args, kwargs = await loop.run_in_executor(
+                None, lambda: self._resolve_args(spec)
+            )
+            result = await method(*args, **kwargs)
+            return await loop.run_in_executor(
+                None, lambda: self._package_returns(spec, result)
+            )
+        except BaseException as e:  # noqa: BLE001
+            err = RayTaskError.from_exception(e, spec.function_name)
+            data = self.worker._serialize_value(err).to_bytes()
+            return {
+                "error": True,
+                "returns": [
+                    {"inline": data, "is_exception": True}
+                    for _ in range(spec.num_returns)
+                ],
+            }
+
+    def _package_returns(self, spec: TaskSpec, result: Any) -> Dict:
+        if spec.num_returns == 0:
+            return {"returns": []}
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task declared num_returns={spec.num_returns} but returned "
+                    f"{len(values)} values"
+                )
+        returns = []
+        for i, value in enumerate(values):
+            sobj = self.worker._serialize_value(value)
+            size = sobj.total_size()
+            if size <= CONFIG.inline_object_max_size_bytes:
+                returns.append({"inline": sobj.to_bytes(), "is_exception": False})
+            else:
+                oid = ObjectID(spec.task_id + _u32(i))
+                view, handle = self.worker.store.create(oid, size)
+                used = sobj.write_into(view)
+                self.worker.store.seal(oid, handle)
+                self.worker._acall(
+                    self.worker.agent.call(
+                        "ObjectSealed", {"object_id": oid.hex(), "size": used}
+                    )
+                )
+                returns.append(
+                    {"plasma": True, "size": used,
+                     "node_addr": self.worker.agent_tcp_addr}
+                )
+        return {"returns": returns}
+
+    # --------------------------------------------------------------- actors
+    async def become_actor(self, payload: Dict) -> None:
+        spec = payload["spec"]
+        self._actor_id = ActorID.from_hex(payload["actor_id"])
+        self._max_concurrency = spec.get("max_concurrency", 1)
+        self._actor_pool = ThreadPoolExecutor(
+            max_workers=max(1, self._max_concurrency),
+            thread_name_prefix="actor-exec",
+        )
+        _apply_accelerator_env(payload.get("assigned_instances") or {})
+        loop = asyncio.get_running_loop()
+
+        def construct():
+            cls = ser.loads(spec["class_blob"])
+            args = [self._materialize(e) for e in spec.get("init_args", [])]
+            kwargs = {k: self._materialize(v)
+                      for k, v in spec.get("init_kwargs", {}).items()}
+            self.worker.job_id = JobID.from_hex(spec["job_id"]) if spec.get("job_id") \
+                else self.worker.job_id
+            self.worker.actor_instance = cls(*args, **kwargs)
+
+        try:
+            await loop.run_in_executor(self._actor_pool, construct)
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            try:
+                await self.worker.head.call(
+                    "ActorDied",
+                    {"actor_id": payload["actor_id"],
+                     "reason": f"creation task failed: {e!r}"},
+                )
+            finally:
+                os._exit(1)
+            return
+        self.worker.current_actor_id = self._actor_id
+        await self.worker.head.call(
+            "ActorReady",
+            {
+                "actor_id": payload["actor_id"],
+                "addr": self.worker.direct_addr(),
+                "node_id": self.worker.node_id,
+                "pid": os.getpid(),
+            },
+        )
+
+
+def _u32(i: int) -> bytes:
+    import struct
+
+    return struct.pack("<I", i)
+
+
+def _apply_accelerator_env(assigned: Dict[str, List[int]]) -> None:
+    if "TPU" in assigned:
+        chips = ",".join(str(i) for i in assigned["TPU"])
+        os.environ["TPU_VISIBLE_CHIPS"] = chips
+        os.environ.pop("JAX_PLATFORMS", None)
+    if "GPU" in assigned:
+        os.environ["CUDA_VISIBLE_DEVICES"] = ",".join(
+            str(i) for i in assigned["GPU"]
+        )
+
+
+def main() -> None:
+    agent_sock = os.environ["RAY_TPU_AGENT_SOCK"]
+    from ray_tpu._private.ids import WorkerID
+
+    worker = Worker()
+    worker.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    executor = Executor(worker)
+
+    # Executor routes must exist before registration makes us leasable.
+    worker.direct_server.add_handler("PushTask", executor.handle_push_task)
+
+    async def on_agent_push(method: str, payload):
+        if method == "BecomeActor":
+            await worker.ready_event.wait()
+            await executor.become_actor(payload)
+
+    worker._on_agent_push = on_agent_push  # type: ignore[method-assign]
+    worker.connect(agent_sock, mode=Worker.MODE_WORKER)
+
+    # Park the main thread; all work happens on the IO loop + executors.
+    try:
+        while worker.connected and worker.agent.connected:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

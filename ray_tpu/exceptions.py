@@ -1,0 +1,121 @@
+"""Public exception hierarchy.
+
+Parity with the reference's exception surface (reference:
+``python/ray/exceptions.py``): task errors wrap the remote traceback and
+re-raise at ``get``; actor death, object loss and store pressure each have a
+distinct type so user retry logic can discriminate.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised an exception remotely; re-raised at ray_tpu.get().
+
+    Carries the remote traceback string and, when picklable, the original
+    cause (reference behavior: python/ray/exceptions.py RayTaskError).
+    """
+
+    def __init__(
+        self,
+        function_name: str = "",
+        traceback_str: str = "",
+        cause: Optional[BaseException] = None,
+    ):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(traceback_str or str(cause))
+
+    @classmethod
+    def from_exception(cls, e: BaseException, function_name: str = "") -> "RayTaskError":
+        tb = "".join(traceback.format_exception(type(e), e, e.__traceback__))
+        try:
+            import pickle
+
+            pickle.dumps(e)
+            cause = e
+        except Exception:
+            cause = None
+        return cls(function_name, tb, cause)
+
+    def __str__(self):
+        return (
+            f"Task '{self.function_name}' failed remotely:\n{self.traceback_str}"
+        )
+
+
+class RayActorError(RayTpuError):
+    """The actor died before or during this method call."""
+
+    def __init__(self, actor_id: str = "", reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} died: {reason}")
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """Actor temporarily unreachable (restarting); call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str = "", reason: str = "lost"):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"Object {object_id_hex} {reason}")
+
+
+class ObjectFetchTimedOutError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    def __init__(self, object_id_hex: str = ""):
+        super().__init__(object_id_hex, "lost because its owner died")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Raised when the node memory monitor kills a task to relieve pressure."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id_hex: str = ""):
+        super().__init__(f"Task {task_id_hex} was cancelled")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (e.g. OOM-killed, segfault)."""
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class CrossLanguageError(RayTpuError):
+    pass

@@ -1,0 +1,118 @@
+"""@ray_tpu.remote functions.
+
+Parity with the reference (reference: ``python/ray/remote_function.py``):
+``RemoteFunction`` wraps the user function, ``.remote(...)`` submits through
+the core worker, ``.options(...)`` returns a per-call override view validated
+the same way (reference: ``python/ray/_private/ray_option_utils.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "scheduling_strategy", "name",
+    "placement_group", "placement_group_bundle_index", "runtime_env",
+    "memory", "_metadata",
+}
+
+
+def _resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        resources["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_gpus") is not None:
+        resources["GPU"] = float(opts["num_gpus"])
+    if opts.get("num_tpus") is not None:
+        resources["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory") is not None:
+        resources["memory"] = float(opts["memory"])
+    return resources
+
+
+def validate_options(opts: Dict[str, Any]) -> None:
+    for k in opts:
+        if k not in _VALID_OPTIONS and k not in (
+            "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
+            "namespace", "get_if_exists", "max_pending_calls",
+        ):
+            raise ValueError(f"invalid option '{k}'")
+    if opts.get("num_returns") is not None and opts["num_returns"] < 0:
+        raise ValueError("num_returns must be >= 0")
+    num_tpus = opts.get("num_tpus")
+    if num_tpus:
+        from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+        ok, msg = TPUAcceleratorManager.validate_resource_request_quantity(num_tpus)
+        if not ok:
+            raise ValueError(msg)
+
+
+class RemoteFunction:
+    def __init__(self, function, **default_options):
+        validate_options(default_options)
+        self._function = function
+        self._default_options = default_options
+        functools.update_wrapper(self, function)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote functions cannot be called directly. "
+            f"Use {self._function.__name__}.remote(...) instead."
+        )
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_options)
+
+    def options(self, **options):
+        validate_options(options)
+        merged = {**self._default_options, **options}
+        parent = self
+
+        class _Wrapped:
+            def remote(self, *args, **kwargs):
+                return parent._remote(args, kwargs, merged)
+
+            def __getattr__(self, item):
+                return getattr(parent, item)
+
+        return _Wrapped()
+
+    def _remote(self, args, kwargs, opts):
+        w = worker_mod.global_worker
+        if w is None or not w.connected:
+            raise RuntimeError(
+                "ray_tpu.init() must be called before invoking remote functions"
+            )
+        refs = w.submit_task(
+            self._function,
+            args,
+            kwargs,
+            num_returns=opts.get("num_returns", 1),
+            resources=_resources_from_options(opts),
+            max_retries=opts.get("max_retries", -1),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            placement_group=_resolve_pg(opts),
+            placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
+            runtime_env=opts.get("runtime_env"),
+            name=opts.get("name", ""),
+        )
+        if opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def underlying_function(self):
+        return self._function
+
+
+def _resolve_pg(opts):
+    strategy = opts.get("scheduling_strategy")
+    if strategy is not None and type(strategy).__name__ == "PlacementGroupSchedulingStrategy":
+        return strategy.placement_group
+    return opts.get("placement_group")

@@ -1,0 +1,62 @@
+"""Runtime context introspection.
+
+Parity with the reference (reference: ``python/ray/runtime_context.py``):
+``get_runtime_context()`` exposes job/node/worker/task/actor identity and
+assigned accelerator ids from inside any task or actor.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._worker = worker
+
+    def get_job_id(self) -> str:
+        return self._worker.job_id.hex()
+
+    def get_node_id(self) -> str:
+        return self._worker.node_id
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        tid = getattr(self._worker.current_task_info, "task_id", None)
+        return tid.hex() if tid else None
+
+    def get_task_name(self) -> Optional[str]:
+        return getattr(self._worker.current_task_info, "task_name", None)
+
+    def get_actor_id(self) -> Optional[str]:
+        actor_id = getattr(self._worker, "current_actor_id", None)
+        return actor_id.hex() if actor_id else None
+
+    def get_accelerator_ids(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        tpu = os.environ.get("TPU_VISIBLE_CHIPS")
+        if tpu:
+            out["TPU"] = tpu.split(",")
+        gpu = os.environ.get("CUDA_VISIBLE_DEVICES")
+        if gpu:
+            out["GPU"] = gpu.split(",")
+        return out
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        return {}
+
+
+def get_runtime_context() -> RuntimeContext:
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return RuntimeContext(w)

@@ -1,0 +1,36 @@
+"""Shared fixtures (modeled on the reference's conftest strategy,
+reference: python/ray/tests/conftest.py ray_start_regular / ray_start_cluster).
+
+JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (SURVEY §4 "fake TPU topology" note).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    cluster.shutdown()

@@ -1,0 +1,172 @@
+"""Unit tests for kernel primitives: ids, resources, config, serialization.
+(reference test strategy: SURVEY §4 tier 1 — pure unit tests, no cluster)"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+
+
+class TestIds:
+    def test_roundtrip(self):
+        t = TaskID.from_random()
+        assert TaskID.from_hex(t.hex()) == t
+        assert len(t.binary()) == 16
+
+    def test_object_id_structure(self):
+        t = TaskID.from_random()
+        o = ObjectID.for_task_return(t, 3)
+        assert o.task_id() == t
+        assert o.return_index() == 3
+        assert not o.is_put()
+
+    def test_put_id(self):
+        w = WorkerID.from_random()
+        o = ObjectID.from_put(7, w)
+        assert o.is_put()
+        assert o.return_index() == 7
+
+    def test_nil(self):
+        assert JobID.nil().is_nil()
+        assert not JobID.from_random().is_nil()
+
+    def test_actor_task_id_prefix(self):
+        a = ActorID.from_random()
+        t1 = TaskID.for_actor_task(a, 1)
+        t2 = TaskID.for_actor_task(a, 2)
+        assert t1.binary()[:8] == t2.binary()[:8]
+        assert t1 != t2
+
+    def test_pickle(self):
+        t = TaskID.from_random()
+        assert pickle.loads(pickle.dumps(t)) == t
+
+
+class TestResources:
+    def test_fixed_point_exact(self):
+        rs = ResourceSet({"CPU": 0.1})
+        for _ in range(9):
+            rs.add(ResourceSet({"CPU": 0.1}))
+        assert rs.get("CPU") == 1.0
+
+    def test_fits_and_subtract(self):
+        avail = ResourceSet({"CPU": 4, "TPU": 8})
+        req = ResourceSet({"CPU": 2, "TPU": 4})
+        assert req.fits(avail)
+        assert avail.subtract(req)
+        assert avail.get("TPU") == 4
+        assert not ResourceSet({"TPU": 8}).fits(avail)
+        assert not avail.subtract(ResourceSet({"TPU": 8}))
+
+    def test_node_resources_instances(self):
+        nr = NodeResources(ResourceSet({"CPU": 4, "TPU": 4}),
+                           accelerator_ids={"TPU": [0, 1, 2, 3]})
+        got = nr.allocate(ResourceSet({"TPU": 2, "CPU": 1}), owner="w1")
+        assert got["TPU"] == [0, 1]
+        assert nr.available.get("TPU") == 2
+        nr.release(ResourceSet({"TPU": 2, "CPU": 1}), owner="w1")
+        assert sorted(nr.free_instances["TPU"]) == [0, 1, 2, 3]
+
+    def test_utilization(self):
+        nr = NodeResources(ResourceSet({"CPU": 4}))
+        assert nr.utilization() == 0.0
+        nr.allocate(ResourceSet({"CPU": 3}))
+        assert abs(nr.utilization() - 0.75) < 1e-9
+
+    def test_wire_roundtrip(self):
+        nr = NodeResources(ResourceSet({"CPU": 4, "custom": 1.5}),
+                           labels={"zone": "a"})
+        nr2 = NodeResources.from_wire(nr.to_wire())
+        assert nr2.total == nr.total
+        assert nr2.labels == {"zone": "a"}
+
+
+class TestConfig:
+    def test_defaults_and_env_override(self):
+        assert CONFIG.inline_object_max_size_bytes > 0
+        os.environ["RAY_TPU_gossip_period_ms"] = "123"
+        try:
+            assert CONFIG.gossip_period_ms == 123
+        finally:
+            del os.environ["RAY_TPU_gossip_period_ms"]
+
+    def test_unknown_flag(self):
+        with pytest.raises(AttributeError):
+            CONFIG.not_a_flag
+
+
+class TestSerialization:
+    def test_roundtrip_basics(self):
+        ctx = ser.SerializationContext()
+        for value in [1, "x", {"a": [1, 2]}, None, (1, 2), {3, 4}]:
+            sobj = ctx.serialize(value)
+            assert ctx.deserialize(memoryview(sobj.to_bytes())) == value
+
+    def test_numpy_zero_copy_out_of_band(self):
+        ctx = ser.SerializationContext()
+        arr = np.arange(100_000, dtype=np.float64)
+        sobj = ctx.serialize(arr)
+        assert len(sobj.buffers) >= 1  # big array went out-of-band
+        out = ctx.deserialize(memoryview(sobj.to_bytes()))
+        np.testing.assert_array_equal(arr, out)
+
+    def test_closure(self):
+        ctx = ser.SerializationContext()
+        y = 10
+        sobj = ctx.serialize(lambda x: x + y)
+        fn = ctx.deserialize(memoryview(sobj.to_bytes()))
+        assert fn(5) == 15
+
+    def test_jax_array_crosses_as_numpy(self):
+        import jax.numpy as jnp
+
+        ctx = ser.SerializationContext()
+        arr = jnp.arange(16)
+        sobj = ctx.serialize({"x": arr})
+        out = ctx.deserialize(memoryview(sobj.to_bytes()))
+        np.testing.assert_array_equal(np.asarray(arr), out["x"])
+
+
+class TestObjectStoreLocal:
+    def test_create_seal_get(self, tmp_path):
+        from ray_tpu._private.object_store import StoreClient
+
+        c = StoreClient(str(tmp_path / "store"))
+        oid = ObjectID.from_put(1, WorkerID.from_random())
+        data = os.urandom(4096)
+        c.put_bytes(oid, data)
+        view = c.get_view(oid)
+        assert bytes(view[:4096]) == data
+
+    def test_eviction_and_spill(self, tmp_path):
+        from ray_tpu._private.object_store import StoreDirectory
+
+        d = StoreDirectory(str(tmp_path / "store"), capacity=10_000)
+        ids = []
+        for i in range(5):
+            oid = ObjectID.from_put(i + 1, WorkerID.from_random())
+            d.client.put_bytes(oid, bytes(3000))
+            d.on_sealed(oid.hex(), 3000)
+            ids.append(oid)
+        # capacity 10k, 5*3k = 15k: oldest evicted
+        assert d.used <= 10_000
+        assert d.num_evictions > 0
+        # pin everything, next insert must spill
+        for oid in ids:
+            if d.contains(oid.hex()):
+                d.pin(oid.hex())
+        oid = ObjectID.from_put(99, WorkerID.from_random())
+        d.client.put_bytes(oid, bytes(9000))
+        d.on_sealed(oid.hex(), 9000)
+        assert d.num_spills > 0
+        # spilled objects are restorable
+        spilled = [h for h in [o.hex() for o in ids] if d.is_spilled(h)]
+        if spilled:
+            assert d.restore(spilled[0])
+            assert d.client.get_view(ObjectID.from_hex(spilled[0])) is not None
