@@ -1,0 +1,88 @@
+"""Headline benchmark: Llama train-step throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference has no TPU training numbers (BASELINE.md); the north-star is
+≥40% MFU (SURVEY §6). ``vs_baseline`` is therefore MFU / 0.40 — ≥1.0 beats
+the target. Runs a ~350M-param Llama decoder (bf16 activations, fp32
+adam) sized for one v5e chip's 16 GiB HBM; CPU fallback uses the tiny config
+so the script always emits a line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# v5e bf16 peak ~197 TFLOP/s; v5p ~459; fall back to v5e figure.
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+
+
+def main() -> None:
+    import optax
+
+    from ray_tpu.models.llama import (
+        LlamaConfig, init_llama, llama_loss, llama_logical_axes)
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.parallel.train_step import (
+        create_train_state, make_train_step)
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden=1024, mlp_hidden=2816, num_layers=16,
+            num_heads=8, num_kv_heads=8, head_dim=128, max_seq_len=2048,
+            remat=True, attn_impl="auto")
+        batch, seq, steps = 8, 2048, 10
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 8, 128, 3
+
+    mesh = create_mesh(MeshConfig(data=-1), devices=jax.devices()[:1])
+    tx = optax.adamw(1e-4)
+    with jax.set_mesh(mesh):
+        state, shardings = create_train_state(
+            lambda k: init_llama(cfg, k), tx, mesh, llama_logical_axes(cfg))
+        step = make_train_step(
+            lambda p, b: llama_loss(p, b, cfg), tx, mesh, shardings,
+            batch_logical_axes=("batch", "seq"))
+        rng = np.random.default_rng(0)
+        tok = rng.integers(0, cfg.vocab_size, (batch, seq + 1),
+                           dtype=np.int32)
+        b = {"inputs": tok[:, :-1], "targets": tok[:, 1:]}
+        state, m = step(state, b)          # compile
+        float(m["loss"])  # D2H sync (block_until_ready is a no-op on the
+        t0 = time.perf_counter()  # axon remote platform)
+        for _ in range(steps):
+            state, m = step(state, b)
+        final_loss = float(m["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    flops_tok = cfg.flops_per_token(seq)
+    mfu = tok_s * flops_tok / PEAK_FLOPS.get(platform, 1e12)
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": f"tokens/s ({cfg.num_params()/1e6:.0f}M params, "
+                f"{platform}, mfu={mfu:.3f})",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit one line
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": f"tokens/s (failed: {type(e).__name__}: {e})",
+            "vs_baseline": 0.0}))
+        sys.exit(1)

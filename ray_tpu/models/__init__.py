@@ -1,0 +1,24 @@
+"""ray_tpu.models — JAX-native model families.
+
+The reference ships no models of its own for Train (users bring torch models);
+RLlib ships torch/tf model catalogs (reference: rllib/models/, 12.1k LoC).
+TPU-native, the framework provides sharding-annotated JAX model families that
+the Train/Serve/RLlib layers consume directly.
+"""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    init_llama,
+    llama_forward,
+    llama_decode,
+    llama_loss,
+    llama_logical_axes,
+)
+from ray_tpu.models.mlp import (
+    MLPConfig, init_mlp, mlp_forward, mlp_loss, mlp_logical_axes)
+
+__all__ = [
+    "LlamaConfig", "init_llama", "llama_forward", "llama_decode",
+    "llama_loss", "llama_logical_axes",
+    "MLPConfig", "init_mlp", "mlp_forward", "mlp_loss", "mlp_logical_axes",
+]

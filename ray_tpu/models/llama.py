@@ -1,0 +1,285 @@
+"""Llama-2/3-family decoder-only transformer, TPU-first.
+
+Design notes (why this is not a torch translation):
+- Pure functional: params are a pytree of ``jnp.ndarray``; the forward pass is
+  a jit-friendly function of (params, tokens). No module objects, no state.
+- Every parameter carries *logical axis names* (see ``llama_logical_axes``) so
+  the same model runs 1-chip or on any (data, fsdp, seq, tensor) mesh purely
+  by changing the rule table — GSPMD inserts the collectives.
+- Layers are stacked into single arrays (num_layers leading dim) and scanned
+  with ``jax.lax.scan``: one compiled layer body regardless of depth, which
+  keeps XLA compile time flat and enables per-layer remat.
+- Attention dispatches to ``ray_tpu.ops`` (Pallas flash attention on TPU,
+  reference einsum path elsewhere; ring attention when the seq axis > 1).
+- bfloat16 activations / fp32 params+optimizer by default: MXU-native.
+
+Reference capability being replaced: Train users bring HF torch models
+(reference: python/ray/train/huggingface/, release/air_examples/gptj_deepspeed
+_finetuning); here the model is framework-native.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.parallel.sharding import constrain
+
+
+def _ring_seq_attention(q, k, v):
+    """Sequence-parallel exact attention: shard_map over the ambient mesh's
+    ``seq`` axis; kv chunks ride the ICI ring (ops.ring_attention)."""
+    from ray_tpu.ops.ring_attention import ring_attention
+    from ray_tpu.parallel.sharding import logical_to_spec
+
+    qs = logical_to_spec(("batch", "seq", "heads", "head_dim"))
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="seq", causal=True),
+        in_specs=(qs, qs, qs), out_specs=qs, check_vma=False)
+    return fn(q, k, v)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    mlp_hidden: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16      # activation dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True             # checkpoint each layer (HBM↔FLOPs trade)
+    attn_impl: str = "auto"        # auto | flash | reference | ring_seq
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab_size, hidden=128, mlp_hidden=352,
+                           num_layers=2, num_heads=4, num_kv_heads=2,
+                           head_dim=32, max_seq_len=256, remat=False)
+
+    @staticmethod
+    def debug_1l() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128, hidden=64, mlp_hidden=176,
+                           num_layers=1, num_heads=2, num_kv_heads=1,
+                           head_dim=32, max_seq_len=128, remat=False)
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approximate fwd+bwd FLOPs/token: 6*N, plus the attention
+        quadratic term 12*L*H*D*S when ``seq_len`` is given."""
+        flops = 6.0 * self.num_params()
+        if seq_len is not None:
+            flops += (12.0 * self.num_layers * self.num_heads
+                      * self.head_dim * seq_len)
+        return flops
+
+    def num_params(self) -> int:
+        h, m, v = self.hidden, self.mlp_hidden, self.vocab_size
+        qkv = h * (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
+        o = self.num_heads * self.head_dim * h
+        mlp = 3 * h * m
+        per_layer = qkv + o + mlp + 2 * h
+        return self.num_layers * per_layer + 2 * v * h + h
+
+
+def llama_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Pytree (same structure as params) of logical-axis tuples."""
+    layer = {
+        "wq": ("embed", "heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+        "w_gate": ("embed", "mlp"),
+        "w_up": ("embed", "mlp"),
+        "w_down": ("mlp", "embed"),
+        "attn_norm": ("norm",),
+        "mlp_norm": ("norm",),
+    }
+    # scanned layers carry a leading 'layers' dim — replicated (None)
+    layers = {k: (None,) + v for k, v in layer.items()}
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_llama(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Initialize params (truncated-normal fan-in scaling, fp32)."""
+    h, m = cfg.hidden, cfg.mlp_hidden
+    nh, nkv, hd, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.num_layers
+    ks = jax.random.split(key, 10)
+    pd = cfg.param_dtype
+
+    def norm_init(shape, k, fan_in):
+        scale = fan_in ** -0.5
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32)
+                * scale).astype(pd)
+
+    layers = {
+        "wq": norm_init((L, h, nh, hd), ks[0], h),
+        "wk": norm_init((L, h, nkv, hd), ks[1], h),
+        "wv": norm_init((L, h, nkv, hd), ks[2], h),
+        "wo": norm_init((L, nh, hd, h), ks[3], nh * hd),
+        "w_gate": norm_init((L, h, m), ks[4], h),
+        "w_up": norm_init((L, h, m), ks[5], h),
+        "w_down": norm_init((L, m, h), ks[6], m),
+        "attn_norm": jnp.ones((L, h), pd),
+        "mlp_norm": jnp.ones((L, h), pd),
+    }
+    return {
+        "embed": norm_init((cfg.vocab_size, h), ks[7], 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), pd),
+        "lm_head": norm_init((h, cfg.vocab_size), ks[8], h),
+    }
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * w.astype(jnp.float32)).astype(dt)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, S, H, D]; rotate pairs (d, d + D/2) — llama convention."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
+           positions: jax.Array, kv_cache=None,
+           cache_index: Optional[jax.Array] = None):
+    """One transformer block. x: [B, S, H_model]."""
+    dt = cfg.dtype
+    # --- attention ---
+    h = _rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsh,hnd->bsnd", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bsh,hnd->bsnd", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bsh,hnd->bsnd", h, lp["wv"].astype(dt))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    q = constrain(q, ("batch", "seq", "heads", None))
+    k = constrain(k, ("batch", "seq", "kv_heads", None))
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv = kv_cache  # [B, max_S, nkv, d]
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, axis=1)
+        k, v = ck, cv
+        new_cache = (ck, cv)
+        attn_out = attention(q, k, v, impl="reference", causal=True,
+                             q_offset=cache_index)
+    else:
+        if cfg.attn_impl == "ring_seq":
+            attn_out = _ring_seq_attention(q, k, v)
+        else:
+            attn_out = attention(q, k, v, impl=cfg.attn_impl, causal=True)
+    attn_out = constrain(attn_out, ("batch", "seq", "heads", None))
+    x = x + jnp.einsum("bsnd,ndh->bsh", attn_out, lp["wo"].astype(dt))
+    # --- mlp (SwiGLU) ---
+    h = _rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    gate = jnp.einsum("bsh,hm->bsm", h, lp["w_gate"].astype(dt))
+    up = jnp.einsum("bsh,hm->bsm", h, lp["w_up"].astype(dt))
+    act = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum("bsm,mh->bsh", act, lp["w_down"].astype(dt))
+    x = constrain(x, ("batch", "seq", "embed"))
+    return x, new_cache
+
+
+def llama_decode(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    kv_caches,
+    cache_index: jax.Array,
+    *,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, list]:
+    """Incremental decode: tokens [B, S] appended to the kv caches at
+    ``cache_index`` → (logits [B, S, V] fp32, updated caches). Python loop
+    over layers so each layer's cache updates functionally in place."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32) + cache_index, (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    new_caches = []
+    for i in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        x, c = _layer(cfg, x, lp, positions, kv_caches[i], cache_index)
+        new_caches.append(c)
+    x = _rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"].astype(cfg.dtype))
+    return logits.astype(jnp.float32), new_caches
+
+
+def llama_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, V] (fp32). Layers run under
+    ``lax.scan`` with optional per-layer remat. For kv-cache decoding use
+    ``llama_decode``."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", "embed"))
+
+    body = partial(_layer, cfg)
+
+    def scan_fn(carry, lp):
+        y, _ = body(carry, lp, positions)
+        return y, None
+
+    if cfg.remat:
+        scan_fn = jax.checkpoint(
+            scan_fn, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"].astype(cfg.dtype))
+    return logits.astype(jnp.float32)
+
+
+def llama_loss(params: Dict[str, Any], batch: Dict[str, jax.Array],
+               cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross-entropy; batch = {tokens [B,S]} or {inputs, targets}."""
+    if "targets" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+        mask = None
+    logits = llama_forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

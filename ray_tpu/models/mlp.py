@@ -1,0 +1,65 @@
+"""Tiny MLP family — test/bench workhorse (the 'trivial task' of models)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: int = 512
+    out_dim: int = 10
+    num_layers: int = 2
+    dtype: Any = jnp.float32
+
+
+def mlp_logical_axes(cfg: MLPConfig) -> Dict[str, Any]:
+    return {
+        "w_in": ("embed", "mlp"),
+        "b_in": ("mlp",),
+        "w_hidden": (None, "mlp", "mlp"),
+        "b_hidden": (None, "mlp"),
+        "w_out": ("mlp", None),
+        "b_out": (None,),
+    }
+
+
+def init_mlp(cfg: MLPConfig, key: jax.Array) -> Dict[str, Any]:
+    ks = jax.random.split(key, 4)
+    n_hid = max(cfg.num_layers - 2, 0)
+    return {
+        "w_in": jax.random.normal(ks[0], (cfg.in_dim, cfg.hidden),
+                                  cfg.dtype) * cfg.in_dim ** -0.5,
+        "b_in": jnp.zeros((cfg.hidden,), cfg.dtype),
+        "w_hidden": jax.random.normal(
+            ks[1], (n_hid, cfg.hidden, cfg.hidden),
+            cfg.dtype) * cfg.hidden ** -0.5,
+        "b_hidden": jnp.zeros((n_hid, cfg.hidden), cfg.dtype),
+        "w_out": jax.random.normal(ks[2], (cfg.hidden, cfg.out_dim),
+                                   cfg.dtype) * cfg.hidden ** -0.5,
+        "b_out": jnp.zeros((cfg.out_dim,), cfg.dtype),
+    }
+
+
+def mlp_forward(params: Dict[str, Any], x: jax.Array,
+                cfg: MLPConfig) -> jax.Array:
+    h = jax.nn.relu(x @ params["w_in"] + params["b_in"])
+    if params["w_hidden"].shape[0]:
+        def body(h, wb):
+            w, b = wb
+            return jax.nn.relu(h @ w + b), None
+        h, _ = jax.lax.scan(body, h, (params["w_hidden"],
+                                      params["b_hidden"]))
+    return h @ params["w_out"] + params["b_out"]
+
+
+def mlp_loss(params, batch, cfg: MLPConfig) -> jax.Array:
+    logits = mlp_forward(params, batch["x"], cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, batch["y"][:, None], axis=-1))

@@ -1,0 +1,11 @@
+"""ray_tpu.ops — TPU compute kernels (Pallas) and their reference fallbacks.
+
+The hot ops of the model families live here: flash attention (Pallas, VMEM
+blocked, online softmax), ring attention (seq-parallel via ppermute), and
+fused pieces XLA doesn't get right on its own. Everything has a pure-XLA
+reference path so the suite runs on the CPU test mesh.
+"""
+
+from ray_tpu.ops.attention import attention, reference_attention
+
+__all__ = ["attention", "reference_attention"]

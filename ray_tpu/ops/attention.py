@@ -1,0 +1,85 @@
+"""Attention dispatcher: reference XLA path, Pallas flash kernel, ring path.
+
+GQA layout everywhere: q [B, S, H, D], k/v [B, S_kv, KVH, D] with
+H % KVH == 0. Returns [B, S, H, D] in q.dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return k
+    B, S, KVH, D = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (B, S, KVH, n_rep, D)
+    ).reshape(B, S, KVH * n_rep, D)
+
+
+def reference_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, causal: bool = True,
+    q_offset: Optional[jax.Array] = None,
+    valid_kv_len: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain einsum attention with fp32 softmax. ``q_offset`` positions the
+    query block inside a longer kv sequence (decode with kv cache)."""
+    B, Sq, H, D = q.shape
+    Skv, KVH = k.shape[1], k.shape[2]
+    k = _repeat_kv(k, H // KVH)
+    v = _repeat_kv(v, H // KVH)
+    scale = D ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    kv_pos = jnp.arange(Skv)
+    if causal:
+        q_pos = jnp.arange(Sq)
+        if q_offset is not None:
+            q_pos = q_pos + q_offset
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    if valid_kv_len is not None:
+        vmask = kv_pos[None, :] < valid_kv_len[:, None]  # [B, Skv]
+        logits = jnp.where(vmask[:, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, impl: str = "auto", causal: bool = True,
+    q_offset: Optional[jax.Array] = None,
+    valid_kv_len: Optional[jax.Array] = None,
+) -> jax.Array:
+    """impl: auto (flash on TPU when shapes allow, else reference), flash,
+    reference. Ring attention is invoked explicitly via ops.ring_attention
+    by the seq-parallel layer, not through this dispatcher."""
+    if impl == "auto":
+        use_flash = (
+            _on_tpu() and q_offset is None and valid_kv_len is None
+            and q.shape[1] == k.shape[1]
+            and q.shape[1] % 128 == 0 and q.shape[3] % 128 == 0
+        )
+        impl = "flash" if use_flash else "reference"
+    if impl == "flash":
+        from ray_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    if impl != "reference":
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected auto|flash|reference "
+            "(ring attention is the model layer's 'ring_seq' path)")
+    return reference_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               valid_kv_len=valid_kv_len)

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels. Each kernel has an XLA reference twin in ray_tpu.ops
+used for CPU testing and as the custom-VJP recompute path."""

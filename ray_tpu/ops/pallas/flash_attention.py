@@ -1,0 +1,146 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Online-softmax blocked attention: the kv axis is the innermost grid dim, and
+running (max, sum, acc) state lives in VMEM scratch that persists across the
+sequential TPU grid — the classic FlashAttention-2 schedule mapped onto
+Pallas. Causal blocks above the diagonal are skipped with ``pl.when`` (zero
+MXU work, the DMA still runs; a fused skip via index_map is a later
+optimization).
+
+GQA is handled in the index maps (kv head = q head // n_rep) — no kv
+materialization. Backward currently recomputes through the XLA reference path
+under ``jax.custom_vjp`` (correct; Pallas dq/dkv kernels are the planned
+upgrade).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, block_q: int, block_k: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Skip fully-masked blocks (strictly above the causal diagonal).
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)            # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)              # [bq, 1]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               causal: bool, block_q: int, block_k: int) -> jax.Array:
+    """q [B,H,S,D], k/v [B,KVH,S,D] → o [B,H,S,D]."""
+    B, H, Sq, D = q.shape
+    KVH, Skv = k.shape[1], k.shape[2]
+    n_rep = H // KVH
+    scale = D ** -0.5
+    block_q = next(b for b in (block_q, 256, 128) if Sq % b == 0 or b == 128)
+    block_k = next(b for b in (block_k, 256, 128) if Skv % b == 0 or b == 128)
+    if Sq % block_q or Skv % block_k:
+        raise ValueError(f"seq lens ({Sq},{Skv}) must divide by 128")
+    grid = (B, H, Sq // block_q, Skv // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, iq, ik: (b, h // n_rep, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, iq, ik: (b, h // n_rep, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),     # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=jax.devices()[0].platform != "tpu",
+    )(q, k, v)
+
+
+# Kernel takes [B,H,S,D]; public API is [B,S,H,D] to match ops.attention.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True) -> jax.Array:
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash_fwd(qt, kt, vt, causal=causal, block_q=256, block_k=256)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def _fa_fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal), (q, k, v)
+
+
+def _fa_bwd(causal, res, g):
+    from ray_tpu.ops.attention import reference_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

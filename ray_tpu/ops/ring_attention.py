@@ -1,0 +1,92 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+The reference has NO sequence/context parallelism (SURVEY §2.5 — repo-wide
+grep for ring attention / Ulysses is empty); long-context is delegated to
+external frameworks. Here it is first-class: q/k/v are sharded along the
+``seq`` mesh axis, kv chunks rotate around the ICI ring via
+``lax.ppermute``, and each hop folds into an online softmax — so memory per
+chip is O(S/N) while the result is exact.
+
+Call under ``shard_map`` (or from a jit whose shardings put S on ``seq``):
+per-device shapes q [B, S_loc, H, D], k/v [B, S_loc, KVH, D].
+
+Overlap note: XLA overlaps the ppermute DMA of step j+1 with the compute of
+step j when latency hiding is enabled (standard on TPU); the loop is written
+so kv for the next step is sent before the current block's math is consumed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, k_off, scale, causal):
+    """One blockwise attention: returns (unnormalized out, m, l) in fp32.
+
+    q [B,Sq,H,D], k/v [B,Sk,KVH,D]; offsets are global position offsets.
+    """
+    from ray_tpu.ops.attention import _repeat_kv
+
+    B, Sq, H, D = q.shape
+    n_rep = H // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_off + jnp.arange(Sq)
+        k_pos = k_off + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m == -inf → p would be exp(0)=1; zero them.
+    p = jnp.where((m > _NEG_INF / 2)[..., None], p, 0.0)
+    m = jnp.maximum(m, _NEG_INF)
+    l = jnp.sum(p, axis=-1)                                   # [B,H,Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)   # [B,Sq,H,D]
+    return o.astype(jnp.float32), m, l
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, axis_name: str = "seq", causal: bool = True,
+) -> jax.Array:
+    """Exact attention with kv rotating around the ``axis_name`` ring."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, S_loc, H, D = q.shape
+    scale = D ** -0.5
+    q_off = my_idx * S_loc
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, j):
+        k_cur, v_cur, m_acc, l_acc, o_acc = carry
+        src = (my_idx - j) % axis_size
+        # Send kv onward immediately so the DMA overlaps the block compute.
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        o_blk, m_blk, l_blk = _block_attn(
+            q, k_cur, v_cur, q_off, src * S_loc, scale, causal)
+        m_new = jnp.maximum(m_acc, m_blk)
+        a_old = jnp.exp(m_acc - m_new)
+        a_blk = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a_old + l_blk * a_blk
+        o_new = (o_acc * a_old.transpose(0, 2, 1)[..., None]
+                 + o_blk * a_blk.transpose(0, 2, 1)[..., None])
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((B, H, S_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S_loc), jnp.float32)
+    o0 = jnp.zeros((B, S_loc, H, D), jnp.float32)
+    (k_f, v_f, m_f, l_f, o_f), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(axis_size))
+    out = o_f / jnp.maximum(l_f, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

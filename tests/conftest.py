@@ -7,9 +7,16 @@ exercised without TPU hardware (SURVEY §4 "fake TPU topology" note).
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize imports jax and pins the axon (real-TPU)
+# platform before conftest runs, so plain env vars are too late; override
+# through jax.config before any backend is initialized. Tests run on the
+# deterministic 8-device virtual CPU mesh (SURVEY §4 fake-TPU-topology note).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
