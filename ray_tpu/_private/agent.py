@@ -350,7 +350,34 @@ class NodeAgent:
         return await fut
 
     def _maybe_spillback(self, request: ResourceSet, p: Dict) -> Optional[Dict]:
+        from ray_tpu._private.resources import label_constraints_match
+
         strategy = p.get("scheduling_strategy") or {}
+        if isinstance(strategy, dict) and strategy.get("type") == "node_label":
+            hard = strategy.get("hard") or {}
+            soft = strategy.get("soft") or {}
+            local_ok = (label_constraints_match(self.resources.labels, hard)
+                        and request.feasible_on(self.resources.total))
+            # Candidate remotes that satisfy hard + feasibility; prefer
+            # soft-matching ones (best-effort, reference: node-label soft).
+            candidates = []
+            for node_id, view in self.cluster_view.items():
+                if node_id == self.node_id or not view.get("alive", True):
+                    continue
+                nr = NodeResources.from_wire(view["resources"])
+                if (label_constraints_match(nr.labels, hard)
+                        and request.feasible_on(nr.total)):
+                    candidates.append(
+                        (label_constraints_match(nr.labels, soft),
+                         node_id, view["addr"]))
+            if local_ok and (label_constraints_match(self.resources.labels, soft)
+                             or not any(c[0] for c in candidates)):
+                return None
+            for prefer_soft in (True, False):
+                for soft_ok, node_id, addr in candidates:
+                    if soft_ok == prefer_soft:
+                        return {"node_id": node_id, "addr": addr}
+            return None
         if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
             target_node = strategy.get("node_id")
             if target_node and target_node != self.node_id:
@@ -417,6 +444,13 @@ class NodeAgent:
 
     async def _try_grant(self, req: Dict) -> bool:
         request: ResourceSet = req["resources"]
+        strategy = req["p"].get("scheduling_strategy") or {}
+        if isinstance(strategy, dict) and strategy.get("type") == "node_label":
+            from ray_tpu._private.resources import label_constraints_match
+
+            if not label_constraints_match(self.resources.labels,
+                                           strategy.get("hard") or {}):
+                return False
         pg = req.get("pg")
         if pg:
             key = (pg[0], pg[1])

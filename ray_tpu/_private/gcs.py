@@ -294,20 +294,48 @@ class HeadServer:
         """Pick the least-utilized feasible node (GcsActorScheduler analog)."""
         request = ResourceSet.from_wire(info.spec_wire.get("resources", {}))
         strategy = info.spec_wire.get("scheduling_strategy")
+        pg = info.spec_wire.get("pg")  # [pg_id, bundle_index] or None
+        pg_node: Optional[str] = None
+        if pg:
+            group = self.placement_groups.get(pg[0])
+            if not group or group["state"] == "REMOVED":
+                await self._handle_actor_death(
+                    info, f"placement group {pg[0]} removed")
+                return True
+            if group["state"] != "CREATED":
+                return False  # PENDING: _retry_schedule polls us again
+            pg_node = group["placement"][pg[1]]
         candidates = []
         for node in self.nodes.values():
             if not node.alive:
                 continue
+            if pg_node is not None and node.node_id != pg_node:
+                continue
             if strategy and strategy.get("type") == "node_affinity":
                 if node.node_id != strategy.get("node_id"):
                     continue
-            if request.feasible_on(node.resources.total):
-                candidates.append(node)
+            if strategy and strategy.get("type") == "node_label":
+                from ray_tpu._private.resources import label_constraints_match
+
+                if not label_constraints_match(
+                        node.labels, strategy.get("hard") or {}):
+                    continue
+            if pg_node is None and not request.feasible_on(node.resources.total):
+                continue
+            candidates.append(node)
         if not candidates:
             return False
         fits = [n for n in candidates if request.fits(n.resources.available)]
         pool = fits or candidates
-        pool.sort(key=lambda n: n.resources.utilization())
+        if strategy and strategy.get("type") == "node_label":
+            from ray_tpu._private.resources import label_constraints_match
+
+            soft = strategy.get("soft") or {}
+            pool.sort(key=lambda n: (
+                not label_constraints_match(n.labels, soft),
+                n.resources.utilization()))
+        else:
+            pool.sort(key=lambda n: n.resources.utilization())
         node = pool[0]
         info.node_id = node.node_id
         try:
@@ -418,17 +446,29 @@ class HeadServer:
 
         2-phase (prepare on agents, rollback on failure) like the reference's
         PG protocol (reference: node_manager.proto:385-392 Prepare/Commit).
+        Infeasible groups stay PENDING and are retried as nodes/resources
+        appear (reference: GcsPlacementGroupManager pending queue).
         """
         pg_id = p["pg_id"]
-        bundles = [ResourceSet.from_wire(b) for b in p["bundles"]]
-        strategy = p.get("strategy", "PACK")
-        placement = self._place_bundles(bundles, strategy)
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "state": "PENDING", "bundles": p["bundles"],
+            "strategy": p.get("strategy", "PACK"), "placement": None,
+            "name": p.get("name", ""),
+        }
+        if await self._try_place_pg(pg_id):
+            return {"state": "CREATED",
+                    "placement": self.placement_groups[pg_id]["placement"]}
+        asyncio.get_running_loop().create_task(self._retry_place_pg(pg_id))
+        return {"state": "PENDING"}
+
+    async def _try_place_pg(self, pg_id: str) -> bool:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg["state"] != "PENDING":
+            return pg is not None and pg["state"] == "CREATED"
+        bundles = [ResourceSet.from_wire(b) for b in pg["bundles"]]
+        placement = self._place_bundles(bundles, pg["strategy"])
         if placement is None:
-            self.placement_groups[pg_id] = {
-                "pg_id": pg_id, "state": "PENDING", "bundles": p["bundles"],
-                "strategy": strategy, "placement": None, "name": p.get("name", ""),
-            }
-            return {"state": "PENDING"}
+            return False
         prepared = []
         ok = True
         for idx, (bundle, node_id) in enumerate(zip(bundles, placement)):
@@ -448,20 +488,27 @@ class HeadServer:
             except Exception:
                 ok = False
                 break
+        # The group may have been removed while we awaited the prepares;
+        # committing would resurrect it and leak the agents' reservations.
+        if pg["state"] != "PENDING":
+            ok = False
         if not ok:
             for node, idx, bundle in prepared:
                 await node.conn.push("ReturnPGBundle",
                                      {"pg_id": pg_id, "bundle_index": idx})
-            self.placement_groups[pg_id] = {
-                "pg_id": pg_id, "state": "PENDING", "bundles": p["bundles"],
-                "strategy": strategy, "placement": None, "name": p.get("name", ""),
-            }
-            return {"state": "PENDING"}
-        self.placement_groups[pg_id] = {
-            "pg_id": pg_id, "state": "CREATED", "bundles": p["bundles"],
-            "strategy": strategy, "placement": placement, "name": p.get("name", ""),
-        }
-        return {"state": "CREATED", "placement": placement}
+            return False
+        pg["state"] = "CREATED"
+        pg["placement"] = placement
+        return True
+
+    async def _retry_place_pg(self, pg_id: str) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg["state"] != "PENDING":
+                return
+            if await self._try_place_pg(pg_id):
+                return
 
     def _place_bundles(self, bundles: List[ResourceSet], strategy: str
                        ) -> Optional[List[str]]:

@@ -119,6 +119,52 @@ class ResourceSet:
         return cls._from_fixed_map(dict(wire))
 
 
+def normalize_label_constraints(d) -> Dict[str, Dict]:
+    """Normalize user label constraints into wire form.
+
+    Accepts values that are a string, a list of strings, or the
+    In/NotIn/Exists/DoesNotExist helper objects
+    (ray_tpu.util.scheduling_strategies); emits
+    ``{key: {"op": ..., "values": [...]}}``.
+    """
+    out: Dict[str, Dict] = {}
+    for k, v in (d or {}).items():
+        tname = type(v).__name__
+        if isinstance(v, str):
+            out[k] = {"op": "in", "values": [v]}
+        elif tname == "In":
+            out[k] = {"op": "in", "values": list(v.values)}
+        elif tname == "NotIn":
+            out[k] = {"op": "not_in", "values": list(v.values)}
+        elif tname == "Exists":
+            out[k] = {"op": "exists", "values": []}
+        elif tname == "DoesNotExist":
+            out[k] = {"op": "not_exists", "values": []}
+        else:
+            out[k] = {"op": "in", "values": list(v)}
+    return out
+
+
+def label_constraints_match(labels: Mapping[str, str], constraints) -> bool:
+    """Evaluate wire-form label constraints against a node's labels."""
+    for key, c in (constraints or {}).items():
+        op, values = c.get("op", "in"), c.get("values", [])
+        present = key in labels
+        if op == "in":
+            if labels.get(key) not in values:
+                return False
+        elif op == "not_in":
+            if present and labels[key] in values:
+                return False
+        elif op == "exists":
+            if not present:
+                return False
+        elif op == "not_exists":
+            if present:
+                return False
+    return True
+
+
 class NodeResources:
     """Total + available resources of one node, plus labels.
 

@@ -309,10 +309,13 @@ class Worker:
                                              name="raytpu-io")
         self._loop_thread.start()
         ready.wait()
-        self._acall(self._async_connect(agent_unix_path))
-        self.connected = True
+        # Must be visible before RegisterClient makes this process leasable:
+        # a task can be pushed (and executed) the moment registration lands,
+        # and user code resolves global_worker at call time.
         global global_worker
         global_worker = self
+        self._acall(self._async_connect(agent_unix_path))
+        self.connected = True
 
     async def _async_connect(self, agent_unix_path: str) -> None:
         self.ready_event = asyncio.Event()
@@ -1140,6 +1143,12 @@ def _strategy_wire(strategy) -> Optional[Dict]:
                 "soft": strategy.soft}
     if t == "SpreadSchedulingStrategy":
         return {"type": "spread"}
+    if t == "NodeLabelSchedulingStrategy":
+        from ray_tpu._private.resources import normalize_label_constraints
+
+        return {"type": "node_label",
+                "hard": normalize_label_constraints(strategy.hard),
+                "soft": normalize_label_constraints(strategy.soft)}
     return None
 
 
@@ -1177,6 +1186,10 @@ class KvClient:
 # ---------------------------------------------------------------------------
 # Direct task submitter internals (loop-owned)
 # ---------------------------------------------------------------------------
+
+
+class _PlacementGroupGone(Exception):
+    """The target placement group was removed; queued tasks must fail."""
 
 
 class _LeasePool:
@@ -1221,6 +1234,26 @@ class _LeasePool:
             asyncio.get_running_loop().create_task(self._request_lease())
             want -= 1
 
+    async def _resolve_pg_agent(self):
+        """Target the agent of the node holding our PG bundle (the reference
+        pins PG leases via bundle location, placement_group.py +
+        direct_task_transport lease policy). Waits for a PENDING group."""
+        w = self.worker
+        while True:
+            info = await w.head.call("GetPlacementGroup", {"pg_id": self.pg[0]})
+            if info is None or info.get("state") == "REMOVED":
+                raise _PlacementGroupGone(
+                    f"placement group {self.pg[0]} removed")
+            placement = info.get("placement")
+            if placement:
+                node_id = placement[self.pg[1]]
+                view = await w.head.call("GetClusterView", {})
+                node = view.get(node_id)
+                if node is None:
+                    raise RpcError(f"bundle node {node_id} lost")
+                return node["addr"]
+            await asyncio.sleep(0.1)
+
     async def _request_lease(self) -> None:
         w = self.worker
         try:
@@ -1230,8 +1263,14 @@ class _LeasePool:
                 "pg": self.pg,
                 "owner": w.worker_id.hex(),
             }
-            reply = await w.agent.call("RequestWorkerLease", payload)
             agent_addr = None
+            if self.pg:
+                agent_addr = await self._resolve_pg_agent()
+                client = await w._owner_client(agent_addr)
+                reply = await client.call(
+                    "RequestWorkerLease", {**payload, "spilled_once": True})
+            else:
+                reply = await w.agent.call("RequestWorkerLease", payload)
             hops = 0
             while reply and reply.get("spillback") and hops < 4:
                 hops += 1
@@ -1263,7 +1302,18 @@ class _LeasePool:
             # lease is returned rather than pinning resources forever.
             asyncio.get_running_loop().create_task(self._idle_return_later(conn))
             self._pump()
+        except _PlacementGroupGone as e:
+            # Unschedulable forever: fail every queued task, don't retry.
+            self.inflight_leases -= 1
+            while self.pending:
+                record = self.pending.popleft()
+                self.worker._on_task_failure(
+                    record, RuntimeError(str(e)), retriable=False)
         except Exception:
+            if os.environ.get("RAY_TPU_DEBUG"):
+                import traceback
+
+                traceback.print_exc()
             self.inflight_leases -= 1
             if self.pending:
                 await asyncio.sleep(0.2)

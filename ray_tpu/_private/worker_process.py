@@ -104,6 +104,12 @@ class Executor:
         raise ValueError(f"bad arg entry kind {kind}")
 
     def _execute_sync(self, spec: TaskSpec, assigned: Dict) -> Dict:
+        if os.environ.get("RAY_TPU_DEBUG"):
+            from ray_tpu._private import worker as _wm
+            print(f"EXEC pid={os.getpid()} fn={spec.function_name} "
+                  f"gw_none={_wm.global_worker is None} "
+                  f"gw_is_self={_wm.global_worker is self.worker}",
+                  file=sys.stderr, flush=True)
         _apply_accelerator_env(assigned)
         ctx = self.worker.current_task_info
         ctx.task_id = TaskID(spec.task_id)

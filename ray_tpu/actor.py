@@ -14,7 +14,9 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ActorID
-from ray_tpu.remote_function import _resources_from_options, validate_options, _resolve_pg
+from ray_tpu.remote_function import (
+    _resources_from_options, validate_options, _resolve_pg,
+    _resolve_pg_bundle_index)
 
 
 class ActorMethod:
@@ -122,7 +124,7 @@ class ActorClass:
             get_if_exists=bool(opts.get("get_if_exists", False)),
             scheduling_strategy=opts.get("scheduling_strategy"),
             placement_group=_resolve_pg(opts),
-            placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
+            placement_group_bundle_index=_resolve_pg_bundle_index(opts),
             runtime_env=opts.get("runtime_env"),
         )
         method_num_returns = {}

@@ -98,7 +98,7 @@ class RemoteFunction:
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             scheduling_strategy=opts.get("scheduling_strategy"),
             placement_group=_resolve_pg(opts),
-            placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
+            placement_group_bundle_index=_resolve_pg_bundle_index(opts),
             runtime_env=opts.get("runtime_env"),
             name=opts.get("name", ""),
         )
@@ -116,3 +116,13 @@ def _resolve_pg(opts):
     if strategy is not None and type(strategy).__name__ == "PlacementGroupSchedulingStrategy":
         return strategy.placement_group
     return opts.get("placement_group")
+
+
+def _resolve_pg_bundle_index(opts) -> int:
+    strategy = opts.get("scheduling_strategy")
+    if (strategy is not None
+            and type(strategy).__name__ == "PlacementGroupSchedulingStrategy"
+            and opts.get("placement_group_bundle_index") is None):
+        return strategy.placement_group_bundle_index
+    idx = opts.get("placement_group_bundle_index")
+    return -1 if idx is None else idx

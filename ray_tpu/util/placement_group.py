@@ -1,0 +1,177 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+Reference: python/ray/util/placement_group.py (``placement_group`` :146,
+``PlacementGroup`` handle :41, ``remove_placement_group`` :257). The head
+reserves bundles on agents with a prepare/return protocol
+(ray_tpu._private.gcs.HeadServer._create_placement_group); tasks and actors
+target a bundle via ``PlacementGroupSchedulingStrategy``.
+
+TPU note: a bundle asking for ``{"TPU": 4}`` is chip-granular on one host;
+slice-atomic gangs use one bundle per host with STRICT_SPREAD plus the
+slice-name custom resource (see ray_tpu._private.accelerators.tpu).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+class PlacementGroup:
+    """Handle to a placement group (reference: placement_group.py:41)."""
+
+    def __init__(self, id_hex: str, bundles: Optional[List[Dict[str, float]]] = None):
+        self.id_hex = id_hex
+        self._bundles = bundles
+
+    @property
+    def id(self) -> str:
+        return self.id_hex
+
+    @staticmethod
+    def empty() -> "PlacementGroup":
+        return PlacementGroup("")
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.id_hex
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        if self._bundles is None:
+            from ray_tpu._private.resources import ResourceSet
+
+            wire = (self._table() or {}).get("bundles", [])
+            self._bundles = [ResourceSet.from_wire(b).to_dict() for b in wire]
+        return self._bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def _table(self) -> Optional[Dict]:
+        w = _worker()
+        return w._acall(w.head.call("GetPlacementGroup", {"pg_id": self.id_hex}))
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        """Block until all bundles are reserved (reference:
+        placement_group.py wait)."""
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            t = self._table()
+            if t and t.get("state") == "CREATED":
+                return True
+            if t and t.get("state") == "REMOVED":
+                return False
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def ready(self):
+        """ObjectRef that resolves when the PG is ready — schedulable with
+        ``ray_tpu.get`` (reference: placement_group.py ready())."""
+        import ray_tpu
+
+        pg_id = self.id_hex
+
+        @ray_tpu.remote
+        def _pg_ready(pg_id: str) -> bool:
+            return PlacementGroup(pg_id).wait(timeout_seconds=3600)
+
+        return _pg_ready.options(num_cpus=0).remote(pg_id)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PlacementGroup) and other.id_hex == self.id_hex
+
+    def __hash__(self) -> int:
+        return hash(self.id_hex)
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+    _max_cpu_fraction_per_node: Optional[float] = None,
+) -> PlacementGroup:
+    """Reserve ``bundles`` across the cluster (reference:
+    placement_group.py:146). Asynchronous: use ``.wait()`` / ``.ready()``."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not isinstance(b, dict) or not b:
+            raise ValueError(f"malformed bundle {b!r}")
+        if any(v < 0 for v in b.values()):
+            raise ValueError(f"negative resource in bundle {b!r}")
+    from ray_tpu._private.resources import ResourceSet
+
+    w = _worker()
+    pg_id = os.urandom(14).hex()
+    w._acall(w.head.call("CreatePlacementGroup", {
+        "pg_id": pg_id,
+        # Head-side bundle state is fixed-point wire form (resources.py).
+        "bundles": [ResourceSet(b).to_wire() for b in bundles],
+        "strategy": strategy,
+        "name": name,
+        "lifetime": lifetime or "",
+    }))
+    return PlacementGroup(pg_id, [dict(b) for b in bundles])
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """Release all bundles (reference: placement_group.py:257)."""
+    w = _worker()
+    w._acall(w.head.call("RemovePlacementGroup", {"pg_id": pg.id_hex}))
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    from ray_tpu._private.resources import ResourceSet
+
+    w = _worker()
+    for t in w._acall(w.head.call("ListPlacementGroups", {})):
+        if t.get("name") == name and t.get("state") != "REMOVED":
+            bundles = [ResourceSet.from_wire(b).to_dict()
+                       for b in t.get("bundles", [])]
+            return PlacementGroup(t["pg_id"], bundles)
+    raise ValueError(f"placement group {name!r} not found")
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> Dict:
+    w = _worker()
+    if pg is not None:
+        t = pg._table()
+        return {pg.id_hex: t} if t else {}
+    return {t["pg_id"]: t
+            for t in w._acall(w.head.call("ListPlacementGroups", {}))}
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """The PG the current task/actor runs in, if any."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        return None
+    pg_id = getattr(w, "current_placement_group_id", None)
+    return PlacementGroup(pg_id) if pg_id else None
+
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "get_placement_group", "placement_group_table",
+    "get_current_placement_group",
+]

@@ -1,0 +1,80 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py).
+
+These are plain data objects interpreted by the worker's submit path
+(``ray_tpu._private.worker._strategy_wire``) and by the node agents' lease
+scheduler. TPU note: ``NodeLabelSchedulingStrategy`` is the idiomatic way to
+pin work to a pod slice (labels like ``{"tpu-pod-type": "v5e-64"}``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    """Schedule onto a reserved placement-group bundle
+    (reference: scheduling_strategies.py:15)."""
+
+    def __init__(self, placement_group,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: Optional[bool] = None):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks)
+
+
+class NodeAffinitySchedulingStrategy:
+    """Pin to a specific node id (reference: scheduling_strategies.py:41)."""
+
+    def __init__(self, node_id: str, soft: bool = False,
+                 _spill_on_unavailable: bool = False,
+                 _fail_on_unavailable: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+        self._spill_on_unavailable = _spill_on_unavailable
+        self._fail_on_unavailable = _fail_on_unavailable
+
+
+class NodeLabelSchedulingStrategy:
+    """Match node labels (reference: scheduling_strategies.py:135).
+
+    ``hard`` must match; ``soft`` is best-effort preference. Each is a dict
+    of label -> list of acceptable values (In semantics).
+    """
+
+    def __init__(self, hard: Optional[Dict[str, List[str]]] = None,
+                 soft: Optional[Dict[str, List[str]]] = None):
+        self.hard = dict(hard or {})
+        self.soft = dict(soft or {})
+
+
+class In:
+    def __init__(self, *values: str):
+        self.values = list(values)
+
+
+class NotIn:
+    def __init__(self, *values: str):
+        self.values = list(values)
+
+
+class Exists:
+    pass
+
+
+class DoesNotExist:
+    pass
+
+
+class SpreadSchedulingStrategy:
+    """Best-effort spread across nodes (the "SPREAD" string strategy)."""
+
+
+__all__ = [
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "SpreadSchedulingStrategy",
+    "In", "NotIn", "Exists", "DoesNotExist",
+]

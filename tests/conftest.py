@@ -41,3 +41,35 @@ def ray_start_cluster():
 
     ray_tpu.shutdown()
     cluster.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_cluster_2():
+    """Two-node cluster (head + 1 worker), driver attached."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    cluster.add_node(num_cpus=4)
+    ray_tpu.init(_node=cluster.head_node)
+    cluster.wait_for_nodes()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_label_cluster():
+    """Head (role=head) + worker (role=worker) for label scheduling tests."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2,
+                                      "labels": {"role": "head"}})
+    cluster.add_node(num_cpus=2, labels={"role": "worker"})
+    ray_tpu.init(_node=cluster.head_node)
+    cluster.wait_for_nodes()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()

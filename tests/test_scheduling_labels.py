@@ -1,0 +1,57 @@
+"""Node-label scheduling strategy test (reference:
+python/ray/util/scheduling_strategies.py:135 NodeLabelSchedulingStrategy)."""
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+
+def test_node_label_strategy(ray_label_cluster):
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # Pin to the node labeled role=worker.
+    node = ray_tpu.get(where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"role": ["worker"]}),
+    ).remote())
+    labeled = [n for n in ray_tpu.nodes()
+               if n.get("labels", {}).get("role") == "worker"]
+    assert len(labeled) == 1
+    assert node == labeled[0]["node_id"]
+
+
+def test_node_label_not_in(ray_label_cluster):
+    from ray_tpu.util.scheduling_strategies import NotIn
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    node = ray_tpu.get(where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"role": NotIn("head")}),
+    ).remote(), timeout=30)
+    head = [n for n in ray_tpu.nodes()
+            if n.get("labels", {}).get("role") == "head"][0]["node_id"]
+    assert node != head
+
+
+def test_label_constraint_ops():
+    from ray_tpu._private.resources import (
+        label_constraints_match, normalize_label_constraints)
+    from ray_tpu.util.scheduling_strategies import (
+        DoesNotExist, Exists, In, NotIn)
+
+    wire = normalize_label_constraints({
+        "a": In("x", "y"), "b": NotIn("z"), "c": Exists(),
+        "d": DoesNotExist(), "e": "lit", "f": ["p", "q"]})
+    assert label_constraints_match(
+        {"a": "x", "b": "w", "c": "anything", "e": "lit", "f": "q"}, wire)
+    assert not label_constraints_match({"a": "z"}, wire)          # a not in
+    assert not label_constraints_match(
+        {"a": "x", "b": "z", "c": "1", "e": "lit", "f": "q"}, wire)  # b NotIn
+    assert not label_constraints_match(
+        {"a": "x", "b": "w", "e": "lit", "f": "q"}, wire)         # c missing
+    assert not label_constraints_match(
+        {"a": "x", "c": "1", "d": "1", "e": "lit", "f": "q"}, wire)  # d present
