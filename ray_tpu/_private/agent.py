@@ -27,7 +27,8 @@ from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import StoreDirectory
 from ray_tpu._private.protocol import AsyncRpcClient, Connection, RpcServer
-from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.resources import (
+    NodeResources, ResourceSet, label_constraints_match)
 
 
 class WorkerHandle:
@@ -350,8 +351,6 @@ class NodeAgent:
         return await fut
 
     def _maybe_spillback(self, request: ResourceSet, p: Dict) -> Optional[Dict]:
-        from ray_tpu._private.resources import label_constraints_match
-
         strategy = p.get("scheduling_strategy") or {}
         if isinstance(strategy, dict) and strategy.get("type") == "node_label":
             hard = strategy.get("hard") or {}
@@ -446,17 +445,22 @@ class NodeAgent:
         request: ResourceSet = req["resources"]
         strategy = req["p"].get("scheduling_strategy") or {}
         if isinstance(strategy, dict) and strategy.get("type") == "node_label":
-            from ray_tpu._private.resources import label_constraints_match
-
             if not label_constraints_match(self.resources.labels,
                                            strategy.get("hard") or {}):
                 return False
         pg = req.get("pg")
+        pg_key = None
         if pg:
-            key = (pg[0], pg[1])
-            pool = self._pg_available.get(key)
-            if pool is None or not request.fits(pool):
-                return False
+            pg_key = self._match_pg_bundle(pg, request)
+            if pg_key is None:
+                if any(k[0] == pg[0] for k in self._pg_bundles):
+                    return False  # bundles exist but are full: stay queued
+                # Every bundle of this group is gone from this node — the
+                # group was removed; fail the lease instead of wedging it.
+                fut: asyncio.Future = req["fut"]
+                if not fut.done():
+                    fut.set_result({"error": "pg_removed"})
+                return True
         elif not request.fits(self.resources.available):
             return False
         worker = self._pop_idle_worker()
@@ -467,7 +471,7 @@ class NodeAgent:
         # allocate resources
         assigned_instances: Dict[str, list] = {}
         if pg:
-            self._pg_available[(pg[0], pg[1])].subtract(request)
+            self._pg_available[pg_key].subtract(request)
         else:
             assigned_instances = self.resources.allocate(request, owner=worker.worker_id) or {}
             self._resources_dirty = True
@@ -476,7 +480,7 @@ class NodeAgent:
         worker.leased_to = lease_id
         worker.assigned_resources = request
         self.leases[lease_id] = worker
-        worker.meta_pg = pg
+        worker.meta_pg = list(pg_key) if pg_key else None
         fut: asyncio.Future = req["fut"]
         if not fut.done():
             fut.set_result(
@@ -536,15 +540,24 @@ class NodeAgent:
         request = ResourceSet.from_wire(spec.get("resources", {}))
         pg = spec.get("pg")
         if pg:
-            key = (pg[0], pg[1])
-            pool = self._pg_available.get(key)
-            if pool is None or not request.fits(pool):
-                await self.head.call(
-                    "ActorDied",
-                    {"actor_id": p["actor_id"], "reason": "pg bundle unavailable"},
-                )
-                return
-            pool.subtract(request)
+            # Wait for bundle capacity like the non-PG path waits for node
+            # resources: a just-returned lease may still hold the bundle.
+            deadline = time.monotonic() + CONFIG.actor_creation_timeout_ms / 1000
+            while True:
+                key = self._match_pg_bundle(pg, request)
+                if key is not None:
+                    break
+                if not any(k[0] == pg[0] for k in self._pg_bundles) or \
+                        time.monotonic() > deadline:
+                    await self.head.call(
+                        "ActorDied",
+                        {"actor_id": p["actor_id"],
+                         "reason": "pg bundle unavailable"},
+                    )
+                    return
+                await asyncio.sleep(0.1)
+            pg = list(key)
+            self._pg_available[key].subtract(request)
             assigned = {}
         else:
             deadline = time.monotonic() + CONFIG.actor_creation_timeout_ms / 1000
@@ -605,6 +618,26 @@ class NodeAgent:
                     pass
 
     # ------------------------------------------------------ placement groups
+    def _match_pg_bundle(self, pg, request: ResourceSet):
+        """Map a lease/actor pg target onto a concrete local bundle.
+
+        bundle_index -1 means "any bundle of the group" (reference semantics:
+        placement_group.py bundle_index default); scan this node's bundles of
+        the group for one the request fits.
+        """
+        pg_id, idx = pg[0], pg[1]
+        if idx is not None and idx >= 0:
+            pool = self._pg_available.get((pg_id, idx))
+            if pool is not None and request.fits(pool):
+                return (pg_id, idx)
+            if (pg_id, idx) in self._pg_bundles:
+                return None  # exists but full — caller decides to queue
+            return None
+        for key, pool in sorted(self._pg_available.items()):
+            if key[0] == pg_id and request.fits(pool):
+                return key
+        return None
+
     def _prepare_pg_bundle(self, p: Dict) -> bool:
         key = (p["pg_id"], p["bundle_index"])
         if key in self._pg_bundles:
@@ -624,6 +657,9 @@ class NodeAgent:
         if request is not None:
             self.resources.release(request)
             self._resources_dirty = True
+        # Queued leases targeting this group must fail now, not hang: the
+        # drain's _try_grant sees the bundles are gone and replies pg_removed.
+        asyncio.get_running_loop().create_task(self._drain_pending_leases())
 
     # --------------------------------------------------------- object plane
     async def _object_sealed(self, conn: Connection, p: Dict) -> None:

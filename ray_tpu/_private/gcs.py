@@ -23,7 +23,8 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.protocol import Connection, RpcServer
-from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.resources import (
+    NodeResources, ResourceSet, label_constraints_match)
 
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
@@ -93,14 +94,24 @@ class HeadServer:
         self.task_events: List[Dict] = []  # ring buffer of task state transitions
         self.cluster_config = CONFIG.snapshot()
         self._pg_counter = 0
+        # Strong refs to background tasks: the loop only holds weak refs, so
+        # an unreferenced retry task can be GC'd mid-flight (asyncio docs).
+        self._bg_tasks: set = set()
         self._register_routes()
+
+    def _hold_task(self, task: "asyncio.Task") -> "asyncio.Task":
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     # ------------------------------------------------------------------ boot
     async def start(self) -> int:
         self.port = await self.server.start_tcp("0.0.0.0", self.port)
         self.server.set_disconnect_handler(self._on_disconnect)
-        asyncio.get_running_loop().create_task(self._health_check_loop())
-        asyncio.get_running_loop().create_task(self._broadcast_loop())
+        self._hold_task(
+            asyncio.get_running_loop().create_task(self._health_check_loop()))
+        self._hold_task(
+            asyncio.get_running_loop().create_task(self._broadcast_loop()))
         return self.port
 
     def _register_routes(self) -> None:
@@ -287,7 +298,8 @@ class HeadServer:
         ok = await self._schedule_actor(info)
         if not ok:
             # No feasible node right now; keep PENDING and retry when nodes join
-            asyncio.get_running_loop().create_task(self._retry_schedule(info))
+            self._hold_task(asyncio.get_running_loop().create_task(
+                self._retry_schedule(info)))
         return {"actor_id": actor_id, "state": info.state}
 
     async def _schedule_actor(self, info: ActorInfo) -> bool:
@@ -304,7 +316,14 @@ class HeadServer:
                 return True
             if group["state"] != "CREATED":
                 return False  # PENDING: _retry_schedule polls us again
-            pg_node = group["placement"][pg[1]]
+            if pg[1] is None or pg[1] < 0:
+                # bundle_index -1 = any bundle: round-robin over the group's
+                # nodes; the agent maps onto a concrete local bundle.
+                rr = group.get("rr", 0)
+                group["rr"] = rr + 1
+                pg_node = group["placement"][rr % len(group["placement"])]
+            else:
+                pg_node = group["placement"][pg[1]]
         candidates = []
         for node in self.nodes.values():
             if not node.alive:
@@ -315,8 +334,6 @@ class HeadServer:
                 if node.node_id != strategy.get("node_id"):
                     continue
             if strategy and strategy.get("type") == "node_label":
-                from ray_tpu._private.resources import label_constraints_match
-
                 if not label_constraints_match(
                         node.labels, strategy.get("hard") or {}):
                     continue
@@ -328,8 +345,6 @@ class HeadServer:
         fits = [n for n in candidates if request.fits(n.resources.available)]
         pool = fits or candidates
         if strategy and strategy.get("type") == "node_label":
-            from ray_tpu._private.resources import label_constraints_match
-
             soft = strategy.get("soft") or {}
             pool.sort(key=lambda n: (
                 not label_constraints_match(n.labels, soft),
@@ -379,7 +394,8 @@ class HeadServer:
             info.addr = None
             await self._publish_event("actor", info.public_view())
             if not await self._schedule_actor(info):
-                asyncio.get_running_loop().create_task(self._retry_schedule(info))
+                self._hold_task(asyncio.get_running_loop().create_task(
+                self._retry_schedule(info)))
         else:
             await self._handle_actor_death(info, reason)
 
@@ -458,7 +474,8 @@ class HeadServer:
         if await self._try_place_pg(pg_id):
             return {"state": "CREATED",
                     "placement": self.placement_groups[pg_id]["placement"]}
-        asyncio.get_running_loop().create_task(self._retry_place_pg(pg_id))
+        self._hold_task(
+            asyncio.get_running_loop().create_task(self._retry_place_pg(pg_id)))
         return {"state": "PENDING"}
 
     async def _try_place_pg(self, pg_id: str) -> bool:
@@ -486,6 +503,10 @@ class HeadServer:
                     ok = False
                     break
             except Exception:
+                # A timed-out prepare may still land on the agent; roll it
+                # back too (ReturnPGBundle is idempotent) so the reservation
+                # can't leak.
+                prepared.append((node, idx, bundle))
                 ok = False
                 break
         # The group may have been removed while we awaited the prepares;

@@ -1246,7 +1246,14 @@ class _LeasePool:
                     f"placement group {self.pg[0]} removed")
             placement = info.get("placement")
             if placement:
-                node_id = placement[self.pg[1]]
+                idx = self.pg[1]
+                if idx is None or idx < 0:
+                    # bundle_index -1 = any bundle: rotate over the group's
+                    # nodes; the agent maps onto a concrete local bundle.
+                    self._pg_rr = getattr(self, "_pg_rr", -1) + 1
+                    node_id = placement[self._pg_rr % len(placement)]
+                else:
+                    node_id = placement[idx]
                 view = await w.head.call("GetClusterView", {})
                 node = view.get(node_id)
                 if node is None:
@@ -1280,6 +1287,9 @@ class _LeasePool:
                 reply = await client.call(
                     "RequestWorkerLease", {**payload, "spilled_once": True}
                 )
+            if reply and reply.get("error") == "pg_removed":
+                raise _PlacementGroupGone(
+                    f"placement group {self.pg[0] if self.pg else ''} removed")
             grant = (reply or {}).get("grant")
             if not grant:
                 raise RpcError("lease request failed")

@@ -114,6 +114,7 @@ class Executor:
         ctx = self.worker.current_task_info
         ctx.task_id = TaskID(spec.task_id)
         ctx.task_name = spec.function_name
+        ctx.placement_group_id = spec.placement_group_id
         start = time.time()
         try:
             args, kwargs = self._resolve_args(spec)
@@ -139,6 +140,7 @@ class Executor:
         finally:
             ctx.task_id = None
             ctx.task_name = None
+            ctx.placement_group_id = None
 
     async def _run_async_method(self, spec: TaskSpec, method) -> Dict:
         loop = asyncio.get_running_loop()
@@ -230,6 +232,9 @@ class Executor:
                 os._exit(1)
             return
         self.worker.current_actor_id = self._actor_id
+        pg = spec.get("pg")
+        if pg:
+            self.worker.current_placement_group_id = pg[0]
         await self.worker.head.call(
             "ActorReady",
             {

@@ -1,0 +1,5 @@
+from ray_tpu.util.collective.collective_group.base_group import BaseGroup
+from ray_tpu.util.collective.collective_group.cpu_group import CPUGroup
+from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+__all__ = ["BaseGroup", "CPUGroup", "XLAGroup"]

@@ -1,0 +1,171 @@
+"""Host-memory (gloo-equivalent) collective group.
+
+Reference analog: python/ray/util/collective/collective_group/
+gloo_collective_group.py (565 LoC). Transport is the named store actor; every
+collective is gather-compute: all members contribute their tensor, each
+member pulls the completed set and reduces locally. That is O(n) traffic like
+gloo's default ring for the small host tensors this backend is for (rendezvous
+metadata, rewards, eval scalars) — device tensors belong on the XLA backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List
+
+import numpy as np
+
+from ray_tpu.util.collective.collective_group.base_group import BaseGroup
+from ray_tpu.util.collective.collective_group.store import CollectiveStore
+from ray_tpu.util.collective.types import (
+    AllGatherOptions, AllReduceOptions, BarrierOptions, BroadcastOptions,
+    RecvOptions, ReduceOp, ReduceOptions, ReduceScatterOptions, SendOptions)
+
+_POLL_S = 0.002
+
+
+def _reduce_arrays(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    acc = np.asarray(arrays[0]).copy()
+    for a in arrays[1:]:
+        a = np.asarray(a)
+        if op == ReduceOp.SUM:
+            acc += a
+        elif op == ReduceOp.PRODUCT:
+            acc *= a
+        elif op == ReduceOp.MIN:
+            np.minimum(acc, a, out=acc)
+        elif op == ReduceOp.MAX:
+            np.maximum(acc, a, out=acc)
+    return acc
+
+
+class CPUGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import ray_tpu
+
+        store_cls = ray_tpu.remote(CollectiveStore)
+        self._store = store_cls.options(
+            name=f"_collective_store:{group_name}",
+            get_if_exists=True,
+            lifetime="detached",
+        ).remote()
+        import ray_tpu as _rt
+
+        _rt.get(self._store.register.remote(rank))
+        self._seq = 0
+        self._p2p_seq: dict = {}
+
+    @classmethod
+    def backend(cls) -> str:
+        return "cpu"
+
+    def destroy_group(self) -> None:
+        import ray_tpu
+
+        try:
+            remaining = ray_tpu.get(self._store.deregister.remote(self._rank))
+            if remaining == 0:
+                ray_tpu.kill(self._store)
+        except Exception:
+            pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _next_key(self, op: str) -> str:
+        self._seq += 1
+        return f"{op}:{self._seq}"
+
+    def _exchange(self, op: str, payload: Any, timeout_ms: int) -> List[Any]:
+        import ray_tpu
+
+        key = self._next_key(op)
+        ray_tpu.get(self._store.contribute.remote(key, self._rank, payload))
+        deadline = time.time() + timeout_ms / 1000.0
+        while True:
+            out = ray_tpu.get(
+                self._store.collect.remote(key, self._world_size))
+            if out is not None:
+                return out
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"collective {op} timed out in group "
+                    f"{self._group_name!r} (rank {self._rank})")
+            time.sleep(_POLL_S)
+
+    # host<->transport hooks, overridden by the XLA group
+    def _to_wire(self, tensor) -> np.ndarray:
+        return np.asarray(tensor)
+
+    def _from_wire(self, array: np.ndarray, like):
+        if isinstance(like, np.ndarray) and like.shape == array.shape:
+            np.copyto(like, array.astype(like.dtype, copy=False))
+            return like
+        return array
+
+    # -- ops ---------------------------------------------------------------
+
+    def allreduce(self, tensor, opts: AllReduceOptions = AllReduceOptions()):
+        parts = self._exchange("ar", self._to_wire(tensor), opts.timeout_ms)
+        return self._from_wire(_reduce_arrays(parts, opts.reduceOp), tensor)
+
+    def barrier(self, opts: BarrierOptions = BarrierOptions()):
+        self._exchange("bar", None, opts.timeout_ms)
+
+    def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
+        parts = self._exchange("red", self._to_wire(tensor), opts.timeout_ms)
+        if self._rank == opts.root_rank:
+            return self._from_wire(_reduce_arrays(parts, opts.reduceOp), tensor)
+        return tensor
+
+    def allgather(self, tensor,
+                  opts: AllGatherOptions = AllGatherOptions()) -> List[Any]:
+        parts = self._exchange("ag", self._to_wire(tensor), opts.timeout_ms)
+        return [self._from_wire(np.asarray(p), None) for p in parts]
+
+    def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
+        payload = self._to_wire(tensor) if self._rank == opts.root_rank else None
+        parts = self._exchange("bc", payload, opts.timeout_ms)
+        return self._from_wire(np.asarray(parts[opts.root_rank]), tensor)
+
+    def reducescatter(self, tensor_list,
+                      opts: ReduceScatterOptions = ReduceScatterOptions()):
+        """Each member contributes world_size shards; returns its reduced shard."""
+        if len(tensor_list) != self._world_size:
+            raise ValueError(
+                f"reducescatter needs {self._world_size} input shards, got "
+                f"{len(tensor_list)}")
+        wire = [self._to_wire(t) for t in tensor_list]
+        parts = self._exchange("rs", wire, opts.timeout_ms)
+        mine = [np.asarray(p[self._rank]) for p in parts]
+        return self._from_wire(
+            _reduce_arrays(mine, opts.reduceOp), tensor_list[self._rank])
+
+    def send(self, tensor, opts: SendOptions):
+        import ray_tpu
+
+        pair = (self._rank, opts.dst_rank)
+        seq = self._p2p_seq.get(pair, 0) + 1
+        self._p2p_seq[pair] = seq
+        key = f"sr:{self._rank}:{opts.dst_rank}:{seq}"
+        ray_tpu.get(self._store.put_p2p.remote(key, self._to_wire(tensor)))
+
+    def recv(self, like, opts: RecvOptions):
+        import ray_tpu
+
+        pair = (opts.src_rank, self._rank)
+        seq = self._p2p_seq.get(pair, 0) + 1
+        key = f"sr:{opts.src_rank}:{self._rank}:{seq}"
+        deadline = time.time() + opts.timeout_ms / 1000.0
+        while True:
+            boxed = ray_tpu.get(self._store.take_p2p.remote(key))
+            if boxed is not None:
+                # Commit the sequence number only on success so a timed-out
+                # recv can be retried without desynchronizing the pair.
+                self._p2p_seq[pair] = seq
+                return self._from_wire(np.asarray(boxed[0]), like)
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"recv from rank {opts.src_rank} timed out "
+                    f"(group {self._group_name!r})")
+            time.sleep(_POLL_S)

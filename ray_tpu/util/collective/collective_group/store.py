@@ -1,0 +1,70 @@
+"""Named rendezvous/transport store actor for host-side collectives.
+
+Reference analog: python/ray/util/collective/collective_group/gloo_util.py:29-98
+(the named-actor Store used for gloo rendezvous). Here the store carries both
+rendezvous *and* the cross-member payloads of the DCN fallback path: on a real
+multi-host TPU pod, bulk traffic rides ICI inside the global XLA mesh and this
+store only ever sees group metadata.
+
+All methods are non-blocking so a ``max_concurrency=1`` actor can serve every
+member; callers poll.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+class CollectiveStore:
+    """One instance per group, named ``_collective_store:{group_name}``."""
+
+    def __init__(self):
+        # op_key -> {rank: payload}
+        self._parts: Dict[str, Dict[int, Any]] = {}
+        # op_key -> number of members that already read the completed set
+        self._reads: Dict[str, int] = {}
+        self._p2p: Dict[str, Any] = {}
+        self._members: Dict[int, float] = {}
+
+    def register(self, rank: int) -> int:
+        self._members[rank] = time.time()
+        return len(self._members)
+
+    def num_members(self) -> int:
+        return len(self._members)
+
+    def deregister(self, rank: int) -> int:
+        self._members.pop(rank, None)
+        return len(self._members)
+
+    def contribute(self, op_key: str, rank: int, payload: Any) -> int:
+        parts = self._parts.setdefault(op_key, {})
+        parts[rank] = payload
+        return len(parts)
+
+    def collect(self, op_key: str, world_size: int) -> Optional[List[Any]]:
+        """Return payloads ordered by rank once all members contributed.
+
+        The entry is garbage-collected after ``world_size`` successful reads.
+        """
+        parts = self._parts.get(op_key)
+        if parts is None or len(parts) < world_size:
+            return None
+        out = [parts[r] for r in range(world_size)]
+        reads = self._reads.get(op_key, 0) + 1
+        if reads >= world_size:
+            del self._parts[op_key]
+            self._reads.pop(op_key, None)
+        else:
+            self._reads[op_key] = reads
+        return out
+
+    def put_p2p(self, key: str, payload: Any) -> None:
+        self._p2p[key] = payload
+
+    def take_p2p(self, key: str) -> Optional[List[Any]]:
+        """Boxed result ([payload] or None) so None payloads round-trip."""
+        if key in self._p2p:
+            return [self._p2p.pop(key)]
+        return None

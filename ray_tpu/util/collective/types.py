@@ -1,0 +1,88 @@
+"""Collective types (reference: python/ray/util/collective/types.py).
+
+The reference enumerates NCCL/GLOO backends; the TPU-native build replaces
+them with:
+
+- ``Backend.XLA`` — device-mesh collectives: intra-member reduction over the
+  member's local ``jax.Device`` mesh (ICI), cross-member combine over the
+  control plane (DCN). On a real multi-host pod the group *is* a global mesh
+  (``jax.distributed``) and every op lowers to one ``jax.lax`` collective.
+- ``Backend.CPU`` — gloo-equivalent host-memory backend for CPU tensors,
+  rendezvous + transport via a named store actor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+
+
+class Backend(str, Enum):
+    XLA = "xla"
+    CPU = "cpu"
+    # Aliases accepted for reference-API compatibility: "nccl"/"gloo" map to
+    # the closest TPU-native backend rather than erroring out.
+    @classmethod
+    def coerce(cls, name: "str | Backend") -> "Backend":
+        if isinstance(name, Backend):
+            return name
+        name = str(name).lower()
+        if name in ("xla", "tpu", "nccl"):
+            return cls.XLA
+        if name in ("cpu", "gloo", "host"):
+            return cls.CPU
+        raise ValueError(f"Unknown collective backend: {name!r}")
+
+
+class ReduceOp(str, Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+@dataclasses.dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclasses.dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000

@@ -166,7 +166,10 @@ def get_current_placement_group() -> Optional[PlacementGroup]:
     w = worker_mod.global_worker
     if w is None:
         return None
-    pg_id = getattr(w, "current_placement_group_id", None)
+    # Task path: executor stamps the spec's pg onto the task-local context;
+    # actor path: BecomeActor stamps the worker-level attribute.
+    pg_id = getattr(w.current_task_info, "placement_group_id", None) or \
+        getattr(w, "current_placement_group_id", None)
     return PlacementGroup(pg_id) if pg_id else None
 
 

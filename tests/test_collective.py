@@ -1,0 +1,138 @@
+"""Collective API tests (reference analog:
+python/ray/util/collective/tests/ single_node_cpu_tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world_size, backend="cpu", group="g"):
+        self.rank = rank
+        self.ws = world_size
+        self.group = group
+        col.init_collective_group(world_size, rank, backend=backend,
+                                  group_name=group)
+
+    def do_allreduce(self):
+        x = np.full((4,), float(self.rank + 1), np.float64)
+        return col.allreduce(x, group_name=self.group)
+
+    def do_barrier(self):
+        col.barrier(group_name=self.group)
+        return self.rank
+
+    def do_broadcast(self):
+        x = (np.arange(3.0) if self.rank == 0
+             else np.zeros(3))
+        return col.broadcast(x, src_rank=0, group_name=self.group)
+
+    def do_allgather(self):
+        x = np.array([float(self.rank)])
+        return col.allgather(x, group_name=self.group)
+
+    def do_reducescatter(self):
+        shards = [np.full((2,), float(self.rank * 10 + i))
+                  for i in range(self.ws)]
+        return col.reducescatter(shards, group_name=self.group)
+
+    def do_reduce(self):
+        x = np.full((2,), float(self.rank + 1))
+        return col.reduce(x, dst_rank=0, group_name=self.group)
+
+    def do_sendrecv(self):
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name=self.group)
+            return None
+        return col.recv(None, src_rank=0, group_name=self.group)
+
+    def rank_info(self):
+        return col.get_rank(self.group), col.get_collective_group_size(self.group)
+
+
+@pytest.fixture(scope="module")
+def members(ray_start_regular):
+    ms = [Member.remote(r, 2, "cpu", "g") for r in range(2)]
+    ray_tpu.get([m.rank_info.remote() for m in ms])
+    yield ms
+
+
+def test_allreduce(members):
+    out = ray_tpu.get([m.do_allreduce.remote() for m in members])
+    for o in out:
+        np.testing.assert_allclose(o, np.full((4,), 3.0))
+
+
+def test_barrier(members):
+    assert sorted(ray_tpu.get([m.do_barrier.remote() for m in members])) == [0, 1]
+
+
+def test_broadcast(members):
+    out = ray_tpu.get([m.do_broadcast.remote() for m in members])
+    for o in out:
+        np.testing.assert_allclose(o, np.arange(3.0))
+
+
+def test_allgather(members):
+    out = ray_tpu.get([m.do_allgather.remote() for m in members])
+    for o in out:
+        np.testing.assert_allclose(np.concatenate(o), [0.0, 1.0])
+
+
+def test_reducescatter(members):
+    out = ray_tpu.get([m.do_reducescatter.remote() for m in members])
+    # rank r gets sum over members of shard r: (0*10+r) + (1*10+r) = 10+2r
+    np.testing.assert_allclose(out[0], np.full((2,), 10.0))
+    np.testing.assert_allclose(out[1], np.full((2,), 12.0))
+
+
+def test_reduce(members):
+    out = ray_tpu.get([m.do_reduce.remote() for m in members])
+    np.testing.assert_allclose(out[0], np.full((2,), 3.0))  # root reduced
+    np.testing.assert_allclose(out[1], np.full((2,), 2.0))  # non-root unchanged
+
+
+def test_send_recv(members):
+    out = ray_tpu.get([m.do_sendrecv.remote() for m in members])
+    assert out[0] is None
+    np.testing.assert_allclose(out[1], [42.0])
+
+
+def test_declarative_group(ray_start_regular):
+    @ray_tpu.remote
+    class Plain:
+        def ar(self):
+            return col.allreduce(np.ones(2), group_name="decl_g")
+
+    actors = [Plain.remote() for _ in range(2)]
+    col.create_collective_group(actors, 2, [0, 1], backend="cpu",
+                                group_name="decl_g")
+    out = ray_tpu.get([a.ar.remote() for a in actors])
+    for o in out:
+        np.testing.assert_allclose(o, np.full((2,), 2.0))
+
+
+def test_xla_backend_jax_arrays(ray_start_regular):
+    @ray_tpu.remote
+    class JaxMember:
+        def __init__(self, rank):
+            col.init_collective_group(2, rank, backend="xla",
+                                      group_name="jx")
+
+        def ar(self, rank):
+            import jax.numpy as jnp
+
+            x = jnp.full((3,), float(rank + 1), jnp.float32)
+            out = col.allreduce(x, group_name="jx")
+            import jax
+
+            assert isinstance(out, jax.Array)
+            return np.asarray(out)
+
+    ms = [JaxMember.remote(r) for r in range(2)]
+    out = ray_tpu.get([m.ar.remote(r) for r, m in enumerate(ms)])
+    for o in out:
+        np.testing.assert_allclose(o, np.full((3,), 3.0))

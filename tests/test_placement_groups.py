@@ -156,3 +156,84 @@ def test_named_pg_bundle_specs_roundtrip(ray_cluster_2):
     got = get_placement_group("specs_pg")
     assert got.bundle_specs == [{"CPU": 1.5}]
     remove_placement_group(pg)
+
+
+def test_pg_default_bundle_index_any(ray_cluster_2):
+    """bundle_index defaults to -1 = any bundle (reference semantics)."""
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    assert pg.wait(timeout_seconds=10)
+    nodes = set(placement_group_table(pg)[pg.id_hex]["placement"])
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    out = ray_tpu.get([
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg),
+            num_cpus=1,
+        ).remote()
+        for _ in range(4)
+    ], timeout=30)
+    assert set(out) <= nodes
+
+    @ray_tpu.remote
+    class A:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg),
+        num_cpus=1,
+    ).remote()
+    assert ray_tpu.get(a.where.remote()) in nodes
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_get_current_placement_group_inside_task(ray_cluster_2):
+    from ray_tpu.util.placement_group import get_current_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+
+    @ray_tpu.remote
+    def current():
+        cur = get_current_placement_group()
+        return cur.id_hex if cur else None
+
+    got = ray_tpu.get(current.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0),
+        num_cpus=1,
+    ).remote(), timeout=30)
+    assert got == pg.id_hex
+    # outside any PG
+    assert ray_tpu.get(current.remote(), timeout=30) is None
+    remove_placement_group(pg)
+
+
+def test_queued_pg_lease_fails_on_remove(ray_cluster_2):
+    """A lease queued on a full bundle must fail (not hang) when the PG is
+    removed."""
+    import time
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+
+    @ray_tpu.remote
+    def hold(sec):
+        time.sleep(sec)
+        return "held"
+
+    strat = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    blocker = hold.options(scheduling_strategy=strat, num_cpus=1).remote(3)
+    time.sleep(0.5)  # let it occupy the bundle
+    queued = hold.options(scheduling_strategy=strat, num_cpus=1).remote(0)
+    time.sleep(0.3)
+    remove_placement_group(pg)
+    with pytest.raises(Exception):
+        ray_tpu.get(queued, timeout=15)
