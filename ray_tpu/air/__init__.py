@@ -1,0 +1,7 @@
+"""ray_tpu.air — shared configs for Train/Tune (reference:
+python/ray/air/__init__.py)."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
+
+__all__ = ["CheckpointConfig", "FailureConfig", "RunConfig", "ScalingConfig"]

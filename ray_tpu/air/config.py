@@ -1,0 +1,80 @@
+"""Shared AIR-style configs (reference: python/ray/air/config.py —
+ScalingConfig :101, FailureConfig :377, CheckpointConfig :427,
+RunConfig :576), re-based on TPU topology.
+
+``ScalingConfig`` speaks TPU natively: a worker is one *host* of a pod
+slice; ``topology`` names the slice type whose chips-per-host product sets
+the per-worker accelerator count. ``mesh_shape`` carries the (dp, fsdp, seq,
+tensor) axes the JaxTrainer hands to ``ray_tpu.parallel.mesh``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    # resources per training worker actor (e.g. {"TPU": 4}); CPU default 1.
+    resources_per_worker: Optional[Dict[str, float]] = None
+    # named TPU slice topology, e.g. "v5e-8" (reference models slices via
+    # custom resources, tpu.py:335-398); informational + used for defaults.
+    topology: Optional[str] = None
+    # mesh axes for in-worker SPMD: {"data": -1, "fsdp": 1, ...}
+    mesh_shape: Optional[Dict[str, int]] = None
+    placement_strategy: str = "PACK"
+
+    def _resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            return {"TPU": float(self.chips_per_worker), "CPU": 1.0}
+        return {"CPU": 1.0}
+
+    @property
+    def chips_per_worker(self) -> int:
+        if self.resources_per_worker and "TPU" in self.resources_per_worker:
+            return int(self.resources_per_worker["TPU"])
+        return 4 if self.use_tpu else 0
+
+    def as_placement_group_bundles(self) -> list:
+        return [self._resources() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Worker-group restart policy. On TPU the failure domain is the slice:
+    one dead host invalidates the whole mesh, so recovery always restarts
+    the full worker group (SURVEY §2.5 elastic row)."""
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")

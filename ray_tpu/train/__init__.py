@@ -1,0 +1,43 @@
+"""ray_tpu.train — distributed training orchestration, JAX-first
+(reference: python/ray/train/__init__.py; the JaxTrainer is the capability
+the reference lacks — SURVEY §2.4)."""
+
+from typing import Dict, Optional
+
+from ray_tpu.air.config import (
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
+from ray_tpu.train._checkpoint import (
+    Checkpoint, load_pytree, load_pytree_orbax, save_pytree,
+    save_pytree_orbax)
+from ray_tpu.train._internal.session import TrainContext, get_session, in_session
+from ray_tpu.train.base_trainer import BaseTrainer, Result, TrainingFailedError
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+from ray_tpu.train.jax.jax_trainer import JaxTrainer
+
+
+def report(metrics: Dict, *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optional checkpoint) from within a train loop
+    (reference: ray.train.report, _internal/session.py:654)."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return TrainContext()
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)
+
+
+__all__ = [
+    "BaseTrainer", "Checkpoint", "CheckpointConfig", "DataParallelTrainer",
+    "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
+    "ScalingConfig", "TrainContext", "TrainingFailedError", "get_checkpoint",
+    "get_context", "get_dataset_shard", "report", "save_pytree",
+    "load_pytree", "save_pytree_orbax", "load_pytree_orbax",
+]

@@ -1,0 +1,107 @@
+"""Checkpoint: a directory of files, framework-agnostic (reference:
+python/ray/train/_checkpoint.py). Sharded ``jax.Array`` pytrees get
+first-class helpers (host-gather for small models, per-shard files for
+FSDP-style layouts — orbax handles the real multi-host case)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    """A reference to a directory holding checkpoint data."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy the checkpoint data into ``path`` (or a fresh temp dir)."""
+        dest = path or os.path.join(
+            tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    # -- dict convenience (reference keeps these on legacy Checkpoint) -----
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ckpt_")
+        with open(os.path.join(d, "_dict.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "_dict.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(tree: Any, directory: str, name: str = "params") -> str:
+    """Persist a jax pytree of (possibly sharded) arrays.
+
+    Device arrays are host-gathered per-leaf (fully-addressable shards on
+    this host); the flat leaves go into one .npz + a pickled treedef. For
+    multi-host sharded state use orbax via ``save_pytree_orbax``.
+    """
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    os.makedirs(directory, exist_ok=True)
+    arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
+              for i, x in enumerate(leaves)}
+    np.savez(os.path.join(directory, f"{name}.npz"), **arrays)
+    with open(os.path.join(directory, f"{name}.treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+    return directory
+
+
+def load_pytree(directory: str, name: str = "params") -> Any:
+    import jax
+    import numpy as np
+
+    with open(os.path.join(directory, f"{name}.treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    data = np.load(os.path.join(directory, f"{name}.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def save_pytree_orbax(tree: Any, directory: str) -> str:
+    """Sharded checkpoint via orbax (the real TPU path: each host writes its
+    own shards; reference analog: StorageContext + framework checkpointing,
+    train/_internal/storage.py:99-111)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(os.path.abspath(directory), "orbax"), tree,
+               force=True)
+    ckptr.wait_until_finished()
+    return directory
+
+
+def load_pytree_orbax(directory: str, like: Any) -> Any:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.join(os.path.abspath(directory), "orbax"),
+                         like)

@@ -1,0 +1,143 @@
+"""BackendExecutor: drives the worker group through backend setup and the
+user train loop (reference: python/ray/train/_internal/backend_executor.py —
+start :124 → Backend.on_start :190, start_training :438,
+get_next_results :552)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train._internal.session import TrainingResult
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+class TrainingWorkerError(RuntimeError):
+    pass
+
+
+class Backend:
+    """Framework plugin ABC (reference: train/backend.py:27)."""
+
+    def on_start(self, worker_group: WorkerGroup, backend_config) -> None:
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup, backend_config) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config) -> None:
+        pass
+
+
+class BackendExecutor:
+    def __init__(self, backend_config, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_group=None):
+        self._backend_config = backend_config
+        self._backend: Backend = backend_config.backend_cls()
+        self._num_workers = num_workers
+        self._resources = resources_per_worker
+        self._pg = placement_group
+        self.worker_group: Optional[WorkerGroup] = None
+        self._ranks: List[Dict] = []
+        self._done_workers: set = set()
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self._num_workers, self._resources, self._pg)
+        metas = self.worker_group.node_metas()
+        # rank assignment: stable by (node, order) — local ranks group by node
+        per_node: Dict[str, int] = defaultdict(int)
+        node_order: Dict[str, int] = {}
+        self._ranks = []
+        for world_rank, meta in enumerate(metas):
+            node = meta["node_id"]
+            if node not in node_order:
+                node_order[node] = len(node_order)
+            self._ranks.append({
+                "world_rank": world_rank,
+                "local_rank": per_node[node],
+                "node_rank": node_order[node],
+                "node_id": node,
+            })
+            per_node[node] += 1
+        for r in self._ranks:
+            r["local_world_size"] = per_node[r["node_id"]]
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    @property
+    def ranks(self) -> List[Dict]:
+        return self._ranks
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: Dict,
+        experiment_name: str,
+        storage_path: str,
+        trial_dir: str,
+        checkpoint_path: Optional[str] = None,
+        dataset_shards: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
+        from ray_tpu._private import serialization as ser
+
+        import ray_tpu
+
+        blob = ser.dumps(train_fn)
+        inits = []
+        for i, (w, r) in enumerate(zip(self.worker_group.workers, self._ranks)):
+            shards = dataset_shards[i] if dataset_shards else {}
+            inits.append(w.init_train_session.remote(
+                world_rank=r["world_rank"],
+                world_size=self._num_workers,
+                local_rank=r["local_rank"],
+                local_world_size=r["local_world_size"],
+                node_rank=r["node_rank"],
+                experiment_name=experiment_name,
+                storage_path=storage_path,
+                trial_dir=trial_dir,
+                config=config,
+                checkpoint_path=checkpoint_path,
+                dataset_shards=shards,
+            ))
+        ray_tpu.get(inits)
+        self._done_workers = set()
+        self._backend.on_training_start(self.worker_group, self._backend_config)
+        ray_tpu.get([w.start_training.remote(blob)
+                     for w in self.worker_group.workers])
+
+    def get_next_results(self, timeout: float = 3600.0) -> Optional[List[TrainingResult]]:
+        """One result from every still-running worker — a sync barrier per
+        report round. Returns None once all workers are DONE. Workers that
+        already returned DONE are not re-polled (their queues are empty;
+        uneven report counts across ranks must not wedge the round)."""
+        import ray_tpu
+
+        live = [i for i in range(len(self.worker_group.workers))
+                if i not in self._done_workers]
+        if not live:
+            return None
+        wire = ray_tpu.get(
+            [self.worker_group.workers[i].get_next.remote(timeout)
+             for i in live],
+            timeout=timeout)
+        results = [TrainingResult.from_wire(d) for d in wire]
+        errors = [r for r in results if r.kind == TrainingResult.ERROR]
+        if errors:
+            raise TrainingWorkerError(errors[0].error)
+        for i, r in zip(live, results):
+            if r.kind == TrainingResult.DONE:
+                self._done_workers.add(i)
+        reports = [r for r in results if r.kind == TrainingResult.REPORT]
+        if not reports and len(self._done_workers) == len(self.worker_group.workers):
+            return None
+        return reports or None
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group, self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,71 @@
+"""Top-K checkpoint retention (reference:
+python/ray/train/_internal/checkpoint_manager.py, config
+air/config.py:427)."""
+
+from __future__ import annotations
+
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.air.config import CheckpointConfig
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class _TrackedCheckpoint:
+    def __init__(self, checkpoint: Checkpoint, metrics: Dict, index: int):
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.index = index
+
+
+class CheckpointManager:
+    def __init__(self, config: Optional[CheckpointConfig] = None):
+        self.config = config or CheckpointConfig()
+        self._checkpoints: List[_TrackedCheckpoint] = []
+        self._counter = 0
+
+    def register_checkpoint(self, checkpoint: Checkpoint, metrics: Dict) -> None:
+        self._counter += 1
+        self._checkpoints.append(
+            _TrackedCheckpoint(checkpoint, metrics, self._counter))
+        keep = self.config.num_to_keep
+        if keep is None or len(self._checkpoints) <= keep:
+            return
+        attr = self.config.checkpoint_score_attribute
+        if attr:
+            ranked = sorted(self._checkpoints, key=self._score, reverse=True)
+        else:
+            ranked = sorted(self._checkpoints, key=lambda t: t.index,
+                            reverse=True)
+        for dropped in ranked[keep:]:
+            self._checkpoints.remove(dropped)
+            shutil.rmtree(dropped.checkpoint.path, ignore_errors=True)
+
+    def _score(self, t: _TrackedCheckpoint) -> Tuple:
+        """Rank key, higher = better. A checkpoint missing the score
+        attribute ranks worst in BOTH orders (leading bool), so min-order
+        can't accidentally crown it via -1 * -inf."""
+        attr = self.config.checkpoint_score_attribute
+        sign = 1 if self.config.checkpoint_score_order == "max" else -1
+        val = t.metrics.get(attr)
+        return (val is not None, sign * val if val is not None else 0.0,
+                t.index)
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._checkpoints:
+            return None
+        return max(self._checkpoints, key=lambda t: t.index).checkpoint
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._checkpoints:
+            return None
+        attr = self.config.checkpoint_score_attribute
+        if not attr:
+            return self.latest_checkpoint
+        return max(self._checkpoints, key=self._score).checkpoint
+
+    def best_checkpoints(self) -> List[Tuple[Checkpoint, Dict]]:
+        return [(t.checkpoint, t.metrics)
+                for t in sorted(self._checkpoints, key=lambda t: t.index)]

@@ -1,0 +1,146 @@
+"""Worker-side training session (reference:
+python/ray/train/_internal/session.py — report :394/:654, world-rank
+accessors). One ``_TrainSession`` lives per train-worker process; the user
+loop talks to it through ``ray_tpu.train.report`` / ``get_context``."""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+class TrainingResult:
+    REPORT = "report"
+    DONE = "done"
+    ERROR = "error"
+
+    def __init__(self, kind: str, metrics: Optional[Dict] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 error: Optional[str] = None):
+        self.kind = kind
+        self.metrics = metrics or {}
+        self.checkpoint_dir = checkpoint_dir
+        self.error = error
+
+    def to_wire(self) -> Dict:
+        return {"kind": self.kind, "metrics": self.metrics,
+                "checkpoint_dir": self.checkpoint_dir, "error": self.error}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "TrainingResult":
+        return cls(d["kind"], d.get("metrics"), d.get("checkpoint_dir"),
+                   d.get("error"))
+
+
+class _TrainSession:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 local_world_size: int, node_rank: int,
+                 experiment_name: str, storage_path: str,
+                 trial_dir: str, config: Dict,
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        self.node_rank = node_rank
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.trial_dir = trial_dir
+        self.config = config
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.result_queue: "queue.Queue[TrainingResult]" = queue.Queue()
+        self.iteration = 0
+
+    def report(self, metrics: Dict, checkpoint: Optional[Checkpoint] = None):
+        ckpt_dir = None
+        if checkpoint is not None:
+            # Persist into the trial dir (StorageContext analog:
+            # reference train/_internal/storage.py:99-111). Only rank 0
+            # uploads in the common fully-replicated case; other ranks may
+            # still pass shard checkpoints which land in per-rank subdirs.
+            name = f"checkpoint_{self.iteration:06d}"
+            if self.world_rank == 0:
+                dest = os.path.join(self.trial_dir, name)
+            else:
+                dest = os.path.join(self.trial_dir, name,
+                                    f"rank_{self.world_rank}")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            ckpt_dir = os.path.join(self.trial_dir, name)
+        self.iteration += 1
+        self.result_queue.put(
+            TrainingResult(TrainingResult.REPORT, metrics, ckpt_dir))
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.loaded_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}")
+        return shard
+
+
+class TrainContext:
+    """What ``ray_tpu.train.get_context()`` returns inside a worker
+    (reference: ray.train.get_context TrainContext)."""
+
+    def get_world_rank(self) -> int:
+        return get_session().world_rank
+
+    def get_world_size(self) -> int:
+        return get_session().world_size
+
+    def get_local_rank(self) -> int:
+        return get_session().local_rank
+
+    def get_local_world_size(self) -> int:
+        return get_session().local_world_size
+
+    def get_node_rank(self) -> int:
+        return get_session().node_rank
+
+    def get_experiment_name(self) -> str:
+        return get_session().experiment_name
+
+    def get_trial_dir(self) -> str:
+        return get_session().trial_dir
+
+    def get_storage(self):
+        return get_session().storage_path
+
+
+def init_session(**kwargs) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(**kwargs)
+        return _session
+
+
+def get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "Not inside a ray_tpu.train session — this API must be called "
+            "from within train_loop_per_worker")
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def in_session() -> bool:
+    return _session is not None

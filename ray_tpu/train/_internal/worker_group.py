@@ -1,0 +1,116 @@
+"""WorkerGroup: the actor pool a trainer drives (reference:
+python/ray/train/_internal/worker_group.py — RayTrainWorker :19,
+WorkerGroup :102, actor creation :188, execute_async :235)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._internal.session import (
+    TrainingResult, get_session, in_session, init_session, shutdown_session)
+
+
+@ray_tpu.remote
+class RayTrainWorker:
+    """One training worker process (one TPU host in the multi-host case)."""
+
+    def __init__(self):
+        self._train_thread: Optional[threading.Thread] = None
+        self._session = None
+
+    def execute(self, fn: Callable, *args, **kwargs) -> Any:
+        return fn(*args, **kwargs)
+
+    def node_meta(self) -> Dict:
+        ctx = ray_tpu.get_runtime_context()
+        return {"node_id": ctx.get_node_id(), "hostname": socket.gethostname(),
+                "accelerators": ctx.get_accelerator_ids()}
+
+    def init_train_session(self, **kwargs) -> None:
+        ckpt = kwargs.pop("checkpoint_path", None)
+        self._session = init_session(
+            checkpoint=Checkpoint(ckpt) if ckpt else None, **kwargs)
+
+    def start_training(self, train_fn_blob: bytes) -> None:
+        from ray_tpu._private import serialization as ser
+
+        train_fn = ser.loads(train_fn_blob)
+        session = self._session
+
+        def run():
+            try:
+                train_fn(session.config)
+                session.result_queue.put(TrainingResult(TrainingResult.DONE))
+            except BaseException as e:  # noqa: BLE001 — shipped to driver
+                import traceback
+
+                session.result_queue.put(TrainingResult(
+                    TrainingResult.ERROR,
+                    error=f"{e!r}\n{traceback.format_exc()}"))
+
+        self._train_thread = threading.Thread(target=run, daemon=True,
+                                              name="train-loop")
+        self._train_thread.start()
+
+    def get_next(self, timeout: float = 3600.0) -> Dict:
+        """Block for the worker's next result (report/done/error)."""
+        return self._session.result_queue.get(timeout=timeout).to_wire()
+
+    def end_session(self) -> None:
+        shutdown_session()
+        self._session = None
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_group=None):
+        self._num_workers = num_workers
+        opts: Dict[str, Any] = {}
+        res = dict(resources_per_worker)
+        if "CPU" in res:
+            opts["num_cpus"] = res.pop("CPU")
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+        if placement_group is not None:
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+
+            self.workers = [
+                RayTrainWorker.options(
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=placement_group,
+                        placement_group_bundle_index=i),
+                    **opts).remote()
+                for i in range(num_workers)
+            ]
+        else:
+            self.workers = [RayTrainWorker.options(**opts).remote()
+                            for _ in range(num_workers)]
+
+    def __len__(self) -> int:
+        return self._num_workers
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(
+            [w.execute.remote(fn, *args, **kwargs) for w in self.workers])
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def node_metas(self) -> List[Dict]:
+        return ray_tpu.get([w.node_meta.remote() for w in self.workers])
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

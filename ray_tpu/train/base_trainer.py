@@ -1,0 +1,63 @@
+"""BaseTrainer + Result (reference: python/ray/train/base_trainer.py —
+fit :581; in the reference, fit wraps the trainer as a Tune Trainable
+:700,844).
+
+Here ``fit()`` sets up experiment/trial dirs and calls the subclass's
+``training_loop()`` directly; ``ray_tpu.tune`` reuses trainers through the
+same ``training_loop()`` entry point when sweeping (Trainable wrapping
+lives on the Tune side).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.config import (
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[Exception] = None
+    metrics_dataframe: Optional[Any] = None
+    best_checkpoints: Optional[list] = None
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    # Subclasses implement the actual training drive loop.
+    def training_loop(self) -> Result:
+        raise NotImplementedError
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train_{int(time.time())}"
+        storage = self.run_config.resolved_storage_path()
+        trial_dir = os.path.join(storage, name)
+        os.makedirs(trial_dir, exist_ok=True)
+        self._experiment_name = name
+        self._storage_path = storage
+        self._trial_dir = trial_dir
+        return self.training_loop()

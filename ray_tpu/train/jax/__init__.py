@@ -1,0 +1,4 @@
+from ray_tpu.train.jax.config import JaxBackend, JaxConfig
+from ray_tpu.train.jax.jax_trainer import JaxTrainer
+
+__all__ = ["JaxBackend", "JaxConfig", "JaxTrainer"]

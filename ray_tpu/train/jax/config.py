@@ -1,0 +1,120 @@
+"""JaxConfig + JaxBackend — the north-star backend the reference lacks
+(SURVEY §2.4 Train row: "a JaxTrainer is absent — the north star adds it as
+a sibling of _TorchBackend calling jax.distributed.initialize";
+reference structure: python/ray/train/torch/config.py:47-132).
+
+Setup per worker:
+
+1. Rank-0 publishes a coordinator address; every worker gets it plus its
+   (process_id, num_processes) — the ``jax.distributed.initialize``
+   rendezvous triple, mirroring the torch backend's TCP store rendezvous.
+2. With ``use_jax_distributed=True`` (real multi-host TPU), workers call
+   ``jax.distributed.initialize`` so the slice forms ONE global device mesh
+   and all gradient traffic lowers to XLA collectives over ICI — no
+   host-side allreduce exists at all.
+3. Otherwise (CPU tests, single-host), each worker keeps its local devices
+   and a host-level collective group ("train_default", DCN-analog) provides
+   cross-worker psum for the DDP-style path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.train._internal.backend_executor import Backend, WorkerGroup
+
+
+@dataclasses.dataclass
+class JaxConfig:
+    use_jax_distributed: bool = False
+    collective_backend: str = "cpu"  # host-fallback group backend
+    group_name: str = "train_default"
+
+    @property
+    def backend_cls(self):
+        return JaxBackend
+
+
+def _find_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _setup_worker(rank: int, world_size: int, coordinator: str,
+                  cfg_wire: dict) -> None:
+    import os
+
+    os.environ["RAY_TPU_TRAIN_RANK"] = str(rank)
+    os.environ["RAY_TPU_TRAIN_WORLD_SIZE"] = str(world_size)
+    os.environ["RAY_TPU_TRAIN_COORDINATOR"] = coordinator
+    if cfg_wire["use_jax_distributed"]:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size,
+            process_id=rank,
+        )
+    if world_size > 1:
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(
+            world_size, rank, backend=cfg_wire["collective_backend"],
+            group_name=cfg_wire["group_name"],
+            store_key=cfg_wire["store_key"])
+
+
+class JaxBackend(Backend):
+    def __init__(self):
+        self._store_key: Optional[str] = None
+
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
+        metas = worker_group.node_metas()
+        port = worker_group.execute_single(0, _find_free_port)
+        coordinator = f"{metas[0]['hostname']}:{port}"
+        import uuid
+
+        cfg_wire = {
+            "use_jax_distributed": backend_config.use_jax_distributed,
+            "collective_backend": backend_config.collective_backend,
+            "group_name": backend_config.group_name,
+            # per-incarnation store: a restarted group must not inherit a
+            # dead predecessor's staged contributions
+            "store_key": f"{backend_config.group_name}:{uuid.uuid4().hex[:8]}",
+        }
+        self._store_key = cfg_wire["store_key"]
+        import ray_tpu
+
+        ray_tpu.get([
+            w.execute.remote(_setup_worker, i, len(worker_group), coordinator,
+                             cfg_wire)
+            for i, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group: WorkerGroup, backend_config: JaxConfig):
+        def teardown(group_name: str):
+            try:
+                from ray_tpu.util import collective as col
+
+                col.destroy_collective_group(group_name)
+            except Exception:
+                pass
+
+        try:
+            worker_group.execute(teardown, backend_config.group_name)
+        except Exception:
+            pass
+        # Driver-side backstop: dead workers can't deregister, which would
+        # strand the detached store actor of this incarnation forever.
+        if self._store_key:
+            import ray_tpu
+
+            try:
+                ray_tpu.kill(
+                    ray_tpu.get_actor(f"_collective_store:{self._store_key}"))
+            except Exception:
+                pass

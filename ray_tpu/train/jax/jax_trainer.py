@@ -1,0 +1,54 @@
+"""JaxTrainer — the flagship trainer (reference sibling:
+python/ray/train/torch/torch_trainer.py:14; the JAX backend itself is the
+north-star capability BASELINE.json asks for).
+
+Example::
+
+    def train_loop(config):
+        import jax, optax
+        from ray_tpu import train
+        ctx = train.get_context()
+        # ... build model; mesh axes from config; psum grads over the
+        # collective group (CPU fallback) or rely on the global mesh
+        # (use_jax_distributed on a real pod slice) ...
+        train.report({"loss": loss}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        train_loop, scaling_config=ScalingConfig(num_workers=8, use_tpu=True),
+        jax_config=JaxConfig(use_jax_distributed=True))
+    result = trainer.fit()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _backend_config_cls = JaxConfig
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict], None],
+        *,
+        train_loop_config: Optional[Dict] = None,
+        jax_config: Optional[JaxConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            datasets=datasets,
+        )

@@ -32,7 +32,7 @@ class GroupManager:
         self._lock = threading.Lock()
 
     def create_group(self, backend: Backend, world_size: int, rank: int,
-                     group_name: str):
+                     group_name: str, store_key: str = ""):
         from ray_tpu.util.collective.collective_group.cpu_group import CPUGroup
         from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
 
@@ -42,7 +42,7 @@ class GroupManager:
                 raise RuntimeError(
                     f"Collective group {group_name!r} already initialized in "
                     f"this process")
-            g = cls(world_size, rank, group_name)
+            g = cls(world_size, rank, group_name, store_key)
             self._groups[group_name] = g
             return g
 
@@ -104,13 +104,14 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "xla",
-                          group_name: str = "default"):
+                          group_name: str = "default",
+                          store_key: str = ""):
     """Initialize this process's membership in a collective group
     (reference: collective.py:120). Call once per member, same order args."""
     if not (0 <= rank < world_size):
         raise ValueError(f"rank {rank} out of range [0, {world_size})")
     return _group_mgr.create_group(
-        Backend.coerce(backend), world_size, rank, group_name)
+        Backend.coerce(backend), world_size, rank, group_name, store_key)
 
 
 def create_collective_group(actors: List[Any], world_size: int,

@@ -40,13 +40,17 @@ def _reduce_arrays(arrays: List[np.ndarray], op: ReduceOp) -> np.ndarray:
 
 
 class CPUGroup(BaseGroup):
-    def __init__(self, world_size: int, rank: int, group_name: str):
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 store_key: str = ""):
+        """``store_key`` isolates incarnations of a logical group: a
+        restarted worker group must not see a dead predecessor's staged
+        contributions (same op sequence numbers would collide)."""
         super().__init__(world_size, rank, group_name)
         import ray_tpu
 
         store_cls = ray_tpu.remote(CollectiveStore)
         self._store = store_cls.options(
-            name=f"_collective_store:{group_name}",
+            name=f"_collective_store:{store_key or group_name}",
             get_if_exists=True,
             lifetime="detached",
         ).remote()
@@ -98,7 +102,8 @@ class CPUGroup(BaseGroup):
         return np.asarray(tensor)
 
     def _from_wire(self, array: np.ndarray, like):
-        if isinstance(like, np.ndarray) and like.shape == array.shape:
+        if (isinstance(like, np.ndarray) and like.shape == array.shape
+                and like.flags.writeable):
             np.copyto(like, array.astype(like.dtype, copy=False))
             return like
         return array
