@@ -97,6 +97,21 @@ class TPUAcceleratorManager(AcceleratorManager):
         return CONFIG.tpu_chips_per_host_default
 
     @staticmethod
+    def chips_per_host_for_topology(topology: str) -> Optional[int]:
+        """Chips per host for a named slice topology (e.g. "v5e-8" → 8,
+        "v5p-16" → 4). Single-host v5e slices put all chips on one host;
+        multi-host slices are 4 chips/host across generations
+        (reference: tpu.py pod-type accounting, tpu.py:198-287)."""
+        try:
+            gen, total_s = topology.rsplit("-", 1)
+            total = int(total_s)
+        except ValueError:
+            return None
+        if gen.lower() in ("v5e", "v5litepod", "v6e") and total <= 8:
+            return total
+        return min(total, 4)
+
+    @staticmethod
     def validate_resource_request_quantity(quantity: float) -> Tuple[bool, Optional[str]]:
         if quantity != int(quantity):
             return False, "TPU request must be a whole number of chips"

@@ -39,7 +39,17 @@ class ScalingConfig:
     def chips_per_worker(self) -> int:
         if self.resources_per_worker and "TPU" in self.resources_per_worker:
             return int(self.resources_per_worker["TPU"])
-        return 4 if self.use_tpu else 0
+        if not self.use_tpu:
+            return 0
+        if self.topology:
+            from ray_tpu._private.accelerators.tpu import (
+                TPUAcceleratorManager)
+
+            chips = TPUAcceleratorManager.chips_per_host_for_topology(
+                self.topology)
+            if chips:
+                return chips
+        return 4
 
     def as_placement_group_bundles(self) -> list:
         return [self._resources() for _ in range(self.num_workers)]

@@ -1,0 +1,100 @@
+"""ray_tpu.data — streaming distributed datasets (reference:
+python/ray/data/read_api.py public surface).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import aggregate
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy, Dataset, GroupedData, MaterializedDataset, from_blocks)
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data._internal.logical import Read
+from ray_tpu.data import datasource as _ds
+
+__all__ = [
+    "Dataset", "MaterializedDataset", "DataIterator", "GroupedData",
+    "ActorPoolStrategy", "BlockAccessor", "BlockMetadata", "aggregate",
+    "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
+    "from_arrow", "from_blocks", "read_parquet", "read_csv", "read_json",
+    "read_text", "read_binary_files", "read_numpy", "read_datasource",
+]
+
+_builtin_range = range
+
+
+def read_datasource(source: _ds.Datasource, *,
+                    parallelism: int = 8) -> Dataset:
+    return Dataset(Read(source.get_read_tasks(parallelism),
+                        name=source.name))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return read_datasource(_ds.RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: int = 8) -> Dataset:
+    return read_datasource(
+        _ds.RangeDatasource(n, tensor_shape=tuple(shape), column="data"),
+        parallelism=parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return read_datasource(_ds.ItemsDatasource(list(items)),
+                           parallelism=parallelism)
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return from_blocks([{column: a} for a in arrays])
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return from_blocks([
+        pa.Table.from_pandas(df, preserve_index=False) for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return from_blocks(list(tables))
+
+
+def read_parquet(paths, *, parallelism: int = 8, **kw) -> Dataset:
+    return read_datasource(_ds.ParquetDatasource(paths, **kw),
+                           parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = 8, **kw) -> Dataset:
+    return read_datasource(_ds.CSVDatasource(paths, **kw),
+                           parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = 8, **kw) -> Dataset:
+    return read_datasource(_ds.JSONDatasource(paths, **kw),
+                           parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = 8) -> Dataset:
+    return read_datasource(_ds.TextDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
+    return read_datasource(_ds.BinaryDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = 8) -> Dataset:
+    return read_datasource(_ds.NumpyDatasource(paths),
+                           parallelism=parallelism)

@@ -1,0 +1,168 @@
+"""Streaming executor.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py —
+a daemon thread runs a scheduling loop (``_scheduling_loop_step``
+:241) that polls operator completions, moves bundles downstream, and
+dispatches new tasks on the operator chosen by
+``select_operator_to_run`` (streaming_executor_state.py:501) under
+backpressure. We keep the same shape: bounded in-flight work per operator,
+bounded final-output buffer so a slow consumer (the training loop) throttles
+upstream reads instead of buffering the dataset in RAM.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.data._internal.physical import (
+    PhysicalOperator, RefBundle, UnionOperator, ZipOperator)
+
+
+class Topology:
+    """Operators in topological order plus edges (who feeds whom)."""
+
+    def __init__(self):
+        self.ops: List[PhysicalOperator] = []
+        self.edges: Dict[int, List[Tuple[int, str]]] = {}  # src -> (dst, port)
+
+    def add(self, op: PhysicalOperator) -> int:
+        self.ops.append(op)
+        return len(self.ops) - 1
+
+    def connect(self, src: int, dst: int, port: str = "in") -> None:
+        self.edges.setdefault(src, []).append((dst, port))
+
+    @property
+    def sink(self) -> PhysicalOperator:
+        return self.ops[-1]
+
+
+class ExecutorStats:
+    def __init__(self):
+        self.start_time = time.perf_counter()
+        self.wall_s = 0.0
+        self.per_op: List[Dict] = []
+
+    def summary(self) -> str:
+        lines = [f"Dataset execution: {self.wall_s:.3f}s wall"]
+        for rec in self.per_op:
+            lines.append(
+                f"  {rec['name']}: {rec['tasks']} tasks, "
+                f"{rec['rows']} rows, {rec['exec_s']:.3f}s task time")
+        return "\n".join(lines)
+
+
+class StreamingExecutor:
+    """Drives a Topology on a daemon thread; final bundles land in a bounded
+    queue consumed by ``iter_bundles``."""
+
+    OUTPUT_BUFFER = 16
+    POLL_INTERVAL = 0.003
+
+    def __init__(self, topology: Topology, stats: Optional[ExecutorStats] = None):
+        self.topology = topology
+        self.out: "queue.Queue[Optional[RefBundle]]" = queue.Queue()
+        self.error: Optional[BaseException] = None
+        self.stats = stats or ExecutorStats()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="raytpu-data-exec")
+
+    def start(self) -> "StreamingExecutor":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        for op in self.topology.ops:
+            if hasattr(op, "shutdown"):
+                op.shutdown()
+
+    # ---------------------------------------------------------------- loop
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                progressed = self._step()
+                if self._all_done():
+                    break
+                if not progressed:
+                    time.sleep(self.POLL_INTERVAL)
+        except BaseException as e:  # surfaced via iter_bundles
+            self.error = e
+        finally:
+            self._record_stats()
+            self.out.put(None)
+
+    def _step(self) -> bool:
+        progressed = False
+        ops = self.topology.ops
+        # 1. poll completions + propagate outputs downstream.
+        for i, op in enumerate(ops):
+            op.poll()
+            while op.output_queue:
+                bundle = op.output_queue.popleft()
+                dsts = self.topology.edges.get(i, [])
+                if not dsts:
+                    self.out.put(bundle)
+                for dst, port in dsts:
+                    target = ops[dst]
+                    if isinstance(target, ZipOperator) and port == "right":
+                        target.add_right(bundle)
+                    elif isinstance(target, ZipOperator):
+                        target.add_left(bundle)
+                    else:
+                        target.input_queue.append(bundle)
+                progressed = True
+            # propagate completion edges
+            if op.completed():
+                for dst, port in self.topology.edges.get(i, []):
+                    target = ops[dst]
+                    if isinstance(target, UnionOperator):
+                        if not getattr(op, f"_union_done_{dst}", False):
+                            setattr(op, f"_union_done_{dst}", True)
+                            target.branch_done()
+                    elif isinstance(target, ZipOperator):
+                        if port == "right":
+                            target._right_done = True
+                        else:
+                            target._left_done = True
+                    else:
+                        target.inputs_complete = True
+        # 2. backpressure: hold dispatch when the consumer lags.
+        if self.out.qsize() >= self.OUTPUT_BUFFER:
+            return progressed
+        # 3. dispatch — most-downstream runnable op first, so the pipeline
+        #    drains toward the consumer (reference: select_operator_to_run
+        #    prefers ops with less queued output).
+        for op in reversed(ops):
+            while op.can_dispatch():
+                op.dispatch()
+                progressed = True
+                if self.out.qsize() >= self.OUTPUT_BUFFER:
+                    return True
+        return progressed
+
+    def _all_done(self) -> bool:
+        return all(op.completed() for op in self.topology.ops) and not any(
+            op.output_queue for op in self.topology.ops)
+
+    def _record_stats(self):
+        self.stats.wall_s = time.perf_counter() - self.stats.start_time
+        self.stats.per_op = [
+            {"name": op.name, "tasks": op.tasks_launched,
+             "rows": op.rows_out, "exec_s": op.exec_time_s}
+            for op in self.topology.ops]
+
+    # ------------------------------------------------------------- consume
+    def iter_bundles(self):
+        while True:
+            bundle = self.out.get()
+            if bundle is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield bundle

@@ -1,0 +1,402 @@
+"""Physical operators for the streaming executor.
+
+Reference: python/ray/data/_internal/execution/operators/ —
+``TaskPoolMapOperator``, ``ActorPoolMapOperator``, ``InputDataBuffer``,
+limit/union/zip, and the all-to-all planner (_internal/planner/). Blocks flow
+as ``RefBundle``s (block ObjectRef + metadata); payloads stay in the object
+store and only metadata crosses the executor.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data._internal.logical import MapSpec
+
+
+class RefBundle:
+    __slots__ = ("block_ref", "meta")
+
+    def __init__(self, block_ref, meta: BlockMetadata):
+        self.block_ref = block_ref
+        self.meta = meta
+
+
+# --------------------------------------------------------------------- UDFs
+def _apply_specs(specs: List[MapSpec], block: Block) -> Block:
+    """Run a fused chain of transforms over one block inside a task."""
+    acc = BlockAccessor(block)
+    for spec in specs:
+        fn = spec.fn
+        kwargs = spec.fn_kwargs or {}
+        if spec.kind == "batches":
+            batch = acc.to_batch(spec.batch_format)
+            out = fn(batch, *spec.fn_args, **kwargs)
+            block = BlockAccessor.batch_to_block(out)
+        elif spec.kind == "rows":
+            rows = [fn(r, *spec.fn_args, **kwargs) for r in acc.iter_rows()]
+            block = BlockAccessor.rows_to_block(rows)
+        elif spec.kind == "flat":
+            rows = []
+            for r in acc.iter_rows():
+                rows.extend(fn(r, *spec.fn_args, **kwargs))
+            block = BlockAccessor.rows_to_block(rows)
+        elif spec.kind == "filter":
+            keep = np.asarray(
+                [bool(fn(r, *spec.fn_args, **kwargs))
+                 for r in acc.iter_rows()])
+            idx = np.nonzero(keep)[0]
+            block = acc.take_indices(idx)
+        else:
+            raise ValueError(f"unknown map kind {spec.kind!r}")
+        acc = BlockAccessor(block)
+    return block
+
+
+def _map_task(specs: List[MapSpec], block: Block):
+    t0 = time.perf_counter()
+    out = _apply_specs(specs, block)
+    meta = BlockAccessor(out).metadata(exec_time_s=time.perf_counter() - t0)
+    return out, meta
+
+
+def _read_task(read_fn: Callable[[], Any], specs: List[MapSpec]):
+    """Run a datasource read and any fused transforms; one output block."""
+    t0 = time.perf_counter()
+    out = read_fn()
+    blocks = list(out) if isinstance(out, (list, tuple)) else [out]
+    blocks = [BlockAccessor.batch_to_block(b) if isinstance(b, (dict, list))
+              else b for b in blocks]
+    block = BlockAccessor.concat(blocks) if len(blocks) != 1 else blocks[0]
+    if specs:
+        block = _apply_specs(specs, block)
+    meta = BlockAccessor(block).metadata(exec_time_s=time.perf_counter() - t0)
+    return block, meta
+
+
+class _MapWorker:
+    """Actor for class-based UDFs (reference: ActorPoolMapOperator's
+    _MapWorker). The UDF class is constructed once per actor; batches stream
+    through it — the pattern for carrying an expensive jitted model."""
+
+    def __init__(self, fn_cls_blob: bytes, args: tuple, kwargs: dict):
+        import cloudpickle
+
+        cls = cloudpickle.loads(fn_cls_blob)
+        self._udf = cls(*args, **(kwargs or {}))
+
+    def map(self, specs: List[MapSpec], block: Block):
+        specs = [MapSpec(**{**s.__dict__, "fn": self._udf})
+                 if s.fn is None else s for s in specs]
+        return _map_task(specs, block)
+
+    def ready(self):
+        return True
+
+
+# ---------------------------------------------------------------- operators
+class PhysicalOperator:
+    """Base: pull bundles from ``input_queue``, expose them on
+    ``output_queue``. The executor wires queues and drives ``poll``/
+    ``dispatch``."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.input_queue: collections.deque = collections.deque()
+        self.output_queue: collections.deque = collections.deque()
+        self.inputs_complete = False
+        self.rows_out = 0
+        self.exec_time_s = 0.0
+        self.tasks_launched = 0
+
+    # --- scheduling interface
+    def num_active_tasks(self) -> int:
+        return 0
+
+    def can_dispatch(self) -> bool:
+        return bool(self.input_queue)
+
+    def dispatch(self) -> None:
+        raise NotImplementedError
+
+    def poll(self) -> None:
+        pass
+
+    def all_inputs_done(self) -> None:
+        self.inputs_complete = True
+
+    def completed(self) -> bool:
+        return (self.inputs_complete and not self.input_queue
+                and self.num_active_tasks() == 0)
+
+    def _emit(self, bundle: RefBundle) -> None:
+        self.rows_out += bundle.meta.num_rows
+        self.exec_time_s += bundle.meta.exec_time_s
+        self.output_queue.append(bundle)
+
+
+class InputDataBuffer(PhysicalOperator):
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("Input")
+        self.output_queue.extend(bundles)
+        self.inputs_complete = True
+
+    def can_dispatch(self) -> bool:
+        return False
+
+    def completed(self) -> bool:
+        return True
+
+
+class TaskPoolMapOperator(PhysicalOperator):
+    """Map via stateless tasks; also hosts fused Read stages
+    (reference: operators/task_pool_map_operator.py)."""
+
+    def __init__(self, name: str, specs: List[MapSpec],
+                 read_tasks: Optional[List[Callable]] = None,
+                 max_concurrency: int = 8,
+                 ray_remote_args: Optional[Dict] = None):
+        super().__init__(name)
+        self.specs = specs
+        self.max_concurrency = max_concurrency
+        self.ray_remote_args = dict(ray_remote_args or {})
+        self._inflight: List[Tuple[Any, Any]] = []  # (block_ref, meta_ref)
+        if read_tasks is not None:
+            self.input_queue.extend(read_tasks)
+            self.inputs_complete = True
+        self._is_read = read_tasks is not None
+
+    def num_active_tasks(self) -> int:
+        return len(self._inflight)
+
+    def can_dispatch(self) -> bool:
+        return bool(self.input_queue) and len(self._inflight) < self.max_concurrency
+
+    def dispatch(self) -> None:
+        item = self.input_queue.popleft()
+        opts = {"num_returns": 2, "name": f"Data::{self.name}",
+                **self.ray_remote_args}
+        if self._is_read:
+            refs = ray_tpu.remote(_read_task).options(**opts).remote(
+                item, self.specs)
+        else:
+            refs = ray_tpu.remote(_map_task).options(**opts).remote(
+                self.specs, item.block_ref)
+        self.tasks_launched += 1
+        self._inflight.append((refs[0], refs[1]))
+
+    def poll(self) -> None:
+        # Emit strictly in dispatch order so downstream zip/take see blocks
+        # in input order (reference: execution_options.preserve_order).
+        while self._inflight:
+            block_ref, meta_ref = self._inflight[0]
+            ready, _ = ray_tpu.wait([meta_ref], num_returns=1, timeout=0)
+            if not ready:
+                return
+            self._inflight.pop(0)
+            meta = ray_tpu.get(meta_ref)  # raises on task error
+            self._emit(RefBundle(block_ref, meta))
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Map via a fixed pool of UDF actors
+    (reference: operators/actor_pool_map_operator.py)."""
+
+    MAX_TASKS_PER_ACTOR = 2
+
+    def __init__(self, name: str, specs: List[MapSpec], fn_cls,
+                 pool_size: int = 2,
+                 fn_constructor_args: tuple = (),
+                 fn_constructor_kwargs: Optional[dict] = None,
+                 ray_remote_args: Optional[Dict] = None):
+        super().__init__(name)
+        import cloudpickle
+
+        self.specs = [MapSpec(**{**s.__dict__, "fn": None}) for s in specs]
+        self._actors = []
+        self._load: Dict[int, int] = {}
+        blob = cloudpickle.dumps(fn_cls)
+        opts = dict(ray_remote_args or {})
+        actor_cls = ray_tpu.remote(_MapWorker)
+        for i in range(pool_size):
+            a = (actor_cls.options(**opts) if opts else actor_cls).remote(
+                blob, fn_constructor_args, fn_constructor_kwargs or {})
+            self._actors.append(a)
+            self._load[i] = 0
+        self._inflight: List[Tuple[int, Any, Any]] = []
+
+    def num_active_tasks(self) -> int:
+        return len(self._inflight)
+
+    def can_dispatch(self) -> bool:
+        return (bool(self.input_queue)
+                and any(v < self.MAX_TASKS_PER_ACTOR
+                        for v in self._load.values()))
+
+    def dispatch(self) -> None:
+        idx = min(self._load, key=self._load.get)
+        bundle = self.input_queue.popleft()
+        refs = self._actors[idx].map.options(num_returns=2).remote(
+            self.specs, bundle.block_ref)
+        self.tasks_launched += 1
+        self._load[idx] += 1
+        self._inflight.append((idx, refs[0], refs[1]))
+
+    def poll(self) -> None:
+        while self._inflight:
+            idx, block_ref, meta_ref = self._inflight[0]
+            ready, _ = ray_tpu.wait([meta_ref], num_returns=1, timeout=0)
+            if not ready:
+                return
+            self._inflight.pop(0)
+            meta = ray_tpu.get(meta_ref)
+            self._load[idx] -= 1
+            self._emit(RefBundle(block_ref, meta))
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class LimitOperator(PhysicalOperator):
+    def __init__(self, limit: int):
+        super().__init__(f"Limit[{limit}]")
+        self.limit = limit
+        self._taken = 0
+
+    def can_dispatch(self) -> bool:
+        return bool(self.input_queue) and self._taken < self.limit
+
+    def dispatch(self) -> None:
+        bundle = self.input_queue.popleft()
+        remaining = self.limit - self._taken
+        if bundle.meta.num_rows <= remaining:
+            self._taken += bundle.meta.num_rows
+            self._emit(bundle)
+        else:
+            refs = ray_tpu.remote(_slice_task).options(num_returns=2).remote(
+                bundle.block_ref, 0, remaining)
+            meta = ray_tpu.get(refs[1])
+            self._taken += meta.num_rows
+            self._emit(RefBundle(refs[0], meta))
+
+    def poll(self) -> None:
+        if self._taken >= self.limit:
+            self.input_queue.clear()
+            self.inputs_complete = True
+
+    def completed(self) -> bool:
+        return self._taken >= self.limit or super().completed()
+
+
+def _slice_task(block: Block, start: int, end: int):
+    out = BlockAccessor(block).slice(start, end)
+    return out, BlockAccessor(out).metadata()
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier operator: buffers every input bundle, then runs ``bulk_fn``
+    once (reference: planner/exchange/ shuffle task scheme)."""
+
+    def __init__(self, name: str,
+                 bulk_fn: Callable[[List[RefBundle]], List[RefBundle]]):
+        super().__init__(name)
+        self.bulk_fn = bulk_fn
+        self._ran = False
+
+    def can_dispatch(self) -> bool:
+        return self.inputs_complete and not self._ran
+
+    def dispatch(self) -> None:
+        bundles = list(self.input_queue)
+        self.input_queue.clear()
+        t0 = time.perf_counter()
+        for out in self.bulk_fn(bundles):
+            self._emit(out)
+        self.exec_time_s += time.perf_counter() - t0
+        self._ran = True
+
+    def completed(self) -> bool:
+        return self._ran
+
+
+class UnionOperator(PhysicalOperator):
+    """Pass-through merge of several upstream branches; the executor wires
+    every branch's output here."""
+
+    def __init__(self, n_branches: int):
+        super().__init__("Union")
+        self._branches_done = 0
+        self.n_branches = n_branches
+
+    def can_dispatch(self) -> bool:
+        return bool(self.input_queue)
+
+    def dispatch(self) -> None:
+        self._emit(self.input_queue.popleft())
+
+    def branch_done(self) -> None:
+        self._branches_done += 1
+        if self._branches_done >= self.n_branches:
+            self.inputs_complete = True
+
+
+class ZipOperator(PhysicalOperator):
+    """Barrier zip of two branches by row position."""
+
+    def __init__(self):
+        super().__init__("Zip")
+        self.left: List[RefBundle] = []
+        self.right: List[RefBundle] = []
+        self._left_done = False
+        self._right_done = False
+        self._ran = False
+
+    def add_left(self, b: RefBundle):
+        self.left.append(b)
+
+    def add_right(self, b: RefBundle):
+        self.right.append(b)
+
+    def can_dispatch(self) -> bool:
+        return self._left_done and self._right_done and not self._ran
+
+    def dispatch(self) -> None:
+        lrefs = [b.block_ref for b in self.left]
+        rrefs = [b.block_ref for b in self.right]
+        refs = ray_tpu.remote(_zip_task).options(num_returns=2).remote(
+            lrefs, rrefs)
+        self._emit(RefBundle(refs[0], ray_tpu.get(refs[1])))
+        self._ran = True
+
+    def completed(self) -> bool:
+        return self._ran
+
+
+def _zip_task(left_refs, right_refs):
+    lblocks = [ray_tpu.get(r) for r in left_refs]
+    rblocks = [ray_tpu.get(r) for r in right_refs]
+    lb = BlockAccessor.concat(lblocks)
+    rb = BlockAccessor.concat(rblocks)
+    la, ra = BlockAccessor(lb), BlockAccessor(rb)
+    if la.num_rows() != ra.num_rows():
+        raise ValueError(
+            f"zip: datasets have different row counts "
+            f"({la.num_rows()} vs {ra.num_rows()})")
+    ld, rd = la.to_numpy_dict(), ra.to_numpy_dict()
+    for k, v in rd.items():
+        name = k
+        while name in ld:
+            name = name + "_1"
+        ld[name] = v
+    out = BlockAccessor.batch_to_block(ld)
+    return out, BlockAccessor(out).metadata()

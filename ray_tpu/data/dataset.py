@@ -1,0 +1,491 @@
+"""Dataset: the user-facing lazy, streaming dataset.
+
+Reference: python/ray/data/dataset.py (5.1k LoC: map_batches, iter_batches
+:3599, materialize :4498). A Dataset is an immutable logical-operator chain;
+execution happens on consumption through the streaming executor
+(SURVEY §3.6 call stack).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import aggregate as agg_mod
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data._internal import logical as L
+from ray_tpu.data._internal.executor import ExecutorStats, StreamingExecutor
+from ray_tpu.data._internal.physical import RefBundle
+from ray_tpu.data._internal.planner import optimize, plan
+from ray_tpu.data.iterator import DataIterator
+
+
+class ActorPoolStrategy:
+    """compute= for class-based UDFs (reference: data/_internal/compute.py)."""
+
+    is_actor_pool = True
+
+    def __init__(self, size: int = 2, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        self.size = min_size or size
+
+
+class Dataset:
+    def __init__(self, last_op: L.LogicalOperator,
+                 max_concurrency: int = 8):
+        self._last_op = last_op
+        self._max_concurrency = max_concurrency
+        self._last_stats: Optional[ExecutorStats] = None
+
+    # ------------------------------------------------------------ transforms
+    def _append(self, op: L.LogicalOperator) -> "Dataset":
+        return Dataset(op, self._max_concurrency)
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_args: tuple = (),
+        fn_kwargs: Optional[dict] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
+        concurrency: Optional[int] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        **ray_remote_args,
+    ) -> "Dataset":
+        if isinstance(fn, type):
+            if compute is None:
+                compute = ActorPoolStrategy(size=concurrency or 2)
+        if num_cpus is not None:
+            ray_remote_args["num_cpus"] = num_cpus
+        if num_tpus is not None:
+            ray_remote_args["num_tpus"] = num_tpus
+        spec = L.MapSpec(kind="batches", fn=fn, fn_args=fn_args,
+                         fn_kwargs=fn_kwargs, batch_size=batch_size,
+                         batch_format=batch_format,
+                         fn_constructor_args=fn_constructor_args,
+                         fn_constructor_kwargs=fn_constructor_kwargs)
+        name = f"MapBatches({getattr(fn, '__name__', type(fn).__name__)})"
+        return self._append(L.AbstractMap(
+            self._last_op, spec, name, compute=compute,
+            ray_remote_args=ray_remote_args))
+
+    def map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        spec = L.MapSpec(kind="rows", fn=fn)
+        return self._append(L.AbstractMap(
+            self._last_op, spec, f"Map({getattr(fn, '__name__', 'fn')})",
+            ray_remote_args=ray_remote_args))
+
+    def flat_map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        spec = L.MapSpec(kind="flat", fn=fn)
+        return self._append(L.AbstractMap(
+            self._last_op, spec, f"FlatMap({getattr(fn, '__name__', 'fn')})",
+            ray_remote_args=ray_remote_args))
+
+    def filter(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        spec = L.MapSpec(kind="filter", fn=fn)
+        return self._append(L.AbstractMap(
+            self._last_op, spec, f"Filter({getattr(fn, '__name__', 'fn')})",
+            ray_remote_args=ray_remote_args))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch, _name=name, _fn=fn):
+            batch[_name] = np.asarray(_fn(batch))
+            return batch
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in cols})
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda b: {k: b[k] for k in cols})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(L.Limit(self._last_op, n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(L.AbstractAllToAll(
+            self._last_op, "repartition", f"Repartition[{num_blocks}]",
+            num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        return self._append(L.AbstractAllToAll(
+            self._last_op, "random_shuffle", "RandomShuffle",
+            seed=seed, num_blocks=num_blocks))
+
+    def sort(self, key: Union[str, List[str]],
+             descending: bool = False) -> "Dataset":
+        return self._append(L.AbstractAllToAll(
+            self._last_op, "sort", f"Sort[{key}]", key=key,
+            descending=descending))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._append(L.Union(
+            self._last_op, [o._last_op for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._append(L.Zip(self._last_op, other._last_op))
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        def sample(batch):
+            import zlib
+
+            n = len(next(iter(batch.values()))) if batch else 0
+            if seed is None:
+                rng = np.random.default_rng()
+            else:
+                # salt by block content: a bare seed would draw the same
+                # mask positions in every block
+                first = next(iter(batch.values()))
+                salt = zlib.crc32(np.ascontiguousarray(first).tobytes()
+                                  if first.dtype != object
+                                  else str(first[:4]).encode())
+                rng = np.random.default_rng((seed, salt))
+            keep = rng.random(n) < fraction
+            return {k: v[keep] for k, v in batch.items()}
+
+        return self.map_batches(sample)
+
+    # -------------------------------------------------------------- writes
+    def write_parquet(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_parquet_fn
+
+        self._consume_write(write_parquet_fn(path), "WriteParquet")
+
+    def write_csv(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_csv_fn
+
+        self._consume_write(write_csv_fn(path), "WriteCSV")
+
+    def write_json(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_json_fn
+
+        self._consume_write(write_json_fn(path), "WriteJSON")
+
+    def _consume_write(self, write_fn, name: str) -> None:
+        ds = self._append(L.Write(self._last_op, write_fn, name))
+        for _ in ds._execute_bundles():
+            pass
+
+    # ----------------------------------------------------------- execution
+    def _execute_bundles(self) -> Iterator[RefBundle]:
+        stats = ExecutorStats()
+        topo = plan(optimize(self._last_op.chain()),
+                    max_concurrency=self._max_concurrency)
+        executor = StreamingExecutor(topo, stats).start()
+        self._last_stats = stats
+        try:
+            yield from executor.iter_bundles()
+        finally:
+            executor.shutdown()
+
+    def iter_internal_ref_bundles(self) -> Iterator[RefBundle]:
+        return self._execute_bundles()
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        for bundle in self._execute_bundles():
+            yield ray_tpu.get(bundle.block_ref)
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._iter_blocks, stats_fn=self.stats)
+
+    # ---------------------------------------------------------- consumption
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_jax_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_jax_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_rows()
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy") -> Any:
+        for b in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=batch_format,
+                prefetch_batches=0):
+            return b
+        return {}
+
+    def count(self) -> int:
+        return sum(b.meta.num_rows for b in self._execute_bundles())
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for bundle in self._execute_bundles():
+            if bundle.meta.schema:
+                return bundle.meta.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s) if s else []
+
+    def to_pandas(self):
+        import pandas as pd
+
+        dfs = [BlockAccessor(b).to_pandas() for b in self._iter_blocks()]
+        if not dfs:
+            return pd.DataFrame()
+        return pd.concat(dfs, ignore_index=True)
+
+    def to_arrow(self):
+        return BlockAccessor(
+            BlockAccessor.concat(list(self._iter_blocks()))).to_arrow()
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = [(b.block_ref, b.meta) for b in self._execute_bundles()]
+        return MaterializedDataset(
+            L.InputData(bundles), self._max_concurrency)
+
+    # simple aggregates
+    def sum(self, on: str):
+        return self.aggregate(agg_mod.Sum(on))
+
+    def min(self, on: str):
+        return self.aggregate(agg_mod.Min(on))
+
+    def max(self, on: str):
+        return self.aggregate(agg_mod.Max(on))
+
+    def mean(self, on: str):
+        return self.aggregate(agg_mod.Mean(on))
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(agg_mod.Std(on, ddof=ddof))
+
+    def aggregate(self, *aggs: agg_mod.AggregateFn):
+        ds = self._append(L.AbstractAllToAll(
+            self._last_op, "global_agg", "Aggregate", aggs=list(aggs)))
+        rows = ds.take_all()
+        row = rows[0] if rows else {}
+        vals = [row.get(a.output_name(None)) for a in aggs]
+        return vals[0] if len(vals) == 1 else tuple(vals)
+
+    # ----------------------------------------------------------- splitting
+    def split(self, n: int, *, equal: bool = False) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        bundles = mat._last_op.bundles
+        if equal:
+            total = sum(m.num_rows for _, m in bundles)
+            per = total // n
+            mat2 = mat.repartition(n) if per else mat
+            rows_target = [per] * n
+            blocks = list(mat2._iter_blocks())
+            merged = BlockAccessor.concat(blocks)
+            acc = BlockAccessor(merged)
+            out = []
+            pos = 0
+            for t in rows_target:
+                out.append(from_blocks([acc.slice(pos, pos + t)]))
+                pos += t
+            return out
+        groups: List[List[Tuple[Any, BlockMetadata]]] = [[] for _ in range(n)]
+        for i, b in enumerate(bundles):
+            groups[i % n].append(b)
+        return [MaterializedDataset(L.InputData(g), self._max_concurrency)
+                for g in groups]
+
+    def streaming_split(self, n: int, *, equality: bool = False,
+                        locality_hints=None) -> List[DataIterator]:
+        """N coordinated iterators backed by one execution (reference:
+        dataset.streaming_split / _internal/execution/operators/
+        output_splitter.py). A coordinator actor runs the stream and hands
+        out bundles round-robin; per-consumer iterators pull from it."""
+        coordinator = _SplitCoordinator.options(name=None).remote(
+            _serialize_plan(self), n)
+
+        def make_block_fn(idx: int):
+            def block_fn():
+                import time as _time
+
+                # client-side epoch counter; the coordinator gates epoch
+                # starts on ALL consumers having drained the previous one.
+                epoch = getattr(block_fn, "_epoch", 0)
+                block_fn._epoch = epoch + 1
+                while True:
+                    ref = ray_tpu.get(coordinator.next_ref.remote(idx, epoch))
+                    if ref is None:
+                        return
+                    if ref == "WAIT":
+                        _time.sleep(0.02)
+                        continue
+                    yield ray_tpu.get(ref)
+
+            return block_fn
+
+        return [DataIterator(make_block_fn(i)) for i in range(n)]
+
+    # ------------------------------------------------------------- misc
+    def stats(self) -> str:
+        return self._last_stats.summary() if self._last_stats else ""
+
+    def num_blocks(self) -> Optional[int]:
+        op = self._last_op
+        if isinstance(op, L.InputData):
+            return len(op.bundles)
+        if isinstance(op, L.Read):
+            return len(op.read_tasks)
+        return None
+
+    def __repr__(self):
+        names = [op.name for op in self._last_op.chain()]
+        return f"Dataset({' -> '.join(names)})"
+
+    # pickling a Dataset ships the logical plan (used by trainers)
+    def __reduce__(self):
+        return (Dataset, (self._last_op, self._max_concurrency))
+
+
+class MaterializedDataset(Dataset):
+    """Fully-executed dataset: blocks pinned in the object store."""
+
+    def count(self) -> int:
+        return sum(m.num_rows for _, m in self._last_op.bundles)
+
+
+class GroupedData:
+    """Reference: python/ray/data/grouped_data.py."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dataset:
+        return self._ds._append(L.AbstractAllToAll(
+            self._ds._last_op, "groupby_agg", f"GroupBy[{self._key}]",
+            key=self._key, aggs=list(aggs)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(agg_mod.Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Mean(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Sort by key, then apply fn per contiguous group."""
+        key = self._key
+
+        def apply_groups(batch):
+            col = batch[key]
+            uniq, inverse = np.unique(col, return_inverse=True)
+            outs = []
+            for g in range(len(uniq)):
+                mask = inverse == g
+                out = fn({k: v[mask] for k, v in batch.items()})
+                outs.append(out)
+            merged: Dict[str, list] = {}
+            for o in outs:
+                for k, v in o.items():
+                    merged.setdefault(k, []).append(np.asarray(v))
+            return {k: np.concatenate(v) for k, v in merged.items()}
+
+        return self._ds.repartition(1).map_batches(apply_groups)
+
+
+# ---------------------------------------------------------- split coordinator
+def _serialize_plan(ds: Dataset) -> bytes:
+    import cloudpickle
+
+    return cloudpickle.dumps(ds)
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Owns one streaming execution per epoch; consumers pull bundles
+    round-robin. Epoch e+1 starts only after every consumer drained epoch e
+    — a consumer arriving early gets "WAIT" so it never observes an empty
+    epoch (reference: output_splitter.py's epoch barrier)."""
+
+    def __init__(self, plan_blob: bytes, n: int):
+        import cloudpickle
+
+        self._ds: Dataset = cloudpickle.loads(plan_blob)
+        self._n = n
+        self._epoch = -1
+        self._gen = None
+        self._queues: List[List] = [[] for _ in range(n)]
+        self._done = False
+        self._rr = 0
+        self._finished = set(range(n))  # everyone "drained" epoch -1
+        # Refs stay pinned here after hand-out: this actor owns the blocks,
+        # so dropping them before the consumer fetches would lose the object.
+        self._hold: List = []
+
+    def _start_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._gen = self._ds._execute_bundles()
+        self._queues = [[] for _ in range(self._n)]
+        self._hold = []
+        self._done = False
+        self._rr = 0
+        self._finished = set()
+
+    def next_ref(self, idx: int, epoch: int):
+        if epoch > self._epoch:
+            if len(self._finished) == self._n:
+                self._start_epoch(epoch)
+            else:
+                return "WAIT"  # peers still draining the previous epoch
+        elif epoch < self._epoch:
+            return None  # stale consumer; its epoch is gone
+        while not self._queues[idx] and not self._done:
+            try:
+                bundle = next(self._gen)
+            except StopIteration:
+                self._done = True
+                break
+            self._queues[self._rr].append(bundle.block_ref)
+            self._rr = (self._rr + 1) % self._n
+        if self._queues[idx]:
+            ref = self._queues[idx].pop(0)
+            self._hold.append(ref)
+            return ref
+        if self._done and not self._queues[idx]:
+            self._finished.add(idx)
+        return None
+
+
+def from_blocks(blocks: List[Block]) -> MaterializedDataset:
+    bundles = []
+    for b in blocks:
+        acc = BlockAccessor(b)
+        bundles.append((ray_tpu.put(b), acc.metadata()))
+    return MaterializedDataset(L.InputData(bundles))

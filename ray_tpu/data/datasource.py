@@ -1,0 +1,229 @@
+"""Datasources: each produces a list of read tasks (closures returning
+blocks), one per file/fragment, so reads parallelize as tasks
+(reference: python/ray/data/datasource/ + read_api.py).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, "**", f"*{suffix}" if suffix else "*")
+            out.extend(sorted(f for f in glob.glob(pat, recursive=True)
+                              if os.path.isfile(f)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+class Datasource:
+    """ABC (reference: datasource/datasource.py Datasource/Reader)."""
+
+    name = "Datasource"
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable[[], Any]]:
+        raise NotImplementedError
+
+
+class RangeDatasource(Datasource):
+    name = "Range"
+
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None,
+                 column: str = "id"):
+        self.n = n
+        self.tensor_shape = tensor_shape
+        self.column = column
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable[[], Any]]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        tasks = []
+        per = (self.n + parallelism - 1) // parallelism if self.n else 0
+        for i in range(parallelism):
+            lo, hi = i * per, min((i + 1) * per, self.n)
+            if lo >= hi and self.n > 0:
+                continue
+            shape, col = self.tensor_shape, self.column
+
+            def read(lo=lo, hi=hi):
+                ids = np.arange(lo, hi, dtype=np.int64)
+                if shape is None:
+                    return {col: ids}
+                data = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(shape)),
+                    (hi - lo,) + shape).astype(np.float64)
+                return {col: np.ascontiguousarray(data)}
+
+            tasks.append(read)
+        return tasks or [lambda: {self.column: np.asarray([], np.int64)}]
+
+
+class ItemsDatasource(Datasource):
+    name = "FromItems"
+
+    def __init__(self, items: List[Any]):
+        self.items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable[[], Any]]:
+        from ray_tpu.data.block import BlockAccessor
+
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        per = (n + parallelism - 1) // parallelism if n else 0
+        tasks = []
+        for i in range(parallelism):
+            chunk = self.items[i * per:(i + 1) * per]
+            if not chunk and n > 0:
+                continue
+            tasks.append(lambda chunk=chunk: BlockAccessor.rows_to_block(chunk))
+        return tasks or [lambda: BlockAccessor.rows_to_block([])]
+
+
+class FileDatasource(Datasource):
+    """One read task per file."""
+
+    suffix: Optional[str] = None
+
+    def __init__(self, paths, **read_kwargs):
+        self.paths = _expand_paths(paths, self.suffix)
+        self.read_kwargs = read_kwargs
+
+    def read_file(self, path: str) -> Any:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable[[], Any]]:
+        return [lambda p=p: self.read_file(p) for p in self.paths]
+
+
+class ParquetDatasource(FileDatasource):
+    name = "ReadParquet"
+    suffix = ".parquet"
+
+    def read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, **self.read_kwargs)
+
+
+class CSVDatasource(FileDatasource):
+    name = "ReadCSV"
+    suffix = ".csv"
+
+    def read_file(self, path: str):
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path, **self.read_kwargs)
+
+
+class JSONDatasource(FileDatasource):
+    name = "ReadJSON"
+    suffix = ".json"
+
+    def read_file(self, path: str):
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path, **self.read_kwargs)
+
+
+class TextDatasource(FileDatasource):
+    name = "ReadText"
+    suffix = None
+
+    def read_file(self, path: str):
+        with open(path, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return {"text": np.asarray(lines, dtype=object)}
+
+
+class BinaryDatasource(FileDatasource):
+    name = "ReadBinary"
+    suffix = None
+
+    def read_file(self, path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        return {"bytes": np.asarray([data], dtype=object),
+                "path": np.asarray([path], dtype=object)}
+
+
+class NumpyDatasource(FileDatasource):
+    name = "ReadNumpy"
+    suffix = ".npy"
+
+    def read_file(self, path: str):
+        return {"data": np.load(path)}
+
+
+# ------------------------------------------------------------------ writers
+def write_parquet_fn(path: str):
+    os.makedirs(path, exist_ok=True)
+
+    def write(batch):
+        import uuid
+
+        import pyarrow.parquet as pq
+
+        from ray_tpu.data.block import BlockAccessor
+
+        table = BlockAccessor(BlockAccessor.batch_to_block(batch)).to_arrow()
+        fn = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.parquet")
+        pq.write_table(table, fn)
+        return {"path": np.asarray([fn], dtype=object),
+                "num_rows": np.asarray([table.num_rows])}
+
+    return write
+
+
+def write_csv_fn(path: str):
+    os.makedirs(path, exist_ok=True)
+
+    def write(batch):
+        import uuid
+
+        import pyarrow.csv as pacsv
+
+        from ray_tpu.data.block import BlockAccessor
+
+        table = BlockAccessor(BlockAccessor.batch_to_block(batch)).to_arrow()
+        fn = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.csv")
+        pacsv.write_csv(table, fn)
+        return {"path": np.asarray([fn], dtype=object),
+                "num_rows": np.asarray([table.num_rows])}
+
+    return write
+
+
+def write_json_fn(path: str):
+    os.makedirs(path, exist_ok=True)
+
+    def write(batch):
+        import json
+        import uuid
+
+        from ray_tpu.data.block import BlockAccessor
+
+        acc = BlockAccessor(BlockAccessor.batch_to_block(batch))
+        fn = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.json")
+        with open(fn, "w") as f:
+            for row in acc.iter_rows():
+                f.write(json.dumps(
+                    {k: (v.tolist() if isinstance(v, np.ndarray)
+                         else v.item() if isinstance(v, np.generic) else v)
+                     for k, v in row.items()}) + "\n")
+        return {"path": np.asarray([fn], dtype=object),
+                "num_rows": np.asarray([acc.num_rows()])}
+
+    return write

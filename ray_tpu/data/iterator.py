@@ -1,0 +1,206 @@
+"""DataIterator: batch-level consumption of a block stream.
+
+Reference: python/ray/data/iterator.py (iter_batches, iter_torch_batches).
+TPU-first addition: ``iter_jax_batches`` ships each batch to device —
+optionally onto a ``NamedSharding`` so a data-parallel mesh gets its
+per-device shards directly — with background prefetch so host→HBM transfer
+overlaps the train step.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class _Batcher:
+    """Re-chunk a stream of blocks into fixed-size batches."""
+
+    def __init__(self, batch_size: Optional[int], drop_last: bool = False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._buffer: collections.deque = collections.deque()
+        self._buffered_rows = 0
+
+    def add(self, block: Block) -> None:
+        n = BlockAccessor(block).num_rows()
+        if n:
+            self._buffer.append(block)
+            self._buffered_rows += n
+
+    def next_batches(self, final: bool = False) -> Iterator[Block]:
+        bs = self.batch_size
+        if bs is None:
+            while self._buffer:
+                self._buffered_rows -= BlockAccessor(
+                    self._buffer[0]).num_rows()
+                yield self._buffer.popleft()
+            return
+        while self._buffered_rows >= bs:
+            yield self._take(bs)
+        if final and self._buffered_rows and not self.drop_last:
+            yield self._take(self._buffered_rows)
+
+    def _take(self, n: int) -> Block:
+        parts = []
+        got = 0
+        while got < n:
+            block = self._buffer.popleft()
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            if got + rows <= n:
+                parts.append(block)
+                got += rows
+            else:
+                need = n - got
+                parts.append(acc.slice(0, need))
+                self._buffer.appendleft(acc.slice(need, rows))
+                got = n
+        self._buffered_rows -= n
+        return BlockAccessor.concat(parts)
+
+
+class DataIterator:
+    """Iterates batches over a (re-executable) stream of blocks."""
+
+    def __init__(self, block_fn: Callable[[], Iterator[Block]],
+                 stats_fn: Optional[Callable[[], str]] = None):
+        self._block_fn = block_fn
+        self._stats_fn = stats_fn
+
+    def iter_blocks(self) -> Iterator[Block]:
+        return self._block_fn()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+        prefetch_batches: int = 1,
+    ) -> Iterator[Any]:
+        def gen():
+            batcher = _Batcher(batch_size, drop_last)
+            shuffler = (_LocalShuffler(local_shuffle_buffer_size,
+                                       local_shuffle_seed)
+                        if local_shuffle_buffer_size else None)
+            source = self.iter_blocks()
+            if shuffler is not None:
+                source = shuffler.shuffle(source)
+            for block in source:
+                batcher.add(block)
+                for b in batcher.next_batches():
+                    yield BlockAccessor(b).to_batch(batch_format)
+            for b in batcher.next_batches(final=True):
+                yield BlockAccessor(b).to_batch(batch_format)
+
+        if prefetch_batches and prefetch_batches > 0:
+            return _prefetch(gen(), prefetch_batches)
+        return gen()
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        dtypes: Optional[Dict[str, Any]] = None,
+        sharding=None,
+        device=None,
+        drop_last: bool = True,
+        local_shuffle_buffer_size: Optional[int] = None,
+        prefetch_batches: int = 2,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as device-resident ``jax.Array``s.
+
+        ``sharding`` may be a ``jax.sharding.Sharding`` (e.g. NamedSharding
+        over the mesh's data axis) applied to every column; ``drop_last``
+        defaults True because XLA recompiles on shape change.
+        """
+        import jax
+
+        def to_device(batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+            out = {}
+            for k, v in batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                if sharding is not None:
+                    out[k] = jax.device_put(v, sharding)
+                elif device is not None:
+                    out[k] = jax.device_put(v, device)
+                else:
+                    out[k] = jax.device_put(v)
+            return out
+
+        it = self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            prefetch_batches=0)
+        return _prefetch(map(to_device, it), prefetch_batches)
+
+    def materialize(self):
+        from ray_tpu.data.dataset import from_blocks
+
+        return from_blocks(list(self.iter_blocks()))
+
+    def stats(self) -> str:
+        return self._stats_fn() if self._stats_fn else ""
+
+
+class _LocalShuffler:
+    def __init__(self, buffer_rows: int, seed: Optional[int]):
+        self.buffer_rows = buffer_rows
+        self.rng = np.random.default_rng(seed)
+
+    def shuffle(self, blocks: Iterator[Block]) -> Iterator[Block]:
+        held = []
+        held_rows = 0
+        for block in blocks:
+            held.append(block)
+            held_rows += BlockAccessor(block).num_rows()
+            if held_rows >= self.buffer_rows:
+                merged = BlockAccessor.concat(held)
+                acc = BlockAccessor(merged)
+                yield acc.take_indices(self.rng.permutation(acc.num_rows()))
+                held, held_rows = [], 0
+        if held:
+            merged = BlockAccessor.concat(held)
+            acc = BlockAccessor(merged)
+            yield acc.take_indices(self.rng.permutation(acc.num_rows()))
+
+
+def _prefetch(it: Iterator, depth: int) -> Iterator:
+    """Run the source iterator on a thread, buffering ``depth`` items."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    DONE = object()
+    err: list = []
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:
+            err.append(e)
+        finally:
+            q.put(DONE)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is DONE:
+            if err:
+                raise err[0]
+            return
+        yield item

@@ -122,6 +122,8 @@ class BackendExecutor:
              for i in live],
             timeout=timeout)
         results = [TrainingResult.from_wire(d) for d in wire]
+        for i, r in zip(live, results):
+            r.world_rank = self._ranks[i]["world_rank"]
         errors = [r for r in results if r.kind == TrainingResult.ERROR]
         if errors:
             raise TrainingWorkerError(errors[0].error)

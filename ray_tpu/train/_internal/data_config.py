@@ -1,0 +1,52 @@
+"""DataConfig: how Datasets are split across train workers
+(reference: python/ray/train/_internal/data_config.py).
+
+Datasets named in ``datasets_to_split`` (default: just ``"train"``) are
+streaming-split into one coordinated iterator per worker; all others are
+replicated (each worker gets its own full iterator over the same plan).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+
+class DataConfig:
+    def __init__(self,
+                 datasets_to_split: Union[str, List[str]] = "train",
+                 enable_streaming: bool = True):
+        if isinstance(datasets_to_split, str) and datasets_to_split != "all":
+            datasets_to_split = [datasets_to_split]
+        self.datasets_to_split = datasets_to_split
+        self.enable_streaming = enable_streaming
+
+    def _should_split(self, name: str) -> bool:
+        if self.datasets_to_split == "all":
+            return True
+        return name in self.datasets_to_split
+
+    def configure(self, datasets: Dict[str, Any],
+                  num_workers: int) -> Optional[List[Dict[str, Any]]]:
+        """Returns per-worker shard dicts. Values are ``DataIterator``s for
+        ray_tpu Datasets, or the raw object (replicated) otherwise."""
+        if not datasets:
+            return None
+        shards: List[Dict[str, Any]] = [dict() for _ in range(num_workers)]
+        for name, ds in datasets.items():
+            is_dataset = hasattr(ds, "streaming_split")
+            if is_dataset and self._should_split(name) and num_workers > 1:
+                if self.enable_streaming:
+                    its = ds.streaming_split(num_workers)
+                    for i in range(num_workers):
+                        shards[i][name] = its[i]
+                else:
+                    parts = ds.split(num_workers, equal=True)
+                    for i in range(num_workers):
+                        shards[i][name] = parts[i].iterator()
+            elif is_dataset:
+                for i in range(num_workers):
+                    shards[i][name] = ds.iterator()
+            else:
+                for i in range(num_workers):
+                    shards[i][name] = ds
+        return shards

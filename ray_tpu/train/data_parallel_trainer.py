@@ -34,10 +34,12 @@ class DataParallelTrainer(BaseTrainer):
         run_config: Optional[RunConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        dataset_config=None,
     ):
         super().__init__(scaling_config=scaling_config, run_config=run_config,
                          resume_from_checkpoint=resume_from_checkpoint,
                          datasets=datasets)
+        self.dataset_config = dataset_config
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
         if backend_config is None:
@@ -63,7 +65,15 @@ class DataParallelTrainer(BaseTrainer):
         failures = 0
         error: Optional[Exception] = None
         pg = self._reserve_placement_group()
+        try:
+            return self._run_with_pg(
+                pg, failure_config, ckpt_manager, latest_metrics,
+                checkpoint_path, failures, error)
+        finally:
+            self._release_placement_group(pg)
 
+    def _run_with_pg(self, pg, failure_config, ckpt_manager, latest_metrics,
+                     checkpoint_path, failures, error) -> Result:
         while True:
             executor = BackendExecutor(
                 self.backend_config,
@@ -87,14 +97,24 @@ class DataParallelTrainer(BaseTrainer):
                     if results is None:
                         break
                     # rank-0's metrics are canonical (reference consolidates
-                    # the same way in _fetch_next_result)
-                    latest_metrics = results[0].metrics
+                    # the same way in _fetch_next_result); fall back to the
+                    # lowest live rank once rank 0 finishes early
+                    by_rank = {r.world_rank: r for r in results
+                               if getattr(r, "world_rank", None) is not None}
+                    canonical = (by_rank[min(by_rank)] if by_rank
+                                 else results[0])
+                    latest_metrics = canonical.metrics
                     ckpt_dirs = [r.checkpoint_dir for r in results
                                  if r.checkpoint_dir]
                     if ckpt_dirs:
                         checkpoint_path = ckpt_dirs[0]
                         ckpt_manager.register_checkpoint(
                             Checkpoint(checkpoint_path), latest_metrics or {})
+                        # pruning may have deleted a badly-scoring newest
+                        # checkpoint; restart from one that still exists
+                        latest = ckpt_manager.latest_checkpoint
+                        if latest is not None:
+                            checkpoint_path = latest.path
                 error = None
                 break
             except self._RESTARTABLE as e:
@@ -108,7 +128,6 @@ class DataParallelTrainer(BaseTrainer):
             finally:
                 executor.shutdown()
 
-        self._release_placement_group(pg)
         return Result(
             metrics=latest_metrics,
             checkpoint=ckpt_manager.latest_checkpoint or (
@@ -149,19 +168,10 @@ class DataParallelTrainer(BaseTrainer):
 
     # ------------------------------------------------------------- datasets
     def _split_datasets(self):
-        """Per-worker dataset shards (reference: DataConfig
+        """Per-worker dataset shards via DataConfig (reference:
         train/_internal/data_config.py — train dataset split, others
         replicated)."""
-        if not self.datasets:
-            return None
-        n = self.scaling_config.num_workers
-        shards = [dict() for _ in range(n)]
-        for name, ds in self.datasets.items():
-            if hasattr(ds, "split") and name == "train":
-                parts = ds.split(n, equal=True)
-                for i in range(n):
-                    shards[i][name] = parts[i]
-            else:
-                for i in range(n):
-                    shards[i][name] = ds
-        return shards
+        from ray_tpu.train._internal.data_config import DataConfig
+
+        cfg = getattr(self, "dataset_config", None) or DataConfig()
+        return cfg.configure(self.datasets, self.scaling_config.num_workers)

@@ -1,0 +1,235 @@
+"""ray_tpu.data tests (model: python/ray/data/tests/ suites —
+test_dataset*, test_map, test_all_to_all, test_consumption)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+
+
+@pytest.fixture(scope="module")
+def data_cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_schema(data_cluster):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.schema() == {"id": "int64"}
+    assert ds.num_blocks() == 4
+
+
+def test_map_batches_fusion(data_cluster):
+    ds = rd.range(50, parallelism=2).map_batches(
+        lambda b: {"id": b["id"] * 2}).map_batches(
+        lambda b: {"id": b["id"] + 1})
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == [2 * i + 1 for i in range(50)]
+    # both map stages fused into the read: one op in the plan
+    from ray_tpu.data._internal.planner import optimize
+
+    ops = optimize(ds._last_op.chain())
+    assert len(ops) == 1, [o.name for o in ops]
+
+
+def test_map_filter_flat_map(data_cluster):
+    ds = rd.range(20, parallelism=2)
+    assert ds.map(lambda r: {"x": r["id"] ** 2}).take(3) == [
+        {"x": 0}, {"x": 1}, {"x": 4}]
+    assert ds.filter(lambda r: r["id"] % 2 == 0).count() == 10
+    assert ds.flat_map(lambda r: [r, r]).count() == 40
+
+
+def test_take_and_limit(data_cluster):
+    ds = rd.range(1000, parallelism=8)
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+    assert ds.limit(7).count() == 7
+
+
+def test_columns_ops(data_cluster):
+    ds = rd.range(10).add_column("y", lambda b: b["id"] * 3)
+    assert ds.take(2) == [{"id": 0, "y": 0}, {"id": 1, "y": 3}]
+    assert ds.select_columns(["y"]).columns() == ["y"]
+    assert ds.drop_columns(["y"]).columns() == ["id"]
+    assert ds.rename_columns({"id": "key"}).columns()[0] == "key"
+
+
+def test_aggregates(data_cluster):
+    ds = rd.range(100, parallelism=4)
+    assert ds.sum("id") == 4950
+    assert ds.min("id") == 0
+    assert ds.max("id") == 99
+    assert abs(ds.mean("id") - 49.5) < 1e-9
+    vals = np.arange(100)
+    assert abs(ds.std("id") - vals.std(ddof=1)) < 1e-6
+    c, s = ds.aggregate(Count(), Sum("id"))
+    assert (c, s) == (100, 4950)
+
+
+def test_groupby(data_cluster):
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)])
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert out == {0: 135, 1: 145, 2: 155}
+    counts = {r["k"]: r["count()"]
+              for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+
+
+def test_sort_shuffle_repartition(data_cluster):
+    ds = rd.range(50, parallelism=5).random_shuffle(seed=7)
+    ids = [r["id"] for r in ds.take_all()]
+    assert sorted(ids) == list(range(50))
+    assert ids != list(range(50))  # actually shuffled
+    back = [r["id"] for r in ds.sort("id").take_all()]
+    assert back == list(range(50))
+    desc = [r["id"] for r in rd.range(10).sort("id", descending=True).take(3)]
+    assert desc == [9, 8, 7]
+    assert rd.range(100, parallelism=7).repartition(3).materialize() \
+        .num_blocks() == 3
+
+
+def test_union_zip(data_cluster):
+    assert rd.range(5).union(rd.range(5), rd.range(5)).count() == 15
+    z = rd.range(5).zip(rd.range(5).map_batches(
+        lambda b: {"x": b["id"] * 10}))
+    assert z.take(2) == [{"id": 0, "x": 0}, {"id": 1, "x": 10}]
+
+
+def test_actor_pool_udf(data_cluster):
+    class AddN:
+        def __init__(self, n):
+            self.n = n
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.n}
+
+    ds = rd.range(40, parallelism=4).map_batches(
+        AddN, fn_constructor_args=(100,), concurrency=2)
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(100, 140))
+
+
+def test_iter_batches_shapes(data_cluster):
+    sizes = [len(b["id"]) for b in rd.range(100, parallelism=4)
+             .iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+    sizes = [len(b["id"]) for b in rd.range(100, parallelism=4)
+             .iter_batches(batch_size=32, drop_last=True)]
+    assert sizes == [32, 32, 32]
+    df = next(iter(rd.range(8).iter_batches(
+        batch_size=8, batch_format="pandas")))
+    assert list(df.columns) == ["id"]
+
+
+def test_iter_jax_batches(data_cluster):
+    import jax.numpy as jnp
+
+    got = list(rd.range(32).iter_jax_batches(
+        batch_size=16, dtypes={"id": jnp.float32}))
+    assert len(got) == 2
+    assert got[0]["id"].dtype == jnp.float32
+    assert got[0]["id"].shape == (16,)
+
+
+def test_tensor_blocks(data_cluster):
+    ds = rd.range_tensor(16, shape=(2, 3), parallelism=2)
+    b = ds.take_batch(4)
+    assert b["data"].shape == (4, 2, 3)
+    out = ds.map_batches(lambda b: {"data": b["data"] * 2}).take_batch(2)
+    assert out["data"].shape == (2, 2, 3)
+
+
+def test_from_numpy_pandas_arrow(data_cluster):
+    import pandas as pd
+    import pyarrow as pa
+
+    assert rd.from_numpy(np.ones((5, 2)))._last_op is not None
+    assert rd.from_numpy(np.arange(5)).count() == 5
+    assert rd.from_pandas(pd.DataFrame({"a": [1, 2]})).count() == 2
+    assert rd.from_arrow(pa.table({"a": [1, 2, 3]})).count() == 3
+    df = rd.from_pandas(pd.DataFrame({"a": [1, 2]})).to_pandas()
+    assert df["a"].tolist() == [1, 2]
+
+
+def test_parquet_csv_json_roundtrip(data_cluster, tmp_path):
+    d = str(tmp_path / "pq")
+    rd.range(50, parallelism=2).write_parquet(d)
+    assert rd.read_parquet(d).count() == 50
+    d = str(tmp_path / "csv")
+    rd.range(20).write_csv(d)
+    assert rd.read_csv(d).sum("id") == 190
+    d = str(tmp_path / "json")
+    rd.range(10).write_json(d)
+    assert rd.read_json(d).count() == 10
+
+
+def test_split(data_cluster):
+    parts = rd.range(30, parallelism=6).split(3)
+    assert [p.count() for p in parts] == [10, 10, 10]
+    parts = rd.range(31, parallelism=6).split(3, equal=True)
+    assert [p.count() for p in parts] == [10, 10, 10]
+
+
+def test_streaming_split_epochs(data_cluster):
+    its = rd.range(24, parallelism=4).streaming_split(2)
+    assert sum(len(list(it.iter_rows())) for it in its) == 24
+    # a second epoch works (iterators are re-usable)
+    assert sum(len(list(it.iter_rows())) for it in its) == 24
+
+
+def test_random_sample(data_cluster):
+    n = rd.range(1000, parallelism=4).random_sample(0.5, seed=0).count()
+    assert 350 < n < 650
+
+
+def test_udf_error_propagates(data_cluster):
+    def boom(batch):
+        raise ValueError("boom")
+
+    with pytest.raises(Exception, match="boom"):
+        rd.range(10).map_batches(boom).take_all()
+
+
+def test_local_shuffle(data_cluster):
+    rows = []
+    for b in rd.range(64, parallelism=2).iter_batches(
+            batch_size=16, local_shuffle_buffer_size=64,
+            local_shuffle_seed=3):
+        rows.extend(b["id"].tolist())
+    assert sorted(rows) == list(range(64))
+    assert rows != list(range(64))
+
+
+def test_dataset_stats(data_cluster):
+    ds = rd.range(100, parallelism=4).map_batches(lambda b: b)
+    ds.count()
+    assert "tasks" in ds.stats()
+
+
+def test_train_integration(data_cluster):
+    from ray_tpu.train import get_context, get_dataset_shard, report
+    from ray_tpu.train.jax import JaxTrainer
+    from ray_tpu.air.config import ScalingConfig
+
+    def loop(config):
+        it = get_dataset_shard("train")
+        total = 0
+        for batch in it.iter_batches(batch_size=8):
+            total += len(batch["id"])
+        report({"rows": total})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 0.5}),
+        datasets={"train": rd.range(64, parallelism=4)},
+    )
+    result = trainer.fit()
+    assert result.metrics["rows"] > 0
