@@ -24,7 +24,6 @@ __all__ = [
     "read_text", "read_binary_files", "read_numpy", "read_datasource",
 ]
 
-_builtin_range = range
 
 
 def read_datasource(source: _ds.Datasource, *,

@@ -35,10 +35,6 @@ class Topology:
     def connect(self, src: int, dst: int, port: str = "in") -> None:
         self.edges.setdefault(src, []).append((dst, port))
 
-    @property
-    def sink(self) -> PhysicalOperator:
-        return self.ops[-1]
-
 
 class ExecutorStats:
     def __init__(self):
@@ -60,6 +56,10 @@ class StreamingExecutor:
     queue consumed by ``iter_bundles``."""
 
     OUTPUT_BUFFER = 16
+    # max bundles buffered between an operator and its consumer; bounds
+    # intermediate queues so a slow middle stage throttles upstream reads
+    # (reference: backpressure_policy/ + under_resource_limits)
+    PER_OP_BUFFER = 32
     POLL_INTERVAL = 0.003
 
     def __init__(self, topology: Topology, stats: Optional[ExecutorStats] = None):
@@ -138,13 +138,22 @@ class StreamingExecutor:
         # 3. dispatch — most-downstream runnable op first, so the pipeline
         #    drains toward the consumer (reference: select_operator_to_run
         #    prefers ops with less queued output).
-        for op in reversed(ops):
-            while op.can_dispatch():
+        for i in reversed(range(len(ops))):
+            op = ops[i]
+            while op.can_dispatch() and \
+                    self._downstream_backlog(i) < self.PER_OP_BUFFER:
                 op.dispatch()
                 progressed = True
                 if self.out.qsize() >= self.OUTPUT_BUFFER:
                     return True
         return progressed
+
+    def _downstream_backlog(self, i: int) -> int:
+        op = self.topology.ops[i]
+        backlog = len(op.output_queue)
+        for dst, _ in self.topology.edges.get(i, []):
+            backlog += len(self.topology.ops[dst].input_queue)
+        return backlog
 
     def _all_done(self) -> bool:
         return all(op.completed() for op in self.topology.ops) and not any(

@@ -62,7 +62,6 @@ class MapSpec:
     fn_constructor_kwargs: Optional[dict] = None
     batch_size: Optional[int] = None
     batch_format: str = "numpy"
-    zero_copy: bool = False
 
 
 class AbstractMap(LogicalOperator):
@@ -77,9 +76,6 @@ class AbstractMap(LogicalOperator):
         self.name = name
         self.compute = compute
         self.ray_remote_args = ray_remote_args or {}
-
-    def fused_name(self) -> str:
-        return self.name
 
 
 class Limit(LogicalOperator):

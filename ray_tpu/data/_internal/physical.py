@@ -36,9 +36,22 @@ def _apply_specs(specs: List[MapSpec], block: Block) -> Block:
         fn = spec.fn
         kwargs = spec.fn_kwargs or {}
         if spec.kind == "batches":
-            batch = acc.to_batch(spec.batch_format)
-            out = fn(batch, *spec.fn_args, **kwargs)
-            block = BlockAccessor.batch_to_block(out)
+            bs = spec.batch_size
+            n = acc.num_rows()
+            if bs is None or n <= bs:
+                out = fn(acc.to_batch(spec.batch_format), *spec.fn_args,
+                         **kwargs)
+                block = BlockAccessor.batch_to_block(out)
+            else:
+                # honor batch_size by re-chunking the block — critical for
+                # fixed-shape jitted UDFs (reference: block_batching/)
+                outs = []
+                for s in range(0, n, bs):
+                    chunk = BlockAccessor(acc.slice(s, min(s + bs, n)))
+                    out = fn(chunk.to_batch(spec.batch_format),
+                             *spec.fn_args, **kwargs)
+                    outs.append(BlockAccessor.batch_to_block(out))
+                block = BlockAccessor.concat(outs)
         elif spec.kind == "rows":
             rows = [fn(r, *spec.fn_args, **kwargs) for r in acc.iter_rows()]
             block = BlockAccessor.rows_to_block(rows)
@@ -47,6 +60,9 @@ def _apply_specs(specs: List[MapSpec], block: Block) -> Block:
             for r in acc.iter_rows():
                 rows.extend(fn(r, *spec.fn_args, **kwargs))
             block = BlockAccessor.rows_to_block(rows)
+        elif spec.kind == "block":
+            # whole-block transform (zero-copy Arrow ops: select/drop/rename)
+            block = fn(block, *spec.fn_args, **kwargs)
         elif spec.kind == "filter":
             keep = np.asarray(
                 [bool(fn(r, *spec.fn_args, **kwargs))

@@ -52,11 +52,17 @@ def _fuse(ops: List[L.LogicalOperator]) -> List[L.LogicalOperator]:
 
     out: List[L.LogicalOperator] = []
     for op in ops:
-        if _fusable(op) and out and _fusable(out[-1]):
+        if (_fusable(op) and out and _fusable(out[-1])
+                and (not out[-1].ray_remote_args or not op.ray_remote_args
+                     or out[-1].ray_remote_args == op.ray_remote_args)):
+            # refuse to fuse stages with conflicting resource requests
+            # (reference: rules/operator_fusion.py _can_fuse)
             prev = out[-1]
             prev.specs = prev.specs + op.specs
             prev.name = f"{prev.name}->{op.name}"
-        elif (_fusable(op) and out and isinstance(out[-1], L.Read)
+            prev.ray_remote_args = op.ray_remote_args or prev.ray_remote_args
+        elif (_fusable(op) and not op.ray_remote_args and out
+              and isinstance(out[-1], L.Read)
               and not getattr(out[-1], "_no_fuse", False)):
             read = out[-1]
             read._fused_specs = getattr(read, "_fused_specs", []) + op.specs

@@ -250,6 +250,3 @@ class BlockAccessor:
         idx = np.lexsort(arrs)
         return idx[::-1] if descending else idx
 
-
-def empty_block() -> Block:
-    return pa.table({}) if pa is not None else {}

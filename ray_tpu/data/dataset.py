@@ -8,7 +8,6 @@ execution happens on consumption through the streaming executor
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 import numpy as np
@@ -102,16 +101,21 @@ class Dataset:
 
         return self.map_batches(add)
 
+    def _map_blocks(self, fn: Callable, name: str) -> "Dataset":
+        spec = L.MapSpec(kind="block", fn=fn)
+        return self._append(L.AbstractMap(self._last_op, spec, name))
+
     def drop_columns(self, cols: List[str]) -> "Dataset":
-        return self.map_batches(
-            lambda b: {k: v for k, v in b.items() if k not in cols})
+        return self._map_blocks(
+            lambda b: BlockAccessor(b).drop(cols), f"DropColumns{cols}")
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        return self.map_batches(lambda b: {k: b[k] for k in cols})
+        return self._map_blocks(
+            lambda b: BlockAccessor(b).select(cols), f"SelectColumns{cols}")
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
-        return self.map_batches(
-            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+        return self._map_blocks(
+            lambda b: BlockAccessor(b).rename(mapping), "RenameColumns")
 
     def limit(self, n: int) -> "Dataset":
         return self._append(L.Limit(self._last_op, n))
@@ -445,15 +449,21 @@ class _SplitCoordinator:
         self._done = False
         self._rr = 0
         self._finished = set(range(n))  # everyone "drained" epoch -1
-        # Refs stay pinned here after hand-out: this actor owns the blocks,
-        # so dropping them before the consumer fetches would lose the object.
-        self._hold: List = []
+        # Hand-outs stay pinned until the consumer's NEXT request: a
+        # consumer fetches each block before asking for another, so holding
+        # the last two refs per consumer keeps fetches safe while bounding
+        # object-store usage (instead of pinning the whole epoch).
+        import collections as _c
+
+        self._hold: List = [_c.deque(maxlen=2) for _ in range(n)]
 
     def _start_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+        import collections as _c
+
         self._gen = self._ds._execute_bundles()
         self._queues = [[] for _ in range(self._n)]
-        self._hold = []
+        self._hold = [_c.deque(maxlen=2) for _ in range(self._n)]
         self._done = False
         self._rr = 0
         self._finished = set()
@@ -476,7 +486,7 @@ class _SplitCoordinator:
             self._rr = (self._rr + 1) % self._n
         if self._queues[idx]:
             ref = self._queues[idx].pop(0)
-            self._hold.append(ref)
+            self._hold[idx].append(ref)
             return ref
         if self._done and not self._queues[idx]:
             self._finished.add(idx)

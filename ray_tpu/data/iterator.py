@@ -181,26 +181,49 @@ class _LocalShuffler:
 
 
 def _prefetch(it: Iterator, depth: int) -> Iterator:
-    """Run the source iterator on a thread, buffering ``depth`` items."""
+    """Run the source iterator on a thread, buffering ``depth`` items.
+
+    An abandoned consumer (``break`` mid-loop) closes this generator; the
+    worker sees the stop flag on its next bounded put, closes the source
+    iterator (which tears down the StreamingExecutor via its ``finally``)
+    and exits instead of leaking a thread blocked on ``q.put``."""
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     DONE = object()
     err: list = []
+    stop = threading.Event()
 
     def worker():
         try:
             for item in it:
-                q.put(item)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    break
         except BaseException as e:
             err.append(e)
         finally:
+            if stop.is_set():
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
             q.put(DONE)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is DONE:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()

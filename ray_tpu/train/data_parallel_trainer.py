@@ -106,6 +106,12 @@ class DataParallelTrainer(BaseTrainer):
                     latest_metrics = canonical.metrics
                     ckpt_dirs = [r.checkpoint_dir for r in results
                                  if r.checkpoint_dir]
+                    report_fn = getattr(self, "_tune_report_fn", None)
+                    if report_fn is not None:
+                        # stream per-iteration results to Tune (reference
+                        # wires this through the shared Train/Tune session)
+                        report_fn(latest_metrics,
+                                  ckpt_dirs[0] if ckpt_dirs else None)
                     if ckpt_dirs:
                         checkpoint_path = ckpt_dirs[0]
                         ckpt_manager.register_checkpoint(

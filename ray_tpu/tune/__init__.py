@@ -1,0 +1,72 @@
+"""ray_tpu.tune — hyperparameter sweep + trial execution engine
+(reference: python/ray/tune/ — Tuner :54 in tuner.py, tune.run in tune.py,
+TuneController event loop; SURVEY §2.4 Tune row, §7 phase 5).
+
+The controller schedules one lightweight actor per trial; trainer trials
+reserve their real (TPU) resources through the trainer's own worker-group
+placement group, keeping the sweep engine independent of slice topology.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
+    MedianStoppingRule, PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.sample import (
+    choice, grid_search, lograndint, loguniform, quniform, randint,
+    sample_from, uniform)
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
+from ray_tpu.tune.trainable import (
+    FunctionTrainable, Trainable, with_parameters, wrap_function)
+from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+__all__ = [
+    "Tuner", "TuneConfig", "Trainable", "FunctionTrainable", "Trial",
+    "ResultGrid", "report", "get_checkpoint", "with_parameters",
+    "uniform", "quniform", "loguniform", "randint", "lograndint", "choice",
+    "sample_from", "grid_search", "Searcher", "ConcurrencyLimiter",
+    "BasicVariantGenerator", "TrialScheduler", "FIFOScheduler",
+    "ASHAScheduler", "AsyncHyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "run",
+]
+
+
+def report(metrics: Dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report one iteration's metrics (+ optional checkpoint) from inside a
+    function trainable (reference: ray.tune.report / train.report)."""
+    from ray_tpu.tune.trainable import _get_fn_session
+
+    _get_fn_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from, inside a function trainable."""
+    from ray_tpu.tune.trainable import _get_fn_session
+
+    return _get_fn_session().loaded_checkpoint
+
+
+def run(trainable, *, config: Optional[Dict] = None, metric=None,
+        mode="max", num_samples: int = 1, search_alg=None, scheduler=None,
+        stop=None, storage_path=None, name=None,
+        resources_per_trial=None, **_ignored) -> ResultGrid:
+    """Legacy ``tune.run`` shim over Tuner (reference: tune/tune.py:276)."""
+    from ray_tpu.air.config import RunConfig
+
+    tuner = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples,
+                               search_alg=search_alg, scheduler=scheduler),
+        run_config=RunConfig(name=name, storage_path=storage_path,
+                             stop=stop),
+        resources_per_trial=resources_per_trial,
+    )
+    return tuner.fit()

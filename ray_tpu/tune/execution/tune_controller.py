@@ -1,0 +1,331 @@
+"""TuneController — the experiment event loop (reference:
+python/ray/tune/execution/tune_controller.py:72 — step :718, actor
+scheduling :1016, train :1522, save :1743, restore :1844).
+
+One trial = one ``_TrialActor`` scheduled through the normal actor path
+with the trial's resource request; trainers launched inside a trial
+reserve their own worker-group placement groups
+(ray_tpu.train.DataParallelTrainer._reserve_placement_group), so the trial
+actor itself stays lightweight.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.schedulers.trial_scheduler import (
+    FIFOScheduler, TrialScheduler)
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class _TrialActor:
+    """Remote wrapper hosting one Trainable instance."""
+
+    def __init__(self, trainable_cls, config, trial_id, trial_dir):
+        self._trainable = trainable_cls(
+            config=config, trial_id=trial_id, trial_dir=trial_dir)
+
+    def train(self):
+        return self._trainable.train()
+
+    def save(self):
+        return self._trainable.save()
+
+    def restore(self, checkpoint_dir):
+        self._trainable.restore(checkpoint_dir)
+        return True
+
+    def stop(self):
+        self._trainable.stop()
+        return True
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable_cls,
+        *,
+        experiment_dir: str,
+        search_alg: Searcher,
+        scheduler: Optional[TrialScheduler] = None,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        num_samples_cap: Optional[int] = None,
+        max_concurrent: int = 8,
+        time_budget_s: Optional[float] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        self._trainable_cls = trainable_cls
+        self.experiment_dir = experiment_dir
+        os.makedirs(experiment_dir, exist_ok=True)
+        self.search_alg = search_alg
+        self.scheduler = scheduler or FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        self.search_alg.set_search_properties(metric, mode, None)
+        self.scheduler.set_search_properties(metric, mode)
+        self.num_samples_cap = num_samples_cap
+        self.max_concurrent = max_concurrent
+        self.time_budget_s = time_budget_s
+        self.run_config = run_config or RunConfig()
+        self.failure_config = self.run_config.failure_config or FailureConfig()
+        self.checkpoint_config = (self.run_config.checkpoint_config
+                                  or CheckpointConfig())
+        self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
+
+        self.trials: List[Trial] = []
+        self._actors: Dict[str, object] = {}       # trial_id -> ActorHandle
+        self._inflight: Dict[object, Trial] = {}   # train() ref -> trial
+        self._searcher_done = False
+        self._ckpt_requests: set = set()
+        self._last_state_save = 0.0
+
+    # --------------------------------------------------- scheduler interface
+    def live_trials(self) -> List[Trial]:
+        return [t for t in self.trials if not t.is_finished]
+
+    def trial_checkpoint(self, trial: Trial) -> Optional[str]:
+        """Synchronously checkpoint a (running) trial; used by PBT exploit."""
+        actor = self._actors.get(trial.trial_id)
+        if actor is None:
+            return trial.checkpoint_path
+        try:
+            path = ray_tpu.get(actor.save.remote(), timeout=120)
+            trial.checkpoint_path = path
+            return path
+        except Exception:
+            return trial.checkpoint_path
+
+    def request_checkpoint(self, trial: Trial) -> None:
+        self._ckpt_requests.add(trial.trial_id)
+
+    # ------------------------------------------------------------- main loop
+    def run(self) -> List[Trial]:
+        start = time.monotonic()
+        while True:
+            self._maybe_create_trials()
+            self._maybe_start_trials()
+            if not self._inflight:
+                if all(t.is_finished for t in self.trials) and (
+                        self._searcher_done or self._reached_sample_cap()):
+                    break
+                if not self.live_trials() and self._searcher_done:
+                    break
+                time.sleep(0.01)
+                continue
+            ready, _ = ray_tpu.wait(
+                list(self._inflight.keys()), num_returns=1, timeout=1.0)
+            for ref in ready:
+                trial = self._inflight.pop(ref)
+                self._process_result(trial, ref)
+            if self.time_budget_s is not None and \
+                    time.monotonic() - start > self.time_budget_s:
+                self._stop_all("time budget exhausted")
+                break
+            self._maybe_save_state()
+        self._save_state()
+        return self.trials
+
+    def _reached_sample_cap(self) -> bool:
+        return (self.num_samples_cap is not None
+                and len(self.trials) >= self.num_samples_cap)
+
+    # ------------------------------------------------------- trial lifecycle
+    def _maybe_create_trials(self) -> None:
+        while not self._searcher_done and not self._reached_sample_cap() \
+                and len(self.live_trials()) < self.max_concurrent:
+            tid = uuid.uuid4().hex[:8]
+            cfg = self.search_alg.suggest(tid)
+            if cfg == Searcher.FINISHED:
+                self._searcher_done = True
+                return
+            if cfg is None:
+                return
+            trial = Trial(cfg, self.experiment_dir, trial_id=tid,
+                          resources=dict(self.resources_per_trial))
+            self.trials.append(trial)
+            self.scheduler.on_trial_add(self, trial)
+
+    def _maybe_start_trials(self) -> None:
+        running = len(self._actors)
+        for trial in self.trials:
+            if running >= self.max_concurrent:
+                return
+            if trial.status in (Trial.PENDING, Trial.PAUSED) and \
+                    trial.trial_id not in self._actors:
+                self._start_trial(trial)
+                running += 1
+
+    def _start_trial(self, trial: Trial) -> None:
+        actor = ray_tpu.remote(_TrialActor).options(
+            resources=trial.resources).remote(
+                self._trainable_cls, trial.config, trial.trial_id,
+                trial.local_dir)
+        self._actors[trial.trial_id] = actor
+        try:
+            if trial.restore_path:
+                ray_tpu.get(actor.restore.remote(trial.restore_path),
+                            timeout=300)
+                trial.restore_path = None
+        except Exception as e:
+            self._handle_failure(trial, e)
+            return
+        trial.status = Trial.RUNNING
+        self._submit_train(trial)
+
+    def _submit_train(self, trial: Trial) -> None:
+        actor = self._actors[trial.trial_id]
+        ref = actor.train.remote()
+        self._inflight[ref] = trial
+
+    def _teardown_actor(self, trial: Trial, graceful: bool = True) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is None:
+            return
+        # drop any stale in-flight ref for this trial
+        for ref, t in list(self._inflight.items()):
+            if t is trial:
+                del self._inflight[ref]
+        if graceful:
+            try:
+                ray_tpu.get(actor.stop.remote(), timeout=30)
+            except Exception:
+                pass
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------ result handling
+    def _process_result(self, trial: Trial, ref) -> None:
+        try:
+            result = ray_tpu.get(ref)
+        except Exception as e:
+            self._handle_failure(trial, e)
+            return
+
+        ckpt_dir = result.pop("_checkpoint_dir", None)
+        if ckpt_dir:
+            trial.checkpoint_path = ckpt_dir
+        done = bool(result.get("done")) or self._hit_stop_criteria(result)
+        if done:
+            # a trial resumed at its end reports a bare done step; keep the
+            # metrics it had already reached
+            result = {**trial.last_result, **result}
+        trial.last_result = result
+        trial.metric_history.append(result)
+
+        if done:
+            self._complete_trial(trial, result)
+            return
+
+        self.search_alg.on_trial_result(trial.trial_id, result)
+        decision = self.scheduler.on_trial_result(self, trial, result)
+
+        freq = self.checkpoint_config.checkpoint_frequency
+        want_ckpt = (trial.trial_id in self._ckpt_requests or (
+            freq and result.get("training_iteration", 0) % freq == 0))
+        if want_ckpt:
+            self._ckpt_requests.discard(trial.trial_id)
+            self.trial_checkpoint(trial)
+
+        if decision == TrialScheduler.CONTINUE:
+            self._submit_train(trial)
+        elif decision == TrialScheduler.PAUSE:
+            self.trial_checkpoint(trial)
+            trial.restore_path = trial.checkpoint_path
+            self._teardown_actor(trial)
+            trial.status = Trial.PAUSED
+        elif decision == TrialScheduler.RESTART:
+            # PBT exploit: trial.config/restore_path already mutated
+            self._teardown_actor(trial)
+            trial.status = Trial.PENDING
+        elif decision == TrialScheduler.STOP:
+            self._complete_trial(trial, result, early_stopped=True)
+        else:
+            raise ValueError(f"unknown scheduler decision {decision!r}")
+
+    def _hit_stop_criteria(self, result: Dict) -> bool:
+        stop = self.run_config.stop
+        if not stop:
+            return False
+        if callable(stop):
+            return bool(stop(result.get("trial_id"), result))
+        return any(k in result and result[k] >= v for k, v in stop.items())
+
+    def _complete_trial(self, trial: Trial, result: Dict,
+                        early_stopped: bool = False) -> None:
+        if self.checkpoint_config.checkpoint_frequency or \
+                trial.trial_id in self._ckpt_requests:
+            self.trial_checkpoint(trial)
+            self._ckpt_requests.discard(trial.trial_id)
+        self.scheduler.on_trial_complete(self, trial, result)
+        self.search_alg.on_trial_complete(trial.trial_id, result, error=False)
+        self._teardown_actor(trial)
+        trial.status = Trial.TERMINATED
+
+    def _handle_failure(self, trial: Trial, error: Exception) -> None:
+        trial.num_failures += 1
+        self._teardown_actor(trial, graceful=False)
+        max_failures = self.failure_config.max_failures
+        if not self.failure_config.fail_fast and (
+                max_failures < 0 or trial.num_failures <= max_failures):
+            # retry from the last checkpoint
+            trial.restore_path = trial.checkpoint_path
+            trial.status = Trial.PENDING
+            return
+        trial.status = Trial.ERROR
+        trial.error_msg = f"{type(error).__name__}: {error}"
+        self.scheduler.on_trial_error(self, trial)
+        self.search_alg.on_trial_complete(trial.trial_id, None, error=True)
+        if self.failure_config.fail_fast:
+            self._stop_all("fail_fast")
+
+    def _stop_all(self, reason: str) -> None:
+        for trial in self.live_trials():
+            self._teardown_actor(trial)
+            if trial.status in (Trial.RUNNING, Trial.PENDING, Trial.PAUSED):
+                trial.status = Trial.TERMINATED
+        self._inflight.clear()
+
+    # ------------------------------------------------------ experiment state
+    def _maybe_save_state(self) -> None:
+        if time.monotonic() - self._last_state_save > 10:
+            self._save_state()
+
+    def _save_state(self) -> None:
+        self._last_state_save = time.monotonic()
+        state = {
+            "timestamp": time.time(),
+            "metric": self.metric,
+            "mode": self.mode,
+            "trials": [t.to_state() for t in self.trials],
+        }
+        path = os.path.join(self.experiment_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1, default=str)
+        os.replace(tmp, path)
+        try:
+            with open(os.path.join(self.experiment_dir,
+                                   "searcher_state.pkl"), "wb") as f:
+                f.write(self.search_alg.save_state())
+        except Exception:
+            pass
+
+    @staticmethod
+    def load_state(experiment_dir: str) -> Optional[Dict]:
+        path = os.path.join(experiment_dir, "experiment_state.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)

@@ -1,0 +1,73 @@
+"""Trial model (reference: python/ray/tune/experiment/trial.py — status
+machine PENDING/RUNNING/PAUSED/TERMINATED/ERROR)."""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Dict, Optional
+
+
+class Trial:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    TERMINATED = "TERMINATED"
+    ERROR = "ERROR"
+
+    def __init__(self, config: Dict, experiment_dir: str,
+                 trial_id: Optional[str] = None,
+                 resources: Optional[Dict[str, float]] = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.config = config
+        self.resources = resources or {"CPU": 1.0}
+        self.status = Trial.PENDING
+        self.last_result: Dict[str, Any] = {}
+        self.metric_history: list = []
+        self.checkpoint_path: Optional[str] = None
+        # set by PBT exploit / fault recovery: restore from here on (re)start
+        self.restore_path: Optional[str] = None
+        self.error_msg: Optional[str] = None
+        self.num_failures = 0
+        self.local_dir = os.path.join(experiment_dir, f"trial_{self.trial_id}")
+        os.makedirs(self.local_dir, exist_ok=True)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status in (Trial.TERMINATED, Trial.ERROR)
+
+    def best_metric(self, metric: str, mode: str = "max") -> Optional[float]:
+        vals = [r[metric] for r in self.metric_history if metric in r]
+        if not vals:
+            return None
+        return max(vals) if mode == "max" else min(vals)
+
+    def to_state(self) -> Dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "resources": self.resources,
+            "status": self.status,
+            "last_result": self.last_result,
+            "checkpoint_path": self.checkpoint_path,
+            "error_msg": self.error_msg,
+            "num_failures": self.num_failures,
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict, experiment_dir: str) -> "Trial":
+        t = cls(state["config"], experiment_dir,
+                trial_id=state["trial_id"], resources=state.get("resources"))
+        t.status = state["status"]
+        t.last_result = state.get("last_result", {})
+        t.checkpoint_path = state.get("checkpoint_path")
+        t.error_msg = state.get("error_msg")
+        t.num_failures = state.get("num_failures", 0)
+        # interrupted runs resume from their last checkpoint
+        if t.status in (Trial.RUNNING, Trial.PENDING, Trial.PAUSED):
+            t.restore_path = t.checkpoint_path
+            t.status = Trial.PENDING
+        return t
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"

@@ -1,0 +1,88 @@
+"""ResultGrid (reference: python/ray/tune/result_grid.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.base_trainer import Result
+from ray_tpu.tune.experiment import Trial
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str] = None,
+                 mode: str = "max"):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+        self._results = [self._trial_to_result(t) for t in trials]
+
+    @staticmethod
+    def _trial_to_result(trial: Trial) -> Result:
+        return Result(
+            metrics=trial.last_result or None,
+            checkpoint=(Checkpoint(trial.checkpoint_path)
+                        if trial.checkpoint_path else None),
+            path=trial.local_dir,
+            error=(RuntimeError(trial.error_msg)
+                   if trial.error_msg else None),
+        )
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[Exception]:
+        return [r.error for r in self._results if r.error]
+
+    @property
+    def num_errors(self) -> int:
+        return len(self.errors)
+
+    @property
+    def num_terminated(self) -> int:
+        return sum(1 for t in self._trials if t.status == Trial.TERMINATED)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None,
+                        scope: str = "last") -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric is required (set it in TuneConfig or "
+                             "pass it to get_best_result)")
+        sign = 1 if mode == "max" else -1
+
+        def key(pair):
+            trial, _ = pair
+            if scope == "all":
+                best = trial.best_metric(metric, mode)
+                return sign * best if best is not None else float("-inf")
+            v = (trial.last_result or {}).get(metric)
+            return sign * v if v is not None else float("-inf")
+
+        candidates = [(t, r) for t, r in zip(self._trials, self._results)
+                      if r.metrics]
+        if not candidates:
+            raise RuntimeError("no trial produced results")
+        return max(candidates, key=key)[1]
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_result or {})
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            for k, v in t.config.items():
+                if isinstance(v, (int, float, str, bool)) or v is None:
+                    row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)

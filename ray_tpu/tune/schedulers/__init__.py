@@ -1,0 +1,16 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/ —
+TrialScheduler ABC in trial_scheduler.py, ASHA in async_hyperband.py:19,
+PBT in pbt.py:221, MedianStoppingRule in median_stopping_rule.py)."""
+
+from ray_tpu.tune.schedulers.trial_scheduler import (
+    FIFOScheduler, TrialScheduler)
+from ray_tpu.tune.schedulers.async_hyperband import (
+    ASHAScheduler, AsyncHyperBandScheduler)
+from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+
+__all__ = [
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "AsyncHyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
+]

@@ -1,0 +1,100 @@
+"""ASHA — asynchronous successive halving (reference:
+python/ray/tune/schedulers/async_hyperband.py:19 AsyncHyperBandScheduler;
+bracket/rung logic mirrors its ``_Bracket.on_result`` cutoff rule)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class _Rung:
+    """One promotion rung: trials record their score when they reach
+    ``milestone`` iterations; laggards below the top-1/rf quantile stop."""
+
+    def __init__(self, milestone: float):
+        self.milestone = milestone
+        self.recorded: Dict[str, float] = {}
+
+    def cutoff(self, reduction_factor: float) -> Optional[float]:
+        if not self.recorded:
+            return None
+        scores = sorted(self.recorded.values())
+        k = int(len(scores) * (1 - 1 / reduction_factor))
+        if k <= 0:
+            return None
+        return scores[k - 1]
+
+
+class _Bracket:
+    def __init__(self, min_t: float, max_t: float, reduction_factor: float,
+                 stop_last_trials: bool):
+        self.rf = reduction_factor
+        self.stop_last_trials = stop_last_trials
+        self.rungs: List[_Rung] = []
+        t = min_t
+        while t < max_t:
+            self.rungs.append(_Rung(t))
+            t *= reduction_factor
+        self.rungs.reverse()  # highest milestone first, like the reference
+
+    def on_result(self, trial_id: str, cur_iter: float,
+                  score: float) -> str:
+        action = TrialScheduler.CONTINUE
+        for rung in self.rungs:
+            if cur_iter < rung.milestone or trial_id in rung.recorded:
+                continue
+            rung.recorded[trial_id] = score
+            cutoff = rung.cutoff(self.rf)
+            # strict <: a trial tying the cutoff (e.g. plateaued metrics)
+            # is in the surviving fraction, like the reference
+            if cutoff is not None and score < cutoff:
+                action = TrialScheduler.STOP
+            break
+        return action
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 time_attr: str = "training_iteration",
+                 max_t: float = 100, grace_period: float = 1,
+                 reduction_factor: float = 4, brackets: int = 1,
+                 stop_last_trials: bool = True):
+        super().__init__(metric, mode)
+        if grace_period < 1:
+            raise ValueError("grace_period must be >= 1")
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self._brackets = [
+            _Bracket(grace_period * reduction_factor ** s, max_t,
+                     reduction_factor, stop_last_trials)
+            for s in range(brackets)
+        ]
+        self._trial_bracket: Dict[str, _Bracket] = {}
+        self._counter = 0
+
+    def on_trial_add(self, controller, trial) -> None:
+        # round-robin bracket assignment (reference randomizes by size;
+        # round-robin is deterministic for tests)
+        b = self._brackets[self._counter % len(self._brackets)]
+        self._counter += 1
+        self._trial_bracket[trial.trial_id] = b
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        cur = result.get(self.time_attr, 0)
+        if cur >= self.max_t:
+            return TrialScheduler.STOP
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return TrialScheduler.CONTINUE
+        return bracket.on_result(trial.trial_id, cur, self._score(result))
+
+    def debug_string(self) -> str:
+        sizes = [sum(len(r.recorded) for r in b.rungs) for b in self._brackets]
+        return f"ASHA: bracket sizes {sizes}"
+
+
+ASHAScheduler = AsyncHyperBandScheduler

@@ -1,0 +1,50 @@
+"""Median stopping rule (reference:
+python/ray/tune/schedulers/median_stopping_rule.py — stop a trial whose
+best score is worse than the median of running averages at the same time)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 time_attr: str = "training_iteration",
+                 grace_period: float = 1,
+                 min_samples_required: int = 3,
+                 hard_stop: bool = True):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples_required = min_samples_required
+        self.hard_stop = hard_stop
+        # trial_id -> list of (time, score)
+        self._results: Dict[str, List] = {}
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        self._results.setdefault(trial.trial_id, []).append((t, score))
+        if t < self.grace_period:
+            return TrialScheduler.CONTINUE
+
+        # running average of every *other* trial up to time t
+        averages = []
+        for tid, hist in self._results.items():
+            if tid == trial.trial_id:
+                continue
+            pts = [s for (tt, s) in hist if tt <= t]
+            if pts:
+                averages.append(sum(pts) / len(pts))
+        if len(averages) < self.min_samples_required:
+            return TrialScheduler.CONTINUE
+        averages.sort()
+        median = averages[len(averages) // 2]
+        best = max(s for (_, s) in self._results[trial.trial_id])
+        if best < median:
+            return (TrialScheduler.STOP if self.hard_stop
+                    else TrialScheduler.PAUSE)
+        return TrialScheduler.CONTINUE

@@ -1,0 +1,113 @@
+"""Population Based Training (reference: python/ray/tune/schedulers/pbt.py:221
+PopulationBasedTraining — quantile-based exploit of checkpoints + explore by
+hyperparameter perturbation).
+
+The exploit path here returns ``TrialScheduler.RESTART`` after mutating
+``trial.config`` and setting ``trial.restore_path`` to the donor's
+checkpoint; the controller tears the trial actor down and relaunches it with
+the new config from that checkpoint (slice-granular restart reuses the same
+machinery as fault recovery).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional, Union
+
+from ray_tpu.tune.search.sample import Domain
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: float = 4,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 perturbation_factors=(1.2, 0.8),
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        if not hyperparam_mutations:
+            raise ValueError("hyperparam_mutations is required for PBT")
+        if not 0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.time_attr = time_attr
+        self.perturbation_interval = perturbation_interval
+        self.hyperparam_mutations = hyperparam_mutations
+        self.quantile_fraction = quantile_fraction
+        self.resample_probability = resample_probability
+        self.perturbation_factors = perturbation_factors
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._scores: Dict[str, float] = {}
+        self._exploits = 0
+
+    # ------------------------------------------------------------ explore
+    def _mutate_value(self, current, spec):
+        if isinstance(spec, Domain):
+            return spec.sample(self._rng)
+        if isinstance(spec, list):
+            if self._rng.random() < self.resample_probability or \
+                    current not in spec:
+                return self._rng.choice(spec)
+            # shift to a neighboring value (reference pbt.py explore)
+            i = spec.index(current)
+            j = min(max(i + self._rng.choice((-1, 1)), 0), len(spec) - 1)
+            return spec[j]
+        if callable(spec):
+            return spec()
+        raise TypeError(f"unsupported mutation spec {spec!r}")
+
+    def _explore(self, config: Dict) -> Dict:
+        new = dict(config)
+        for key, spec in self.hyperparam_mutations.items():
+            cur = new.get(key)
+            if isinstance(cur, (int, float)) and not isinstance(spec, list) \
+                    and self._rng.random() >= self.resample_probability:
+                factor = self._rng.choice(self.perturbation_factors)
+                new[key] = type(cur)(cur * factor)
+            else:
+                new[key] = self._mutate_value(cur, spec)
+        return new
+
+    # ------------------------------------------------------------- exploit
+    def _quantiles(self, controller, trial) -> (List, List):
+        trials = [t for t in controller.live_trials()
+                  if t.trial_id in self._scores]
+        if trial.trial_id in self._scores and trial not in trials:
+            trials.append(trial)
+        trials.sort(key=lambda t: self._scores[t.trial_id])
+        if len(trials) <= 1:
+            return [], []
+        num = max(1, int(len(trials) * self.quantile_fraction))
+        if num > len(trials) / 2:
+            num = int(len(trials) / 2)
+        return trials[:num], trials[-num:]
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        self._scores[trial.trial_id] = self._score(result)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.perturbation_interval:
+            return TrialScheduler.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        lower, upper = self._quantiles(controller, trial)
+        if trial in lower and upper:
+            donor = self._rng.choice(upper)
+            ckpt = controller.trial_checkpoint(donor)
+            if ckpt is None:
+                return TrialScheduler.CONTINUE
+            trial.config = self._explore(dict(donor.config))
+            trial.restore_path = ckpt
+            self._exploits += 1
+            return TrialScheduler.RESTART
+        # top/middle trials checkpoint at each perturbation interval so they
+        # can donate (reference: pbt checkpoints on _save_trial_state)
+        controller.request_checkpoint(trial)
+        return TrialScheduler.CONTINUE
+
+    def debug_string(self) -> str:
+        return f"PBT: {self._exploits} exploits"

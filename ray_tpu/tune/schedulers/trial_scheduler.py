@@ -1,0 +1,57 @@
+"""TrialScheduler ABC + FIFO (reference:
+python/ray/tune/schedulers/trial_scheduler.py — decisions CONTINUE/PAUSE/
+STOP; FIFOScheduler passes everything through)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+    # PBT exploit: controller must restart the trial with its (mutated)
+    # config, restoring from ``trial.restore_path``.
+    RESTART = "RESTART"
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
+        self.metric = metric
+        self.mode = mode or "max"
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def _score(self, result: Dict) -> float:
+        v = result.get(self.metric)
+        if v is None:
+            raise KeyError(
+                f"scheduler metric {self.metric!r} missing from result "
+                f"(keys: {sorted(result)})")
+        return float(v) if self.mode == "max" else -float(v)
+
+    # Lifecycle hooks; ``controller`` exposes trials + stop/pause/save.
+    def on_trial_add(self, controller, trial) -> None:
+        pass
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        pass
+
+    def on_trial_error(self, controller, trial) -> None:
+        pass
+
+    def debug_string(self) -> str:
+        return type(self).__name__
+
+
+class FIFOScheduler(TrialScheduler):
+    pass

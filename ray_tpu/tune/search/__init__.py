@@ -1,0 +1,4 @@
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
+
+__all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator"]

@@ -1,0 +1,97 @@
+"""Grid/random variant generation (reference:
+python/ray/tune/search/basic_variant.py + variant_generator.py).
+
+Expands every ``grid_search`` marker exhaustively (cross product), samples
+every ``Domain`` leaf, repeats the whole expansion ``num_samples`` times.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search.sample import Domain
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _walk(prefix: Tuple, spec: Any):
+    """Yield (path, leaf) for grid/domain leaves; nested dicts recursed."""
+    if _is_grid(spec) or isinstance(spec, Domain):
+        yield prefix, spec
+    elif isinstance(spec, dict):
+        for k, v in spec.items():
+            yield from _walk(prefix + (k,), v)
+
+
+def _set_path(d: Dict, path: Tuple, value) -> None:
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _deepcopy_spec(spec):
+    if isinstance(spec, dict):
+        return {k: _deepcopy_spec(v) for k, v in spec.items()}
+    return spec
+
+
+def generate_variants(space: Dict, num_samples: int,
+                      rng: random.Random) -> List[Dict]:
+    """All resolved configs for the space (grid × num_samples)."""
+    grid_leaves = []
+    domain_leaves = []
+    for path, leaf in _walk((), space):
+        if _is_grid(leaf):
+            grid_leaves.append((path, leaf["grid_search"]))
+        else:
+            domain_leaves.append((path, leaf))
+
+    grid_combos = (list(itertools.product(*[vals for _, vals in grid_leaves]))
+                   if grid_leaves else [()])
+    out = []
+    for _ in range(num_samples):
+        for combo in grid_combos:
+            cfg = _deepcopy_spec(space)
+            for (path, _), val in zip(grid_leaves, combo):
+                _set_path(cfg, path, val)
+            for path, dom in domain_leaves:
+                _set_path(cfg, path, dom.sample(rng))
+            out.append(cfg)
+    return out
+
+
+class BasicVariantGenerator(Searcher):
+    """The default searcher: pre-expands the whole space
+    (reference: basic_variant.py:43)."""
+
+    def __init__(self, space: Optional[Dict] = None, num_samples: int = 1,
+                 seed: Optional[int] = None,
+                 points_to_evaluate: Optional[List[Dict]] = None):
+        super().__init__()
+        self._space = space or {}
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._points = list(points_to_evaluate or [])
+        self._queue: Optional[List[Dict]] = None
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = config
+        return True
+
+    def _ensure_expanded(self) -> None:
+        if self._queue is None:
+            self._queue = self._points + generate_variants(
+                self._space, self._num_samples, self._rng)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        self._ensure_expanded()
+        if not self._queue:
+            return Searcher.FINISHED
+        return self._queue.pop(0)

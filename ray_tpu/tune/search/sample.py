@@ -1,0 +1,117 @@
+"""Search-space domains (reference: python/ray/tune/search/sample.py —
+Domain/Float/Integer/Categorical and the ``tune.uniform``-family
+constructors; grid_search is a plain dict marker like the reference's
+``tune.grid_search``)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+class Domain:
+    """A hyperparameter range to sample from."""
+
+    sampler: Optional[str] = None
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    def cast(self, value):
+        return value
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: Optional[float] = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform lower bound must be > 0")
+        self.lower = float(lower)
+        self.upper = float(upper)
+        self.log = log
+        self.q = q
+
+    def sample(self, rng: random.Random) -> float:
+        if self.log:
+            import math
+
+            v = math.exp(rng.uniform(math.log(self.lower),
+                                     math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q is not None:
+            v = round(round(v / self.q) * self.q, 10)
+        return float(min(max(v, self.lower), self.upper))
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False):
+        self.lower = int(lower)
+        self.upper = int(upper)  # exclusive, like the reference's randint
+        self.log = log
+
+    def sample(self, rng: random.Random) -> int:
+        if self.log:
+            import math
+
+            v = int(math.exp(rng.uniform(math.log(self.lower),
+                                         math.log(self.upper))))
+        else:
+            v = rng.randrange(self.lower, self.upper)
+        return int(min(max(v, self.lower), self.upper - 1))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    """``tune.sample_from`` — arbitrary callable of the spec so far."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:
+        try:
+            return self.fn({})
+        except TypeError:
+            return self.fn()
+
+
+# ---------------------------------------------------------------- public API
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    """Marker dict, expanded exhaustively by BasicVariantGenerator
+    (reference: tune/search/variant_generator.py grid expansion)."""
+    return {"grid_search": list(values)}

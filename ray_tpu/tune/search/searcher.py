@@ -1,0 +1,87 @@
+"""Searcher plugin ABC (reference: python/ray/tune/search/searcher.py) and
+ConcurrencyLimiter (reference: tune/search/concurrency_limiter.py)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional
+
+
+class Searcher:
+    """Suggest configs for new trials; observe results to adapt.
+
+    Subclasses implement ``suggest`` (return a config dict, ``None`` when
+    temporarily out of suggestions, or ``Searcher.FINISHED`` when the space
+    is exhausted) and optionally the observation hooks.
+    """
+
+    FINISHED = "FINISHED"
+
+    def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None):
+        self.metric = metric
+        self.mode = mode or "max"
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str],
+                              config: Optional[Dict]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+    # ------------------------------------------------- experiment state
+    def save_state(self) -> bytes:
+        return pickle.dumps(self.__dict__)
+
+    def restore_state(self, data: bytes) -> None:
+        self.__dict__.update(pickle.loads(data))
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions from a wrapped searcher
+    (reference: tune/search/concurrency_limiter.py:21)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        suggestion = self.searcher.suggest(trial_id)
+        if suggestion is not None and suggestion != Searcher.FINISHED:
+            self._live.add(trial_id)
+        return suggestion
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+    def save_state(self) -> bytes:
+        return pickle.dumps((self.max_concurrent, self.searcher.save_state()))
+
+    def restore_state(self, data: bytes) -> None:
+        self.max_concurrent, inner = pickle.loads(data)
+        self._live = set()
+        self.searcher.restore_state(inner)

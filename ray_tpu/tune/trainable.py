@@ -1,0 +1,240 @@
+"""Trainable ABC + function trainables (reference:
+python/ray/tune/trainable/trainable.py:61 — train :301, save :434,
+restore :508, user step :835; function wrapping mirrors
+tune/trainable/function_trainable.py's thread+queue design).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+# Standard result fields (reference: tune/result.py)
+TRAINING_ITERATION = "training_iteration"
+DONE = "done"
+TRIAL_ID = "trial_id"
+TIME_TOTAL_S = "time_total_s"
+TIME_THIS_ITER_S = "time_this_iter_s"
+
+
+class Trainable:
+    """Class API: subclass and implement ``setup``/``step``/
+    ``save_checkpoint``/``load_checkpoint``."""
+
+    def __init__(self, config: Optional[Dict] = None,
+                 trial_id: str = "", trial_dir: str = ""):
+        self.config = config or {}
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir or os.getcwd()
+        self.iteration = 0
+        self._time_total = 0.0
+        self._restored = False
+        self.setup(self.config)
+
+    # ------------------------------------------------------------ user API
+    def setup(self, config: Dict) -> None:
+        pass
+
+    def step(self) -> Dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        raise NotImplementedError
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict) -> bool:
+        """Return True if the trainable can hot-swap configs (used to reuse
+        actors across trials, reference trainable.py reset)."""
+        return False
+
+    # --------------------------------------------------------- driver API
+    def train(self) -> Dict:
+        start = time.monotonic()
+        result = self.step() or {}
+        took = time.monotonic() - start
+        self.iteration += 1
+        self._time_total += took
+        result.setdefault(DONE, False)
+        result[TRAINING_ITERATION] = self.iteration
+        result[TRIAL_ID] = self.trial_id
+        result[TIME_THIS_ITER_S] = took
+        result[TIME_TOTAL_S] = self._time_total
+        return result
+
+    def save(self) -> str:
+        d = os.path.join(self.trial_dir,
+                         f"checkpoint_{self.iteration:06d}")
+        os.makedirs(d, exist_ok=True)
+        self.save_checkpoint(d)
+        self._save_trainable_meta(d)
+        return d
+
+    def restore(self, checkpoint_dir: str) -> None:
+        self._load_trainable_meta(checkpoint_dir)
+        self.load_checkpoint(checkpoint_dir)
+        self._restored = True
+
+    def stop(self) -> None:
+        self.cleanup()
+
+    # ------------------------------------------------------------ internals
+    def _save_trainable_meta(self, d: str) -> None:
+        import json
+
+        with open(os.path.join(d, ".tune_metadata"), "w") as f:
+            json.dump({"iteration": self.iteration,
+                       "time_total": self._time_total}, f)
+
+    def _load_trainable_meta(self, d: str) -> None:
+        import json
+
+        p = os.path.join(d, ".tune_metadata")
+        if os.path.exists(p):
+            with open(p) as f:
+                meta = json.load(f)
+            self.iteration = meta.get("iteration", 0)
+            self._time_total = meta.get("time_total", 0.0)
+
+
+class _FunctionSession:
+    """Per-process session backing ``ray_tpu.tune.report`` inside function
+    trainables."""
+
+    def __init__(self, trial_dir: str, loaded_checkpoint: Optional[Checkpoint]):
+        self.trial_dir = trial_dir
+        self.loaded_checkpoint = loaded_checkpoint
+        self.results: "queue.Queue" = queue.Queue()
+        self.resume = threading.Semaphore(0)
+        self.iteration = 0
+
+    def report(self, metrics: Dict,
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        ckpt_dir = None
+        if checkpoint is not None:
+            ckpt_dir = os.path.join(
+                self.trial_dir, f"checkpoint_{self.iteration:06d}")
+            if os.path.abspath(checkpoint.path) != os.path.abspath(ckpt_dir):
+                shutil.copytree(checkpoint.path, ckpt_dir,
+                                dirs_exist_ok=True)
+        self.iteration += 1
+        self.results.put(("report", metrics, ckpt_dir))
+        self.resume.acquire()  # block until the driver consumed it
+
+
+_fn_session: Optional[_FunctionSession] = None
+
+
+def _get_fn_session() -> _FunctionSession:
+    if _fn_session is None:
+        raise RuntimeError(
+            "ray_tpu.tune.report() must be called from inside a Tune "
+            "function trainable")
+    return _fn_session
+
+
+class FunctionTrainable(Trainable):
+    """Wraps ``fn(config)`` into the iteration protocol: each
+    ``tune.report`` call is one training iteration."""
+
+    _fn: Callable = None  # set by wrap_function subclassing
+
+    def setup(self, config: Dict) -> None:
+        self._session = _FunctionSession(self.trial_dir, None)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+        self._last_ckpt_dir: Optional[str] = None
+
+    def _runner(self) -> None:
+        global _fn_session
+        _fn_session = self._session
+        try:
+            self._fn(self.config)
+            self._session.results.put(("done", {}, None))
+        except Exception:
+            self._session.results.put(
+                ("error", {"traceback": traceback.format_exc()}, None))
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._runner, daemon=True)
+            self._thread.start()
+
+    def step(self) -> Dict:
+        self._ensure_started()
+        kind, metrics, ckpt_dir = self._session.results.get()
+        if kind == "error":
+            raise RuntimeError(
+                f"trainable function failed:\n{metrics['traceback']}")
+        if kind == "done":
+            # final pseudo-step carries the last reported metrics forward
+            # (reference: function trainables mark the last result done)
+            return {**getattr(self, "_last_metrics", {}), DONE: True}
+        self._session.resume.release()
+        metrics = dict(metrics)
+        self._last_metrics = dict(metrics)
+        if ckpt_dir:
+            self._last_ckpt_dir = ckpt_dir
+            # surfaced to the controller so fault recovery / PBT can restore
+            # from the last *reported* checkpoint (reference tracks this in
+            # the session's TrainingResult)
+            metrics["_checkpoint_dir"] = ckpt_dir
+        return metrics
+
+    def save(self) -> str:
+        # function trainables checkpoint through report(); hand back the
+        # latest one (reference: function_trainable saves the last reported)
+        if self._last_ckpt_dir is None:
+            d = os.path.join(self.trial_dir,
+                             f"checkpoint_{self.iteration:06d}")
+            os.makedirs(d, exist_ok=True)
+            self._save_trainable_meta(d)
+            return d
+        self._save_trainable_meta(self._last_ckpt_dir)
+        return self._last_ckpt_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        self._load_trainable_meta(checkpoint_dir)
+        self._session.loaded_checkpoint = Checkpoint(checkpoint_dir)
+        self._session.iteration = self.iteration
+        self._restored = True
+
+    def stop(self) -> None:
+        # the user thread is daemonic; just unblock it if waiting
+        if self._thread is not None and self._thread.is_alive():
+            self._session.resume.release()
+        self.cleanup()
+
+
+def wrap_function(fn: Callable) -> type:
+    """Build a FunctionTrainable subclass bound to ``fn``."""
+
+    class _Wrapped(FunctionTrainable):
+        pass
+
+    _Wrapped._fn = staticmethod(fn)
+    _Wrapped.__name__ = getattr(fn, "__name__", "fn")
+    return _Wrapped
+
+
+def with_parameters(fn: Callable, **params) -> Callable:
+    """Attach large constant objects to a trainable function
+    (reference: tune/trainable/util.py with_parameters)."""
+    import functools
+
+    @functools.wraps(fn)
+    def inner(config):
+        return fn(config, **params)
+
+    return inner

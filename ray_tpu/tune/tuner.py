@@ -1,0 +1,182 @@
+"""Tuner — the public entry point (reference: python/ray/tune/tuner.py:54
+and tune/impl/tuner_internal.py; TuneConfig from tune/tune_config.py)."""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.tune.execution.tune_controller import TuneController
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.trainable import Trainable, wrap_function
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 8
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    time_budget_s: Optional[float] = None
+    seed: Optional[int] = None
+
+
+def _trainer_to_function(trainer: BaseTrainer) -> Callable:
+    """Wrap a Train trainer so Tune can sweep it: each trial deep-copies the
+    trainer, applies the trial config (``train_loop_config`` merge like the
+    reference's param_space convention, base_trainer.py:700), and streams
+    per-iteration results through the trainer's tune hook."""
+
+    def trainable(config: Dict) -> None:
+        from ray_tpu.train._checkpoint import Checkpoint
+        from ray_tpu.tune import get_checkpoint, report
+        from ray_tpu.tune.trainable import _get_fn_session
+
+        t = copy.deepcopy(trainer)
+        cfg = dict(config)
+        loop_cfg = cfg.pop("train_loop_config", None)
+        if loop_cfg and hasattr(t, "train_loop_config"):
+            t.train_loop_config = {**t.train_loop_config, **loop_cfg}
+        if "scaling_config" in cfg:
+            t.scaling_config = cfg.pop("scaling_config")
+        for k, v in cfg.items():
+            if hasattr(t, k):
+                setattr(t, k, v)
+            elif hasattr(t, "train_loop_config"):
+                t.train_loop_config[k] = v
+        session = _get_fn_session()
+        t._experiment_name = os.path.basename(session.trial_dir)
+        t._storage_path = os.path.dirname(session.trial_dir)
+        t._trial_dir = os.path.join(session.trial_dir, "trainer")
+        os.makedirs(t._trial_dir, exist_ok=True)
+        resumed = get_checkpoint()
+        if resumed is not None and t.resume_from_checkpoint is None:
+            t.resume_from_checkpoint = resumed
+
+        def on_result(metrics, checkpoint_path):
+            report(metrics,
+                   checkpoint=Checkpoint(checkpoint_path)
+                   if checkpoint_path else None)
+
+        t._tune_report_fn = on_result
+        result = t.training_loop()
+        if result.error:
+            raise result.error
+
+    trainable.__name__ = type(trainer).__name__
+    return trainable
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable, type, BaseTrainer],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        _restore_dir: Optional[str] = None,
+    ):
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._resources_per_trial = resources_per_trial
+        self._restore_dir = _restore_dir
+
+        if isinstance(trainable, BaseTrainer):
+            if resources_per_trial is None:
+                # trial actor is a lightweight driver; the trainer's worker
+                # group reserves the real resources via its own PG
+                self._resources_per_trial = {"CPU": 0.5}
+            trainable = _trainer_to_function(trainable)
+        if callable(trainable) and not (
+                isinstance(trainable, type)
+                and issubclass(trainable, Trainable)):
+            trainable = wrap_function(trainable)
+        self._trainable_cls = trainable
+
+    # ----------------------------------------------------------------- fit
+    def fit(self) -> ResultGrid:
+        cfg = self._tune_config
+        name = self._run_config.name or f"tune_{int(time.time())}"
+        experiment_dir = os.path.join(
+            self._run_config.resolved_storage_path(), name)
+
+        search_alg = cfg.search_alg
+        num_samples_cap = None
+        if search_alg is None:
+            search_alg = BasicVariantGenerator(
+                self._param_space, num_samples=cfg.num_samples,
+                seed=cfg.seed)
+        else:
+            search_alg.set_search_properties(
+                cfg.metric, cfg.mode, self._param_space)
+            num_samples_cap = cfg.num_samples
+
+        controller = TuneController(
+            self._trainable_cls,
+            experiment_dir=experiment_dir,
+            search_alg=search_alg,
+            scheduler=cfg.scheduler,
+            metric=cfg.metric,
+            mode=cfg.mode,
+            num_samples_cap=num_samples_cap,
+            max_concurrent=cfg.max_concurrent_trials,
+            time_budget_s=cfg.time_budget_s,
+            run_config=self._run_config,
+            resources_per_trial=self._resources_per_trial,
+        )
+        if self._restore_dir:
+            state = TuneController.load_state(self._restore_dir)
+            if state:
+                controller.experiment_dir = self._restore_dir
+                controller.trials = [
+                    Trial.from_state(s, self._restore_dir)
+                    for s in state["trials"]]
+                for t in controller.trials:
+                    controller.scheduler.on_trial_add(controller, t)
+                # restore the searcher so the sweep continues from where it
+                # stopped instead of silently dropping remaining samples
+                searcher_file = os.path.join(
+                    self._restore_dir, "searcher_state.pkl")
+                if os.path.exists(searcher_file):
+                    with open(searcher_file, "rb") as f:
+                        controller.search_alg.restore_state(f.read())
+                else:
+                    controller._searcher_done = True
+        trials = controller.run()
+        return ResultGrid(trials, cfg.metric, cfg.mode)
+
+    # ------------------------------------------------------------- restore
+    @classmethod
+    def restore(cls, path: str,
+                trainable: Union[Callable, type, BaseTrainer],
+                *, param_space: Optional[Dict] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: Tuner.restore, tuner.py:54 docstring)."""
+        if not os.path.exists(os.path.join(path, "experiment_state.json")):
+            raise FileNotFoundError(f"no experiment state under {path}")
+        run_config = run_config or RunConfig(
+            name=os.path.basename(path),
+            storage_path=os.path.dirname(path))
+        return cls(trainable, param_space=param_space,
+                   tune_config=tune_config, run_config=run_config,
+                   _restore_dir=path)
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "experiment_state.json"))

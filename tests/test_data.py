@@ -233,3 +233,35 @@ def test_train_integration(data_cluster):
     )
     result = trainer.fit()
     assert result.metrics["rows"] > 0
+
+
+def test_map_batches_honors_batch_size(data_cluster):
+    def udf(batch):
+        # one output row per invocation, recording the batch length
+        return {"n": np.array([len(batch["id"])])}
+
+    out = rd.range(100, parallelism=1).map_batches(
+        udf, batch_size=32).take_all()
+    assert [r["n"] for r in out] == [32, 32, 32, 4]
+
+
+def test_fusion_preserves_remote_args(data_cluster):
+    ds = rd.range(10, parallelism=2).map_batches(
+        lambda b: b, num_cpus=0.25)
+    from ray_tpu.data._internal.planner import optimize
+
+    ops = optimize(ds._last_op.chain())
+    # the resource-carrying map must NOT be fused into the read
+    assert len(ops) == 2
+    assert ops[1].ray_remote_args == {"num_cpus": 0.25}
+    # matching/empty remote args still fuse map->map
+    ds2 = rd.range(10, parallelism=2).map(lambda r: r).map(lambda r: r)
+    assert len(optimize(ds2._last_op.chain())) == 1
+
+
+def test_select_drop_rename_block_ops(data_cluster):
+    ds = rd.from_items([{"a": i, "b": i * 2, "c": 0} for i in range(8)])
+    out = ds.select_columns(["a", "b"]).rename_columns(
+        {"b": "bb"}).drop_columns(["a"]).take_all()
+    assert list(out[0].keys()) == ["bb"]
+    assert [r["bb"] for r in out] == [i * 2 for i in range(8)]

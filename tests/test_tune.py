@@ -1,0 +1,318 @@
+"""Tune tests (reference analog: python/ray/tune/tests/test_tune_*.py,
+test_trial_scheduler.py, test_basic_variant.py)."""
+
+import json
+import os
+import random
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig
+from ray_tpu.train import Checkpoint
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler, MedianStoppingRule, PopulationBasedTraining)
+from ray_tpu.tune.search.basic_variant import generate_variants
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------- unit tests
+def test_variant_generation_grid_and_domains():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.loguniform(1e-5, 1e-2),
+        "layers": tune.choice([2, 4]),
+        "nested": {"units": tune.grid_search([8, 16])},
+    }
+    variants = generate_variants(space, num_samples=2, rng=random.Random(0))
+    assert len(variants) == 8  # 2 grid x 2 grid x 2 samples
+    for v in variants:
+        assert v["lr"] in (0.1, 0.01)
+        assert 1e-5 <= v["wd"] <= 1e-2
+        assert v["layers"] in (2, 4)
+        assert v["nested"]["units"] in (8, 16)
+
+
+def test_sample_domains_deterministic():
+    rng = random.Random(42)
+    assert 1 <= tune.randint(1, 10).sample(rng) < 10
+    v = tune.quniform(0, 1, 0.25).sample(rng)
+    assert v in (0.0, 0.25, 0.5, 0.75, 1.0)
+    assert tune.choice(["a"]).sample(rng) == "a"
+
+
+def test_concurrency_limiter():
+    class Seq(Searcher):
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+
+        def suggest(self, trial_id):
+            self.n += 1
+            return {"i": self.n}
+
+    lim = ConcurrencyLimiter(Seq(), max_concurrent=2)
+    assert lim.suggest("a") == {"i": 1}
+    assert lim.suggest("b") == {"i": 2}
+    assert lim.suggest("c") is None
+    lim.on_trial_complete("a")
+    assert lim.suggest("c") == {"i": 3}
+
+
+# ---------------------------------------------------------------- e2e sweeps
+def test_function_trainable_sweep(ray4, tmp_path):
+    def objective(config):
+        for i in range(3):
+            tune.report({"loss": config["x"] ** 2 + i * 0.01})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([-2.0, -1.0, 0.0, 1.0])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="sweep", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["loss"] == pytest.approx(0.02)
+    # experiment state was persisted
+    assert os.path.exists(
+        os.path.join(tmp_path, "sweep", "experiment_state.json"))
+
+
+def test_class_trainable(ray4, tmp_path):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.acc = 0.0
+
+        def step(self):
+            self.acc += self.config["rate"]
+            return {"acc": self.acc, "done": self.acc >= 1.0}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"acc": self.acc}, f)
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "state.json")) as f:
+                self.acc = json.load(f)["acc"]
+
+    results = Tuner(
+        MyTrainable,
+        param_space={"rate": tune.grid_search([0.5, 0.25])},
+        tune_config=TuneConfig(metric="acc", mode="max"),
+        run_config=RunConfig(name="cls", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 2
+    assert results.num_errors == 0
+    by_iters = sorted(r.metrics["training_iteration"] for r in results)
+    assert by_iters == [2, 4]
+
+
+def test_stop_criteria(ray4, tmp_path):
+    def objective(config):
+        for i in range(100):
+            tune.report({"score": i})
+
+    results = Tuner(
+        objective,
+        param_space={},
+        run_config=RunConfig(name="stop", storage_path=str(tmp_path),
+                             stop={"score": 5}),
+    ).fit()
+    assert results[0].metrics["score"] == 5
+
+
+def test_asha_early_stops(ray4, tmp_path):
+    def objective(config):
+        import time as _time
+
+        for i in range(20):
+            # good trials report fast and record at rungs first, so the
+            # laggards see a populated cutoff (ASHA is async: stop decisions
+            # only fire once a rung has peers)
+            _time.sleep(0.005 if config["q"] > 0.5 else 0.03)
+            tune.report({"reward": config["q"] * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+        tune_config=TuneConfig(
+            metric="reward", mode="max", max_concurrent_trials=4,
+            scheduler=ASHAScheduler(max_t=20, grace_period=2,
+                                    reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    iters = sorted(r.metrics["training_iteration"] for r in results)
+    assert iters[0] < 20          # at least one trial stopped early
+    assert iters[-1] == 20        # the best ran to max_t
+    best = results.get_best_result()
+    assert best.metrics["reward"] == pytest.approx(40.0)
+
+
+def test_fault_tolerance_retries_from_checkpoint(ray4, tmp_path):
+    marker = str(tmp_path / "fail_once")
+
+    def objective(config):
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "it.txt")) as f:
+                start = int(f.read()) + 1
+        for i in range(start, 6):
+            if i == 3 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("injected failure")
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "it.txt"), "w") as f:
+                    f.write(str(i))
+                tune.report({"i": i}, checkpoint=Checkpoint(d))
+
+    results = Tuner(
+        objective,
+        param_space={},
+        run_config=RunConfig(
+            name="ft", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)),
+    ).fit()
+    assert results.num_errors == 0
+    assert results[0].metrics["i"] == 5
+
+
+def test_failed_trial_reports_error(ray4, tmp_path):
+    def objective(config):
+        raise ValueError("boom")
+
+    results = Tuner(
+        objective,
+        param_space={},
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 1
+    assert "boom" in str(results.errors[0])
+
+
+def test_pbt_runs_and_perturbs(ray4, tmp_path):
+    def objective(config):
+        score = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "s.txt")) as f:
+                score = float(f.read())
+        for i in range(12):
+            score += config["lr"]
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "s.txt"), "w") as f:
+                    f.write(str(score))
+                tune.report({"score": score}, checkpoint=Checkpoint(d))
+
+    pbt = PopulationBasedTraining(
+        time_attr="training_iteration", perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=7)
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.1, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                               max_concurrent_trials=4, scheduler=pbt,
+                               seed=3),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    assert len(results) == 4
+    # every trial finished with a positive score
+    assert all(r.metrics["score"] > 0 for r in results)
+
+
+def test_median_stopping(ray4, tmp_path):
+    def objective(config):
+        for i in range(15):
+            tune.report({"m": config["v"]})
+
+    results = Tuner(
+        objective,
+        param_space={"v": tune.grid_search([1.0, 1.0, 1.0, 0.0])},
+        tune_config=TuneConfig(
+            metric="m", mode="max", max_concurrent_trials=4,
+            scheduler=MedianStoppingRule(grace_period=3,
+                                         min_samples_required=2)),
+        run_config=RunConfig(name="med", storage_path=str(tmp_path)),
+    ).fit()
+    iters = [r.metrics["training_iteration"] for r in results]
+    assert min(iters) < 15
+
+
+def test_tuner_restore_resumes_unfinished(ray4, tmp_path):
+    def objective(config):
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "it.txt")) as f:
+                start = int(f.read()) + 1
+        for i in range(start, 4):
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "it.txt"), "w") as f:
+                    f.write(str(i))
+                tune.report({"i": i}, checkpoint=Checkpoint(d))
+
+    exp_dir = str(tmp_path / "resume")
+    results = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(name="resume", storage_path=str(tmp_path)),
+    ).fit()
+    assert all(r.metrics["i"] == 3 for r in results)
+
+    # simulate an interrupted run: mark one trial as mid-flight
+    state_file = os.path.join(exp_dir, "experiment_state.json")
+    with open(state_file) as f:
+        state = json.load(f)
+    state["trials"][0]["status"] = "RUNNING"
+    with open(state_file, "w") as f:
+        json.dump(state, f)
+
+    assert Tuner.can_restore(exp_dir)
+    results2 = Tuner.restore(exp_dir, objective).fit()
+    assert len(results2) == 2
+    assert all(r.metrics["i"] == 3 for r in results2)
+
+
+def test_tune_wraps_trainer(ray4, tmp_path):
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        val = 0.0
+        for i in range(3):
+            val += config["inc"]
+            train.report({"val": val})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"inc": 0.0},
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    results = Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "inc": tune.grid_search([1.0, 2.0])}},
+        tune_config=TuneConfig(metric="val", mode="max",
+                               max_concurrent_trials=1),
+        run_config=RunConfig(name="trainer_sweep",
+                             storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["val"] == pytest.approx(6.0)
