@@ -38,6 +38,12 @@ class Executor:
                                              thread_name_prefix="task-exec")
         self._actor_pool: Optional[ThreadPoolExecutor] = None
         self._actor_sem: Optional[asyncio.Semaphore] = None
+        # Async actor methods run on a DEDICATED event loop thread, not the
+        # worker's IO loop: user coroutines may make blocking ray_tpu calls
+        # (get/remote/get_actor), which round-trip through the IO loop and
+        # would deadlock it (reference keeps async actors on fibers separate
+        # from the core-worker io_service for the same reason, fiber.h).
+        self._actor_loop: Optional[asyncio.AbstractEventLoop] = None
         self._actor_cls = None
         self._actor_id: Optional[ActorID] = None
         self._max_concurrency = 1
@@ -79,10 +85,17 @@ class Executor:
             method = getattr(self.worker.actor_instance, spec.actor_method, None)
             is_async = method is not None and inspect.iscoroutinefunction(method)
         if is_async:
-            if self._actor_sem is None:
-                self._actor_sem = asyncio.Semaphore(self._max_concurrency)
-            async with self._actor_sem:
-                return await self._run_async_method(spec, method)
+            actor_loop = self._ensure_actor_loop()
+
+            async def run_on_actor_loop():
+                if self._actor_sem is None:
+                    self._actor_sem = asyncio.Semaphore(self._max_concurrency)
+                async with self._actor_sem:
+                    return await self._run_async_method(spec, method)
+
+            fut = asyncio.run_coroutine_threadsafe(
+                run_on_actor_loop(), actor_loop)
+            return await asyncio.wrap_future(fut)
         pool = self._actor_pool if spec.task_type == ACTOR_TASK and self._actor_pool \
             else self._task_pool
         loop = asyncio.get_running_loop()
@@ -141,6 +154,25 @@ class Executor:
             ctx.task_id = None
             ctx.task_name = None
             ctx.placement_group_id = None
+
+    def _ensure_actor_loop(self) -> asyncio.AbstractEventLoop:
+        if self._actor_loop is None:
+            import threading
+
+            loop = asyncio.new_event_loop()
+            ready = threading.Event()
+
+            def run():
+                asyncio.set_event_loop(loop)
+                loop.call_soon(ready.set)
+                loop.run_forever()
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name="async-actor-loop")
+            t.start()
+            ready.wait()
+            self._actor_loop = loop
+        return self._actor_loop
 
     async def _run_async_method(self, spec: TaskSpec, method) -> Dict:
         loop = asyncio.get_running_loop()

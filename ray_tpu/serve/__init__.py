@@ -1,0 +1,184 @@
+"""ray_tpu.serve — online model serving (reference: python/ray/serve/ —
+serve.run api.py:439, @serve.deployment :246, controller/proxy/replica
+triad; SURVEY §3.5 call stack, §7 phase 6).
+
+TPU-first deviations: dynamic batching speaks ``allowed_batch_sizes`` so
+dispatch aligns with compiled XLA shapes; multiplexing targets LoRA-adapter
+serving on a shared base model.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.batching import batch, pad_batch
+from ray_tpu.serve.deployment import (
+    Application, AutoscalingConfig, Deployment, deployment)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve._private.controller import (
+    CONTROLLER_NAME, SERVE_NAMESPACE, ServeController)
+from ray_tpu.serve._private.proxy import ProxyActor, Request
+from ray_tpu.serve._private.replica import _HandlePlaceholder
+
+__all__ = [
+    "deployment", "Deployment", "Application", "AutoscalingConfig",
+    "DeploymentHandle", "DeploymentResponse", "Request",
+    "start", "run", "shutdown", "delete", "status", "get_app_handle",
+    "get_deployment_handle", "batch", "pad_batch", "multiplexed",
+    "get_multiplexed_model_id",
+]
+
+PROXY_NAME = "SERVE_PROXY"
+_http_port: Optional[int] = None
+
+
+def start(http_options: Optional[Dict] = None, detached: bool = True):
+    """Start the Serve control plane: controller + HTTP proxy
+    (reference: serve.start / _private/api.py)."""
+    global _http_port
+    http_options = http_options or {}
+    try:
+        ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        return
+    except Exception:
+        pass
+    port = http_options.get("port", 8000)
+    host = http_options.get("host", "127.0.0.1")
+    ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
+        max_concurrency=64, num_cpus=0.1).remote(http_port=port)
+    proxy = ray_tpu.remote(ProxyActor).options(
+        name=PROXY_NAME, namespace=SERVE_NAMESPACE,
+        max_concurrency=64, num_cpus=0.1).remote(port=port, host=host)
+    _http_port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+
+
+def get_http_port() -> Optional[int]:
+    """The proxy's bound port (0 in http_options picks a free one)."""
+    return _http_port
+
+
+def _controller():
+    return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+
+def _build_specs(app: Application):
+    """Flatten the bind graph into wire specs; nested Applications become
+    handle placeholders (reference: deployment_graph_build.py)."""
+    import cloudpickle
+
+    nodes = app.walk()
+    specs = []
+    for node in nodes:
+        d = node.deployment
+
+        def to_placeholder(a):
+            if isinstance(a, Application):
+                return _HandlePlaceholder("__APP__", a.deployment.name)
+            return a
+
+        args = tuple(to_placeholder(a) for a in node.args)
+        kwargs = {k: to_placeholder(v) for k, v in node.kwargs.items()}
+        auto = d.autoscaling_config
+        specs.append({
+            "name": d.name,
+            "blob": cloudpickle.dumps(d.func_or_class),
+            "init_blob": cloudpickle.dumps((args, kwargs)),
+            "num_replicas": d.num_replicas,
+            "max_ongoing_requests": d.max_ongoing_requests,
+            "user_config": d.user_config,
+            "autoscaling_config": auto.__dict__ if auto else None,
+            "ray_actor_options": d.ray_actor_options,
+            "health_check_period_s": d.health_check_period_s,
+            "graceful_shutdown_timeout_s": d.graceful_shutdown_timeout_s,
+        })
+    return specs
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: str = "/", _blocking: bool = True,
+        wait_timeout_s: float = 120.0) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress
+    (reference: serve.run api.py:439)."""
+    start()
+    specs = _build_specs(target)
+    # resolve the placeholder app name now that we know it
+    import cloudpickle
+
+    for spec in specs:
+        args, kwargs = cloudpickle.loads(spec["init_blob"])
+
+        def fix(a):
+            if isinstance(a, _HandlePlaceholder):
+                a.app_name = name
+            return a
+
+        args = tuple(fix(a) for a in args)
+        kwargs = {k: fix(v) for k, v in kwargs.items()}
+        spec["init_blob"] = cloudpickle.dumps((args, kwargs))
+    ingress = target.deployment.name
+    ctrl = _controller()
+    ray_tpu.get(
+        ctrl.deploy_application.remote(name, specs, ingress, route_prefix),
+        timeout=60)
+    if _blocking:
+        deadline = time.monotonic() + wait_timeout_s
+        st: Dict = {}
+        while True:
+            st = ray_tpu.get(ctrl.get_app_status.remote(name), timeout=30)
+            if st["status"] == "RUNNING":
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"application {name!r} not RUNNING within "
+                    f"{wait_timeout_s}s: {st}")
+            time.sleep(0.1)
+    return DeploymentHandle(name, ingress)
+
+
+def status(name: str = "default") -> Dict:
+    try:
+        return ray_tpu.get(
+            _controller().get_app_status.remote(name), timeout=30)
+    except Exception:
+        return {"status": "NOT_STARTED", "deployments": {}}
+
+
+def delete(name: str, _blocking: bool = True) -> None:
+    ray_tpu.get(_controller().delete_application.remote(name), timeout=60)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    routes = ray_tpu.get(_controller().get_routes.remote(), timeout=30)
+    for prefix, (app, ingress) in routes.items():
+        if app == name:
+            return DeploymentHandle(name, ingress)
+    raise ValueError(f"no application named {name!r}")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def shutdown() -> None:
+    """Tear down all applications + the control plane."""
+    global _http_port
+    try:
+        ctrl = _controller()
+    except Exception:
+        return
+    try:
+        ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+        try:
+            ray_tpu.kill(
+                ray_tpu.get_actor(actor_name, namespace=SERVE_NAMESPACE))
+        except Exception:
+            pass
+    _http_port = None

@@ -1,0 +1,297 @@
+"""ServeController — the singleton control-plane actor (reference:
+python/ray/serve/_private/controller.py:91 owning ApplicationStateManager
+(application_state.py), DeploymentStateManager (deployment_state.py:2354 —
+DeploymentState :1221 reconciles replica actors), and the LongPollHost).
+
+One async reconcile loop drives: replica scale-up/down, health checks,
+and request-based autoscaling. Replica discovery is name-based: the
+controller publishes replica actor names over long-poll; routers resolve
+them with ``get_actor``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.long_poll import LongPollHost
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+
+
+class _ReplicaState:
+    def __init__(self, name: str, handle):
+        self.name = name
+        self.handle = handle
+        self.started_at = time.monotonic()
+        self.healthy = True
+        self.last_queue_len = 0
+
+
+class _DeploymentInfo:
+    def __init__(self, spec: Dict):
+        self.spec = spec
+        self.name = spec["name"]
+        self.target_replicas = spec.get("num_replicas", 1)
+        self.autoscaling = spec.get("autoscaling_config")
+        if self.autoscaling:
+            self.target_replicas = max(
+                self.autoscaling["min_replicas"],
+                min(self.target_replicas,
+                    self.autoscaling["max_replicas"]))
+        self.replicas: List[_ReplicaState] = []
+        self.status = "UPDATING"
+        self._last_scale_up = 0.0
+        self._last_scale_down = 0.0
+        self._ongoing_history: List = []  # (t, total_ongoing)
+
+
+class ServeController(LongPollHost):
+    def __init__(self, http_port: int = 8000):
+        LongPollHost.__init__(self)
+        self.http_port = http_port
+        self._apps: Dict[str, Dict[str, _DeploymentInfo]] = {}
+        self._routes: Dict[str, tuple] = {}  # prefix -> (app, ingress dep)
+        self._loop_task = None
+        self._shutdown = False
+
+    async def _ensure_loop(self):
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._reconcile_loop())
+
+    # ---------------------------------------------------------------- deploy
+    async def deploy_application(self, app_name: str, dep_specs: List[Dict],
+                                 ingress: str, route_prefix: str) -> None:
+        await self._ensure_loop()
+        existing = self._apps.get(app_name, {})
+        new: Dict[str, _DeploymentInfo] = {}
+        for spec in dep_specs:
+            info = _DeploymentInfo(spec)
+            old = existing.get(info.name)
+            if old is not None and old.spec.get("blob") == spec.get("blob") \
+                    and old.spec.get("init_blob") == spec.get("init_blob"):
+                # in-place update: keep replicas, adopt new targets
+                info.replicas = old.replicas
+                if spec.get("user_config") != old.spec.get("user_config"):
+                    await self._reconfigure_replicas(info)
+            elif old is not None:
+                await self._stop_replicas(old, len(old.replicas))
+            new[info.name] = info
+        # drop deployments removed from the app
+        for name, old in existing.items():
+            if name not in new:
+                await self._stop_replicas(old, len(old.replicas))
+        self._apps[app_name] = new
+        for prefix, (a, _) in list(self._routes.items()):
+            if a == app_name:
+                del self._routes[prefix]
+        self._routes[route_prefix] = (app_name, ingress)
+        self.notify_changed("routes", dict(self._routes))
+
+    async def delete_application(self, app_name: str) -> None:
+        deps = self._apps.pop(app_name, {})
+        for info in deps.values():
+            await self._stop_replicas(info, len(info.replicas))
+            self.notify_changed(f"replicas::{app_name}#{info.name}", [])
+        for prefix, (a, _) in list(self._routes.items()):
+            if a == app_name:
+                del self._routes[prefix]
+        self.notify_changed("routes", dict(self._routes))
+
+    async def shutdown(self) -> None:
+        self._shutdown = True
+        for app in list(self._apps):
+            await self.delete_application(app)
+
+    # ---------------------------------------------------------------- status
+    def get_routes(self) -> Dict[str, tuple]:
+        return dict(self._routes)
+
+    def get_app_status(self, app_name: str) -> Dict:
+        deps = self._apps.get(app_name)
+        if deps is None:
+            return {"status": "NOT_FOUND", "deployments": {}}
+        out = {}
+        all_running = True
+        for name, info in deps.items():
+            running = sum(1 for r in info.replicas if r.healthy)
+            ok = running >= info.target_replicas
+            all_running = all_running and ok
+            out[name] = {
+                "status": "RUNNING" if ok else "UPDATING",
+                "replicas": running,
+                "target_replicas": info.target_replicas,
+            }
+        return {"status": "RUNNING" if all_running else "UPDATING",
+                "deployments": out}
+
+    def list_replica_names(self, app_name: str, dep_name: str):
+        key = f"replicas::{app_name}#{dep_name}"
+        sid, val = self.get_snapshot(key)
+        return sid, list(val or [])
+
+    def get_deployment_config(self, app_name: str, dep_name: str) -> Dict:
+        info = self._apps.get(app_name, {}).get(dep_name)
+        if info is None:
+            return {}
+        return {k: v for k, v in info.spec.items()
+                if k not in ("blob", "init_blob")}
+
+    # ------------------------------------------------------------- reconcile
+    async def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                for app_name, deps in list(self._apps.items()):
+                    for info in list(deps.values()):
+                        await self._reconcile_deployment(app_name, info)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            await asyncio.sleep(0.25)
+
+    async def _reconcile_deployment(self, app_name: str,
+                                    info: _DeploymentInfo):
+        await self._health_check(app_name, info)
+        if info.autoscaling:
+            self._autoscale(info)
+        cur = len(info.replicas)
+        if cur < info.target_replicas:
+            # start missing replicas concurrently so one slow model load
+            # doesn't serialize startup or starve other deployments' checks
+            results = await asyncio.gather(
+                *[self._start_replica(app_name, info)
+                  for _ in range(info.target_replicas - cur)],
+                return_exceptions=True)
+            for r in results:
+                if isinstance(r, BaseException):
+                    import traceback
+
+                    traceback.print_exception(type(r), r, r.__traceback__)
+            self._publish(app_name, info)
+        elif cur > info.target_replicas:
+            await self._stop_replicas(info, cur - info.target_replicas)
+            self._publish(app_name, info)
+        info.status = ("RUNNING"
+                       if len(info.replicas) >= info.target_replicas
+                       else "UPDATING")
+
+    async def _start_replica(self, app_name: str, info: _DeploymentInfo):
+        from ray_tpu.serve._private.replica import Replica
+
+        spec = info.spec
+        name = f"SERVE_REPLICA::{app_name}#{info.name}#{uuid.uuid4().hex[:6]}"
+        opts = dict(spec.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0.1)
+        actor = await asyncio.to_thread(
+            lambda: ray_tpu.remote(Replica).options(
+                name=name, namespace=SERVE_NAMESPACE,
+                max_concurrency=max(8, spec.get("max_ongoing_requests", 8) + 4),
+                **opts,
+            ).remote(
+                spec["blob"], spec["init_blob"], app_name, info.name,
+                spec.get("max_ongoing_requests", 8),
+                spec.get("user_config"),
+            ))
+        replica = _ReplicaState(name, actor)
+        try:
+            await asyncio.to_thread(
+                ray_tpu.get, actor.ready.remote(), timeout=120)
+        except Exception:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+            raise
+        info.replicas.append(replica)
+
+    async def _stop_replicas(self, info: _DeploymentInfo, n: int):
+        doomed, info.replicas = info.replicas[:n], info.replicas[n:]
+        for r in doomed:
+            try:
+                await asyncio.to_thread(
+                    ray_tpu.get, r.handle.drain.remote(),
+                    timeout=info.spec.get("graceful_shutdown_timeout_s", 5))
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(r.handle)
+            except Exception:
+                pass
+
+    async def _reconfigure_replicas(self, info: _DeploymentInfo):
+        for r in info.replicas:
+            try:
+                await asyncio.to_thread(
+                    ray_tpu.get,
+                    r.handle.reconfigure.remote(
+                        info.spec.get("user_config")), timeout=30)
+            except Exception:
+                r.healthy = False
+
+    async def _health_check(self, app_name: str, info: _DeploymentInfo):
+        period = info.spec.get("health_check_period_s", 2.0)
+        now = time.monotonic()
+        if now - getattr(info, "_last_health", 0) < period:
+            return
+        info._last_health = now
+        alive: List[_ReplicaState] = []
+        changed = False
+        total_ongoing = 0
+        for r in info.replicas:
+            try:
+                qlen = await asyncio.to_thread(
+                    ray_tpu.get, r.handle.health_check.remote(), timeout=5)
+                r.last_queue_len = int(qlen)
+                total_ongoing += r.last_queue_len
+                alive.append(r)
+            except Exception:
+                changed = True
+                try:
+                    ray_tpu.kill(r.handle)
+                except Exception:
+                    pass
+        info.replicas = alive
+        info._ongoing_history.append((now, total_ongoing))
+        info._ongoing_history = info._ongoing_history[-60:]
+        if changed:
+            self._publish(app_name, info)
+
+    # ------------------------------------------------------------- autoscale
+    def _autoscale(self, info: _DeploymentInfo):
+        """Request-based policy (reference: serve/autoscaling_policy.py):
+        keep ~target_ongoing_requests per replica, with delays to avoid
+        flapping."""
+        cfg = info.autoscaling
+        hist = info._ongoing_history
+        if not hist:
+            return
+        now = time.monotonic()
+        window = [v for (t, v) in hist if now - t < 5.0]
+        if not window:
+            return
+        avg_ongoing = sum(window) / len(window)
+        cur = max(1, len(info.replicas))
+        desired = avg_ongoing / cfg["target_ongoing_requests"]
+        import math
+
+        desired = int(min(max(math.ceil(desired), cfg["min_replicas"]),
+                          cfg["max_replicas"]))
+        if desired > len(info.replicas):
+            if now - info._last_scale_up > cfg["upscale_delay_s"]:
+                info.target_replicas = desired
+                info._last_scale_up = now
+        elif desired < len(info.replicas):
+            if now - info._last_scale_down > cfg["downscale_delay_s"]:
+                info.target_replicas = desired
+                info._last_scale_down = now
+
+    def _publish(self, app_name: str, info: _DeploymentInfo):
+        self.notify_changed(
+            f"replicas::{app_name}#{info.name}",
+            [r.name for r in info.replicas])

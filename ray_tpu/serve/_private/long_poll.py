@@ -1,0 +1,49 @@
+"""Long-poll config push (reference: python/ray/serve/_private/long_poll.py
+— LongPollHost :175 / LongPollClient :66). Clients block on
+``listen_for_change({key: last_snapshot_id})``; the host replies as soon as
+any key advances past the client's snapshot."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict
+
+
+class LongPollHost:
+    """Mixin for the controller: versioned key→value store with blocking
+    listeners."""
+
+    def __init__(self):
+        self._snapshots: Dict[str, int] = {}
+        self._values: Dict[str, Any] = {}
+        self._changed = asyncio.Event()
+
+    def notify_changed(self, key: str, value: Any) -> None:
+        self._values[key] = value
+        self._snapshots[key] = self._snapshots.get(key, 0) + 1
+        self._changed.set()
+
+    def get_snapshot(self, key: str):
+        return self._snapshots.get(key, 0), self._values.get(key)
+
+    async def listen_for_change(self, keys: Dict[str, int],
+                                timeout: float = 30.0) -> Dict[str, Any]:
+        """Return {key: (snapshot_id, value)} for keys newer than the
+        client's ids; empty dict on timeout."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            updates = {
+                k: (self._snapshots.get(k, 0), self._values.get(k))
+                for k, sid in keys.items()
+                if self._snapshots.get(k, 0) > sid
+            }
+            if updates:
+                return updates
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                return {}
+            self._changed.clear()
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                return {}

@@ -1,0 +1,187 @@
+"""HTTP proxy actor (reference: python/ray/serve/_private/proxy.py —
+ProxyActor :1097 runs uvicorn + gRPC servers, routes via proxy_router.py to
+DeploymentHandles).
+
+Hand-rolled asyncio HTTP/1.1 server (no uvicorn in this env): parses
+requests, longest-prefix route match against the controller's route table,
+dispatches through a DeploymentHandle, JSON-encodes responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.parse
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+
+
+class Request:
+    """What ingress callables receive (starlette.Request analog)."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body) if self.body else None
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class ProxyActor:
+    def __init__(self, port: int = 8000, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._handles: Dict[Tuple[str, str], Any] = {}
+        self._routes_snapshot = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._poll_task = None
+
+    async def ready(self) -> int:
+        """Start the HTTP server + route long-poll; returns bound port."""
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+            loop = asyncio.get_running_loop()
+            self._poll_task = loop.create_task(self._poll_routes())
+        return self.port
+
+    def _controller(self):
+        from ray_tpu.serve._private.controller import (
+            CONTROLLER_NAME, SERVE_NAMESPACE)
+
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+    async def _poll_routes(self):
+        """LongPollClient loop (reference: long_poll.py LongPollClient:66)."""
+        while True:
+            try:
+                # everything blocking runs off-loop: resolving the named
+                # controller can wait for it to come up, and a blocked loop
+                # here would freeze request handling (and the ready reply)
+                ctrl = await asyncio.to_thread(self._controller)
+                updates = await asyncio.to_thread(
+                    lambda: ray_tpu.get(
+                        ctrl.listen_for_change.remote(
+                            {"routes": self._routes_snapshot}, 10.0),
+                        timeout=15))
+                if updates and "routes" in updates:
+                    sid, routes = updates["routes"]
+                    self._routes_snapshot = sid
+                    self._routes = routes or {}
+            except Exception:
+                await asyncio.sleep(0.5)
+
+    # ----------------------------------------------------------- HTTP server
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _version = \
+                        line.decode("latin1").strip().split(" ", 2)
+                except ValueError:
+                    break
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                length = int(headers.get("content-length", 0) or 0)
+                if length:
+                    body = await reader.readexactly(length)
+                status, payload, ctype = await self._dispatch(
+                    method, target, headers, body)
+                writer.write(
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: keep-alive\r\n\r\n".encode("latin1"))
+                writer.write(payload)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, method: str, target: str,
+                        headers: Dict[str, str],
+                        body: bytes) -> Tuple[str, bytes, str]:
+        parsed = urllib.parse.urlsplit(target)
+        path = parsed.path
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        if path == "/-/healthz":
+            return "200 OK", b"success", "text/plain"
+        if path == "/-/routes":
+            return ("200 OK",
+                    json.dumps({p: a for p, (a, _) in self._routes.items()}
+                               ).encode(), "application/json")
+        match = self._match_route(path)
+        if match is None:
+            return "404 Not Found", b'{"error": "no route"}', \
+                "application/json"
+        prefix, (app_name, ingress) = match
+        # strip the normalized prefix so request.path keeps its leading "/"
+        sub_path = path[len(prefix.rstrip("/")):] or "/"
+        request = Request(method, sub_path, query, headers, body)
+        try:
+            handle = self._get_handle(app_name, ingress)
+            response = handle.remote(request)
+            result = await asyncio.to_thread(response.result, 60.0)
+            return self._encode(result)
+        except TimeoutError as e:
+            return ("503 Service Unavailable",
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json")
+        except Exception as e:
+            return ("500 Internal Server Error",
+                    json.dumps({"error": f"{type(e).__name__}: {e}"}
+                               ).encode(), "application/json")
+
+    def _match_route(self, path: str):
+        best = None
+        for prefix, target in self._routes.items():
+            norm = prefix.rstrip("/")
+            if path == norm or path.startswith(norm + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, target)
+        return best
+
+    def _get_handle(self, app_name: str, dep_name: str):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        key = (app_name, dep_name)
+        if key not in self._handles:
+            self._handles[key] = DeploymentHandle(app_name, dep_name)
+        return self._handles[key]
+
+    @staticmethod
+    def _encode(result: Any) -> Tuple[str, bytes, str]:
+        if isinstance(result, bytes):
+            return "200 OK", result, "application/octet-stream"
+        if isinstance(result, str):
+            return "200 OK", result.encode(), "text/plain"
+        return ("200 OK", json.dumps(result, default=str).encode(),
+                "application/json")

@@ -1,0 +1,118 @@
+"""Replica actor (reference: python/ray/serve/_private/replica.py —
+ReplicaActor :233, handle_request :391, rejection-based backpressure :487
+``max_ongoing_requests``).
+
+Hosts one instance of the user's deployment class/function. Requests above
+``max_ongoing_requests`` are rejected with a sentinel so the router retries
+elsewhere — backpressure flows to the caller instead of queueing here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, Dict, Optional, Tuple
+
+REJECTED = "__serve_rejected__"
+
+
+class _HandlePlaceholder:
+    """Marks a bound sub-deployment in init args; resolved to a
+    DeploymentHandle inside the replica."""
+
+    def __init__(self, app_name: str, dep_name: str):
+        self.app_name = app_name
+        self.dep_name = dep_name
+
+
+class Replica:
+    def __init__(self, blob: bytes, init_blob: bytes, app_name: str,
+                 dep_name: str, max_ongoing_requests: int,
+                 user_config: Any):
+        import cloudpickle
+
+        self._app_name = app_name
+        self._dep_name = dep_name
+        self._max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._draining = False
+
+        func_or_class = cloudpickle.loads(blob)
+        args, kwargs = cloudpickle.loads(init_blob)
+        args = tuple(self._resolve(a) for a in args)
+        kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+
+        if isinstance(func_or_class, type):
+            self._callable = func_or_class(*args, **kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+            self._is_function = True
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    @staticmethod
+    def _resolve(arg):
+        if isinstance(arg, _HandlePlaceholder):
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            return DeploymentHandle(arg.app_name, arg.dep_name)
+        return arg
+
+    def _apply_user_config(self, cfg):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(cfg)
+
+    # ------------------------------------------------------------- control
+    def ready(self) -> bool:
+        return True
+
+    def health_check(self) -> int:
+        """Doubles as queue-len probe: returns ongoing request count."""
+        check = getattr(self._callable, "check_health", None)
+        if check is not None:
+            check()
+        return self._ongoing
+
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def reconfigure(self, user_config) -> bool:
+        self._apply_user_config(user_config)
+        return True
+
+    async def drain(self) -> bool:
+        self._draining = True
+        while self._ongoing > 0:
+            await asyncio.sleep(0.02)
+        return True
+
+    # ------------------------------------------------------------- requests
+    async def handle_request(self, method_name: Optional[str], args: Tuple,
+                             kwargs: Dict, multiplexed_model_id: str = ""):
+        if self._ongoing >= self._max_ongoing or self._draining:
+            return (REJECTED, self._ongoing)
+        self._ongoing += 1
+        try:
+            from ray_tpu.serve import multiplex
+
+            if multiplexed_model_id:
+                multiplex._set_request_model_id(multiplexed_model_id)
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name or "__call__")
+            if inspect.iscoroutinefunction(target):
+                result = await target(*args, **kwargs)
+            else:
+                # sync user code runs off-loop so concurrent requests (and
+                # the rejection check) aren't serialized behind it
+                result = await asyncio.to_thread(target, *args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+            return ("ok", result)
+        finally:
+            self._ongoing -= 1
+            if multiplexed_model_id:
+                multiplex._set_request_model_id("")

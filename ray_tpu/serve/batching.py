@@ -1,0 +1,128 @@
+"""@serve.batch — dynamic request batching (reference:
+python/ray/serve/batching.py _BatchQueue/@serve.batch).
+
+TPU note: jitted models compile per input shape, so ``allowed_batch_sizes``
+lets the queue dispatch only at XLA-friendly sizes (pad-to-bucket happens in
+user code or via ``pad_batch``); this replaces GPU-style "whatever
+accumulated" batching with compiled-shape bucketing (SURVEY §7 hard part 7).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float,
+                 allowed_batch_sizes: Optional[Sequence[int]]):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.allowed = (sorted(allowed_batch_sizes)
+                        if allowed_batch_sizes else None)
+        if self.allowed and self.allowed[-1] < max_batch_size:
+            self.max_batch_size = self.allowed[-1]
+        self.queue: List = []  # (item, future)
+        self._flush_task: Optional[asyncio.Task] = None
+
+    def put(self, item: Any) -> "asyncio.Future":
+        fut = asyncio.get_running_loop().create_future()
+        self.queue.append((item, fut))
+        if len(self.queue) >= self.max_batch_size:
+            self._flush_now()
+        elif self._flush_task is None:
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_after_timeout())
+        return fut
+
+    def _take(self) -> List:
+        n = min(len(self.queue), self.max_batch_size)
+        if self.allowed:
+            # largest allowed size <= n; otherwise smallest allowed (the
+            # timeout path dispatches a short batch the model must pad)
+            fitting = [a for a in self.allowed if a <= n]
+            n = fitting[-1] if fitting else n
+        batch, self.queue = self.queue[:n], self.queue[n:]
+        return batch
+
+    def _flush_now(self):
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        batch = self._take()
+        if batch:
+            asyncio.get_running_loop().create_task(self._run(batch))
+        if self.queue:
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_after_timeout())
+
+    async def _flush_after_timeout(self):
+        try:
+            await asyncio.sleep(self.batch_wait_timeout_s)
+        except asyncio.CancelledError:
+            return
+        self._flush_task = None
+        self._flush_now()
+
+    async def _run(self, batch: List):
+        items = [i for i, _ in batch]
+        futs = [f for _, f in batch]
+        try:
+            results = await self.fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"batched function returned {len(results)} results for "
+                    f"{len(items)} inputs")
+            for f, r in zip(futs, results):
+                if not f.done():
+                    f.set_result(r)
+        except Exception as e:
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01,
+          allowed_batch_sizes: Optional[Sequence[int]] = None):
+    """Decorator for async methods taking a list of inputs."""
+
+    def deco(fn):
+        queues = {}  # per-instance (bound self) queue
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                owner, item = args
+                key = id(owner)
+                bound = functools.partial(fn, owner)
+            else:
+                (item,) = args
+                key, bound = None, fn
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(
+                    bound, max_batch_size, batch_wait_timeout_s,
+                    allowed_batch_sizes)
+            return await q.put(item)
+
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco
+
+
+def pad_batch(arrays, target: int, pad_value=0):
+    """Pad a list of equal-shape numpy arrays to ``target`` rows — helper
+    for allowed_batch_sizes bucketing on TPU."""
+    import numpy as np
+
+    n = len(arrays)
+    if n >= target:
+        return arrays
+    pad = [np.full_like(arrays[0], pad_value)] * (target - n)
+    return list(arrays) + pad

@@ -1,0 +1,106 @@
+"""Deployment + Application graph (reference: python/ray/serve/api.py
+@serve.deployment :246, deployment.py Deployment/Application;
+deployment_graph_build.py for bind-graph resolution).
+
+``@serve.deployment`` wraps a class or function; ``.bind(*args)`` builds an
+Application node whose bound arguments may themselves be Applications —
+those become ``DeploymentHandle``s injected at replica construction, which
+is how model-composition pipelines are expressed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference: serve/config.py AutoscalingConfig + autoscaling_policy.py.
+    Scale to keep ~target_ongoing_requests in flight per replica."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+
+class Deployment:
+    def __init__(self, func_or_class: Union[Callable, type], name: str,
+                 *, num_replicas: Optional[int] = 1,
+                 max_ongoing_requests: int = 8,
+                 user_config: Optional[Any] = None,
+                 autoscaling_config: Optional[Union[Dict,
+                                                    AutoscalingConfig]] = None,
+                 ray_actor_options: Optional[Dict] = None,
+                 health_check_period_s: float = 2.0,
+                 graceful_shutdown_timeout_s: float = 5.0):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.num_replicas = num_replicas or 1
+        self.max_ongoing_requests = max_ongoing_requests
+        self.user_config = user_config
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        self.autoscaling_config = autoscaling_config
+        self.ray_actor_options = ray_actor_options or {}
+        self.health_check_period_s = health_check_period_s
+        self.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+
+    @property
+    def is_function(self) -> bool:
+        return not isinstance(self.func_or_class, type)
+
+    def options(self, **kwargs) -> "Deployment":
+        fields = dict(
+            num_replicas=self.num_replicas,
+            max_ongoing_requests=self.max_ongoing_requests,
+            user_config=self.user_config,
+            autoscaling_config=self.autoscaling_config,
+            ray_actor_options=self.ray_actor_options,
+            health_check_period_s=self.health_check_period_s,
+            graceful_shutdown_timeout_s=self.graceful_shutdown_timeout_s,
+        )
+        name = kwargs.pop("name", self.name)
+        fields.update(kwargs)
+        return Deployment(self.func_or_class, name, **fields)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+class Application:
+    """A bound deployment node; the root of a graph passed to serve.run."""
+
+    def __init__(self, deployment: Deployment, args: Tuple, kwargs: Dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def walk(self) -> List["Application"]:
+        """All nodes, dependencies first, deduped by deployment name."""
+        seen: Dict[str, Application] = {}
+
+        def visit(node: "Application"):
+            for a in list(node.args) + list(node.kwargs.values()):
+                if isinstance(a, Application):
+                    visit(a)
+            seen.setdefault(node.deployment.name, node)
+
+        visit(self)
+        return list(seen.values())
+
+
+def deployment(func_or_class=None, *, name: Optional[str] = None, **options):
+    """``@serve.deployment`` decorator (reference: serve/api.py:246)."""
+
+    def wrap(target):
+        return Deployment(target, name or target.__name__, **options)
+
+    if func_or_class is not None and not options and name is None:
+        return wrap(func_or_class)
+    return wrap

@@ -1,0 +1,226 @@
+"""DeploymentHandle + Router (reference: python/ray/serve/handle.py:613 —
+``remote`` :685; _private/router.py:37; power-of-two-choices replica
+scheduling replica_scheduler/pow_2_scheduler.py:44 with queue-len probing
+and rejection retry).
+
+``handle.remote(*args)`` returns a ``DeploymentResponse``; resolution picks
+two random replicas, probes their queue lengths, sends to the shorter, and
+retries elsewhere when a replica rejects (it is at ``max_ongoing_requests``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayTaskError
+from ray_tpu.serve._private.controller import SERVE_NAMESPACE
+from ray_tpu.serve._private.replica import REJECTED
+
+
+class _ReplicaSet:
+    """Cached replica handles for one deployment, refreshed from the
+    controller (long-poll on change, TTL fallback)."""
+
+    TTL_S = 2.0
+
+    def __init__(self, app_name: str, dep_name: str):
+        self.app_name = app_name
+        self.dep_name = dep_name
+        self._snapshot_id = 0
+        self._handles: Dict[str, Any] = {}
+        self._names: List[str] = []
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _controller(self):
+        from ray_tpu.serve._private.controller import (
+            CONTROLLER_NAME, SERVE_NAMESPACE as NS)
+
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=NS)
+
+    def refresh(self, force: bool = False) -> None:
+        with self._lock:
+            now = time.monotonic()
+            if not force and now - self._last_refresh < self.TTL_S:
+                return
+            self._last_refresh = now
+            ctrl = self._controller()
+            sid, names = ray_tpu.get(
+                ctrl.list_replica_names.remote(self.app_name, self.dep_name),
+                timeout=30)
+            if sid == self._snapshot_id:
+                return
+            self._snapshot_id = sid
+            self._names = names
+            self._handles = {n: h for n, h in self._handles.items()
+                             if n in names}
+
+    def handles(self) -> List:
+        self.refresh()
+        out = []
+        for n in self._names:
+            h = self._handles.get(n)
+            if h is None:
+                try:
+                    h = ray_tpu.get_actor(n, namespace=SERVE_NAMESPACE)
+                    self._handles[n] = h
+                except Exception:
+                    continue
+            out.append(h)
+        return out
+
+
+class Router:
+    """Pow-2 choice with queue-len probing + rejection retry."""
+
+    def __init__(self, app_name: str, dep_name: str):
+        self.replica_set = _ReplicaSet(app_name, dep_name)
+
+    def _pick(self, handles: List) -> Any:
+        if len(handles) == 1:
+            return handles[0]
+        a, b = random.sample(handles, 2)
+        try:
+            qa, qb = ray_tpu.get(
+                [a.get_queue_len.remote(), b.get_queue_len.remote()],
+                timeout=2)
+        except Exception:
+            return random.choice((a, b))
+        return a if qa <= qb else b
+
+    def assign(self, method_name: Optional[str], args, kwargs,
+               multiplexed_model_id: str = "",
+               timeout: Optional[float] = None) -> Any:
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else 60.0)
+        backoff = 0.02
+        while True:
+            handles = self.replica_set.handles()
+            if not handles:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no replicas for {self.replica_set.app_name}#"
+                        f"{self.replica_set.dep_name}")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                self.replica_set.refresh(force=True)
+                continue
+            replica = self._pick(handles)
+            try:
+                reply = ray_tpu.get(
+                    replica.handle_request.remote(
+                        method_name, args, kwargs, multiplexed_model_id),
+                    timeout=max(0.5, deadline - time.monotonic()))
+            except RayTaskError:
+                # deterministic application error from user code: surface
+                # immediately, do NOT re-execute (side effects!)
+                raise
+            except Exception:
+                # transport/replica-death errors: retry elsewhere
+                if time.monotonic() > deadline:
+                    raise
+                self.replica_set.refresh(force=True)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                continue
+            if isinstance(reply, tuple) and len(reply) == 2 and \
+                    reply[0] == REJECTED:
+                # replica at max_ongoing_requests: back off, try another
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{self.replica_set.dep_name}: all replicas busy")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                continue
+            return reply[1]
+
+
+class DeploymentResponse:
+    """Lazy result of ``handle.remote`` (reference: handle.py
+    DeploymentResponse). ``result()`` blocks; ``await response`` works in
+    async deployments."""
+
+    def __init__(self, router: Router, method_name: Optional[str],
+                 args, kwargs, multiplexed_model_id: str = ""):
+        self._router = router
+        self._method_name = method_name
+        self._args = args
+        self._kwargs = kwargs
+        self._model_id = multiplexed_model_id
+        self._thread: Optional[threading.Thread] = None
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._start()
+
+    def _start(self):
+        def run():
+            try:
+                self._value = self._router.assign(
+                    self._method_name, self._args, self._kwargs,
+                    self._model_id)
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout_s):
+            raise TimeoutError("request did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def __await__(self):
+        return asyncio.to_thread(self.result).__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, dep_name: str,
+                 method_name: Optional[str] = None,
+                 multiplexed_model_id: str = ""):
+        self.app_name = app_name
+        self.deployment_name = dep_name
+        self._method_name = method_name
+        self._model_id = multiplexed_model_id
+        self._router: Optional[Router] = None
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self.app_name, self.deployment_name)
+        return self._router
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.app_name, self.deployment_name,
+            method_name or self._method_name,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id)
+        h._router = self._router
+        return h
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return DeploymentHandle(self.app_name, self.deployment_name, item,
+                                self._model_id)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return DeploymentResponse(
+            self._get_router(), self._method_name, args, kwargs,
+            self._model_id)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.app_name, self.deployment_name, self._method_name,
+                 self._model_id))

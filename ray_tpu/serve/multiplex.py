@@ -1,0 +1,67 @@
+"""Model multiplexing (reference: python/ray/serve/multiplex.py —
+@serve.multiplexed LRU-loads models per model-id; the router steers
+requests for the same id to replicas that already hold it. Serve-on-TPU's
+LoRA-adapter pattern: one base model per replica, adapters multiplexed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextvars
+import functools
+import inspect
+from typing import Any, Callable, Dict, Optional
+
+_request_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def _set_request_model_id(model_id: str) -> None:
+    _request_model_id.set(model_id)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the current request
+    (reference: serve.get_multiplexed_model_id)."""
+    return _request_model_id.get()
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator over an async ``load_model(model_id)`` function/method;
+    calling it returns the cached model, LRU-evicting beyond the cap."""
+
+    def deco(fn):
+        caches: Dict[Optional[int], "collections.OrderedDict"] = {}
+        locks: Dict[Optional[int], asyncio.Lock] = {}
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:
+                owner, model_id = args
+                key = id(owner)
+                call = functools.partial(fn, owner)
+            else:
+                (model_id,) = args
+                key, call = None, fn
+            cache = caches.setdefault(key, collections.OrderedDict())
+            lock = locks.setdefault(key, asyncio.Lock())
+            async with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                model = call(model_id)
+                if inspect.iscoroutine(model):
+                    model = await model
+                cache[model_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    # eviction drops the reference; models owning device
+                    # memory should release it in __del__
+                    cache.popitem(last=False)
+                return model
+
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

@@ -21,11 +21,14 @@ class _Rung:
     def cutoff(self, reduction_factor: float) -> Optional[float]:
         if not self.recorded:
             return None
-        scores = sorted(self.recorded.values())
-        k = int(len(scores) * (1 - 1 / reduction_factor))
-        if k <= 0:
-            return None
-        return scores[k - 1]
+        import numpy as np
+
+        # interpolated percentile, like the reference's nanpercentile-based
+        # cutoff: survive only the top 1/rf fraction (NaN scores from
+        # diverged trials must not poison the rung)
+        return float(np.nanpercentile(
+            list(self.recorded.values()),
+            (1 - 1 / reduction_factor) * 100))
 
 
 class _Bracket:
