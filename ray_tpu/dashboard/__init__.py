@@ -1,0 +1,128 @@
+"""Dashboard-lite (reference: dashboard/ — DashboardHead head.py:81 aiohttp
+REST + per-node agents; the React client is out of scope, the REST surface
+is here).
+
+One actor serves JSON state endpoints + Prometheus metrics over the same
+hand-rolled asyncio HTTP server style as the Serve proxy:
+
+- ``GET /api/nodes|actors|tasks|placement_groups|jobs``
+- ``GET /api/cluster_status`` — resource totals/availability
+- ``GET /api/jobs/<id>/logs``
+- ``GET /metrics`` — Prometheus text (reference: metrics agent)
+- ``GET /healthz``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.parse
+from typing import Optional, Tuple
+
+import ray_tpu
+
+DASHBOARD_NAME = "RAY_TPU_DASHBOARD"
+
+
+class DashboardActor:
+    def __init__(self, port: int = 8265, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._server = None
+
+    async def ready(self) -> int:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _handle_conn(self, reader, writer):
+        try:
+            line = await reader.readline()
+            if not line:
+                return
+            try:
+                method, target, _ = line.decode("latin1").strip().split(" ", 2)
+            except ValueError:
+                return
+            while True:
+                h = await reader.readline()
+                if not h or h in (b"\r\n", b"\n"):
+                    break
+            status, payload, ctype = await self._dispatch(method, target)
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode("latin1"))
+            writer.write(payload)
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, method: str,
+                        target: str) -> Tuple[str, bytes, str]:
+        path = urllib.parse.urlsplit(target).path
+        try:
+            if path == "/healthz":
+                return "200 OK", b"success", "text/plain"
+            if path == "/metrics":
+                from ray_tpu.util.metrics import prometheus_text
+
+                text = await asyncio.to_thread(prometheus_text)
+                return "200 OK", text.encode(), "text/plain"
+            if path.startswith("/api/"):
+                data = await asyncio.to_thread(self._api, path)
+                if data is None:
+                    return ("404 Not Found", b'{"error": "not found"}',
+                            "application/json")
+                return ("200 OK", json.dumps(data, default=str).encode(),
+                        "application/json")
+            return "404 Not Found", b'{"error": "no route"}', \
+                "application/json"
+        except Exception as e:
+            return ("500 Internal Server Error",
+                    json.dumps({"error": repr(e)}).encode(),
+                    "application/json")
+
+    def _api(self, path: str):
+        from ray_tpu.util import state as state_api
+
+        parts = [p for p in path.split("/") if p][1:]  # drop "api"
+        if parts[0] == "nodes":
+            return state_api.list_nodes()
+        if parts[0] == "actors":
+            return state_api.list_actors()
+        if parts[0] == "tasks":
+            return state_api.list_tasks()
+        if parts[0] == "placement_groups":
+            return state_api.list_placement_groups()
+        if parts[0] == "cluster_status":
+            return {"total": ray_tpu.cluster_resources(),
+                    "available": ray_tpu.available_resources()}
+        if parts[0] == "jobs":
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            client = JobSubmissionClient()
+            if len(parts) == 1:
+                return client.list_jobs()
+            if len(parts) == 3 and parts[2] == "logs":
+                return {"logs": client.get_job_logs(parts[1])}
+            return client.get_job_info(parts[1])
+        return None
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
+    """Start (or get) the dashboard actor; returns its bound port."""
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_NAME, namespace="_dashboard")
+    except Exception:
+        actor = ray_tpu.remote(DashboardActor).options(
+            name=DASHBOARD_NAME, namespace="_dashboard",
+            max_concurrency=16, num_cpus=0.1).remote(port=port, host=host)
+    return ray_tpu.get(actor.ready.remote(), timeout=60)

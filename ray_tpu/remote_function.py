@@ -68,6 +68,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._default_options)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: dag/dag_node.py bind)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def options(self, **options):
         validate_options(options)
         merged = {**self._default_options, **options}
@@ -76,6 +82,12 @@ class RemoteFunction:
         class _Wrapped:
             def remote(self, *args, **kwargs):
                 return parent._remote(args, kwargs, merged)
+
+            def bind(self, *args, **kwargs):
+                from ray_tpu.dag import FunctionNode
+
+                # self.remote already applies the merged options
+                return FunctionNode(self, args, kwargs)
 
             def __getattr__(self, item):
                 return getattr(parent, item)

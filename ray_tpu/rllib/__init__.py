@@ -1,0 +1,23 @@
+"""ray_tpu.rllib — RL at scale, JAX-native (reference: rllib/ —
+Algorithm algorithms/algorithm.py:193, new-stack Learner
+core/learner/learner.py:105, EnvRunner env/env_runner.py:15; SURVEY §2.4
+RLlib row, §7 phase 7).
+
+The reference's ``framework='torch'/'tf2'`` stacks are replaced by a single
+JAX stack: RLModules are pure-function params+apply, Learners jit their
+update over the device mesh (GSPMD psum = DDP allreduce), env runners stay
+CPU actors.
+"""
+
+from ray_tpu.rllib.algorithms import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import Learner, PPOLearner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Learner",
+    "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
+    "SingleAgentEnvRunner",
+]

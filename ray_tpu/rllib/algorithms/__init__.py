@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+__all__ = ["Algorithm", "AlgorithmConfig"]

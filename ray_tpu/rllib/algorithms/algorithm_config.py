@@ -1,0 +1,164 @@
+"""AlgorithmConfig — fluent builder (reference:
+rllib/algorithms/algorithm_config.py, 3.5k LoC; ``framework`` :1205. Here
+JAX is the only framework, so ``framework("jax")`` is the default and the
+torch/tf paths don't exist).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Tuple, Type, Union
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Optional[Union[str, Callable]] = None
+        self.env_config: Dict = {}
+        # env runners
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 4
+        self.rollout_fragment_length = 64
+        self.explore = True
+        # training
+        self.gamma = 0.99
+        self.lr = 3e-4
+        self.train_batch_size = 2048
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.grad_clip = 0.5
+        self.seed = 0
+        # learners
+        self.num_learners = 0
+        self.resources_per_learner: Optional[Dict] = None
+        # model
+        self.model: Dict = {"hiddens": (64, 64), "activation": "tanh"}
+        # framework (always jax; kept for API parity)
+        self.framework_str = "jax"
+        # fault tolerance (reference: restart_failed_env_runners)
+        self.restart_failed_env_runners = True
+
+    # ------------------------------------------------------- fluent setters
+    def environment(self, env=None, *, env_config: Optional[Dict] = None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    explore: Optional[bool] = None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if explore is not None:
+            self.explore = explore
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k) and k not in self._training_keys():
+                raise ValueError(f"unknown training key {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def _training_keys(self):
+        return set()
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 resources_per_learner: Optional[Dict] = None
+                 ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if resources_per_learner is not None:
+            self.resources_per_learner = resources_per_learner
+        return self
+
+    def framework(self, framework: str = "jax") -> "AlgorithmConfig":
+        if framework != "jax":
+            raise ValueError(
+                "this build is TPU/JAX-native; framework must be 'jax'")
+        self.framework_str = framework
+        return self
+
+    def fault_tolerance(self, *, restart_failed_env_runners: Optional[bool]
+                        = None) -> "AlgorithmConfig":
+        if restart_failed_env_runners is not None:
+            self.restart_failed_env_runners = restart_failed_env_runners
+        return self
+
+    def rl_module(self, *, model: Optional[Dict] = None) -> "AlgorithmConfig":
+        if model:
+            self.model.update(model)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # --------------------------------------------------------------- build
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict:
+        return {k: v for k, v in self.__dict__.items()
+                if k != "algo_class"}
+
+    def build(self, use_tune_dirs: bool = False):
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig() etc.")
+        return self.algo_class(config=self)
+
+    # ------------------------------------------------------------ env utils
+    def make_env(self) -> Callable:
+        env = self.env
+        env_config = self.env_config
+        if callable(env):
+            return lambda: env(env_config)
+        if isinstance(env, str):
+            def creator():
+                import gymnasium as gym
+
+                return gym.make(env, **env_config)
+
+            return creator
+        raise ValueError(f"unsupported env spec {env!r}")
+
+    def module_spec(self):
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        probe = self.make_env()()
+        try:
+            import gymnasium as gym
+
+            obs_space = probe.observation_space
+            act_space = probe.action_space
+            obs_dim = int(obs_space.shape[0])
+            if isinstance(act_space, gym.spaces.Discrete):
+                return RLModuleSpec(
+                    obs_dim=obs_dim, action_dim=int(act_space.n),
+                    discrete=True,
+                    hiddens=tuple(self.model.get("hiddens", (64, 64))),
+                    activation=self.model.get("activation", "tanh"))
+            return RLModuleSpec(
+                obs_dim=obs_dim, action_dim=int(act_space.shape[0]),
+                discrete=False,
+                hiddens=tuple(self.model.get("hiddens", (64, 64))),
+                activation=self.model.get("activation", "tanh"))
+        finally:
+            probe.close()
+
+    def learner_config_dict(self) -> Dict:
+        return {
+            "lr": self.lr, "grad_clip": self.grad_clip,
+            "num_epochs": self.num_epochs,
+            "minibatch_size": self.minibatch_size, "seed": self.seed,
+        }

@@ -1,0 +1,134 @@
+"""RLModule — the model abstraction (reference:
+rllib/core/rl_module/rl_module.py; the reference's torch/tf modules become
+pure-JAX functional modules here: params are a pytree, forward is a pure
+function, so the same module runs jitted on TPU in the Learner and on CPU in
+env runners).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------ distributions
+class Categorical:
+    """Action distribution over discrete logits (reference:
+    rllib/models/distributions torch Categorical analog)."""
+
+    @staticmethod
+    def sample(rng, logits):
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    @staticmethod
+    def logp(logits, actions):
+        logps = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logps, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits):
+        logps = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logps) * logps, axis=-1)
+
+
+class DiagGaussian:
+    """Squash-free diagonal Gaussian for continuous actions; logits =
+    concat(mean, log_std)."""
+
+    @staticmethod
+    def split(logits):
+        mean, log_std = jnp.split(logits, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(rng, logits):
+        mean, log_std = DiagGaussian.split(logits)
+        return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+    @staticmethod
+    def logp(logits, actions):
+        mean, log_std = DiagGaussian.split(logits)
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((actions - mean) ** 2 / var)
+            - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+    @staticmethod
+    def entropy(logits):
+        _, log_std = DiagGaussian.split(logits)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+
+# ----------------------------------------------------------------- RLModule
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference: rllib/core/rl_module/rl_module.py RLModuleSpec."""
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool = True
+    hiddens: Tuple[int, ...] = (64, 64)
+    activation: str = "tanh"
+
+    def build(self) -> "MLPModule":
+        return MLPModule(self)
+
+
+class MLPModule:
+    """Separate policy/value MLP towers (reference default model:
+    rllib/models/catalog.py fcnet)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self.dist = Categorical if spec.discrete else DiagGaussian
+        self._act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[spec.activation]
+        self._out_dim = (spec.action_dim if spec.discrete
+                         else 2 * spec.action_dim)
+
+    # ------------------------------------------------------------- params
+    def init(self, rng) -> Dict:
+        def mlp_params(key, sizes):
+            layers = []
+            for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+                key, sub = jax.random.split(key)
+                scale = jnp.sqrt(2.0 / a)
+                # tiny final layer: near-uniform initial policy
+                if i == len(sizes) - 2:
+                    scale = scale * 0.01
+                layers.append({
+                    "w": jax.random.normal(sub, (a, b)) * scale,
+                    "b": jnp.zeros((b,)),
+                })
+            return layers
+
+        k1, k2 = jax.random.split(rng)
+        sizes = (self.spec.obs_dim, *self.spec.hiddens)
+        return {
+            "pi": mlp_params(k1, sizes + (self._out_dim,)),
+            "vf": mlp_params(k2, sizes + (1,)),
+        }
+
+    # ------------------------------------------------------------ forward
+    def _tower(self, layers, x):
+        for layer in layers[:-1]:
+            x = self._act(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        """Returns action logits and value estimate."""
+        logits = self._tower(params["pi"], obs)
+        vf = self._tower(params["vf"], obs)[..., 0]
+        return {"logits": logits, "vf": vf}
+
+    def explore_action(self, params, obs, rng):
+        """Sample action + logp + value — the env-runner inference path."""
+        out = self.forward(params, obs)
+        action = self.dist.sample(rng, out["logits"])
+        logp = self.dist.logp(out["logits"], action)
+        return action, logp, out["vf"]

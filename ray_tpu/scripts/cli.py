@@ -1,0 +1,228 @@
+"""CLI (reference: python/ray/scripts/scripts.py — ``ray
+start/stop/status/memory/timeline/summary`` via click; argparse here).
+
+``python -m ray_tpu.scripts.cli start --head`` daemonizes a head node whose
+address lands in ``/tmp/ray_tpu_current_head``; workers join with
+``start --address host:port``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ADDR_FILE = "/tmp/ray_tpu_current_head"
+PID_FILE = "/tmp/ray_tpu_node_pids"
+
+
+def _record_pid(pid: int) -> None:
+    pids = []
+    if os.path.exists(PID_FILE):
+        with open(PID_FILE) as f:
+            pids = json.load(f)
+    pids.append(pid)
+    with open(PID_FILE, "w") as f:
+        json.dump(pids, f)
+
+
+def cmd_start(args) -> int:
+    runner = (
+        "import json, signal, sys, time\n"
+        "import ray_tpu\n"
+        "from ray_tpu._private.node import Node\n"
+        f"head = {args.head}\n"
+        f"addr = {args.address!r}\n"
+        f"res = json.loads({args.resources!r}) if {args.resources!r} else None\n"
+        f"num_cpus = {args.num_cpus!r}\n"
+        "if num_cpus is not None:\n"
+        "    res = dict(res or {}); res['CPU'] = float(num_cpus)\n"
+        "if head:\n"
+        f"    node = Node(head=True, head_port={args.port}, resources=res)\n"
+        "else:\n"
+        "    host, _, port = addr.partition(':')\n"
+        "    node = Node(head=False, head_host=host, head_port=int(port),"
+        " resources=res)\n"
+        "node.start()\n"
+        "if head:\n"
+        f"    open({ADDR_FILE!r}, 'w').write("
+        "f'{node.head_host}:{node.head_port}')\n"
+        "print('NODE_READY', node.session_dir, flush=True)\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+        "while True:\n"
+        "    time.sleep(3600)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", runner],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("NODE_READY"):
+            _record_pid(proc.pid)
+            print(f"node started (pid {proc.pid}): {line.split()[1]}")
+            if args.head:
+                with open(ADDR_FILE) as f:
+                    print(f"head address: {f.read()}")
+            return 0
+        if proc.poll() is not None:
+            print("node failed to start:\n" + line +
+                  (proc.stdout.read() or ""))
+            return 1
+    proc.kill()
+    print("node start timed out")
+    return 1
+
+
+def cmd_stop(args) -> int:
+    n = 0
+    if os.path.exists(PID_FILE):
+        with open(PID_FILE) as f:
+            pids = json.load(f)
+        for pid in pids:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+                n += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+        os.remove(PID_FILE)
+    for f in (ADDR_FILE,):
+        if os.path.exists(f):
+            os.remove(f)
+    print(f"stopped {n} node(s)")
+    return 0
+
+
+def _connect():
+    import ray_tpu
+
+    if not os.path.exists(ADDR_FILE):
+        print("no running head (start one with: "
+              "python -m ray_tpu.scripts.cli start --head)")
+        sys.exit(1)
+    with open(ADDR_FILE) as f:
+        ray_tpu.init(address=f.read().strip())
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _connect()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("Node status")
+    print("-" * 40)
+    for n in ray_tpu.nodes():
+        state = "ALIVE" if n["alive"] else "DEAD"
+        print(f"  {n['node_id'][:12]} {state}")
+    print("\nResources")
+    print("-" * 40)
+    for k in sorted(total):
+        used = total[k] - avail.get(k, 0.0)
+        print(f"  {used:g}/{total[k]:g} {k}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    _connect()
+    fn = {
+        "actors": state_api.list_actors,
+        "nodes": state_api.list_nodes,
+        "tasks": state_api.list_tasks,
+        "placement-groups": state_api.list_placement_groups,
+        "jobs": state_api.list_jobs,
+    }[args.resource]
+    print(json.dumps(fn(), indent=1, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    _connect()
+    fn = {"tasks": state_api.summarize_tasks,
+          "actors": state_api.summarize_actors}[args.resource]
+    print(json.dumps(fn(), indent=1))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    ray_tpu = _connect()
+    events = ray_tpu.timeline()
+    path = args.output or f"/tmp/ray_tpu_timeline_{int(time.time())}.json"
+    with open(path, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {path} "
+          "(open in chrome://tracing or Perfetto)")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    ray_tpu = _connect()
+    for n in ray_tpu.nodes():
+        print(f"node {n['node_id'][:12]}: "
+              f"object store {n.get('store_bytes_used', '?')} bytes used")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    from ray_tpu.util.metrics import prometheus_text
+
+    _connect()
+    print(prometheus_text())
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a head or worker node")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", default="")
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--num-cpus", dest="num_cpus", default=None)
+    s.add_argument("--resources", default="")
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("stop", help="stop all locally-started nodes")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("status", help="cluster resources + nodes")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("list", help="list cluster state")
+    s.add_argument("resource", choices=[
+        "actors", "nodes", "tasks", "placement-groups", "jobs"])
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("summary", help="summarize tasks/actors")
+    s.add_argument("resource", choices=["tasks", "actors"])
+    s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    s.add_argument("--output", default="")
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("memory", help="object store usage")
+    s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser("metrics", help="Prometheus metrics dump")
+    s.set_defaults(fn=cmd_metrics)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,11 +81,17 @@ class ServeController(LongPollHost):
                     await self._reconfigure_replicas(info)
             elif old is not None:
                 await self._stop_replicas(old, len(old.replicas))
+                # publish the now-empty replica set so routers fail fast
+                # instead of probing stopped actors while the reconcile
+                # loop brings up the new version
+                self.notify_changed(f"replicas::{app_name}#{info.name}", [])
             new[info.name] = info
-        # drop deployments removed from the app
+        # drop deployments removed from the app (publish the empty replica
+        # set so routers fail fast instead of probing dead actors)
         for name, old in existing.items():
             if name not in new:
                 await self._stop_replicas(old, len(old.replicas))
+                self.notify_changed(f"replicas::{app_name}#{name}", [])
         self._apps[app_name] = new
         for prefix, (a, _) in list(self._routes.items()):
             if a == app_name:

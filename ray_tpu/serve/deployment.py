@@ -101,6 +101,10 @@ def deployment(func_or_class=None, *, name: Optional[str] = None, **options):
     def wrap(target):
         return Deployment(target, name or target.__name__, **options)
 
-    if func_or_class is not None and not options and name is None:
+    if func_or_class is not None:
+        if options or name is not None:
+            raise ValueError(
+                "pass options via @serve.deployment(...) as a decorator "
+                "factory, not together with the function/class positionally")
         return wrap(func_or_class)
     return wrap

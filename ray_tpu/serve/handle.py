@@ -11,6 +11,7 @@ retries elsewhere when a replica rejects (it is at ``max_ongoing_requests``).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import random
 import threading
 import time
@@ -20,6 +21,64 @@ import ray_tpu
 from ray_tpu.exceptions import RayTaskError
 from ray_tpu.serve._private.controller import SERVE_NAMESPACE
 from ray_tpu.serve._private.replica import REJECTED
+
+# Shared bounded pool driving request resolution: one task per in-flight
+# handle.remote(), instead of an unbounded thread per request. Daemon
+# threads (unlike ThreadPoolExecutor's) so stranded requests never block
+# interpreter exit.
+class _DaemonPool:
+    MAX_WORKERS = 64
+
+    def __init__(self):
+        import collections
+
+        self._q: "collections.deque" = collections.deque()
+        self._cv = threading.Condition()
+        self._threads = 0
+        self._idle = 0  # exact count of threads blocked in wait()
+
+    def submit(self, fn, *args):
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        with self._cv:
+            self._q.append((fut, fn, args))
+            if self._idle >= len(self._q):
+                # enough waiters to claim every queued item
+                self._cv.notify()
+            elif self._threads < self.MAX_WORKERS:
+                self._threads += 1
+                threading.Thread(
+                    target=self._run, name="serve-handle", daemon=True
+                ).start()
+            else:
+                self._cv.notify()  # saturated: item waits for a free thread
+        return fut
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._q:
+                    self._idle += 1
+                    self._cv.wait()
+                    self._idle -= 1
+                fut, fn, args = self._q.popleft()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:
+                fut.set_exception(e)
+
+
+_request_pool: Optional[_DaemonPool] = None
+_request_pool_lock = threading.Lock()
+
+
+def _get_request_pool() -> _DaemonPool:
+    global _request_pool
+    with _request_pool_lock:
+        if _request_pool is None:
+            _request_pool = _DaemonPool()
+        return _request_pool
 
 
 class _ReplicaSet:
@@ -152,32 +211,20 @@ class DeploymentResponse:
         self._args = args
         self._kwargs = kwargs
         self._model_id = multiplexed_model_id
-        self._thread: Optional[threading.Thread] = None
-        self._value = None
-        self._error: Optional[BaseException] = None
-        self._done = threading.Event()
-        self._start()
-
-    def _start(self):
-        def run():
-            try:
-                self._value = self._router.assign(
-                    self._method_name, self._args, self._kwargs,
-                    self._model_id)
-            except BaseException as e:
-                self._error = e
-            finally:
-                self._done.set()
-
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
+        self._future = _get_request_pool().submit(
+            self._router.assign, self._method_name, self._args,
+            self._kwargs, self._model_id)
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        if not self._done.wait(timeout_s):
+        try:
+            return self._future.result(timeout_s)
+        except concurrent.futures.TimeoutError:
+            if self._future.done():
+                # completed in the race window after the wait timed out —
+                # surface the real outcome (a value, or the request's own
+                # TimeoutError with its diagnostic message)
+                return self._future.result(0)
             raise TimeoutError("request did not complete in time")
-        if self._error is not None:
-            raise self._error
-        return self._value
 
     def __await__(self):
         return asyncio.to_thread(self.result).__await__()

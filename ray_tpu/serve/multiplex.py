@@ -45,7 +45,12 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                 (model_id,) = args
                 key, call = None, fn
             cache = caches.setdefault(key, collections.OrderedDict())
-            lock = locks.setdefault(key, asyncio.Lock())
+            # fast path: cached models never wait behind a slow load
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            # per-model lock so only duplicate loads serialize
+            lock = locks.setdefault((key, model_id), asyncio.Lock())
             async with lock:
                 if model_id in cache:
                     cache.move_to_end(model_id)
@@ -56,7 +61,10 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                 cache[model_id] = model
                 while len(cache) > max_num_models_per_replica:
                     # eviction drops the reference; models owning device
-                    # memory should release it in __del__
+                    # memory should release it in __del__. The per-model
+                    # lock is kept: popping it while a waiter holds it
+                    # would let two coroutines load the same model at once
+                    # (locks are tiny; distinct model ids bound their count)
                     cache.popitem(last=False)
                 return model
 

@@ -1,0 +1,8 @@
+"""ray_tpu.util — utilities (reference: python/ray/util/ — ActorPool
+actor_pool.py, Queue queue.py, metrics metrics.py, state api, collective,
+placement groups, scheduling strategies)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
+
+__all__ = ["ActorPool", "Queue"]

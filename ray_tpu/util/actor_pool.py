@@ -1,0 +1,95 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py, 463 LoC — the same
+map/map_unordered/submit/get_next surface)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, TYPE_CHECKING
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List = []
+
+    # ---------------------------------------------------------------- map
+    def map(self, fn: Callable, values: Iterable) -> Iterator:
+        """Ordered map over the pool; yields results in submission order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterator:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, fn: Callable, value: Any) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order. A timeout leaves the pool state
+        intact so the same call can be retried."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        idx = self._next_return_index
+        future = self._index_to_future[idx]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("next result not ready within timeout")
+        del self._index_to_future[idx]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(future)
+        try:
+            return ray_tpu.get(future)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        if not self.has_next():
+            raise StopIteration("no more results")
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        future = ready[0]
+        idx, actor = self._future_to_actor.pop(future)
+        del self._index_to_future[idx]
+        try:
+            return ray_tpu.get(future)
+        finally:
+            self._return_actor(actor)
+
+    # -------------------------------------------------------------- admin
+    def push(self, actor) -> None:
+        """Add an idle actor to the pool."""
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,225 @@
+"""User-defined metrics (reference: python/ray/util/metrics.py
+Counter/Gauge/Histogram → includes/metric.pxi; exported in Prometheus text
+format the way the reference's dashboard agent exposes them).
+
+Metrics are process-local and aggregated through the head KV: each process
+periodically publishes its serialized metric snapshot under
+``metrics::{node}::{pid}``; ``prometheus_text()`` merges all snapshots.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_registry_lock = threading.Lock()
+_FLUSH_PERIOD_S = 2.0
+_flusher_started = False
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _REGISTRY[name] = self
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        return {**self._default_tags, **(tags or {})}
+
+    # ------------------------------------------------------------- export
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "name": self.name, "kind": self.kind,
+                "description": self.description,
+                "values": [[list(k), v] for k, v in self._values.items()],
+            }
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_tag_key(self._merged(tags))] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [
+            0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "name": self.name, "kind": self.kind,
+                "description": self.description,
+                "boundaries": self.boundaries,
+                "counts": [[list(k), v] for k, v in self._counts.items()],
+                "sums": [[list(k), v] for k, v in self._sums.items()],
+            }
+
+
+# ------------------------------------------------------------- aggregation
+def _ensure_flusher() -> None:
+    global _flusher_started
+    if _flusher_started:
+        return
+    _flusher_started = True
+
+    def flush_loop():
+        while True:
+            time.sleep(_FLUSH_PERIOD_S)
+            try:
+                flush_now()
+            except Exception:
+                pass
+
+    threading.Thread(target=flush_loop, daemon=True,
+                     name="metrics-flush").start()
+
+
+def flush_now() -> None:
+    """Publish this process's snapshots to the head KV."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        return
+    with _registry_lock:
+        snaps = [m.snapshot() for m in _REGISTRY.values()]
+    if not snaps:
+        return
+    key = f"metrics::{w.node_id}::{os.getpid()}".encode()
+    w.kv().put(key, json.dumps(snaps).encode(), namespace="_metrics")
+
+
+def collect_cluster_metrics() -> List[Dict]:
+    """All published snapshots across processes (driver-side)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        return []
+    kv = w.kv()
+    out = []
+    for key in kv.keys(b"metrics::", namespace="_metrics"):
+        raw = kv.get(bytes(key), namespace="_metrics")
+        if raw:
+            out.extend(json.loads(raw))
+    return out
+
+
+def _fmt_tags(tag_list: List) -> str:
+    if not tag_list:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in tag_list)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Merge all processes' snapshots into Prometheus exposition format
+    (what the reference's metrics agent serves to Prometheus)."""
+    merged: Dict[str, Dict] = {}
+    for snap in collect_cluster_metrics():
+        cur = merged.setdefault(snap["name"], snap)
+        if cur is snap:
+            continue
+        if snap["kind"] == "histogram":
+            for k, v in snap.get("counts", []):
+                for existing in cur["counts"]:
+                    if existing[0] == k:
+                        existing[1] = [a + b for a, b in zip(existing[1], v)]
+                        break
+                else:
+                    cur["counts"].append([k, v])
+            for k, v in snap.get("sums", []):
+                for existing in cur["sums"]:
+                    if existing[0] == k:
+                        existing[1] += v
+                        break
+                else:
+                    cur["sums"].append([k, v])
+        else:
+            for k, v in snap.get("values", []):
+                for existing in cur["values"]:
+                    if existing[0] == k:
+                        existing[1] = (existing[1] + v
+                                       if snap["kind"] == "counter" else v)
+                        break
+                else:
+                    cur["values"].append([k, v])
+    lines = []
+    for snap in merged.values():
+        name = snap["name"]
+        lines.append(f"# HELP {name} {snap['description']}")
+        lines.append(f"# TYPE {name} {snap['kind']}")
+        if snap["kind"] == "histogram":
+            for key, counts in snap.get("counts", []):
+                cum = 0
+                for bound, c in zip(snap["boundaries"], counts):
+                    cum += c
+                    tag = _fmt_tags(list(key) + [["le", bound]])
+                    lines.append(f"{name}_bucket{tag} {cum}")
+                cum += counts[-1]
+                tag = _fmt_tags(list(key) + [["le", "+Inf"]])
+                lines.append(f"{name}_bucket{tag} {cum}")
+                lines.append(
+                    f"{name}_count{_fmt_tags(list(key))} {cum}")
+            for key, s in snap.get("sums", []):
+                lines.append(f"{name}_sum{_fmt_tags(list(key))} {s}")
+        else:
+            for key, v in snap.get("values", []):
+                lines.append(f"{name}{_fmt_tags(list(key))} {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,116 @@
+"""Distributed Queue (reference: python/ray/util/queue.py, 305 LoC — an
+actor-backed asyncio queue with the same Empty/Full semantics)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            if timeout is None:
+                await self.q.put(item)
+            else:
+                await asyncio.wait_for(self.q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def put_nowait(self, item) -> bool:
+        try:
+            self.q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            if timeout is None:
+                return True, await self.q.get()
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def get_nowait(self):
+        try:
+            return True, self.q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    def qsize(self) -> int:
+        return self.q.qsize()
+
+    def empty(self) -> bool:
+        return self.q.empty()
+
+    def full(self) -> bool:
+        return self.q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("max_concurrency", 64)
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+                raise Full
+            return
+        ok = ray_tpu.get(self.actor.put.remote(item, timeout))
+        if not ok:
+            raise Full
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty
+            return item
+        ok, item = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_async(self, item: Any):
+        return self.actor.put.remote(item, None)
+
+    def get_async(self):
+        return self.actor.get.remote(None)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

@@ -1,0 +1,90 @@
+"""State API (reference: python/ray/util/state/api.py — list_actors :782,
+list_tasks :1014, summaries :1376; aggregated by
+dashboard/state_aggregator.py StateAPIManager :141).
+
+Queries go to the head's info handlers; per-worker live state rides the
+task-event store the way the reference pairs GCS data with
+``QueryAllWorkerStates``.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "list_actors", "list_nodes", "list_tasks", "list_placement_groups",
+    "list_jobs", "summarize_tasks", "summarize_actors",
+]
+
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+def _call(method: str, payload: Optional[Dict] = None):
+    w = _worker()
+    return w._acall(w.head.call(method, payload or {}))
+
+
+def _apply_filters(rows: List[Dict], filters) -> List[Dict]:
+    """filters: [(key, op, value)] with op in ('=', '!=')."""
+    for key, op, value in filters or []:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def list_actors(filters=None, limit: int = 1000) -> List[Dict]:
+    rows = _call("ListActors")
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_nodes(filters=None, limit: int = 1000) -> List[Dict]:
+    rows = _call("ListNodes")
+    for r in rows:
+        r["state"] = "ALIVE" if r.get("alive") else "DEAD"
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 10000) -> List[Dict]:
+    w = _worker()
+    w.flush_task_events()
+    rows = _call("ListTaskEvents", {"limit": limit * 4})
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 1000) -> List[Dict]:
+    rows = _call("ListPlacementGroups")
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_jobs(filters=None, limit: int = 1000) -> List[Dict]:
+    rows = _call("ListJobs")
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_tasks() -> Dict[str, Dict]:
+    """Per-function-name counts by state (reference: ``ray summary tasks``)."""
+    by_name: Dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter)
+    for e in list_tasks():
+        by_name[e.get("name", "?")][e.get("state", "?")] += 1
+    return {name: dict(states) for name, states in by_name.items()}
+
+
+def summarize_actors() -> Dict[str, Dict]:
+    by_class: Dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter)
+    for a in list_actors():
+        by_class[a.get("class_name", "?")][a.get("state", "?")] += 1
+    return {cls: dict(states) for cls, states in by_class.items()}

@@ -1,0 +1,254 @@
+"""Utility-layer tests (reference analog: python/ray/tests/test_actor_pool,
+test_queue, test_metrics, util/state tests, dag tests, workflow tests)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util.queue import Empty, Full
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- ActorPool
+def test_actor_pool_map_ordered(ray4):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, v):
+            return v * 2
+
+    pool = ActorPool([Worker.remote(), Worker.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+    # unordered returns the same set
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+# -------------------------------------------------------------------- Queue
+def test_queue_basics(ray4):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_producer_consumer(ray4):
+    q = Queue()
+
+    @ray_tpu.remote
+    def produce(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ref = produce.remote(q, 10)
+    got = [q.get(timeout=30) for _ in range(10)]
+    assert got == list(range(10))
+    assert ray_tpu.get(ref)
+    q.shutdown()
+
+
+# ------------------------------------------------------------------ metrics
+def test_metrics_prometheus(ray4):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests_total", "reqs",
+                        tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = metrics.Gauge("test_inflight", "inflight")
+    g.set(7)
+    h = metrics.Histogram("test_latency_s", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    metrics.flush_now()
+    text = metrics.prometheus_text()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_inflight 7.0" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1' in text
+    assert 'test_latency_s_bucket{le="+Inf"} 3' in text
+    assert "test_latency_s_sum 5.55" in text
+
+
+# ---------------------------------------------------------------- state API
+def test_state_api(ray4):
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    class Stateful:
+        def ping(self):
+            return "pong"
+
+    a = Stateful.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state_api.list_actors()
+    assert any(x.get("class_name") == "Stateful" for x in actors)
+    nodes = state_api.list_nodes()
+    assert nodes and nodes[0]["state"] == "ALIVE"
+
+    @ray_tpu.remote
+    def named_task():
+        return 1
+
+    ray_tpu.get(named_task.remote())
+    tasks = state_api.list_tasks()
+    assert any("named_task" in t.get("name", "") for t in tasks)
+    summary = state_api.summarize_actors()
+    assert "Stateful" in summary
+    ray_tpu.kill(a)
+
+
+# ---------------------------------------------------------------------- DAG
+def test_dag_bind_execute(ray4):
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def times_two(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        a = plus_one.bind(inp)
+        b = times_two.bind(inp)
+        dag = add.bind(a, b)
+    assert ray_tpu.get(dag.execute(10)) == 31  # (10+1) + (10*2)
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(1)) == 4
+
+    with InputNode() as inp:
+        multi = MultiOutputNode([plus_one.bind(inp), times_two.bind(inp)])
+    assert ray_tpu.get(multi.execute(3)) == [4, 6]
+
+
+def test_dag_actor_methods(ray4):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Accum:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, v):
+            self.total += v
+            return self.total
+
+    acc = Accum.remote()
+    with InputNode() as inp:
+        dag = acc.add.bind(inp)
+    assert ray_tpu.get(dag.execute(5)) == 5
+    assert ray_tpu.get(dag.execute(3)) == 8  # actor state persists
+
+
+# ----------------------------------------------------------------- workflow
+def test_workflow_run_and_resume(ray4, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path))
+    marker = str(tmp_path / "ran_expensive")
+
+    @ray_tpu.remote
+    def expensive(x):
+        open(marker, "a").write("x")
+        return x * 10
+
+    @ray_tpu.remote
+    def flaky(x, fail_marker):
+        if not os.path.exists(fail_marker):
+            open(fail_marker, "w").close()
+            raise RuntimeError("first attempt fails")
+        return x + 1
+
+    fail_marker = str(tmp_path / "fail_once")
+    with InputNode() as inp:
+        dag = flaky.bind(expensive.bind(inp), fail_marker)
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf1", args=(5,))
+    assert workflow.get_status("wf1") == "FAILED"
+    # resume: the expensive step is served from its checkpoint, not re-run
+    out = workflow.resume("wf1", dag, args=(5,))
+    assert out == 51
+    assert open(marker).read() == "x"  # ran exactly once
+    assert workflow.get_status("wf1") == "SUCCESSFUL"
+    assert {"workflow_id": "wf1", "status": "SUCCESSFUL"} in \
+        workflow.list_all()
+
+
+# ----------------------------------------------------------- job submission
+def test_job_submission(ray4, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    out_file = str(tmp_path / "job_out.txt")
+    job_id = client.submit_job(
+        entrypoint=f"echo hello-from-job > {out_file} && echo logged-line",
+        metadata={"owner": "test"})
+    status = client.wait_until_finish(job_id, timeout_s=60)
+    assert status == JobStatus.SUCCEEDED
+    assert open(out_file).read().strip() == "hello-from-job"
+    assert "logged-line" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_status(ray4):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="exit 3")
+    assert client.wait_until_finish(job_id, 60) == JobStatus.FAILED
+    assert "code 3" in client.get_job_info(job_id)["message"]
+
+
+# ---------------------------------------------------------------- dashboard
+def test_dashboard_rest(ray4):
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard(port=0)
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, r.read()
+
+    status, body = get("/healthz")
+    assert status == 200
+    status, body = get("/api/nodes")
+    nodes = json.loads(body)
+    assert nodes and nodes[0]["state"] == "ALIVE"
+    status, body = get("/api/cluster_status")
+    data = json.loads(body)
+    assert data["total"].get("CPU", 0) >= 4
+    status, body = get("/metrics")
+    assert status == 200
