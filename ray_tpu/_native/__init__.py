@@ -1,0 +1,221 @@
+"""Native (C++) kernel components, bound via ctypes.
+
+The reference implements its node-local kernel in C++ (reference:
+``src/ray/object_manager/plasma/store.h:55`` — the shared-memory object store
+that lives inside the raylet). This package holds the TPU-native C++
+equivalents: ``store.cc`` (shm arena object store) compiled on first use into
+``~/.cache/ray_tpu/`` and loaded with ctypes (no pybind11 in this image).
+
+``NativeStoreClient`` mirrors the Python ``StoreClient`` API
+(ray_tpu/_private/object_store.py) so the worker runtime can switch backends
+transparently; set ``RAY_TPU_NATIVE_STORE=0`` to force the pure-Python tmpfs
+backend.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_build_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["store.cc"]
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("RAY_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(os.path.join(_SRC_DIR, src), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_native_lib() -> Optional[str]:
+    """Compile the native kernel to a cached .so; returns its path or None."""
+    out = os.path.join(_cache_dir(), f"libray_tpu_{_source_digest()}.so")
+    if os.path.exists(out):
+        return out
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    tmp = out + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+           *srcs, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, out)  # atomic publish; racing builders both succeed
+        return out
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def get_native_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native kernel library."""
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed:
+        return None
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") == "0":
+            _lib_failed = True
+            return None
+        path = build_native_lib()
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.tpu_store_create.restype = ctypes.c_void_p
+        lib.tpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.tpu_store_attach.restype = ctypes.c_void_p
+        lib.tpu_store_attach.argtypes = [ctypes.c_char_p]
+        lib.tpu_store_detach.argtypes = [ctypes.c_void_p]
+        lib.tpu_store_base.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.tpu_store_base.argtypes = [ctypes.c_void_p]
+        lib.tpu_store_create_object.restype = ctypes.c_uint64
+        lib.tpu_store_create_object.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        for fn in ("tpu_store_seal", "tpu_store_abort", "tpu_store_contains",
+                   "tpu_store_release", "tpu_store_delete"):
+            f = getattr(lib, fn)
+            f.restype = ctypes.c_int
+            f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpu_store_get.restype = ctypes.c_int
+        lib.tpu_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.tpu_store_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.tpu_store_lru_candidates.restype = ctypes.c_int
+        lib.tpu_store_lru_candidates.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class NativeStore:
+    """Handle to one shm arena segment (create or attach)."""
+
+    def __init__(self, path: str, capacity: Optional[int] = None,
+                 create: bool = False):
+        lib = get_native_lib()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self.path = path
+        if create:
+            self._h = lib.tpu_store_create(path.encode(), capacity or 0)
+            if not self._h:
+                # lost a creation race — attach instead
+                self._h = lib.tpu_store_attach(path.encode())
+        else:
+            self._h = lib.tpu_store_attach(path.encode())
+        if not self._h:
+            raise RuntimeError(f"cannot open native store at {path}")
+        # one flat view over the whole mapping; object views slice into it
+        import ctypes as ct
+
+        base = lib.tpu_store_base(self._h)
+        stats = self.stats()
+        seg_size = self._segment_size()
+        self._buf = (ct.c_ubyte * seg_size).from_address(
+            ct.addressof(base.contents))
+        self._view = memoryview(self._buf).cast("B")
+        del stats
+
+    def _segment_size(self) -> int:
+        return os.path.getsize(self.path)
+
+    # -- object lifecycle --------------------------------------------------
+    def create(self, id_bytes: bytes, size: int) -> Optional[memoryview]:
+        off = self._lib.tpu_store_create_object(self._h, id_bytes, size)
+        if off == 0:
+            return None
+        return self._view[off:off + max(size, 1)]
+
+    def seal(self, id_bytes: bytes) -> bool:
+        return self._lib.tpu_store_seal(self._h, id_bytes) == 0
+
+    def abort(self, id_bytes: bytes) -> bool:
+        return self._lib.tpu_store_abort(self._h, id_bytes) == 0
+
+    def get(self, id_bytes: bytes) -> Optional[memoryview]:
+        """Zero-copy view of a sealed object (pins it; call release after)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.tpu_store_get(
+            self._h, id_bytes, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return self._view[off.value:off.value + max(size.value, 1)]
+
+    def get_pinned_view(self, id_bytes: bytes) -> Optional[memoryview]:
+        """Zero-copy view whose pin is released automatically when the last
+        Python alias of the buffer is garbage-collected — safe to hand to
+        deserializers that keep numpy/jax arrays aliasing the store."""
+        import weakref
+
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.tpu_store_get(
+            self._h, id_bytes, ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        n = max(size.value, 1)
+        arr = (ctypes.c_ubyte * n).from_address(
+            ctypes.addressof(self._buf) + off.value)
+        weakref.finalize(arr, self._lib.tpu_store_release, self._h, id_bytes)
+        arr._keepalive = self  # segment mapping must outlive the view
+        return memoryview(arr).cast("B")
+
+    def contains(self, id_bytes: bytes) -> bool:
+        return self._lib.tpu_store_contains(self._h, id_bytes) == 1
+
+    def release(self, id_bytes: bytes) -> None:
+        self._lib.tpu_store_release(self._h, id_bytes)
+
+    def delete(self, id_bytes: bytes) -> bool:
+        return self._lib.tpu_store_delete(self._h, id_bytes) == 0
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.tpu_store_stats(self._h, out)
+        return {
+            "used": out[0], "capacity": out[1], "num_objects": out[2],
+            "num_evictions": out[3], "num_created": out[4],
+        }
+
+    def lru_candidates(self, max_n: int = 16) -> list:
+        buf = (ctypes.c_ubyte * (16 * max_n))()
+        n = self._lib.tpu_store_lru_candidates(self._h, buf, max_n)
+        raw = bytes(buf)
+        return [raw[i * 16:(i + 1) * 16] for i in range(n)]
+
+    def close(self) -> None:
+        if self._h:
+            # detach munmaps: only call at process shutdown, after all views
+            # into the segment are dead. The segment file itself persists.
+            self._lib.tpu_store_detach(self._h)
+            self._h = None

@@ -1,0 +1,516 @@
+// Shared-memory object store kernel (plasma analog, C++ native).
+//
+// Behavioral parity with the reference's plasma store
+// (reference: src/ray/object_manager/plasma/store.h:55, dlmalloc.cc,
+// object_lifecycle_manager.h, eviction_policy.h): one mmap'd shared-memory
+// arena per node holding immutable sealed objects, an object table shared by
+// every process on the node, LRU eviction of unpinned sealed objects, and
+// create/seal/get/release/delete lifecycle.
+//
+// Where the reference runs a store *server* thread inside the raylet and
+// clients talk to it over a unix socket with fd-passing (plasma/fling.cc),
+// this design is TPU-first and kernel-bypass: the whole store state (object
+// table + heap allocator + robust mutex) lives inside the shm segment itself,
+// so every client attaches the segment once and then performs create / seal /
+// lookup directly in shared memory with no per-operation IPC round trip.
+// Readers get zero-copy pointers into the arena, which feed
+// jax.device_put -> HBM with no intermediate host copy.
+//
+// Exposed as a plain C ABI consumed from Python via ctypes
+// (ray_tpu/_native/__init__.py).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7261795f74707531ULL;  // "ray_tpu1"
+constexpr uint32_t kIdSize = 16;                    // ObjectID width (ids.py)
+constexpr uint64_t kAlign = 64;                     // cache-line alignment
+
+// ---------------------------------------------------------------- layout
+
+// Object table slot states.
+enum SlotState : uint32_t {
+  SLOT_EMPTY = 0,
+  SLOT_CREATED = 1,   // allocated, writer filling it in
+  SLOT_SEALED = 2,    // immutable, readable
+  SLOT_TOMBSTONE = 3, // deleted, probe chain continues through it
+};
+
+struct Slot {
+  uint8_t id[kIdSize];
+  uint64_t offset;  // data offset from heap base
+  uint64_t size;
+  uint64_t lru;     // last-touch clock tick
+  uint32_t state;
+  int32_t pincount;
+};
+
+// Free-list block header, lives in the heap itself (boundary-tag allocator).
+struct Block {
+  uint64_t size;       // payload bytes (excluding header)
+  uint64_t prev_size;  // payload of physically-previous block (0 if first)
+  uint32_t free_;      // 1 if on the free list
+  uint32_t last;       // 1 if physically last block in heap
+  // Free blocks thread a doubly-linked list through their payload:
+  // payload[0..8) = next free offset, payload[8..16) = prev free offset
+};
+
+constexpr uint64_t kNoBlock = ~0ULL;
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t capacity;        // heap payload capacity
+  uint64_t used;            // sealed+created payload bytes
+  uint64_t table_slots;     // power of two
+  uint64_t table_offset;    // from segment base
+  uint64_t heap_offset;     // from segment base
+  uint64_t free_head;       // offset of first free block header (kNoBlock if none)
+  uint64_t lru_clock;
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint64_t num_created;
+  pthread_mutex_t mutex;    // robust, process-shared
+};
+
+struct Store {
+  uint8_t* base;
+  uint64_t mapped_size;
+  Header* hdr;
+  Slot* table;
+  uint8_t* heap;
+};
+
+// ---------------------------------------------------------------- helpers
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+inline uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over the 16 id bytes.
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdSize; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+inline Block* block_at(Store* s, uint64_t off) {
+  return reinterpret_cast<Block*>(s->heap + off);
+}
+
+inline uint64_t* free_next(Store* s, uint64_t off) {
+  return reinterpret_cast<uint64_t*>(s->heap + off + sizeof(Block));
+}
+inline uint64_t* free_prev(Store* s, uint64_t off) {
+  return reinterpret_cast<uint64_t*>(s->heap + off + sizeof(Block) + 8);
+}
+
+void freelist_remove(Store* s, uint64_t off) {
+  uint64_t nxt = *free_next(s, off);
+  uint64_t prv = *free_prev(s, off);
+  if (prv == kNoBlock) {
+    s->hdr->free_head = nxt;
+  } else {
+    *free_next(s, prv) = nxt;
+  }
+  if (nxt != kNoBlock) *free_prev(s, nxt) = prv;
+  block_at(s, off)->free_ = 0;
+}
+
+void freelist_push(Store* s, uint64_t off) {
+  Block* b = block_at(s, off);
+  b->free_ = 1;
+  *free_next(s, off) = s->hdr->free_head;
+  *free_prev(s, off) = kNoBlock;
+  if (s->hdr->free_head != kNoBlock) *free_prev(s, s->hdr->free_head) = off;
+  s->hdr->free_head = off;
+}
+
+// Merge a just-freed block with free physical neighbours. `off` must not be on
+// the free list yet; returns the offset of the coalesced block (also not on
+// the free list).
+uint64_t coalesce(Store* s, uint64_t off) {
+  Block* b = block_at(s, off);
+  // merge right
+  if (!b->last) {
+    uint64_t roff = off + sizeof(Block) + b->size;
+    Block* r = block_at(s, roff);
+    if (r->free_) {
+      freelist_remove(s, roff);
+      b->size += sizeof(Block) + r->size;
+      b->last = r->last;
+      if (!b->last) {
+        uint64_t rr = off + sizeof(Block) + b->size;
+        block_at(s, rr)->prev_size = b->size;
+      }
+    }
+  }
+  // merge left
+  if (b->prev_size != 0 || off != 0) {
+    if (off != 0) {
+      uint64_t loff = off - sizeof(Block) - b->prev_size;
+      Block* l = block_at(s, loff);
+      if (l->free_) {
+        freelist_remove(s, loff);
+        l->size += sizeof(Block) + b->size;
+        l->last = b->last;
+        if (!l->last) {
+          uint64_t rr = loff + sizeof(Block) + l->size;
+          block_at(s, rr)->prev_size = l->size;
+        }
+        return loff;
+      }
+    }
+  }
+  return off;
+}
+
+// First-fit allocation; returns payload offset or kNoBlock.
+uint64_t heap_alloc(Store* s, uint64_t want) {
+  want = align_up(want ? want : 1, kAlign);
+  uint64_t off = s->hdr->free_head;
+  while (off != kNoBlock) {
+    Block* b = block_at(s, off);
+    uint64_t nxt = *free_next(s, off);
+    if (b->size >= want) {
+      freelist_remove(s, off);
+      // split if the remainder can hold a useful block
+      if (b->size >= want + sizeof(Block) + kAlign) {
+        uint64_t rest_off = off + sizeof(Block) + want;
+        Block* rest = block_at(s, rest_off);
+        rest->size = b->size - want - sizeof(Block);
+        rest->prev_size = want;
+        rest->last = b->last;
+        b->size = want;
+        b->last = 0;
+        if (!rest->last) {
+          uint64_t rr = rest_off + sizeof(Block) + rest->size;
+          block_at(s, rr)->prev_size = rest->size;
+        }
+        freelist_push(s, rest_off);
+      }
+      return off + sizeof(Block);
+    }
+    off = nxt;
+  }
+  return kNoBlock;
+}
+
+void heap_free(Store* s, uint64_t payload_off) {
+  uint64_t off = payload_off - sizeof(Block);
+  uint64_t merged = coalesce(s, off);
+  freelist_push(s, merged);
+}
+
+// ------------------------------------------------------------ table ops
+
+Slot* table_find(Store* s, const uint8_t* id) {
+  uint64_t mask = s->hdr->table_slots - 1;
+  uint64_t i = id_hash(id) & mask;
+  for (uint64_t probes = 0; probes <= mask; probes++, i = (i + 1) & mask) {
+    Slot* slot = &s->table[i];
+    if (slot->state == SLOT_EMPTY) return nullptr;
+    if (slot->state != SLOT_TOMBSTONE && memcmp(slot->id, id, kIdSize) == 0)
+      return slot;
+  }
+  return nullptr;
+}
+
+Slot* table_insert(Store* s, const uint8_t* id) {
+  uint64_t mask = s->hdr->table_slots - 1;
+  uint64_t i = id_hash(id) & mask;
+  Slot* first_tomb = nullptr;
+  for (uint64_t probes = 0; probes <= mask; probes++, i = (i + 1) & mask) {
+    Slot* slot = &s->table[i];
+    if (slot->state == SLOT_EMPTY) return first_tomb ? first_tomb : slot;
+    if (slot->state == SLOT_TOMBSTONE) {
+      if (!first_tomb) first_tomb = slot;
+      continue;
+    }
+    if (memcmp(slot->id, id, kIdSize) == 0) return nullptr;  // exists
+  }
+  return first_tomb;  // table full unless a tombstone was seen
+}
+
+void delete_slot(Store* s, Slot* slot) {
+  heap_free(s, slot->offset);
+  s->hdr->used -= slot->size;
+  s->hdr->num_objects--;
+  slot->state = SLOT_TOMBSTONE;
+  slot->pincount = 0;
+}
+
+// Evict unpinned sealed objects, oldest LRU tick first, until `need` payload
+// bytes could plausibly be allocated. Mirrors plasma's EvictionPolicy
+// (reference: src/ray/object_manager/plasma/eviction_policy.h).
+bool evict_for(Store* s, uint64_t need) {
+  for (;;) {
+    if (s->hdr->used + need <= s->hdr->capacity) {
+      // logical capacity ok — probe whether the free list can satisfy it
+      uint64_t off = heap_alloc(s, need);
+      if (off != kNoBlock) {
+        heap_free(s, off);  // probe only
+        return true;
+      }
+    }
+    Slot* victim = nullptr;
+    for (uint64_t i = 0; i < s->hdr->table_slots; i++) {
+      Slot* slot = &s->table[i];
+      if (slot->state == SLOT_SEALED && slot->pincount == 0 &&
+          (!victim || slot->lru < victim->lru))
+        victim = slot;
+    }
+    if (!victim) return false;
+    delete_slot(s, victim);
+    s->hdr->num_evictions++;
+  }
+}
+
+struct MutexGuard {
+  pthread_mutex_t* m;
+  explicit MutexGuard(pthread_mutex_t* mu) : m(mu) {
+    int rc = pthread_mutex_lock(m);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(m);  // robust recovery
+  }
+  ~MutexGuard() { pthread_mutex_unlock(m); }
+};
+
+}  // namespace
+
+// ================================================================= C ABI
+
+extern "C" {
+
+// Create a new store segment at `path` with `capacity` payload bytes.
+// Returns an opaque handle or nullptr.
+void* tpu_store_create(const char* path, uint64_t capacity) {
+  uint64_t table_slots = 4096;
+  while (table_slots < capacity / (64 * 1024) && table_slots < (1ULL << 22))
+    table_slots <<= 1;
+
+  uint64_t table_bytes = table_slots * sizeof(Slot);
+  uint64_t table_offset = align_up(sizeof(Header), kAlign);
+  uint64_t heap_offset = align_up(table_offset + table_bytes, kAlign);
+  // heap needs room for block headers too; pad by 1/32 + fixed slack
+  uint64_t heap_bytes = capacity + capacity / 32 + (1 << 20);
+  uint64_t segment_size = heap_offset + heap_bytes;
+
+  int fd = open(path, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)segment_size) != 0) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, segment_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    unlink(path);
+    return nullptr;
+  }
+
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(base);
+  s->mapped_size = segment_size;
+  s->hdr = reinterpret_cast<Header*>(s->base);
+  s->table = reinterpret_cast<Slot*>(s->base + table_offset);
+  s->heap = s->base + heap_offset;
+
+  Header* h = s->hdr;
+  memset(h, 0, sizeof(Header));
+  h->segment_size = segment_size;
+  h->capacity = capacity;
+  h->table_slots = table_slots;
+  h->table_offset = table_offset;
+  h->heap_offset = heap_offset;
+  memset(s->table, 0, table_bytes);
+
+  // one giant free block spanning the heap
+  Block* b0 = block_at(s, 0);
+  b0->size = heap_bytes - sizeof(Block);
+  b0->prev_size = 0;
+  b0->last = 1;
+  h->free_head = kNoBlock;
+  freelist_push(s, 0);
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  h->magic = kMagic;  // publish: attachers spin on magic
+  return s;
+}
+
+// Attach to an existing segment. Returns handle or nullptr.
+void* tpu_store_attach(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  Header* h = reinterpret_cast<Header*>(base);
+  if (h->magic != kMagic || h->segment_size != (uint64_t)st.st_size) {
+    munmap(base, (size_t)st.st_size);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(base);
+  s->mapped_size = (uint64_t)st.st_size;
+  s->hdr = h;
+  s->table = reinterpret_cast<Slot*>(s->base + h->table_offset);
+  s->heap = s->base + h->heap_offset;
+  return s;
+}
+
+void tpu_store_detach(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  munmap(s->base, s->mapped_size);
+  delete s;
+}
+
+// Base pointer of the mapping (python computes buffer offsets against it).
+uint8_t* tpu_store_base(void* handle) {
+  return static_cast<Store*>(handle)->base;
+}
+
+// Allocate an unsealed object. Returns absolute offset of the payload from
+// the segment base, or 0 on failure (0 is never a valid payload offset).
+uint64_t tpu_store_create_object(void* handle, const uint8_t* id, uint64_t size) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  if (size > s->hdr->capacity) return 0;
+  Slot* slot = table_insert(s, id);
+  if (!slot) return 0;  // duplicate or table full
+  if (!evict_for(s, size)) return 0;
+  uint64_t off = heap_alloc(s, size);
+  if (off == kNoBlock) return 0;
+  memcpy(slot->id, id, kIdSize);
+  slot->offset = off;
+  slot->size = size;
+  slot->lru = ++s->hdr->lru_clock;
+  slot->state = SLOT_CREATED;
+  slot->pincount = 0;
+  s->hdr->used += size;
+  s->hdr->num_objects++;
+  s->hdr->num_created++;
+  return s->hdr->heap_offset + off;
+}
+
+int tpu_store_seal(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  Slot* slot = table_find(s, id);
+  if (!slot || slot->state != SLOT_CREATED) return -1;
+  std::atomic_thread_fence(std::memory_order_release);
+  slot->state = SLOT_SEALED;
+  return 0;
+}
+
+int tpu_store_abort(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  Slot* slot = table_find(s, id);
+  if (!slot || slot->state != SLOT_CREATED) return -1;
+  delete_slot(s, slot);
+  return 0;
+}
+
+// Look up a sealed object; pins it (caller must release). Writes the payload
+// absolute offset and size. Returns 0 on hit, -1 on miss.
+int tpu_store_get(void* handle, const uint8_t* id, uint64_t* offset_out,
+                  uint64_t* size_out) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  Slot* slot = table_find(s, id);
+  if (!slot || slot->state != SLOT_SEALED) return -1;
+  slot->lru = ++s->hdr->lru_clock;
+  slot->pincount++;
+  *offset_out = s->hdr->heap_offset + slot->offset;
+  *size_out = slot->size;
+  return 0;
+}
+
+int tpu_store_contains(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  Slot* slot = table_find(s, id);
+  return (slot && slot->state == SLOT_SEALED) ? 1 : 0;
+}
+
+int tpu_store_release(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  Slot* slot = table_find(s, id);
+  if (!slot) return -1;
+  if (slot->pincount > 0) slot->pincount--;
+  return 0;
+}
+
+int tpu_store_delete(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  Slot* slot = table_find(s, id);
+  if (!slot || slot->state == SLOT_TOMBSTONE) return -1;
+  if (slot->pincount > 0) return -2;  // pinned: caller defers
+  delete_slot(s, slot);
+  return 0;
+}
+
+void tpu_store_stats(void* handle, uint64_t* out /* [6] */) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  out[0] = s->hdr->used;
+  out[1] = s->hdr->capacity;
+  out[2] = s->hdr->num_objects;
+  out[3] = s->hdr->num_evictions;
+  out[4] = s->hdr->num_created;
+  out[5] = s->hdr->lru_clock;
+}
+
+// List ids of sealed, unpinned objects (spill candidates), oldest first.
+// Fills up to max ids into out (contiguous 16-byte records); returns count.
+int tpu_store_lru_candidates(void* handle, uint8_t* out, int max) {
+  Store* s = static_cast<Store*>(handle);
+  MutexGuard g(&s->hdr->mutex);
+  // selection sort over at most `max` winners (table scan is the cost anyway)
+  int n = 0;
+  uint64_t last_lru = 0;
+  while (n < max) {
+    Slot* best = nullptr;
+    for (uint64_t i = 0; i < s->hdr->table_slots; i++) {
+      Slot* slot = &s->table[i];
+      if (slot->state == SLOT_SEALED && slot->pincount == 0 &&
+          slot->lru > last_lru && (!best || slot->lru < best->lru))
+        best = slot;
+    }
+    if (!best) break;
+    memcpy(out + n * kIdSize, best->id, kIdSize);
+    last_lru = best->lru;
+    n++;
+  }
+  return n;
+}
+
+}  // extern "C"

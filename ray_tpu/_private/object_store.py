@@ -123,6 +123,94 @@ class StoreClient:
             return 0
 
 
+class NativeStoreClient:
+    """StoreClient-compatible facade over the C++ shm arena store
+    (ray_tpu/_native/store.cc — the plasma analog; reference:
+    ``src/ray/object_manager/plasma/client.cc``).
+
+    All objects live in ONE mmap'd segment shared by every process on the
+    node; create/seal/lookup are lock-protected table operations in shared
+    memory, no per-op IPC. Reads are pinned in the C++ store for exactly as
+    long as any Python alias of the buffer is alive (a ``weakref.finalize``
+    on the ctypes slice releases the pin), so LRU eviction can never pull
+    memory out from under a deserialized numpy/jax array.
+
+    Enabled with ``RAY_TPU_STORE_BACKEND=native``.
+    """
+
+    def __init__(self, store_dir: str, capacity: Optional[int] = None):
+        from ray_tpu import _native
+
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        seg = os.path.join(store_dir, "segment")
+        # First process on the node creates the segment (O_EXCL in C++);
+        # losers of the race attach.
+        self._store = _native.NativeStore(
+            seg, capacity=capacity or default_store_capacity(), create=True)
+
+    # -- write path ----------------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> Tuple[memoryview, object]:
+        view = self._store.create(object_id.binary(), size)
+        if view is None:
+            raise ObjectStoreFullError(
+                f"native store cannot allocate {size} bytes")
+        return view, object_id.binary()
+
+    def seal(self, object_id: ObjectID, handle: object) -> None:
+        self._store.seal(handle)
+
+    def abort(self, handle: object) -> None:
+        self._store.abort(handle)
+
+    def put_bytes(self, object_id: ObjectID, data: bytes) -> int:
+        view, handle = self.create(object_id, len(data))
+        view[: len(data)] = data
+        self.seal(object_id, handle)
+        return len(data)
+
+    # -- read path -----------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._store.contains(object_id.binary())
+
+    def get_view(self, object_id: ObjectID) -> Optional[memoryview]:
+        return self._store.get_pinned_view(object_id.binary())
+
+    def pin(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Pin without the auto-release finalizer (caller must release)."""
+        return self._store.get(object_id.binary())
+
+    def release(self, object_id: ObjectID) -> None:
+        self._store.release(object_id.binary())
+
+    def delete(self, object_id: ObjectID) -> int:
+        # Pinned objects refuse deletion in C++ (rc=-2); they are reclaimed
+        # by LRU eviction once the last reader releases.
+        return 1 if self._store.delete(object_id.binary()) else 0
+
+    def stats(self) -> Dict:
+        return self._store.stats()
+
+
+def make_store_client(store_dir: str, capacity: Optional[int] = None):
+    """Backend factory: C++ arena store (``RAY_TPU_STORE_BACKEND=native``)
+    or the default tmpfs file-per-object store."""
+    backend = os.environ.get("RAY_TPU_STORE_BACKEND", "tmpfs")
+    if backend == "native":
+        try:
+            return NativeStoreClient(store_dir, capacity)
+        except Exception as e:
+            # A node-wide backend mismatch makes objects invisible across
+            # processes, so the fallback must be loud.
+            import logging
+
+            logging.getLogger("ray_tpu").error(
+                "RAY_TPU_STORE_BACKEND=native but the native store failed "
+                "(%s); THIS PROCESS falls back to the tmpfs backend — other "
+                "processes on the node may not see its objects", e)
+    return StoreClient(store_dir)
+
+
 class StoreDirectory:
     """Authoritative per-node accounting: sizes, pins, LRU, spilling.
 
@@ -132,7 +220,11 @@ class StoreDirectory:
 
     def __init__(self, store_dir: str, capacity: Optional[int] = None,
                  spill_dir: Optional[str] = None):
-        self.client = StoreClient(store_dir)
+        self.client = make_store_client(store_dir, capacity)
+        # Native backend: the C++ arena enforces capacity and runs LRU
+        # eviction itself (store.cc evict_for), so this directory only keeps
+        # pins and spill state.
+        self.native = isinstance(self.client, NativeStoreClient)
         self.capacity = capacity or default_store_capacity()
         self.used = 0
         self.spill_dir = spill_dir or os.path.join(store_dir, "spill")
@@ -140,6 +232,7 @@ class StoreDirectory:
         # object hex -> size, insertion-ordered for LRU (move_to_end on touch)
         self._objects: "OrderedDict[str, int]" = OrderedDict()
         self._pins: Dict[str, int] = {}
+        self._native_pins: Dict[str, Optional[memoryview]] = {}
         self._spilled: Dict[str, int] = {}  # hex -> size on disk
         self.num_evictions = 0
         self.num_spills = 0
@@ -149,7 +242,8 @@ class StoreDirectory:
         with self._lock:
             if object_id_hex in self._objects:
                 return
-            self._ensure_space(size)
+            if not self.native:
+                self._ensure_space(size)
             self._objects[object_id_hex] = size
             self.used += size
 
@@ -160,17 +254,31 @@ class StoreDirectory:
 
     def pin(self, object_id_hex: str) -> None:
         with self._lock:
-            self._pins[object_id_hex] = self._pins.get(object_id_hex, 0) + 1
+            n = self._pins.get(object_id_hex, 0)
+            if n == 0 and self.native:
+                # forward the pin into the C++ arena so its LRU eviction
+                # cannot reclaim a primary copy out from under us
+                self._native_pins[object_id_hex] = self.client.pin(
+                    ObjectID.from_hex(object_id_hex))
+            self._pins[object_id_hex] = n + 1
 
     def unpin(self, object_id_hex: str) -> None:
         with self._lock:
             n = self._pins.get(object_id_hex, 0) - 1
             if n <= 0:
                 self._pins.pop(object_id_hex, None)
+                if self.native and self._native_pins.pop(
+                        object_id_hex, None) is not None:
+                    self.client.release(ObjectID.from_hex(object_id_hex))
             else:
                 self._pins[object_id_hex] = n
 
     def contains(self, object_id_hex: str) -> bool:
+        if self.native:
+            # the C++ arena is authoritative — it may have LRU-evicted the
+            # object without telling us, and a stale True here would make
+            # the agent skip a remote pull for a locally-lost object
+            return self.client.contains(ObjectID.from_hex(object_id_hex))
         with self._lock:
             return object_id_hex in self._objects or object_id_hex in self._spilled
 
@@ -191,8 +299,18 @@ class StoreDirectory:
                 except OSError:
                     pass
             self._pins.pop(object_id_hex, None)
+            if self.native and self._native_pins.pop(
+                    object_id_hex, None) is not None:
+                self.client.release(ObjectID.from_hex(object_id_hex))
 
     def stats(self) -> Dict:
+        if self.native:
+            # arena-side numbers are authoritative (incl. its own evictions)
+            st = dict(self.client.stats())
+            with self._lock:
+                st["num_spilled"] = len(self._spilled)
+                st["num_spills"] = self.num_spills
+            return st
         with self._lock:
             return {
                 "used": self.used,
@@ -207,6 +325,8 @@ class StoreDirectory:
     def _ensure_space(self, size: int) -> None:
         """Evict (owner-recoverable) or spill (pinned primaries) until `size`
         fits. Caller holds the lock."""
+        if self.native:
+            return  # C++ arena evicts internally
         if size > self.capacity:
             raise ObjectStoreFullError(
                 f"object of size {size} exceeds store capacity {self.capacity}"
@@ -236,6 +356,8 @@ class StoreDirectory:
                 )
 
     def _spill(self, object_id_hex: str) -> bool:
+        if self.native:
+            return False  # native backend relies on in-arena LRU eviction
         view = self.client.get_view(ObjectID.from_hex(object_id_hex))
         if view is None:
             self.used -= self._objects.pop(object_id_hex, 0)

@@ -33,7 +33,7 @@ from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _Counter
 from ray_tpu._private.memory_store import MemoryStore
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.object_store import StoreClient
+from ray_tpu._private.object_store import StoreClient, make_store_client
 from ray_tpu._private.protocol import (
     AsyncRpcClient,
     Connection,
@@ -335,7 +335,7 @@ class Worker:
         )
         self.node_id = reply["node_id"]
         CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
-        self.store = StoreClient(reply["store_dir"])
+        self.store = make_store_client(reply["store_dir"])
         head_addr = reply["head_addr"]
         self.head = AsyncRpcClient()
         await self.head.connect_tcp(head_addr["host"], head_addr["port"])
