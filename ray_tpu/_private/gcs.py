@@ -42,6 +42,7 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.labels = resources.labels
+        self.pending_demand: List[Dict] = []  # unfulfilled lease requests
 
 
 class ActorInfo:
@@ -172,11 +173,12 @@ class HeadServer:
         if node:
             node.resources = NodeResources.from_wire(p["resources"])
             node.last_heartbeat = time.monotonic()
+            node.pending_demand = p.get("pending", [])
 
     def _cluster_view(self) -> Dict:
         return {
             nid: {"addr": n.addr, "resources": n.resources.to_wire(),
-                  "alive": n.alive}
+                  "alive": n.alive, "pending": n.pending_demand}
             for nid, n in self.nodes.items() if n.alive
         }
 

@@ -1,0 +1,23 @@
+"""Cluster autoscaler (reference: python/ray/autoscaler/ —
+``StandardAutoscaler`` _private/autoscaler.py:171, ``Monitor``
+_private/monitor.py:126, ``NodeProvider`` ABC node_provider.py, bin-packing
+resource_demand_scheduler.py).
+
+TPU-first deviations: demand arrives as per-node ``pending`` lease summaries
+in the agents' resource heartbeats (no separate load-metrics pipeline), and
+node types model TPU pod slices — a type with ``{"TPU": 4}`` scales in whole
+slice-host units, never fractions of a slice.
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.monitor import Monitor
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+from ray_tpu.autoscaler.sdk import request_resources
+
+__all__ = [
+    "StandardAutoscaler",
+    "Monitor",
+    "NodeProvider",
+    "LocalNodeProvider",
+    "request_resources",
+]

@@ -1,0 +1,177 @@
+"""StandardAutoscaler (reference: python/ray/autoscaler/_private/
+autoscaler.py:171 — the update() reconcile loop: read load, launch to cover
+unfulfilled demand, terminate idle nodes past the timeout).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+from ray_tpu.autoscaler.sdk import REQUEST_RESOURCES_KEY
+
+logger = logging.getLogger("ray_tpu.autoscaler")
+
+
+class StandardAutoscaler:
+    """One reconcile step per ``update()`` call.
+
+    Demand signal: each agent heartbeats its queued (unfulfillable) lease
+    requests to the head, exposed through the cluster view (gcs.py
+    ``_cluster_view``) — plus explicit ``sdk.request_resources`` entries from
+    the head KV.
+    """
+
+    def __init__(
+        self,
+        config: Dict,
+        provider: NodeProvider,
+        gcs_call: Callable[[str, Dict], object],
+    ):
+        self.config = config
+        self.provider = provider
+        self.gcs_call = gcs_call
+        self.idle_timeout_s = config.get("idle_timeout_minutes", 5.0) * 60
+        self.max_workers = config.get("max_workers", 8)
+        self.node_types: Dict[str, Dict] = config.get(
+            "available_node_types", {})
+        self._idle_since: Dict[str, float] = {}
+        self._launch_deadline: Dict[str, float] = {}
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    BOOT_TIMEOUT_S = 120.0
+
+    # ------------------------------------------------------------- helpers
+    def _type_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for pid in self.provider.non_terminated_nodes():
+            t = self.provider.node_tags(pid).get("node_type")
+            if t:
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _explicit_requests(self) -> List[Dict[str, int]]:
+        try:
+            raw = self.gcs_call("KvGet", {"ns": "autoscaler",
+                                          "key": REQUEST_RESOURCES_KEY})
+            if not raw:
+                return []
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            return json.loads(raw)
+        except Exception:
+            return []
+
+    # -------------------------------------------------------------- update
+    def update(self) -> Dict:
+        view: Dict = self.gcs_call("GetClusterView", {}) or {}
+
+        demands: List[Dict[str, int]] = []
+        available: List[Dict[str, int]] = []
+        runtime_to_provider: Dict[str, str] = {}
+        for pid in self.provider.non_terminated_nodes():
+            rid = self.provider.runtime_node_id(pid)
+            if rid:
+                runtime_to_provider[rid] = pid
+        totals: List[Dict[str, int]] = []
+        for nid, n in view.items():
+            demands.extend(n.get("pending", []))
+            available.append(n["resources"]["available"])
+            totals.append(n["resources"]["total"])
+
+        # launched-but-not-yet-registered nodes absorb demand as synthetic
+        # full-capacity pools, otherwise every tick during a node's ~seconds
+        # of boot would launch another copy for the same demand
+        from ray_tpu._private.resources import ResourceSet
+
+        registered = set(view)
+        now = time.monotonic()
+        for pid in self.provider.non_terminated_nodes():
+            rid = self.provider.runtime_node_id(pid)
+            if rid in registered:
+                self._launch_deadline.pop(pid, None)
+                continue
+            deadline = self._launch_deadline.setdefault(
+                pid, now + self.BOOT_TIMEOUT_S)
+            if now > deadline:
+                continue  # boot presumed failed: stop counting its capacity
+            ntype = self.provider.node_tags(pid).get("node_type")
+            res = self.node_types.get(ntype, {}).get("resources")
+            if res:
+                wire = ResourceSet(dict(res)).to_wire()
+                available.append(wire)
+                totals.append(wire)
+
+        counts = self._type_counts()
+        total = sum(counts.values())
+
+        # respect per-type min_workers before demand-driven launches
+        to_launch: Dict[str, int] = {}
+        for name, spec in self.node_types.items():
+            deficit = spec.get("min_workers", 0) - counts.get(name, 0)
+            if deficit > 0:
+                to_launch[name] = deficit
+        demand_launch = get_nodes_to_launch(
+            self.node_types, demands, available, counts,
+            self.max_workers, total + sum(to_launch.values()))
+        for name, cnt in demand_launch.items():
+            to_launch[name] = to_launch.get(name, 0) + cnt
+        # sdk.request_resources pins express desired cluster *size*, so they
+        # pack against node totals (busy nodes still count toward them)
+        pin_launch = get_nodes_to_launch(
+            self.node_types, self._explicit_requests(), totals, counts,
+            self.max_workers, total + sum(to_launch.values()))
+        for name, cnt in pin_launch.items():
+            to_launch[name] = to_launch.get(name, 0) + cnt
+
+        for name, cnt in to_launch.items():
+            logger.info("autoscaler: launching %d x %s", cnt, name)
+            self.provider.create_node(name, cnt)
+            self.num_launches += cnt
+
+        # scale down: runtime-registered nodes idle past the timeout
+        now = time.monotonic()
+        terminated = []
+        pins = self._explicit_requests()
+
+        def _needed_for_pins(candidate_nid: str) -> bool:
+            """Would removing this node break a request_resources pin?"""
+            if not pins:
+                return False
+            from ray_tpu.autoscaler.resource_demand_scheduler import _fit_on
+
+            pools = [ResourceSet.from_wire(n2["resources"]["total"])
+                     for nid2, n2 in view.items() if nid2 != candidate_nid]
+            return any(not _fit_on(ResourceSet.from_wire(w), pools)
+                       for w in pins)
+
+        for nid, n in view.items():
+            pid = runtime_to_provider.get(nid)
+            if pid is None:
+                continue  # head or externally-managed node
+            res = n["resources"]
+            busy = res["available"] != res["total"] or n.get("pending")
+            if busy:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            ntype = self.provider.node_tags(pid).get("node_type")
+            min_workers = self.node_types.get(ntype, {}).get("min_workers", 0)
+            if (now - first > self.idle_timeout_s
+                    and counts.get(ntype, 0) > min_workers and not to_launch
+                    and not _needed_for_pins(nid)):
+                logger.info("autoscaler: terminating idle node %s", pid)
+                self.gcs_call("DrainNode", {"node_id": nid})
+                self.provider.terminate_node(pid)
+                counts[ntype] = counts.get(ntype, 0) - 1
+                self.num_terminations += 1
+                terminated.append(pid)
+                self._idle_since.pop(nid, None)
+
+        return {"launched": to_launch, "terminated": terminated,
+                "num_nodes": sum(self._type_counts().values())}

@@ -1,0 +1,71 @@
+"""Autoscaler monitor loop (reference: python/ray/autoscaler/_private/
+monitor.py:126 — the process polling GCS and driving StandardAutoscaler).
+
+Runs as a daemon thread with its own event loop + GCS connection so it works
+both embedded in a driver (AutoscalingCluster tests) and as a standalone
+process (``python -m ray_tpu.autoscaler.monitor``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+class GcsChannel:
+    """Synchronous GCS RPC facade over a private event-loop thread."""
+
+    def __init__(self, host: str, port: int):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="autoscaler-gcs", daemon=True)
+        self._thread.start()
+        from ray_tpu._private.protocol import AsyncRpcClient
+
+        self._client = AsyncRpcClient()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._client.connect_tcp(host, port), self._loop)
+        fut.result(30)
+
+    def call(self, method: str, payload: Dict, timeout: float = 30.0):
+        fut = asyncio.run_coroutine_threadsafe(
+            self._client.call(method, payload), self._loop)
+        return fut.result(timeout)
+
+    def close(self) -> None:
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class Monitor:
+    def __init__(self, config: Dict, provider: NodeProvider,
+                 head_host: str, head_port: int,
+                 update_interval_s: float = 1.0):
+        self.channel = GcsChannel(head_host, head_port)
+        self.autoscaler = StandardAutoscaler(
+            config, provider, self.channel.call)
+        self.update_interval_s = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="autoscaler-monitor", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                pass  # transient GCS hiccups must not kill the loop
+            self._stop.wait(self.update_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.channel.close()

@@ -1,0 +1,114 @@
+"""Node providers (reference: python/ray/autoscaler/node_provider.py ABC and
+the fake_multi_node provider python/ray/autoscaler/_private/fake_multi_node/
+that 'launches' nodes as local processes for tests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Cloud-agnostic node lifecycle interface. Implementations launch and
+    terminate worker nodes of the configured node types."""
+
+    def __init__(self, provider_config: Dict, cluster_name: str):
+        self.provider_config = provider_config
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        return None
+
+    def runtime_node_id(self, node_id: str) -> Optional[str]:
+        """Map a provider node id to the runtime node id it registered as
+        (None until the node's agent has come up)."""
+        return None
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launches worker nodes as local agent processes joining an existing
+    head — the fake-multi-node analog used by ``AutoscalingCluster`` and the
+    autoscaler tests. Each created node boots a real ``Node`` (agent +
+    workers), so scheduling against scaled-up nodes is fully exercised on
+    one machine.
+    """
+
+    def __init__(self, provider_config: Dict, cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self.head_host: str = provider_config["head_host"]
+        self.head_port: int = provider_config["head_port"]
+        self.session_dir: str = provider_config["session_dir"]
+        self.node_types: Dict[str, Dict] = provider_config["node_types"]
+        self._nodes: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._nodes
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            return {"node_type": info["type"]} if info else {}
+
+    def create_node(self, node_type: str, count: int) -> List[str]:
+        from ray_tpu._private.node import Node
+
+        spec = self.node_types[node_type]
+        created = []
+        for _ in range(count):
+            node = Node(
+                head=False,
+                head_host=self.head_host,
+                head_port=self.head_port,
+                resources=dict(spec.get("resources", {})),
+                labels=dict(spec.get("labels", {}) or {}),
+                session_dir=self.session_dir,
+            )
+            node.start()
+            with self._lock:
+                self._counter += 1
+                pid = f"{self.cluster_name}-{node_type}-{self._counter}"
+                self._nodes[pid] = {"type": node_type, "node": node,
+                                    "created": time.time()}
+            created.append(pid)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info:
+            info["node"].stop()
+
+    def runtime_node_id(self, node_id: str) -> Optional[str]:
+        with self._lock:
+            info = self._nodes.get(node_id)
+        if not info:
+            return None
+        return getattr(info["node"], "node_id", None)
+
+    def shutdown(self) -> None:
+        for nid in self.non_terminated_nodes():
+            self.terminate_node(nid)

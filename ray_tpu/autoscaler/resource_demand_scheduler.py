@@ -1,0 +1,77 @@
+"""Bin-packing of unfulfilled resource demands onto node types
+(reference: python/ray/autoscaler/_private/resource_demand_scheduler.py).
+
+Given the live cluster view and a list of pending resource requests, decide
+how many nodes of each type to launch. First-fit-decreasing over demands,
+respecting per-type max_workers and the global max.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ray_tpu._private.resources import ResourceSet
+
+
+def _fit_on(demand: ResourceSet, pools: List[ResourceSet]) -> bool:
+    """Try to place `demand` on one of `pools` (mutating the winner)."""
+    for pool in pools:
+        if demand.fits(pool):
+            pool.subtract(demand)
+            return True
+    return False
+
+
+def get_nodes_to_launch(
+    node_types: Dict[str, Dict],
+    demands: List[Dict[str, int]],
+    existing_available: List[Dict[str, int]],
+    existing_counts: Dict[str, int],
+    max_workers: int,
+    total_workers: int,
+) -> Dict[str, int]:
+    """Returns {node_type: count} to launch.
+
+    node_types: {name: {"resources": {...}, "max_workers": int}}
+    demands: wire-format ResourceSets of queued lease requests
+    existing_available: wire-format available pools of alive nodes
+    existing_counts: current worker count per type
+    """
+    pools = [ResourceSet.from_wire(w) for w in existing_available]
+    unfulfilled: List[ResourceSet] = []
+    for wire in demands:
+        demand = ResourceSet.from_wire(wire)
+        if not _fit_on(demand, pools):
+            unfulfilled.append(demand)
+    if not unfulfilled:
+        return {}
+
+    # largest demands first so big requests claim fresh nodes before small
+    # ones fragment them
+    unfulfilled.sort(key=lambda r: -sum(r.to_wire().values()))
+
+    to_launch: Dict[str, int] = {}
+    counts = dict(existing_counts)
+    budget = max(0, max_workers - total_workers)
+    new_pools: List[ResourceSet] = []
+    for demand in unfulfilled:
+        if _fit_on(demand, new_pools):
+            continue
+        chosen = None
+        for name, spec in node_types.items():
+            cap = ResourceSet(dict(spec.get("resources", {})))
+            if not demand.feasible_on(cap):
+                continue
+            if counts.get(name, 0) >= spec.get("max_workers", max_workers):
+                continue
+            chosen = (name, cap)
+            break
+        if chosen is None or budget <= 0:
+            continue  # infeasible or at capacity: demand stays pending
+        name, cap = chosen
+        cap.subtract(demand)
+        new_pools.append(cap)
+        to_launch[name] = to_launch.get(name, 0) + 1
+        counts[name] = counts.get(name, 0) + 1
+        budget -= 1
+    return to_launch

@@ -1,0 +1,30 @@
+"""Programmatic autoscaler requests (reference:
+python/ray/autoscaler/sdk.py ``request_resources``) — pins a minimum demand
+the autoscaler must satisfy regardless of queued tasks."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+REQUEST_RESOURCES_KEY = "__request_resources"
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None) -> None:
+    """Ask the autoscaler to scale to accommodate the given demand
+    immediately; persists until the next call overrides it."""
+    import ray_tpu
+
+    entries: List[Dict[str, float]] = []
+    if num_cpus:
+        entries.append({"CPU": num_cpus})
+    if bundles:
+        entries.extend(bundles)
+    from ray_tpu._private.resources import ResourceSet
+
+    wire = [ResourceSet(e).to_wire() for e in entries]
+    w = ray_tpu._private.worker.global_worker
+    w._acall(w.head.call("KvPut", {
+        "ns": "autoscaler", "key": REQUEST_RESOURCES_KEY,
+        "value": json.dumps(wire), "overwrite": True}))

@@ -91,3 +91,58 @@ class Cluster:
         if self.head_node is not None:
             self.head_node.stop(cleanup_session=True)
             self.head_node = None
+
+
+class AutoscalingCluster:
+    """Head node + autoscaler monitor over the local node provider
+    (reference: ``cluster_utils.py:25`` AutoscalingCluster driving the fake
+    multi-node provider). Worker nodes are launched/terminated on demand as
+    real local agent processes.
+    """
+
+    def __init__(self, head_resources: Optional[Dict[str, float]] = None,
+                 worker_node_types: Optional[Dict[str, Dict]] = None,
+                 idle_timeout_minutes: float = 0.05,
+                 max_workers: int = 8,
+                 update_interval_s: float = 0.5):
+        self._head_resources = head_resources or {"CPU": 2}
+        self._worker_node_types = worker_node_types or {}
+        self._idle_timeout_minutes = idle_timeout_minutes
+        self._max_workers = max_workers
+        self._update_interval_s = update_interval_s
+        self.cluster: Optional[Cluster] = None
+        self.monitor = None
+        self.provider = None
+
+    @property
+    def address(self) -> str:
+        return self.cluster.address
+
+    def start(self) -> None:
+        from ray_tpu.autoscaler.monitor import Monitor
+        from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+
+        self.cluster = Cluster(
+            initialize_head=True,
+            head_node_args={"resources": self._head_resources})
+        head = self.cluster.head_node
+        self.provider = LocalNodeProvider(
+            {"head_host": "127.0.0.1", "head_port": head.head_port,
+             "session_dir": head.session_dir,
+             "node_types": self._worker_node_types},
+            cluster_name="autoscaling-test")
+        self.monitor = Monitor(
+            {"idle_timeout_minutes": self._idle_timeout_minutes,
+             "max_workers": self._max_workers,
+             "available_node_types": self._worker_node_types},
+            self.provider, "127.0.0.1", head.head_port,
+            update_interval_s=self._update_interval_s)
+        self.monitor.start()
+
+    def shutdown(self) -> None:
+        if self.monitor:
+            self.monitor.stop()
+        if self.provider:
+            self.provider.shutdown()
+        if self.cluster:
+            self.cluster.shutdown()

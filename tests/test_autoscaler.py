@@ -1,0 +1,128 @@
+"""Autoscaler tests (reference parity: python/ray/tests/test_autoscaler.py
+and test_autoscaling_cluster — scale-up on demand, min_workers, idle
+scale-down, bin-packing unit tests)."""
+
+import time
+
+import pytest
+
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+
+
+def _w(d):
+    return ResourceSet(d).to_wire()
+
+
+class TestBinPacking:
+    NODE_TYPES = {
+        "cpu4": {"resources": {"CPU": 4}, "max_workers": 10},
+        "tpu_slice": {"resources": {"TPU": 4, "CPU": 8}, "max_workers": 4},
+    }
+
+    def test_no_demand_no_launch(self):
+        assert get_nodes_to_launch(self.NODE_TYPES, [], [], {}, 8, 0) == {}
+
+    def test_demand_fits_existing(self):
+        out = get_nodes_to_launch(
+            self.NODE_TYPES, [_w({"CPU": 2})], [_w({"CPU": 4})], {}, 8, 1)
+        assert out == {}
+
+    def test_launch_for_unfulfilled(self):
+        out = get_nodes_to_launch(
+            self.NODE_TYPES, [_w({"CPU": 2})], [], {}, 8, 0)
+        assert out == {"cpu4": 1}
+
+    def test_pack_multiple_onto_one_node(self):
+        out = get_nodes_to_launch(
+            self.NODE_TYPES, [_w({"CPU": 2})] * 2, [], {}, 8, 0)
+        assert out == {"cpu4": 1}
+
+    def test_tpu_demand_picks_tpu_type(self):
+        out = get_nodes_to_launch(
+            self.NODE_TYPES, [_w({"TPU": 4})], [_w({"CPU": 4})], {}, 8, 1)
+        assert out == {"tpu_slice": 1}
+
+    def test_max_workers_cap(self):
+        out = get_nodes_to_launch(
+            self.NODE_TYPES, [_w({"CPU": 4})] * 5, [], {}, 2, 0)
+        assert sum(out.values()) <= 2
+
+    def test_infeasible_demand_ignored(self):
+        out = get_nodes_to_launch(
+            self.NODE_TYPES, [_w({"GPU": 1})], [], {}, 8, 0)
+        assert out == {}
+
+    def test_per_type_max(self):
+        types = {"cpu4": {"resources": {"CPU": 4}, "max_workers": 1}}
+        out = get_nodes_to_launch(
+            types, [_w({"CPU": 4})] * 3, [], {}, 8, 0)
+        assert out == {"cpu4": 1}
+
+
+class TestAutoscalingCluster:
+    def test_scale_up_and_down(self):
+        import ray_tpu
+        from ray_tpu.cluster_utils import AutoscalingCluster
+
+        cluster = AutoscalingCluster(
+            head_resources={"CPU": 1},
+            worker_node_types={
+                "worker": {"resources": {"CPU": 2, "extra": 2},
+                           "min_workers": 0, "max_workers": 2},
+            },
+            idle_timeout_minutes=0.03,  # ~2s
+            update_interval_s=0.3,
+        )
+        cluster.start()
+        try:
+            ray_tpu.init(address=cluster.address)
+
+            @ray_tpu.remote(resources={"extra": 1})
+            def on_worker():
+                return "scaled"
+
+            # no worker node exists yet: this demand must trigger scale-up
+            assert ray_tpu.get(on_worker.remote(), timeout=120) == "scaled"
+            assert cluster.provider.non_terminated_nodes()
+
+            # idle: the worker node should be terminated after the timeout
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if not cluster.provider.non_terminated_nodes():
+                    break
+                time.sleep(0.5)
+            assert not cluster.provider.non_terminated_nodes(), \
+                "idle node was not scaled down"
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+    def test_min_workers_maintained(self):
+        import ray_tpu
+        from ray_tpu.cluster_utils import AutoscalingCluster
+
+        cluster = AutoscalingCluster(
+            head_resources={"CPU": 1},
+            worker_node_types={
+                "worker": {"resources": {"CPU": 2},
+                           "min_workers": 1, "max_workers": 2},
+            },
+            idle_timeout_minutes=0.02,
+            update_interval_s=0.3,
+        )
+        cluster.start()
+        try:
+            ray_tpu.init(address=cluster.address)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if len(cluster.provider.non_terminated_nodes()) >= 1:
+                    break
+                time.sleep(0.5)
+            assert len(cluster.provider.non_terminated_nodes()) >= 1
+            # idle min_workers node must NOT be reclaimed
+            time.sleep(3)
+            assert len(cluster.provider.non_terminated_nodes()) >= 1
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
