@@ -44,6 +44,10 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.spawn_time = time.monotonic()
         self.idle_since = time.monotonic()
+        # runtime_env this process has applied (None = pristine). A worker
+        # that applied one env can never serve a different one (reference:
+        # worker_pool keys processes by runtime-env hash, worker_pool.h).
+        self.env_key: Optional[str] = None
 
     @property
     def alive(self) -> bool:
@@ -204,24 +208,20 @@ class NodeAgent:
         period = max(CONFIG.gossip_period_ms, 50) / 1000
         while True:
             await asyncio.sleep(period)
-            if self._resources_dirty:
-                self._resources_dirty = False
-                try:
-                    await self.head.call(
-                        "UpdateResources",
-                        {"node_id": self.node_id, "resources": self.resources.to_wire()},
-                    )
-                except Exception:
-                    pass
-            else:
-                # heartbeat
-                try:
-                    await self.head.call(
-                        "UpdateResources",
-                        {"node_id": self.node_id, "resources": self.resources.to_wire()},
-                    )
-                except Exception:
-                    pass
+            self._resources_dirty = False
+            try:
+                # doubles as heartbeat; `pending` is the autoscaler's demand
+                # signal (reference: raylet resource reports feeding
+                # GcsAutoscalerStateManager / monitor.py)
+                await self.head.call(
+                    "UpdateResources",
+                    {"node_id": self.node_id,
+                     "resources": self.resources.to_wire(),
+                     "pending": [r["resources"].to_wire()
+                                 for r in self._pending_leases]},
+                )
+            except Exception:
+                pass
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self, actor_spec: Optional[Dict] = None) -> WorkerHandle:
@@ -463,9 +463,12 @@ class NodeAgent:
                 return True
         elif not request.fits(self.resources.available):
             return False
-        worker = self._pop_idle_worker()
+        env_key = req["p"].get("env_key")
+        worker = self._pop_idle_worker(env_key)
         if worker is None:
             if len(self.workers) + self._starting_workers < self.max_workers + 8:
+                self._spawn_worker()
+            elif self._evict_mismatched_idle():
                 self._spawn_worker()
             return False
         # allocate resources
@@ -483,6 +486,10 @@ class NodeAgent:
         worker.meta_pg = list(pg_key) if pg_key else None
         fut: asyncio.Future = req["fut"]
         if not fut.done():
+            if env_key is not None:
+                # tag only on a delivered grant: the worker will apply this
+                # runtime_env on its first task and can never serve another
+                worker.env_key = env_key
             fut.set_result(
                 {
                     "grant": {
@@ -499,12 +506,28 @@ class NodeAgent:
             self.idle_workers.append(worker)
         return True
 
-    def _pop_idle_worker(self) -> Optional[WorkerHandle]:
-        while self.idle_workers:
-            w = self.idle_workers.pop()
-            if w.alive and w.registered.is_set():
-                return w
+    def _pop_idle_worker(self, env_key: Optional[str] = None
+                         ) -> Optional[WorkerHandle]:
+        # prune dead workers, then prefer an env-matching worker, falling
+        # back to a pristine one (tagged by the caller on grant)
+        self.idle_workers = [w for w in self.idle_workers
+                             if w.alive and w.registered.is_set()]
+        for tier in (env_key, None):
+            for i in range(len(self.idle_workers) - 1, -1, -1):
+                if self.idle_workers[i].env_key == tier:
+                    return self.idle_workers.pop(i)
         return None
+
+    def _evict_mismatched_idle(self) -> bool:
+        """Kill one idle worker with a foreign runtime_env to make room for
+        a fresh process (its env cannot be un-applied)."""
+        for i, w in enumerate(self.idle_workers):
+            if w.env_key is not None:
+                self.idle_workers.pop(i)
+                w.proc.terminate()
+                self.workers.pop(w.worker_id, None)
+                return True
+        return False
 
     async def _return_worker(self, conn: Connection, p: Dict) -> bool:
         lease_id = p["lease_id"]

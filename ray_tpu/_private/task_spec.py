@@ -9,7 +9,17 @@ crosses the wire as-is.
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, List, Optional, Tuple
+
+
+def runtime_env_key(runtime_env: Optional[Dict]) -> Optional[str]:
+    """Canonical hashable form of a runtime_env — THE key for both lease
+    scheduling (below) and agent-side worker/env affinity
+    (agent._pop_idle_worker); keep the two in sync by using only this."""
+    if not runtime_env:
+        return None
+    return json.dumps(runtime_env, sort_keys=True)
 
 NORMAL_TASK = 0
 ACTOR_CREATION_TASK = 1
@@ -98,7 +108,5 @@ class TaskSpec:
             tuple(sorted(self.resources.items())),
             self.placement_group_id,
             repr(self.scheduling_strategy),
-            tuple(sorted((self.runtime_env or {}).items(), key=lambda kv: kv[0]))
-            if self.runtime_env
-            else None,
+            runtime_env_key(self.runtime_env),
         )

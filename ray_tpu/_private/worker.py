@@ -391,7 +391,19 @@ class Worker:
         self.loop.call_soon_threadsafe(fn, *args)
 
     def _spawn(self, coro):
-        asyncio.run_coroutine_threadsafe(coro, self.loop)
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+        def _log_failure(f):
+            exc = f.exception() if not f.cancelled() else None
+            if exc is not None:
+                import logging
+                import traceback
+
+                logging.getLogger("ray_tpu").error(
+                    "background runtime coroutine failed: %s\n%s", exc,
+                    "".join(traceback.format_exception(exc)))
+
+        fut.add_done_callback(_log_failure)
 
     # --------------------------------------------------------- owner service
     def _register_direct_routes(self):
@@ -1207,6 +1219,11 @@ class _LeasePool:
         self.strategy = spec.scheduling_strategy
         self.pg = ([spec.placement_group_id, spec.placement_group_bundle_index]
                    if spec.placement_group_id else None)
+        from ray_tpu._private.task_spec import runtime_env_key
+
+        # agents only hand this lease workers whose applied runtime_env
+        # matches (or pristine ones) — see agent._pop_idle_worker
+        self.env_key = runtime_env_key(spec.runtime_env)
         self.pending: deque = deque()
         self.conns: List[WorkerConn] = []
         self.idle: List[WorkerConn] = []
@@ -1269,6 +1286,7 @@ class _LeasePool:
                 "scheduling_strategy": self.strategy,
                 "pg": self.pg,
                 "owner": w.worker_id.hex(),
+                "env_key": self.env_key,
             }
             agent_addr = None
             if self.pg:

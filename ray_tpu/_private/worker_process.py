@@ -130,6 +130,11 @@ class Executor:
         ctx.placement_group_id = spec.placement_group_id
         start = time.time()
         try:
+            if spec.runtime_env:
+                from ray_tpu.runtime_env import setup_runtime_env
+
+                setup_runtime_env(spec.runtime_env,
+                                  os.environ.get("RAY_TPU_SESSION_DIR"))
             args, kwargs = self._resolve_args(spec)
             if spec.task_type == ACTOR_TASK:
                 fn = getattr(self.worker.actor_instance, spec.actor_method)
@@ -242,6 +247,11 @@ class Executor:
         loop = asyncio.get_running_loop()
 
         def construct():
+            if spec.get("runtime_env"):
+                from ray_tpu.runtime_env import setup_runtime_env
+
+                setup_runtime_env(spec["runtime_env"],
+                                  os.environ.get("RAY_TPU_SESSION_DIR"))
             cls = ser.loads(spec["class_blob"])
             args = [self._materialize(e) for e in spec.get("init_args", [])]
             kwargs = {k: self._materialize(v)

@@ -1,0 +1,41 @@
+"""Runtime environments (reference: python/ray/_private/runtime_env/ — the
+per-node agent runtime_env_agent.py:161, plugin ABC plugin.py:24, URI cache
+uri_cache.py, and the RuntimeEnv schema python/ray/runtime_env/runtime_env.py).
+
+Supported fields, applied in the worker process right before it first
+executes a task carrying the env (lease scheduling keys already isolate
+workers per runtime_env — task_spec.py lease_key — so application happens
+exactly once per leased worker):
+
+- ``env_vars``:    {str: str} exported into the worker's os.environ
+- ``working_dir``: a local directory, staged into a content-addressed cache
+                   under the session dir and chdir'd into
+- ``py_modules``:  list of local dirs/py files prepended to sys.path
+- ``pip`` / ``conda``: validated only — this deployment forbids network
+                   installs, so packages must already be importable; a
+                   missing import raises RuntimeEnvSetupError at setup time
+                   instead of deep inside user code
+- ``config``:      {"setup_timeout_seconds": ...} accepted for parity
+
+TPU-first deviation: no separate per-node HTTP agent process — env setup is
+a pure-local operation (tmpfs staging + process env), so it runs in-worker,
+keeping the hot lease path free of an extra RPC.
+"""
+
+from ray_tpu.runtime_env.runtime_env import (
+    RuntimeEnv,
+    RuntimeEnvConfig,
+    RuntimeEnvSetupError,
+)
+from ray_tpu.runtime_env.context import RuntimeEnvContext, setup_runtime_env
+from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
+
+__all__ = [
+    "RuntimeEnv",
+    "RuntimeEnvConfig",
+    "RuntimeEnvSetupError",
+    "RuntimeEnvContext",
+    "RuntimeEnvPlugin",
+    "register_plugin",
+    "setup_runtime_env",
+]

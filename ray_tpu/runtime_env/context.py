@@ -1,0 +1,60 @@
+"""Worker-side runtime-env application (the RuntimeEnvContext analog,
+reference: python/ray/_private/runtime_env/context.py — which mutates the
+worker command; here the worker mutates itself before the first task of a
+leased runtime_env executes)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ray_tpu.runtime_env.plugin import _PLUGINS
+from ray_tpu.runtime_env.runtime_env import (
+    RuntimeEnvSetupError,
+    validate_runtime_env,
+)
+
+
+class RuntimeEnvContext:
+    def __init__(self, spec: Dict, cache_root: str):
+        self.spec = spec
+        self.cache_root = cache_root
+
+
+_applied: Optional[Dict] = None
+
+
+def setup_runtime_env(spec: Optional[Dict],
+                      session_dir: Optional[str] = None) -> None:
+    """Apply a runtime_env in this process (idempotent per spec).
+
+    Called by the worker executor before running a task that carries a
+    runtime_env. Lease keys pin one runtime_env per leased worker, so a
+    changed spec in the same process is a scheduling bug worth surfacing.
+    """
+    global _applied
+    if not spec:
+        return
+    if _applied is not None:
+        if _applied != spec:
+            raise RuntimeEnvSetupError(
+                "worker already initialized with a different runtime_env "
+                f"({_applied} != {spec})")
+        return
+    validate_runtime_env(spec)
+    cache_root = os.path.join(
+        session_dir or os.environ.get("RAY_TPU_SESSION_DIR", "/tmp"),
+        "runtime_env_cache")
+    os.makedirs(cache_root, exist_ok=True)
+    context = RuntimeEnvContext(spec, cache_root)
+    plugins = [(k, _PLUGINS[k]) for k in spec if k in _PLUGINS]
+    plugins.sort(key=lambda kv: kv[1].priority)
+    for key, plugin in plugins:
+        try:
+            plugin.setup(spec[key], context)
+        except RuntimeEnvSetupError:
+            raise
+        except Exception as e:
+            raise RuntimeEnvSetupError(
+                f"runtime_env field {key!r} setup failed: {e}") from e
+    _applied = dict(spec)

@@ -1,0 +1,185 @@
+"""Runtime-env plugin interface (reference:
+python/ray/_private/runtime_env/plugin.py:24 RuntimeEnvPlugin ABC).
+
+Built-in fields (env_vars / working_dir / py_modules / pip / conda) are
+implemented as plugins too, so third-party fields register the same way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import os
+import shutil
+import sys
+from typing import Any, Dict, Optional
+
+from ray_tpu.runtime_env.runtime_env import RuntimeEnvSetupError
+
+
+class RuntimeEnvPlugin:
+    """Setup hook for one runtime_env field."""
+
+    name: str = ""
+    priority: int = 10  # lower runs earlier
+
+    def validate(self, value: Any) -> None:
+        pass
+
+    def setup(self, value: Any, context: "RuntimeEnvContext") -> None:
+        """Apply the field inside the worker process."""
+        raise NotImplementedError
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    _PLUGINS[plugin.name] = plugin
+
+
+def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
+    return _PLUGINS.get(name)
+
+
+# ---------------------------------------------------------------- built-ins
+
+def _excluded(rel: str, excludes) -> bool:
+    """gitignore-flavored match on slash-normalized relative paths: a
+    pattern excludes exact matches, fnmatch matches, and everything under a
+    matched directory."""
+    import fnmatch
+
+    rel = rel.replace(os.sep, "/")
+    for pat in excludes or ():
+        pat = pat.rstrip("/")
+        if (rel == pat or fnmatch.fnmatch(rel, pat)
+                or rel.startswith(pat + "/")
+                or fnmatch.fnmatch(rel, pat + "/*")):
+            return True
+    return False
+
+
+def _stage_dir(src: str, cache_root: str, excludes=None) -> str:
+    """Copy ``src`` into a content-addressed cache dir (the URI-cache analog,
+    reference: _private/runtime_env/uri_cache.py); reuses an existing copy.
+    Hash and copy use the SAME exclude predicate — a mismatch would produce
+    stale cache hits."""
+    h = hashlib.sha256()
+    kept = []
+    for root, dirs, files in os.walk(src):
+        dirs.sort()
+        reldir = os.path.relpath(root, src)
+        dirs[:] = [d for d in dirs if not _excluded(
+            os.path.normpath(os.path.join(reldir, d)), excludes)]
+        for fname in sorted(files):
+            path = os.path.join(root, fname)
+            rel = os.path.normpath(os.path.join(reldir, fname))
+            if _excluded(rel, excludes):
+                continue
+            h.update(rel.encode())
+            st = os.stat(path)
+            h.update(f"{st.st_size}:{int(st.st_mtime)}".encode())
+            kept.append((path, rel))
+    digest = h.hexdigest()[:16]
+    dest = os.path.join(cache_root, f"working_dir_{digest}")
+    if not os.path.isdir(dest):
+        tmp = dest + f".tmp{os.getpid()}"
+        for path, rel in kept:
+            target = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            shutil.copy2(path, target)
+        os.makedirs(tmp, exist_ok=True)  # empty src edge case
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)  # lost a race: reuse dest
+    return dest
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    def setup(self, value: Dict[str, str], context) -> None:
+        os.environ.update(value)
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 1
+
+    def setup(self, value: str, context) -> None:
+        if value.startswith(("http://", "https://", "gs://", "s3://")):
+            raise RuntimeEnvSetupError(
+                "remote working_dir URIs need network access, which this "
+                "deployment forbids; use a local path")
+        staged = _stage_dir(value, context.cache_root,
+                            context.spec.get("excludes"))
+        os.chdir(staged)
+        if staged not in sys.path:
+            sys.path.insert(0, staged)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 2
+
+    def setup(self, value, context) -> None:
+        for mod in value:
+            path = os.path.abspath(mod)
+            if path.endswith(".py"):
+                path = os.path.dirname(path)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+
+
+class PipCheckPlugin(RuntimeEnvPlugin):
+    """No-install policy: verify the requested packages are already
+    importable instead of calling pip (reference behavior installs via
+    _private/runtime_env/pip.py; this image forbids installs)."""
+
+    name = "pip"
+    priority = 3
+
+    def setup(self, value, context) -> None:
+        if isinstance(value, dict):
+            value = value.get("packages", [])
+        if isinstance(value, str):
+            raise RuntimeEnvSetupError(
+                "pip requirements files are not supported in the no-install "
+                "deployment; list packages explicitly")
+        import importlib.metadata as im
+
+        missing = []
+        for req in value:
+            dist = (req.split("==")[0].split(">=")[0].split("<=")[0]
+                    .split("[")[0].strip())
+            try:
+                im.version(dist)  # distribution name (handles scikit-learn)
+                continue
+            except im.PackageNotFoundError:
+                pass
+            try:  # fall back: module name given directly (e.g. "sklearn")
+                importlib.import_module(dist.replace("-", "_"))
+            except ImportError:
+                missing.append(req)
+        if missing:
+            raise RuntimeEnvSetupError(
+                f"packages {missing} are not pre-installed and this "
+                "deployment forbids network installs")
+
+
+class CondaGatePlugin(RuntimeEnvPlugin):
+    name = "conda"
+    priority = 3
+
+    def setup(self, value, context) -> None:
+        raise RuntimeEnvSetupError(
+            "conda environments are not supported in the no-install "
+            "deployment")
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+           PipCheckPlugin(), CondaGatePlugin()):
+    register_plugin(_p)

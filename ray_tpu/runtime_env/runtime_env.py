@@ -1,0 +1,88 @@
+"""RuntimeEnv schema + validation (reference:
+python/ray/runtime_env/runtime_env.py RuntimeEnv class)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+
+class RuntimeEnvSetupError(Exception):
+    """Raised when a runtime env cannot be set up on a worker."""
+
+
+class RuntimeEnvConfig(dict):
+    """Setup behavior knobs (reference: runtime_env.py RuntimeEnvConfig)."""
+
+    KNOWN = {"setup_timeout_seconds", "eager_install"}
+
+    def __init__(self, setup_timeout_seconds: int = 600,
+                 eager_install: bool = True):
+        super().__init__(setup_timeout_seconds=setup_timeout_seconds,
+                         eager_install=eager_install)
+
+
+class RuntimeEnv(dict):
+    """Validated runtime environment spec; a plain dict on the wire."""
+
+    KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+                    "config", "excludes"}
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[Any] = None,
+                 conda: Optional[Any] = None,
+                 config: Optional[Dict] = None,
+                 excludes: Optional[List[str]] = None,
+                 **extra):
+        super().__init__()
+        for key, value in [("env_vars", env_vars), ("working_dir", working_dir),
+                           ("py_modules", py_modules), ("pip", pip),
+                           ("conda", conda), ("config", config),
+                           ("excludes", excludes)]:
+            if value is not None:
+                self[key] = value
+        # plugin fields (registered via register_plugin) pass through
+        for key, value in extra.items():
+            if value is not None:
+                self[key] = value
+        validate_runtime_env(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "RuntimeEnv":
+        return cls(**d)
+
+
+def validate_runtime_env(env: Dict) -> None:
+    from ray_tpu.runtime_env.plugin import _PLUGINS
+
+    for key in env:
+        if key not in RuntimeEnv.KNOWN_FIELDS and key not in _PLUGINS:
+            raise ValueError(
+                f"unknown runtime_env field {key!r}; known: "
+                f"{sorted(RuntimeEnv.KNOWN_FIELDS | set(_PLUGINS))}")
+    ev = env.get("env_vars")
+    if ev is not None:
+        if not isinstance(ev, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in ev.items()):
+            raise TypeError("env_vars must be a Dict[str, str]")
+    wd = env.get("working_dir")
+    if wd is not None:
+        if not isinstance(wd, str):
+            raise TypeError("working_dir must be a path string")
+        if not (wd.startswith(("http://", "https://", "gs://", "s3://"))
+                or os.path.isdir(wd)):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+    pm = env.get("py_modules")
+    if pm is not None:
+        if not isinstance(pm, (list, tuple)):
+            raise TypeError("py_modules must be a list of paths")
+        for m in pm:
+            if not isinstance(m, str) or not os.path.exists(m):
+                raise ValueError(f"py_modules entry {m!r} does not exist")
+    pip = env.get("pip")
+    if pip is not None and not isinstance(pip, (list, dict, str)):
+        raise TypeError("pip must be a list of requirements, a dict, or a "
+                        "requirements-file path")

@@ -1,0 +1,123 @@
+"""Runtime-env tests (reference parity: python/ray/tests/test_runtime_env*.py
+— env_vars propagation, working_dir staging, py_modules imports, validation,
+no-install pip gating)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import (
+    RuntimeEnv,
+    RuntimeEnvSetupError,
+    setup_runtime_env,
+)
+from ray_tpu.runtime_env.runtime_env import validate_runtime_env
+
+
+class TestValidation:
+    def test_known_fields_ok(self, tmp_path):
+        RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path),
+                   py_modules=[str(tmp_path)])
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown runtime_env field"):
+            validate_runtime_env({"bogus_field": 1})
+
+    def test_env_vars_type_checked(self):
+        with pytest.raises(TypeError):
+            validate_runtime_env({"env_vars": {"A": 1}})
+
+    def test_missing_working_dir_rejected(self):
+        with pytest.raises(ValueError):
+            validate_runtime_env({"working_dir": "/nonexistent/dir/xyz"})
+
+    def test_missing_py_module_rejected(self):
+        with pytest.raises(ValueError):
+            validate_runtime_env({"py_modules": ["/no/such/module.py"]})
+
+
+class TestTaskRuntimeEnv:
+    def test_env_vars_in_task(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_PROBE": "tpu42"}})
+        def read_env():
+            return os.environ.get("RTENV_PROBE")
+
+        assert ray_tpu.get(read_env.remote(), timeout=60) == "tpu42"
+
+    def test_working_dir_staged_and_cwd(self, ray_start_regular, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "data.txt").write_text("payload-123")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+        def read_file():
+            return open("data.txt").read(), os.getcwd()
+
+        content, cwd = ray_tpu.get(read_file.remote(), timeout=60)
+        assert content == "payload-123"
+        assert "runtime_env_cache" in cwd  # staged copy, not the original
+
+    def test_py_modules_importable(self, ray_start_regular, tmp_path):
+        mod_dir = tmp_path / "mods"
+        mod_dir.mkdir()
+        (mod_dir / "rtenv_probe_mod.py").write_text(
+            textwrap.dedent("""
+            VALUE = "imported-ok"
+            """))
+
+        @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+        def use_module():
+            import rtenv_probe_mod
+            return rtenv_probe_mod.VALUE
+
+        assert ray_tpu.get(use_module.remote(), timeout=60) == "imported-ok"
+
+    def test_pip_preinstalled_passes(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+        def ok():
+            import numpy
+            return "has-numpy"
+
+        assert ray_tpu.get(ok.remote(), timeout=60) == "has-numpy"
+
+    def test_pip_missing_package_fails(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"pip": ["surely_not_installed_pkg_xyz"]})
+        def nope():
+            return 1
+
+        with pytest.raises(RuntimeEnvSetupError, match="not pre-installed"):
+            ray_tpu.get(nope.remote(), timeout=60)
+
+
+class TestActorRuntimeEnv:
+    def test_actor_env_vars(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_RTENV": "actor-1"}})
+        class EnvActor:
+            def probe(self):
+                return os.environ.get("ACTOR_RTENV")
+
+        a = EnvActor.remote()
+        assert ray_tpu.get(a.probe.remote(), timeout=60) == "actor-1"
+        ray_tpu.kill(a)
+
+
+class TestInProcessSetup:
+    def test_idempotent_same_spec(self, tmp_path, monkeypatch):
+        import ray_tpu.runtime_env.context as ctx
+
+        monkeypatch.setattr(ctx, "_applied", None)
+        spec = {"env_vars": {"IDEM": "x"}}
+        setup_runtime_env(spec, str(tmp_path))
+        setup_runtime_env(spec, str(tmp_path))  # no error
+        assert os.environ.get("IDEM") == "x"
+
+    def test_conflicting_spec_raises(self, tmp_path, monkeypatch):
+        import ray_tpu.runtime_env.context as ctx
+
+        monkeypatch.setattr(ctx, "_applied", None)
+        setup_runtime_env({"env_vars": {"A": "1"}}, str(tmp_path))
+        with pytest.raises(RuntimeEnvSetupError):
+            setup_runtime_env({"env_vars": {"A": "2"}}, str(tmp_path))
