@@ -62,6 +62,19 @@ def init(
     - ``init(_node=...)`` attaches to an already-running Node (tests).
     """
     global _global_node
+    if address and address.startswith("ray://"):
+        # client mode: proxy the API to a remote driver (reference:
+        # client_builder.py ray.init("ray://...") path). Named params ride
+        # along so e.g. namespace reaches the server-side driver.
+        from ray_tpu.util.client import connect as _client_connect
+
+        named = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                 "resources": resources,
+                 "object_store_memory": object_store_memory,
+                 "labels": labels, "namespace": namespace}
+        fwd = {k: v for k, v in named.items() if v is not None}
+        fwd.update(_kwargs)
+        return _client_connect(address, **fwd)
     with _init_lock:
         if is_initialized():
             if ignore_reinit_error:

@@ -1,0 +1,18 @@
+"""``ray://`` client mode (reference: python/ray/util/client/ — gRPC proxy
+driver described in python/ray/util/client/ARCHITECTURE.md: a thin client
+ships pickled functions/args to a server that runs a real driver and holds
+the object refs).
+
+Here the transport is the framework's own length-prefixed RPC protocol
+(_private/protocol.py) instead of gRPC: ``ClientServer`` embeds a real
+driver, ``ClientContext`` (returned by ``ray_tpu.init("ray://host:port")``)
+proxies remote()/get()/put()/actors to it. Refs on the client are
+``ClientObjectRef`` handles naming server-held refs; the server releases
+them when the client connection drops.
+"""
+
+from ray_tpu.util.client.client import ClientContext, ClientObjectRef, connect
+from ray_tpu.util.client.server import ClientServer, serve
+
+__all__ = ["ClientContext", "ClientObjectRef", "connect", "ClientServer",
+           "serve"]
