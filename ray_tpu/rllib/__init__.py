@@ -10,14 +10,18 @@ CPU actors.
 """
 
 from ray_tpu.rllib.algorithms import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner, PPOLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Learner",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "Learner",
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
     "SingleAgentEnvRunner",
 ]

@@ -161,4 +161,5 @@ class AlgorithmConfig:
             "lr": self.lr, "grad_clip": self.grad_clip,
             "num_epochs": self.num_epochs,
             "minibatch_size": self.minibatch_size, "seed": self.seed,
+            "gamma": self.gamma,  # TD/V-trace targets must match rollouts
         }

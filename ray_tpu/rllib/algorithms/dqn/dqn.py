@@ -1,0 +1,300 @@
+"""DQN — double DQN with target network and (optionally prioritized)
+replay (reference: rllib/algorithms/dqn/dqn.py DQNConfig/DQN and
+dqn/torch/dqn_torch_learner.py loss; Mnih 2015 / van Hasselt 2016).
+
+TPU-first shape: the whole update — gather Q(s,a), double-DQN target from
+the online argmax + target net, Huber loss, adam step — is one jitted
+function; the replay buffer stays host-side numpy and ships one contiguous
+minibatch per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.utils.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+
+# ------------------------------------------------------------------- module
+@dataclasses.dataclass
+class DQNModuleSpec:
+    """Q-network spec (reference: dqn/dqn_rl_module.py)."""
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool = True  # DQN is discrete-only
+    hiddens: Tuple[int, ...] = (64, 64)
+    activation: str = "relu"
+    dueling: bool = True
+
+    def build(self) -> "DQNModule":
+        return DQNModule(self)
+
+
+class DQNModule:
+    """MLP Q-network, optionally dueling (value + advantage streams,
+    reference: dqn dueling head)."""
+
+    def __init__(self, spec: DQNModuleSpec):
+        self.spec = spec
+        self._act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[spec.activation]
+
+    def init(self, rng) -> Dict:
+        def mlp(key, sizes):
+            layers = []
+            for a, b in zip(sizes[:-1], sizes[1:]):
+                key, sub = jax.random.split(key)
+                layers.append({
+                    "w": jax.random.normal(sub, (a, b)) * jnp.sqrt(2.0 / a),
+                    "b": jnp.zeros((b,)),
+                })
+            return layers
+
+        k1, k2 = jax.random.split(rng)
+        sizes = (self.spec.obs_dim, *self.spec.hiddens)
+        params = {"q": mlp(k1, sizes + (self.spec.action_dim,))}
+        if self.spec.dueling:
+            params["v"] = mlp(k2, sizes + (1,))
+        # exploration epsilon rides in params so the jitted env-runner
+        # inference sees updates without recompilation
+        params["epsilon"] = jnp.asarray(1.0, jnp.float32)
+        return params
+
+    def _tower(self, layers, x):
+        for layer in layers[:-1]:
+            x = self._act(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def q_values(self, params, obs) -> jnp.ndarray:
+        adv = self._tower(params["q"], obs)
+        if self.spec.dueling:
+            v = self._tower(params["v"], obs)
+            return v + adv - adv.mean(axis=-1, keepdims=True)
+        return adv
+
+    # env-runner interface (same contract as MLPModule)
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        q = self.q_values(params, obs)
+        return {"logits": q, "vf": q.max(axis=-1)}
+
+    def explore_action(self, params, obs, rng):
+        q = self.q_values(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(rng)
+        random_a = jax.random.randint(
+            k1, greedy.shape, 0, self.spec.action_dim)
+        explore = (jax.random.uniform(k2, greedy.shape)
+                   < params["epsilon"])
+        action = jnp.where(explore, random_a, greedy)
+        zeros = jnp.zeros_like(q[..., 0])
+        return action, zeros, zeros  # logp/vf unused by off-policy replay
+
+
+# ------------------------------------------------------------------ learner
+class DQNLearner(Learner):
+    """Double-DQN Huber loss with target network
+    (reference: dqn_torch_learner.py compute_loss_for_module)."""
+
+    def __init__(self, module_spec, config, use_mesh: bool = False):
+        # single-mesh learner: _build_update below jits without data-axis
+        # shardings (target_params riding in the batch must stay replicated)
+        super().__init__(module_spec, config, use_mesh=use_mesh)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def loss(self, params, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        q_all = self.module.q_values(params, batch["obs"])
+        q_sa = jnp.take_along_axis(
+            q_all, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+        # double DQN: online net picks a*, target net evaluates it
+        next_online = self.module.q_values(params, batch["next_obs"])
+        a_star = jnp.argmax(next_online, axis=-1)
+        next_target = self.module.q_values(batch["target_params"],
+                                           batch["next_obs"])
+        q_next = jnp.take_along_axis(
+            next_target, a_star[:, None], axis=1)[:, 0]
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_next)
+        td = q_sa - target
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                          jnp.abs(td) - 0.5)
+        weights = batch.get("weights")
+        loss = jnp.mean(huber * weights) if weights is not None \
+            else jnp.mean(huber)
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                      "qf_mean": jnp.mean(q_sa), "td_error": td}
+
+    def _build_update(self):
+        # epsilon is exploration state, not a trainable — mask its gradient
+        def update(params, opt_state, batch):
+            def masked_loss(p):
+                return self.loss(p, batch)
+
+            (loss, metrics), grads = jax.value_and_grad(
+                masked_loss, has_aux=True)(params)
+            grads["epsilon"] = jnp.zeros_like(params["epsilon"])
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        return jax.jit(update)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        batch = dict(batch)
+        idx = batch.pop("batch_indexes", None)
+        batch["target_params"] = self.target_params
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch)
+        td = np.asarray(metrics.pop("td_error"))
+        out = {k: float(v) for k, v in metrics.items()}
+        out["_td_error"] = td
+        out["_batch_indexes"] = idx
+        return out
+
+    def sync_target(self, tau: float = 1.0) -> None:
+        """Hard (tau=1) or polyak target update."""
+        self.target_params = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o,
+            self.target_params, self.params)
+
+    def set_epsilon(self, eps: float) -> None:
+        self.params["epsilon"] = jnp.asarray(eps, jnp.float32)
+
+    def get_state(self) -> Dict:
+        s = super().get_state()
+        s["target_params"] = jax.device_get(self.target_params)
+        return s
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+
+
+# ---------------------------------------------------------------- algorithm
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DQN)
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # env steps
+        self.training_intensity = 1.0  # updates per env step sampled
+        self.epsilon = [(0, 1.0), (10_000, 0.05)]  # linear schedule
+        self.double_q = True
+        self.dueling = True
+        self.prioritized_replay = False
+        self.rollout_fragment_length = 4
+        self.num_env_runners = 1
+
+    def _training_keys(self):
+        return {"replay_buffer_capacity", "target_network_update_freq",
+                "num_steps_sampled_before_learning_starts", "epsilon",
+                "double_q", "dueling", "prioritized_replay",
+                "training_intensity"}
+
+    def module_spec(self) -> DQNModuleSpec:
+        base = super().module_spec()
+        if not base.discrete:
+            raise ValueError("DQN supports discrete action spaces only")
+        return DQNModuleSpec(
+            obs_dim=base.obs_dim, action_dim=base.action_dim,
+            hiddens=tuple(self.model.get("hiddens", (64, 64))),
+            activation=self.model.get("activation", "relu"),
+            dueling=self.dueling)
+
+
+class DQN(Algorithm):
+    learner_cls = DQNLearner
+
+    @classmethod
+    def get_default_config(cls):
+        return DQNConfig(algo_class=cls)
+
+    def setup(self, _config) -> None:
+        super().setup(_config)
+        cfg = self.config
+        self.replay = (PrioritizedReplayBuffer(cfg.replay_buffer_capacity,
+                                               seed=cfg.seed)
+                       if cfg.prioritized_replay
+                       else ReplayBuffer(cfg.replay_buffer_capacity,
+                                         seed=cfg.seed))
+        self._steps_since_target_sync = 0
+
+    def _make_runner(self, idx: int):
+        cfg = self.config
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        return ray_tpu.remote(SingleAgentEnvRunner).options(
+            resources={"CPU": 1}).remote(
+                cfg.make_env(), cfg.num_envs_per_env_runner,
+                cfg.rollout_fragment_length, self._module_spec,
+                seed=cfg.seed + idx * 1000 + 1, explore=cfg.explore,
+                gamma=cfg.gamma, collect_next_obs=True)
+
+    def _epsilon_at(self, step: int) -> float:
+        sched = self.config.epsilon
+        (s0, e0), (s1, e1) = sched[0], sched[-1]
+        if step <= s0:
+            return e0
+        if step >= s1:
+            return e1
+        frac = (step - s0) / max(s1 - s0, 1)
+        return e0 + frac * (e1 - e0)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        learner = self.learner_group.local_learner()
+        learner.set_epsilon(self._epsilon_at(self._total_env_steps))
+        weights_ref = ray_tpu.put(learner.get_weights())
+
+        samples = self._sample_from_runners(weights_ref)
+        new_steps = sum(s["env_steps"] for s in samples)
+        for s in samples:
+            flat = lambda a: a.reshape((-1,) + a.shape[2:])
+            mask = flat(s["valid"])
+            self.replay.add_batch({
+                "obs": flat(s["obs"])[mask],
+                "actions": flat(s["actions"])[mask],
+                "rewards": flat(s["rewards"])[mask],
+                "next_obs": flat(s["next_obs"])[mask],
+                "dones": flat(s["dones"])[mask],
+            })
+
+        metrics: Dict = {"env_steps_this_iter": new_steps}
+        if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+
+        num_updates = max(1, int(new_steps * cfg.training_intensity /
+                                 max(cfg.train_batch_size, 1)))
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.train_batch_size)
+            out = learner.update(batch)
+            td = out.pop("_td_error", None)
+            idx = out.pop("_batch_indexes", None)
+            if idx is not None and td is not None and hasattr(
+                    self.replay, "update_priorities"):
+                self.replay.update_priorities(idx, td)
+            metrics.update(out)
+        self._steps_since_target_sync += new_steps
+        if self._steps_since_target_sync >= cfg.target_network_update_freq:
+            learner.sync_target()
+            self._steps_since_target_sync = 0
+        metrics["epsilon"] = self._epsilon_at(self._total_env_steps)
+        return metrics

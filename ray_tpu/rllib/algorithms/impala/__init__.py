@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig
+
+__all__ = ["IMPALA", "IMPALAConfig"]

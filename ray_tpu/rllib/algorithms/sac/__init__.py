@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig
+
+__all__ = ["SAC", "SACConfig"]

@@ -1,0 +1,297 @@
+"""SAC — soft actor-critic with twin Q critics, polyak targets, and
+auto-tuned temperature (reference: rllib/algorithms/sac/sac.py +
+sac/torch/sac_torch_learner.py; Haarnoja 2018).
+
+One jitted update covers critic, actor, and alpha steps — three
+value_and_grads fused by XLA into a single HBM-resident graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+# ------------------------------------------------------------------- module
+@dataclasses.dataclass
+class SACModuleSpec:
+    """Actor + twin critics (reference: sac/sac_rl_module.py)."""
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool = False  # SAC here is continuous-only
+    hiddens: Tuple[int, ...] = (256, 256)
+    activation: str = "relu"
+
+    def build(self) -> "SACModule":
+        return SACModule(self)
+
+
+class SACModule:
+    def __init__(self, spec: SACModuleSpec):
+        self.spec = spec
+        self._act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[spec.activation]
+
+    def _mlp(self, key, sizes):
+        layers = []
+        for a, b in zip(sizes[:-1], sizes[1:]):
+            key, sub = jax.random.split(key)
+            layers.append({
+                "w": jax.random.normal(sub, (a, b)) * jnp.sqrt(2.0 / a),
+                "b": jnp.zeros((b,)),
+            })
+        return layers
+
+    def init(self, rng) -> Dict:
+        ka, k1, k2 = jax.random.split(rng, 3)
+        h = self.spec.hiddens
+        obs, act = self.spec.obs_dim, self.spec.action_dim
+        return {
+            "actor": self._mlp(ka, (obs, *h, 2 * act)),
+            "q1": self._mlp(k1, (obs + act, *h, 1)),
+            "q2": self._mlp(k2, (obs + act, *h, 1)),
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+        }
+
+    def _tower(self, layers, x):
+        for layer in layers[:-1]:
+            x = self._act(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    # squashed-Gaussian policy
+    def pi(self, params, obs, rng):
+        out = self._tower(params["actor"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        std = jnp.exp(log_std)
+        raw = mean + std * jax.random.normal(rng, mean.shape)
+        action = jnp.tanh(raw)
+        # log-prob with tanh-squash correction (SAC appendix C)
+        logp_raw = jnp.sum(
+            -0.5 * ((raw - mean) / std) ** 2 - log_std
+            - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+        logp = logp_raw - jnp.sum(
+            2.0 * (jnp.log(2.0) - raw - jax.nn.softplus(-2.0 * raw)),
+            axis=-1)
+        return action, logp, jnp.tanh(mean)
+
+    def q(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return (self._tower(params["q1"], x)[..., 0],
+                self._tower(params["q2"], x)[..., 0])
+
+    # env-runner interface
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        out = self._tower(params["actor"], obs)
+        mean, _ = jnp.split(out, 2, axis=-1)
+        action = jnp.tanh(mean)
+        q1, _ = self.q(params, obs, action)
+        return {"logits": out, "vf": q1}
+
+    def explore_action(self, params, obs, rng):
+        action, logp, _ = self.pi(params, obs, rng)
+        q1, _ = self.q(params, obs, action)
+        return action, logp, q1
+
+
+# ------------------------------------------------------------------ learner
+class SACLearner:
+    """Critic + actor + temperature updates (reference:
+    sac_torch_learner.py compute_loss_for_module). Drives its own optax
+    chains per component, so it implements the Learner duck-type rather
+    than subclassing the PG Learner."""
+
+    def __init__(self, module_spec: SACModuleSpec, config: Dict,
+                 use_mesh: bool = True):
+        self.module = module_spec.build()
+        self.config = config
+        self._rng = jax.random.key(config.get("seed", 0))
+        self._rng, init_key = jax.random.split(self._rng)
+        self.params = self.module.init(init_key)
+        self.target_params = jax.tree.map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        lr = config.get("lr", 3e-4)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self.target_entropy = config.get(
+            "target_entropy", -float(module_spec.action_dim))
+        self._update = self._build_update()
+
+    def _build_update(self):
+        gamma = self.config.get("gamma", 0.99)
+        tau = self.config.get("tau", 0.005)
+        target_entropy = self.target_entropy
+
+        def losses(params, target_params, batch, k1, k2):
+            alpha = jnp.exp(params["log_alpha"])
+            # ---- critic target
+            next_a, next_logp, _ = self.module.pi(params, batch["next_obs"],
+                                                  k1)
+            tq1, tq2 = self.module.q(
+                {**params, "q1": target_params["q1"],
+                 "q2": target_params["q2"]},
+                batch["next_obs"], next_a)
+            q_next = jnp.minimum(tq1, tq2) - \
+                jax.lax.stop_gradient(alpha) * next_logp
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * q_next
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = self.module.q(params, batch["obs"], batch["actions"])
+            critic_loss = jnp.mean((q1 - target) ** 2) + \
+                jnp.mean((q2 - target) ** 2)
+            # ---- actor
+            new_a, logp, _ = self.module.pi(params, batch["obs"], k2)
+            pq1, pq2 = self.module.q(jax.lax.stop_gradient(params),
+                                     batch["obs"], new_a)
+            actor_loss = jnp.mean(
+                jax.lax.stop_gradient(alpha) * logp - jnp.minimum(pq1, pq2))
+            # ---- temperature
+            alpha_loss = -jnp.mean(
+                params["log_alpha"] *
+                jax.lax.stop_gradient(logp + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha_loss": alpha_loss, "alpha": alpha,
+                "qf_mean": jnp.mean(q1), "entropy": -jnp.mean(logp),
+            }
+
+        def update(params, target_params, opt_state, batch, rng):
+            rng, k1, k2 = jax.random.split(rng, 3)
+            (loss, metrics), grads = jax.value_and_grad(
+                losses, has_aux=True)(params, target_params, batch, k1, k2)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, target_params,
+                {"q1": params["q1"], "q2": params["q2"]})
+            metrics["total_loss"] = loss
+            return params, target_params, opt_state, metrics, rng
+
+        return jax.jit(update)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self.params, self.target_params, self.opt_state, metrics, self._rng \
+            = self._update(self.params, self.target_params, self.opt_state,
+                           batch, self._rng)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # Learner duck-type
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+    def get_state(self) -> Dict:
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state: Dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+
+
+# ---------------------------------------------------------------- algorithm
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or SAC)
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.tau = 0.005
+        self.target_entropy = None  # None -> -action_dim
+        self.training_intensity = 1.0
+        self.rollout_fragment_length = 1
+        self.num_env_runners = 1
+        self.model = {"hiddens": (256, 256), "activation": "relu"}
+
+    def _training_keys(self):
+        return {"replay_buffer_capacity", "tau", "target_entropy",
+                "num_steps_sampled_before_learning_starts",
+                "training_intensity"}
+
+    def learner_config_dict(self) -> Dict:
+        d = super().learner_config_dict()
+        d["tau"] = self.tau
+        if self.target_entropy is not None:
+            d["target_entropy"] = self.target_entropy
+        return d
+
+    def module_spec(self) -> SACModuleSpec:
+        base = super().module_spec()
+        if base.discrete:
+            raise ValueError("this SAC implements continuous control only")
+        return SACModuleSpec(
+            obs_dim=base.obs_dim, action_dim=base.action_dim,
+            hiddens=tuple(self.model.get("hiddens", (256, 256))),
+            activation=self.model.get("activation", "relu"))
+
+
+class SAC(Algorithm):
+    learner_cls = SACLearner
+
+    @classmethod
+    def get_default_config(cls):
+        return SACConfig(algo_class=cls)
+
+    def setup(self, _config) -> None:
+        super().setup(_config)
+        self.replay = ReplayBuffer(self.config.replay_buffer_capacity,
+                                   seed=self.config.seed)
+
+    def _make_runner(self, idx: int):
+        cfg = self.config
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        return ray_tpu.remote(SingleAgentEnvRunner).options(
+            resources={"CPU": 1}).remote(
+                cfg.make_env(), cfg.num_envs_per_env_runner,
+                cfg.rollout_fragment_length, self._module_spec,
+                seed=cfg.seed + idx * 1000 + 1, explore=cfg.explore,
+                gamma=cfg.gamma, collect_next_obs=True)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        learner = self.learner_group.local_learner()
+        weights_ref = ray_tpu.put(learner.get_weights())
+
+        samples = self._sample_from_runners(weights_ref)
+        new_steps = sum(s["env_steps"] for s in samples)
+        for s in samples:
+            flat = lambda a: a.reshape((-1,) + a.shape[2:])
+            mask = flat(s["valid"])
+            self.replay.add_batch({
+                "obs": flat(s["obs"])[mask],
+                "actions": flat(s["actions"])[mask],
+                "rewards": flat(s["rewards"])[mask],
+                "next_obs": flat(s["next_obs"])[mask],
+                "dones": flat(s["dones"])[mask],
+            })
+
+        metrics: Dict = {"env_steps_this_iter": new_steps}
+        if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+        # training_intensity = replayed/sampled step ratio (same semantics
+        # as DQN): updates * batch_size ~= new_steps * intensity
+        num_updates = max(1, int(new_steps * cfg.training_intensity /
+                                 max(cfg.train_batch_size, 1)))
+        for _ in range(num_updates):
+            metrics.update(learner.update(
+                self.replay.sample(cfg.train_batch_size)))
+        return metrics

@@ -136,6 +136,15 @@ class LearnerGroup:
     def is_local(self) -> bool:
         return self._local is not None
 
+    def local_learner(self) -> Learner:
+        """The in-process learner (off-policy algos drive it directly for
+        target-net/epsilon state; they require num_learners=0)."""
+        if self._local is None:
+            raise RuntimeError(
+                "this algorithm drives a local learner; configure "
+                "num_learners=0")
+        return self._local
+
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         if self._local is not None:
             return self._local.update(batch)

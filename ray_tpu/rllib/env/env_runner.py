@@ -19,7 +19,7 @@ class SingleAgentEnvRunner:
     def __init__(self, env_creator: Callable, num_envs: int,
                  rollout_fragment_length: int, module_spec,
                  seed: int = 0, explore: bool = True,
-                 gamma: float = 0.99):
+                 gamma: float = 0.99, collect_next_obs: bool = False):
         import gymnasium as gym
         import jax
 
@@ -31,6 +31,8 @@ class SingleAgentEnvRunner:
         self.module = module_spec.build()
         self._rng = jax.random.key(seed)
         self._explore = explore
+        # off-policy algos (DQN/SAC) need (s, a, r, s') tuples
+        self._collect_next_obs = collect_next_obs
 
         self._jit_explore = jax.jit(self.module.explore_action)
         self._jit_forward = jax.jit(self.module.forward)
@@ -61,6 +63,8 @@ class SingleAgentEnvRunner:
         rew_buf = np.empty((self.T, self.num_envs), np.float32)
         done_buf = np.empty((self.T, self.num_envs), np.float32)
         valid_buf = np.empty((self.T, self.num_envs), bool)
+        next_obs_buf = (np.empty_like(obs_buf)
+                        if self._collect_next_obs else None)
 
         for t in range(self.T):
             self._rng, key = jax.random.split(self._rng)
@@ -105,11 +109,13 @@ class SingleAgentEnvRunner:
                 self._ep_len[i] = 0
             self._prev_done = done
             self._obs = obs.astype(np.float32)
+            if next_obs_buf is not None:
+                next_obs_buf[t] = self._obs
 
         last_vf = np.asarray(
             self._jit_forward(weights, self._obs)["vf"], np.float32)
         episodes, self._completed = self._completed, []
-        return {
+        out = {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "vf": vf_buf, "rewards": rew_buf, "dones": done_buf,
             "valid": valid_buf, "last_vf": last_vf,
@@ -117,6 +123,9 @@ class SingleAgentEnvRunner:
             "env_steps": self.T * self.num_envs,
             "sample_time_s": time.perf_counter() - t0,
         }
+        if next_obs_buf is not None:
+            out["next_obs"] = next_obs_buf
+        return out
 
     def stop(self):
         self.env.close()

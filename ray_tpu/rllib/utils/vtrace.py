@@ -1,0 +1,42 @@
+"""V-trace off-policy correction (reference: rllib/algorithms/impala/
+vtrace_torch.py; Espeholt 2018 IMPALA eq. 1).
+
+Pure-JAX via ``lax.scan`` over the time axis — the whole correction stays
+inside the jitted learner loss, no host round trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, dones, bootstrap,
+           gamma: float = 0.99, clip_rho: float = 1.0, clip_c: float = 1.0):
+    """All inputs (T, B); bootstrap (B,). Returns (vs, pg_advantages).
+
+    vs_t = V(x_t) + sum_k gamma^k (prod c) rho_k delta_k  computed as the
+    standard backward recursion: acc_t = delta_t + gamma c_t acc_{t+1}.
+    """
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_rho)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_c)
+    not_done = 1.0 - dones
+
+    next_values = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+    # episode boundaries cut the bootstrap
+    deltas = rho * (rewards + gamma * next_values * not_done - values)
+
+    def backward(acc, inp):
+        delta_t, c_t, nd_t = inp
+        acc = delta_t + gamma * c_t * nd_t * acc
+        return acc, acc
+
+    _, acc_rev = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap),
+        (deltas[::-1], c[::-1], not_done[::-1]))
+    vs_minus_v = acc_rev[::-1]
+    vs = values + vs_minus_v
+
+    next_vs = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * next_vs * not_done - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
