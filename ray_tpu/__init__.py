@@ -232,19 +232,45 @@ def available_resources() -> Dict[str, float]:
     return total
 
 
-def timeline() -> List[Dict]:
-    """Chrome-trace-style task events (reference: python/ray/_private/state.py:924)."""
+def timeline(filename: Optional[str] = None) -> List[Dict]:
+    """Chrome-trace task timeline (reference: python/ray/_private/state.py:924
+    ``ray.timeline`` — load the result into chrome://tracing / Perfetto).
+
+    Emits complete ("X") events spanning PENDING→FINISHED/FAILED per task
+    attempt, plus instant events for states without a closing edge.
+    """
     w = _require_worker()
     w.flush_task_events()
     time.sleep(0.05)
     events = w._acall(w.head.call("ListTaskEvents", {"limit": 100000}))
-    out = []
-    for e in events:
-        out.append({
-            "cat": "task", "name": e.get("name"), "ph": "i",
-            "ts": e.get("time", 0) * 1e6, "pid": e.get("node_id", "")[:8],
-            "args": e,
-        })
+    open_start: Dict[str, Dict] = {}
+    out: List[Dict] = []
+    for e in sorted(events, key=lambda e: e.get("time", 0)):
+        tid = e.get("task_id")
+        state = e.get("state")
+        if state in ("PENDING", "RETRYING"):
+            open_start[tid] = e
+        elif state in ("FINISHED", "FAILED") and tid in open_start:
+            s = open_start.pop(tid)
+            out.append({
+                "cat": "task", "name": e.get("name"), "ph": "X",
+                "ts": s["time"] * 1e6,
+                "dur": max(e["time"] - s["time"], 0) * 1e6,
+                "pid": e.get("node_id", "")[:8], "tid": tid[:8],
+                "args": {"state": state, "task_id": tid},
+            })
+        else:
+            out.append({
+                "cat": "task", "name": e.get("name"), "ph": "i",
+                "ts": e.get("time", 0) * 1e6,
+                "pid": e.get("node_id", "")[:8], "tid": (tid or "")[:8],
+                "args": e,
+            })
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(out, f)
     return out
 
 

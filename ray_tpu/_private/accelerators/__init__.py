@@ -1,10 +1,23 @@
 from ray_tpu._private.accelerators.accelerator import AcceleratorManager
 from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
 from ray_tpu._private.accelerators.nvidia_gpu import NvidiaGPUAcceleratorManager
+from ray_tpu._private.accelerators.other import (
+    AMDGPUAcceleratorManager,
+    HPUAcceleratorManager,
+    IntelGPUAcceleratorManager,
+    NeuronAcceleratorManager,
+    NPUAcceleratorManager,
+)
 
 
 def get_all_accelerator_managers():
-    return {"TPU": TPUAcceleratorManager, "GPU": NvidiaGPUAcceleratorManager}
+    return {
+        "TPU": TPUAcceleratorManager,
+        "GPU": NvidiaGPUAcceleratorManager,
+        "neuron_cores": NeuronAcceleratorManager,
+        "HPU": HPUAcceleratorManager,
+        "NPU": NPUAcceleratorManager,
+    }
 
 
 def get_accelerator_manager(resource_name: str):

@@ -1,0 +1,86 @@
+"""Remaining accelerator families (reference:
+python/ray/_private/accelerators/{amd_gpu,intel_gpu,neuron,hpu,npu}.py) —
+detection + visibility env vars so clusters mixing hardware advertise the
+same custom resources the reference does. None of these devices exist in a
+TPU deployment, so detection returns 0 unless the standard env overrides
+say otherwise; the value is API parity for schedulers and tooling."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+
+
+def _env_count(var: str) -> int:
+    try:
+        return int(os.environ.get(var, "0"))
+    except ValueError:
+        return 0
+
+
+class _SimpleManager(AcceleratorManager):
+    RESOURCE = ""
+    VISIBLE_ENV = ""
+    COUNT_ENV = ""
+
+    @classmethod
+    def get_resource_name(cls) -> str:
+        return cls.RESOURCE
+
+    @classmethod
+    def get_visible_accelerator_ids_env_var(cls) -> str:
+        return cls.VISIBLE_ENV
+
+    @classmethod
+    def get_current_node_num_accelerators(cls) -> int:
+        return _env_count(cls.COUNT_ENV)
+
+    @classmethod
+    def set_visible_accelerator_ids(cls, ids: List[int]) -> None:
+        os.environ[cls.VISIBLE_ENV] = ",".join(str(i) for i in ids)
+
+    @classmethod
+    def get_current_node_additional_resources(cls) -> Dict[str, float]:
+        return {}
+
+
+class AMDGPUAcceleratorManager(_SimpleManager):
+    """reference: accelerators/amd_gpu.py (HIP_VISIBLE_DEVICES)."""
+
+    RESOURCE = "GPU"
+    VISIBLE_ENV = "HIP_VISIBLE_DEVICES"
+    COUNT_ENV = "RAY_TPU_NUM_AMD_GPUS"
+
+
+class IntelGPUAcceleratorManager(_SimpleManager):
+    """reference: accelerators/intel_gpu.py (ONEAPI_DEVICE_SELECTOR)."""
+
+    RESOURCE = "GPU"
+    VISIBLE_ENV = "ONEAPI_DEVICE_SELECTOR"
+    COUNT_ENV = "RAY_TPU_NUM_INTEL_GPUS"
+
+
+class NeuronAcceleratorManager(_SimpleManager):
+    """reference: accelerators/neuron.py (NEURON_RT_VISIBLE_CORES)."""
+
+    RESOURCE = "neuron_cores"
+    VISIBLE_ENV = "NEURON_RT_VISIBLE_CORES"
+    COUNT_ENV = "RAY_TPU_NUM_NEURON_CORES"
+
+
+class HPUAcceleratorManager(_SimpleManager):
+    """reference: accelerators/hpu.py (HABANA_VISIBLE_MODULES)."""
+
+    RESOURCE = "HPU"
+    VISIBLE_ENV = "HABANA_VISIBLE_MODULES"
+    COUNT_ENV = "RAY_TPU_NUM_HPUS"
+
+
+class NPUAcceleratorManager(_SimpleManager):
+    """reference: accelerators/npu.py (ASCEND_RT_VISIBLE_DEVICES)."""
+
+    RESOURCE = "NPU"
+    VISIBLE_ENV = "ASCEND_RT_VISIBLE_DEVICES"
+    COUNT_ENV = "RAY_TPU_NUM_NPUS"

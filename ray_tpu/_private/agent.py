@@ -155,6 +155,16 @@ class NodeAgent:
         loop = asyncio.get_running_loop()
         loop.create_task(self._resource_report_loop())
         loop.create_task(self._worker_reaper_loop())
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            async def publish(channel, message):
+                await self.head.call("Publish",
+                                     {"channel": channel, "message": message})
+
+            monitor = LogMonitor(os.path.join(self.session_dir, "logs"),
+                                 self.node_id, publish)
+            loop.create_task(monitor.run())
         if CONFIG.prestart_workers:
             loop.create_task(self._prestart())
 

@@ -1,0 +1,71 @@
+"""Log monitor (reference: python/ray/_private/log_monitor.py, 588 LoC —
+tails worker log files and publishes lines to drivers via GCS pubsub,
+producing the familiar ``(worker)``-prefixed driver output).
+
+Runs inside each node agent's event loop; tracks per-file offsets and
+publishes only appended content to the ``logs:all`` channel.
+
+Known deviation: lines are not routed per job (the reference filters by the
+publishing worker's job). Workers here are leased across jobs, so in a
+multi-driver session every driver sees every worker's output; disable with
+``RAY_TPU_LOG_TO_DRIVER=0``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import os
+from typing import Callable, Dict
+
+
+class LogMonitor:
+    MAX_LINES_PER_BATCH = 200
+
+    def __init__(self, log_dir: str, node_id: str,
+                 publish: Callable, period_s: float = 0.5):
+        self.log_dir = log_dir
+        self.node_id = node_id
+        self._publish = publish  # async fn(channel, message)
+        self.period_s = period_s
+        self._offsets: Dict[str, int] = {}
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:
+                pass  # missing dirs / rotated files are routine
+            await asyncio.sleep(self.period_s)
+
+    async def poll_once(self) -> None:
+        for path in glob.glob(os.path.join(self.log_dir, "worker-*.out")) + \
+                glob.glob(os.path.join(self.log_dir, "worker-*.err")):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._offsets.get(path, 0)
+            if size <= off:
+                if size < off:
+                    self._offsets[path] = 0  # truncated/rotated
+                continue
+            with open(path, "rb") as f:
+                f.seek(off)
+                data = f.read(1 << 20)
+            # only ship complete lines; partial tail stays for next poll
+            last_nl = data.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[path] = off + last_nl + 1
+            lines = data[:last_nl].decode("utf-8", "replace").splitlines()
+            src = os.path.basename(path).rsplit(".", 1)[0]
+            is_err = path.endswith(".err")
+            keep = [ln for ln in lines if ln.strip()]
+            for i in range(0, len(keep), self.MAX_LINES_PER_BATCH):
+                # one Publish RPC per chunk, not per line
+                await self._publish("logs:all", {
+                    "src": src + (" stderr" if is_err else ""),
+                    "node_id": self.node_id[:8],
+                    "lines": keep[i:i + self.MAX_LINES_PER_BATCH],
+                })

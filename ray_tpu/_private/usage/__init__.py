@@ -1,0 +1,3 @@
+from ray_tpu._private.usage import usage_lib
+
+__all__ = ["usage_lib"]

@@ -1,0 +1,74 @@
+"""Usage stats (reference: python/ray/_private/usage/usage_lib.py — opt-out
+telemetry pings).
+
+This deployment has zero egress, so reports are only ever written to a local
+JSON file under the session dir (same schema position as the reference's
+payload); the collection/enable/disable surface matches so tooling that
+checks ``usage_stats_enabled()`` behaves identically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+_ENV = "RAY_TPU_USAGE_STATS_ENABLED"
+
+
+def usage_stats_enabled() -> bool:
+    """Opt-out semantics (reference: usage_lib enablement precedence)."""
+    return os.environ.get(_ENV, "0") == "1"  # default OFF: zero-egress image
+
+
+def set_usage_stats_enabled_via_env_var(enabled: bool) -> None:
+    os.environ[_ENV] = "1" if enabled else "0"
+
+
+def _collect(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    import platform
+
+    data: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collect_timestamp_ms": int(time.time() * 1000),
+    }
+    try:
+        import jax
+
+        data["jax_version"] = jax.__version__
+        data["num_devices"] = jax.device_count()
+        data["device_kind"] = jax.devices()[0].device_kind
+    except Exception:
+        pass
+    try:
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            data["cluster_resources"] = ray_tpu.cluster_resources()
+            data["num_nodes"] = len(
+                [n for n in ray_tpu.nodes() if n.get("alive")])
+    except Exception:
+        pass
+    if extra:
+        data.update(extra)
+    return data
+
+
+def record_usage(session_dir: Optional[str] = None,
+                 extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Write the usage payload locally; returns the path (or None if
+    disabled)."""
+    if not usage_stats_enabled():
+        return None
+    import ray_tpu
+
+    session_dir = session_dir or getattr(
+        ray_tpu._global_node, "session_dir", None) or "/tmp"
+    path = os.path.join(session_dir, "usage_stats.json")
+    with open(path, "w") as f:
+        json.dump(_collect(extra), f)
+    return path

@@ -345,6 +345,11 @@ class Worker:
                 "RegisterDriver",
                 {"job_id": self.job_id.hex(), "entrypoint": " ".join(os.sys.argv)},
             )
+            if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+                # worker stdout/stderr stream here via the agents' log
+                # monitors (log_monitor.py) -> "(worker-x) line" output
+                await self.head.call("Subscribe",
+                                     {"channels": ["logs:all"]})
         info = await self.agent.call("GetNodeInfo", {})
         self.agent_tcp_addr = {"host": node_ip(), "port": info["tcp_port"]}
         self.ready_event.set()
@@ -480,7 +485,10 @@ class Worker:
                 self._on_actor_event(payload["message"])
             elif channel and channel.startswith("logs:"):
                 msg = payload["message"]
-                print(f"({msg.get('src','worker')}) {msg.get('line','')}")
+                src = msg.get("src", "worker")
+                for line in msg.get("lines") or \
+                        ([msg["line"]] if msg.get("line") else []):
+                    print(f"({src}) {line}")
 
     def _notify_owner_async(self, owner_addr: Dict, method: str, payload: Dict):
         if not owner_addr or not self.loop or not self.connected:

@@ -1,0 +1,7 @@
+"""Experimental utilities (reference: python/ray/experimental/ —
+internal_kv :121, tqdm_ray, channel)."""
+
+from ray_tpu.experimental import internal_kv
+from ray_tpu.experimental.channel import Channel
+
+__all__ = ["internal_kv", "Channel"]

@@ -1,0 +1,65 @@
+"""Cluster-wide KV (reference: python/ray/experimental/internal_kv.py —
+the GCS KV the dashboard/serve/autoscaler share)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+def _kv_initialized() -> bool:
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    return w is not None and w.connected
+
+
+def _ns(namespace: Optional[bytes]) -> str:
+    ns = namespace or b"default"
+    return ns.decode() if isinstance(ns, bytes) else ns
+
+
+def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True,
+                     namespace: Optional[bytes] = None) -> bool:
+    w = _worker()
+    return w._acall(w.head.call("KvPut", {
+        "ns": _ns(namespace), "key": key, "value": value,
+        "overwrite": overwrite}))
+
+
+def _internal_kv_get(key: bytes,
+                     namespace: Optional[bytes] = None) -> Optional[bytes]:
+    w = _worker()
+    out = w._acall(w.head.call("KvGet", {
+        "ns": _ns(namespace), "key": key}))
+    return bytes(out) if out is not None else None
+
+
+def _internal_kv_del(key: bytes,
+                     namespace: Optional[bytes] = None) -> int:
+    w = _worker()
+    return w._acall(w.head.call("KvDel", {
+        "ns": _ns(namespace), "key": key}))
+
+
+def _internal_kv_exists(key: bytes,
+                        namespace: Optional[bytes] = None) -> bool:
+    w = _worker()
+    return w._acall(w.head.call("KvExists", {
+        "ns": _ns(namespace), "key": key}))
+
+
+def _internal_kv_list(prefix: bytes,
+                      namespace: Optional[bytes] = None) -> List[bytes]:
+    w = _worker()
+    keys = w._acall(w.head.call("KvKeys", {
+        "ns": _ns(namespace), "prefix": prefix}))
+    return [bytes(k) for k in keys]

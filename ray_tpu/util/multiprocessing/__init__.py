@@ -1,0 +1,7 @@
+"""``multiprocessing.Pool`` shim over cluster tasks (reference:
+python/ray/util/multiprocessing/pool.py — Pool on actors so existing
+Pool-based code scales past one machine unchanged)."""
+
+from ray_tpu.util.multiprocessing.pool import Pool
+
+__all__ = ["Pool"]

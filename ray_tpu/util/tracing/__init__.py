@@ -1,0 +1,7 @@
+from ray_tpu.util.tracing.tracing_helper import (
+    get_tracer,
+    span,
+    trace_enabled,
+)
+
+__all__ = ["get_tracer", "span", "trace_enabled"]

@@ -25,7 +25,7 @@ def store(tmp_path):
 
 
 def _oid():
-    return os.urandom(16)
+    return os.urandom(20)
 
 
 class TestLifecycle:
@@ -228,3 +228,20 @@ print("E2E_OK")
                              capture_output=True, text=True, timeout=300,
                              env=env)
         assert "E2E_OK" in res.stdout, res.stdout + res.stderr
+
+
+def test_ids_differing_only_in_last_4_bytes_do_not_collide(tmp_path):
+    """ObjectIDs are task_id(16B) + return index(4B); puts from one worker
+    share their first 16 bytes — the store must key on all 20."""
+    s = NativeStore(str(tmp_path / "segment"), capacity=1 << 20, create=True)
+    base = os.urandom(16)
+    ids = [base + i.to_bytes(4, "little") for i in range(4)]
+    for i, oid in enumerate(ids):
+        v = s.create(oid, 4)
+        assert v is not None, f"create {i} collided"
+        v[:4] = bytes([i]) * 4
+        s.seal(oid)
+    for i, oid in enumerate(ids):
+        r = s.get(oid)
+        assert bytes(r[:4]) == bytes([i]) * 4
+        s.release(oid)
