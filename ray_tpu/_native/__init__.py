@@ -208,10 +208,10 @@ class NativeStore:
         }
 
     def lru_candidates(self, max_n: int = 16) -> list:
-        buf = (ctypes.c_ubyte * (16 * max_n))()
+        buf = (ctypes.c_ubyte * (20 * max_n))()
         n = self._lib.tpu_store_lru_candidates(self._h, buf, max_n)
         raw = bytes(buf)
-        return [raw[i * 16:(i + 1) * 16] for i in range(n)]
+        return [raw[i * 20:(i + 1) * 20] for i in range(n)]
 
     def close(self) -> None:
         if self._h:

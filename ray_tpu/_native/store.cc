@@ -33,7 +33,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x7261795f74707531ULL;  // "ray_tpu1"
-constexpr uint32_t kIdSize = 16;                    // ObjectID width (ids.py)
+constexpr uint32_t kIdSize = 20;                    // ObjectID width (ids.py: task id 16B + return index 4B)
 constexpr uint64_t kAlign = 64;                     // cache-line alignment
 
 // ---------------------------------------------------------------- layout
@@ -96,7 +96,7 @@ struct Store {
 inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
 
 inline uint64_t id_hash(const uint8_t* id) {
-  // FNV-1a over the 16 id bytes.
+  // FNV-1a over the id bytes.
   uint64_t h = 1469598103934665603ULL;
   for (uint32_t i = 0; i < kIdSize; i++) {
     h ^= id[i];
