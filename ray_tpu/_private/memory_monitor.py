@@ -1,0 +1,122 @@
+"""Node memory monitor + OOM worker-killing policy (reference:
+``src/ray/common/memory_monitor.h:52`` MemoryMonitor and
+``src/ray/raylet/worker_killing_policy.h:34`` — group-by-owner and
+retriable-FIFO victim selection).
+
+Runs in the node agent's event loop: when host memory crosses the usage
+threshold, pick a leased worker to kill — preferring (1) retriable tasks,
+(2) the owner with the most running tasks (group-by-owner: keeps at least
+one task per owner making progress), (3) youngest lease first (FIFO by
+lease age protects long-running work). The killed task fails with an
+``OutOfMemoryError`` the owner can retry.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class MemoryMonitor:
+    def __init__(self, usage_threshold: float = 0.95,
+                 min_memory_free_bytes: Optional[int] = None):
+        self.usage_threshold = usage_threshold
+        self.min_memory_free_bytes = min_memory_free_bytes
+
+    def get_memory_usage(self) -> Tuple[int, int]:
+        """(used, total) bytes; cgroup-aware when limits apply."""
+        import psutil
+
+        vm = psutil.virtual_memory()
+        used, total = vm.total - vm.available, vm.total
+        try:  # container limit, if tighter (reference reads cgroup files)
+            with open("/sys/fs/cgroup/memory.max") as f:
+                raw = f.read().strip()
+            if raw != "max":
+                limit = int(raw)
+                if limit < total:
+                    with open("/sys/fs/cgroup/memory.current") as f:
+                        used = int(f.read().strip())
+                    total = limit
+        except OSError:
+            pass
+        return used, total
+
+    def is_pressure(self) -> bool:
+        used, total = self.get_memory_usage()
+        if self.min_memory_free_bytes is not None:
+            return total - used < self.min_memory_free_bytes
+        return used / max(total, 1) > self.usage_threshold
+
+
+def pick_oom_victim(leases: List[Dict]) -> Optional[Dict]:
+    """Choose which leased worker to kill under memory pressure.
+
+    ``leases``: [{"lease": id, "retriable": bool, "owner": str,
+                  "start": monotonic, ...}]
+    Policy (reference: worker_killing_policy_group_by_owner.h +
+    ...retriable_fifo.h): retriable before non-retriable; within a class,
+    the owner with the most running tasks loses its YOUNGEST task, so every
+    owner keeps its oldest task running.
+    """
+    if not leases:
+        return None
+    by_owner: Dict[str, int] = {}
+    for entry in leases:
+        by_owner[entry.get("owner") or ""] = \
+            by_owner.get(entry.get("owner") or "", 0) + 1
+
+    def sort_key(entry):
+        return (
+            0 if entry.get("retriable", True) else 1,
+            -by_owner[entry.get("owner") or ""],
+            -entry.get("start", 0.0),  # youngest first
+        )
+
+    return sorted(leases, key=sort_key)[0]
+
+
+class OomKiller:
+    """Periodic pressure check + kill loop hosted by the node agent."""
+
+    def __init__(self, monitor: MemoryMonitor,
+                 list_leases: Callable[[], List[Dict]],
+                 kill: Callable[[Dict], None],
+                 check_period_s: float = 1.0,
+                 cooldown_s: float = 5.0):
+        self.monitor = monitor
+        self._list_leases = list_leases
+        self._kill = kill
+        self.check_period_s = check_period_s
+        self.cooldown_s = cooldown_s
+        self._last_kill = 0.0
+        self.num_kills = 0
+
+    async def run(self) -> None:
+        import asyncio
+        import logging
+
+        warned = False
+        while True:
+            await asyncio.sleep(self.check_period_s)
+            try:
+                self.step()
+            except Exception as e:
+                if not warned:  # once: a broken monitor must not be silent
+                    logging.getLogger("ray_tpu").error(
+                        "memory monitor failing (%s); OOM protection is "
+                        "NOT active on this node", e)
+                    warned = True
+
+    def step(self) -> bool:
+        if time.monotonic() - self._last_kill < self.cooldown_s:
+            return False
+        if not self.monitor.is_pressure():
+            return False
+        victim = pick_oom_victim(self._list_leases())
+        if victim is None:
+            return False
+        self._kill(victim)
+        self._last_kill = time.monotonic()
+        self.num_kills += 1
+        return True
