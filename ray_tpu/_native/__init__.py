@@ -26,7 +26,7 @@ _lib = None
 _lib_failed = False
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["store.cc"]
+_SOURCES = ["store.cc", "sched.cc"]
 
 
 def _cache_dir() -> str:
@@ -111,6 +111,19 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
         lib.tpu_store_lru_candidates.restype = ctypes.c_int
         lib.tpu_store_lru_candidates.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int]
+        dbl = ctypes.POINTER(ctypes.c_double)
+        i32 = ctypes.POINTER(ctypes.c_int)
+        lib.tpu_sched_best_node.restype = ctypes.c_int
+        lib.tpu_sched_best_node.argtypes = [
+            dbl, dbl, ctypes.c_int, ctypes.c_int, dbl, ctypes.c_double]
+        lib.tpu_sched_first_feasible.restype = ctypes.c_int
+        lib.tpu_sched_first_feasible.argtypes = [
+            dbl, ctypes.c_int, ctypes.c_int, dbl]
+        lib.tpu_sched_bin_pack.restype = ctypes.c_int
+        lib.tpu_sched_bin_pack.argtypes = [
+            dbl, ctypes.c_int, dbl, ctypes.c_int, dbl, ctypes.c_int,
+            i32, i32, ctypes.c_int, i32,
+            ctypes.POINTER(ctypes.c_uint8)]
         _lib = lib
         return _lib
 
@@ -219,3 +232,102 @@ class NativeStore:
             # into the segment are dead. The segment file itself persists.
             self._lib.tpu_store_detach(self._h)
             self._h = None
+
+
+class NativeScheduler:
+    """Dense-vector scheduling kernel over an interned resource-name space
+    (sched.cc — the cluster_resource_data / hybrid policy / bin-packing
+    analogs). Callers intern resource names to column indices once and ship
+    flat float64 matrices."""
+
+    def __init__(self):
+        lib = get_native_lib()
+        if lib is None:
+            raise RuntimeError("native scheduler library unavailable")
+        self._lib = lib
+        self._names: dict = {}
+
+    def intern(self, name: str) -> int:
+        if name not in self._names:
+            self._names[name] = len(self._names)
+        return self._names[name]
+
+    @property
+    def n_res(self) -> int:
+        return len(self._names)
+
+    def to_vec(self, resources: dict, n_res: Optional[int] = None):
+        import numpy as np
+
+        for name in resources:
+            self.intern(name)
+        vec = np.zeros(n_res or self.n_res, np.float64)
+        for name, qty in resources.items():
+            idx = self._names[name]
+            if idx < len(vec):
+                vec[idx] = float(qty)
+        return vec
+
+    def best_node(self, avail_rows, total_rows, request,
+                  spread_threshold: float = 0.8) -> int:
+        """avail/total: list of resource dicts; returns node index or -1."""
+        import numpy as np
+
+        for d in (*avail_rows, *total_rows, request):
+            for name in d:
+                self.intern(name)
+        n = self.n_res
+        avail = np.ascontiguousarray(
+            [self.to_vec(d, n) for d in avail_rows], np.float64)
+        total = np.ascontiguousarray(
+            [self.to_vec(d, n) for d in total_rows], np.float64)
+        req = self.to_vec(request, n)
+        dblp = ctypes.POINTER(ctypes.c_double)
+        return self._lib.tpu_sched_best_node(
+            avail.ctypes.data_as(dblp), total.ctypes.data_as(dblp),
+            len(avail_rows), n, req.ctypes.data_as(dblp),
+            ctypes.c_double(spread_threshold))
+
+    def bin_pack(self, demands, pools, node_types, max_workers: int,
+                 total_workers: int, existing_counts: dict) -> dict:
+        """Autoscaler packing (mirrors resource_demand_scheduler semantics).
+
+        demands/pools: lists of resource dicts; node_types:
+        {name: {"resources": dict, "max_workers": int}}. Returns
+        {type: count} to launch.
+        """
+        import numpy as np
+
+        type_names = list(node_types)
+        for d in (*demands, *pools,
+                  *(node_types[t].get("resources", {}) for t in type_names)):
+            for name in d:
+                self.intern(name)
+        n = self.n_res
+        if not demands:
+            return {}
+        dm = np.ascontiguousarray(
+            [self.to_vec(d, n) for d in demands], np.float64)
+        pl = (np.ascontiguousarray([self.to_vec(p, n) for p in pools],
+                                   np.float64)
+              if pools else np.zeros((0, n), np.float64))
+        caps = np.ascontiguousarray(
+            [self.to_vec(node_types[t].get("resources", {}), n)
+             for t in type_names], np.float64)
+        max_new = np.ascontiguousarray(
+            [max(0, node_types[t].get("max_workers", max_workers)
+                 - existing_counts.get(t, 0)) for t in type_names],
+            np.int32)
+        budget = np.array([max(0, max_workers - total_workers)], np.int32)
+        out_launch = np.zeros(len(type_names), np.int32)
+        unfulfilled = np.zeros(len(demands), np.uint8)
+        dblp = ctypes.POINTER(ctypes.c_double)
+        i32p = ctypes.POINTER(ctypes.c_int)
+        self._lib.tpu_sched_bin_pack(
+            dm.ctypes.data_as(dblp), len(demands),
+            pl.ctypes.data_as(dblp), len(pools),
+            caps.ctypes.data_as(dblp), len(type_names),
+            max_new.ctypes.data_as(i32p), budget.ctypes.data_as(i32p), n,
+            out_launch.ctypes.data_as(i32p),
+            unfulfilled.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        return {t: int(c) for t, c in zip(type_names, out_launch) if c > 0}

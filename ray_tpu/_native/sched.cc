@@ -1,0 +1,158 @@
+// Scheduling kernel (C++ native).
+//
+// Behavioral parity with the reference's scheduling hot path
+// (reference: src/ray/common/scheduling/cluster_resource_data.h NodeResources
+// + src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50 and the
+// autoscaler's bin-packing resource_demand_scheduler.py): fixed-point
+// resource vectors, best-node selection with the hybrid utilization score,
+// and first-fit-decreasing packing of pending demands onto node types.
+//
+// Resources are dense double vectors over an interned name space the Python
+// side maintains (scheduling_ids.h analog); one call scores the whole
+// cluster without Python-loop overhead, which is what the GCS actor
+// scheduler and the autoscaler grind on at scale.
+//
+// C ABI consumed via ctypes (ray_tpu/_native/__init__.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline bool fits(const double* avail, const double* req, int n_res) {
+  for (int r = 0; r < n_res; r++) {
+    if (req[r] > 0 && avail[r] + 1e-9 < req[r]) return false;
+  }
+  return true;
+}
+
+inline bool feasible(const double* total, const double* req, int n_res) {
+  return fits(total, req, n_res);
+}
+
+// Hybrid score (reference: hybrid_scheduling_policy.h design notes lines
+// 29-49): prefer nodes under the spread threshold by lowest utilization;
+// above it, prefer lowest utilization anyway but after every under-threshold
+// node (top-k behavior collapses to best-node here).
+inline double utilization(const double* avail, const double* total,
+                          int n_res) {
+  double worst = 0.0;
+  for (int r = 0; r < n_res; r++) {
+    if (total[r] > 0) {
+      double u = 1.0 - avail[r] / total[r];
+      if (u > worst) worst = u;
+    }
+  }
+  return worst;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pick the best node for `req`.
+// avail/total: row-major [n_nodes][n_res]. Returns node index or -1.
+int tpu_sched_best_node(const double* avail, const double* total,
+                        int n_nodes, int n_res, const double* req,
+                        double spread_threshold) {
+  int best = -1;
+  double best_score = 1e18;
+  for (int i = 0; i < n_nodes; i++) {
+    const double* a = avail + (size_t)i * n_res;
+    const double* t = total + (size_t)i * n_res;
+    if (!feasible(t, req, n_res) || !fits(a, req, n_res)) continue;
+    double u = utilization(a, t, n_res);
+    // under-threshold nodes sort before over-threshold ones
+    double score = (u < spread_threshold ? 0.0 : 1e9) + u;
+    if (score < best_score) {
+      best_score = score;
+      best = i;
+    }
+  }
+  return best;
+}
+
+// Feasibility-only variant (ignores current availability) for autoscaler
+// "could this node type ever host it" checks. Returns first feasible type
+// index or -1.
+int tpu_sched_first_feasible(const double* totals, int n_types, int n_res,
+                             const double* req) {
+  for (int i = 0; i < n_types; i++) {
+    if (feasible(totals + (size_t)i * n_res, req, n_res)) return i;
+  }
+  return -1;
+}
+
+// First-fit-decreasing bin-packing of demands onto existing pools plus new
+// nodes of given types (the autoscaler core, resource_demand_scheduler.py).
+//
+//  demands:        [n_demands][n_res], pre-sorted by the caller (largest
+//                  first for FFD; order is respected as given)
+//  pools:          [n_pools][n_res] — existing nodes' availability;
+//                  MUTATED in place as demands land on them
+//  type_caps:      [n_types][n_res] — full capacity per launchable type
+//  type_max_new:   [n_types] — per-type launch headroom (already accounts
+//                  for existing counts); MUTATED as launches are decided
+//  budget:         max total new nodes; MUTATED
+//  out_launch:     [n_types] — launch counts per type (+=)
+//  out_unfulfilled:[n_demands] — 1 where a demand could not be placed
+//
+// New nodes' remaining capacity participates in packing for later demands.
+// Returns number of new nodes launched.
+int tpu_sched_bin_pack(const double* demands, int n_demands,
+                       double* pools, int n_pools,
+                       const double* type_caps, int n_types,
+                       int* type_max_new, int* budget, int n_res,
+                       int* out_launch, uint8_t* out_unfulfilled) {
+  std::vector<std::vector<double>> fresh;  // remaining capacity of launches
+  std::vector<int> fresh_type;
+  int launched = 0;
+  for (int d = 0; d < n_demands; d++) {
+    const double* req = demands + (size_t)d * n_res;
+    out_unfulfilled[d] = 0;
+    // 1) existing pools
+    bool placed = false;
+    for (int p = 0; p < n_pools && !placed; p++) {
+      double* pool = pools + (size_t)p * n_res;
+      if (fits(pool, req, n_res)) {
+        for (int r = 0; r < n_res; r++) pool[r] -= req[r];
+        placed = true;
+      }
+    }
+    // 2) capacity remaining on already-decided launches
+    for (size_t f = 0; f < fresh.size() && !placed; f++) {
+      if (fits(fresh[f].data(), req, n_res)) {
+        for (int r = 0; r < n_res; r++) fresh[f][r] -= req[r];
+        placed = true;
+      }
+    }
+    if (placed) continue;
+    // 3) launch a new node of the first feasible type with headroom
+    int chosen = -1;
+    for (int ty = 0; ty < n_types; ty++) {
+      if (type_max_new[ty] <= 0) continue;
+      if (feasible(type_caps + (size_t)ty * n_res, req, n_res)) {
+        chosen = ty;
+        break;
+      }
+    }
+    if (chosen < 0 || *budget <= 0) {
+      out_unfulfilled[d] = 1;
+      continue;
+    }
+    std::vector<double> cap(type_caps + (size_t)chosen * n_res,
+                            type_caps + (size_t)(chosen + 1) * n_res);
+    for (int r = 0; r < n_res; r++) cap[r] -= req[r];
+    fresh.push_back(std::move(cap));
+    fresh_type.push_back(chosen);
+    out_launch[chosen] += 1;
+    type_max_new[chosen] -= 1;
+    *budget -= 1;
+    launched += 1;
+  }
+  return launched;
+}
+
+}  // extern "C"

@@ -13,6 +13,31 @@ from typing import Dict, List, Tuple
 from ray_tpu._private.resources import ResourceSet
 
 
+def _native_pack(node_types, demands, existing_available, existing_counts,
+                 max_workers, total_workers):
+    """C++ bin-packing fast path (ray_tpu/_native/sched.cc); None when the
+    native kernel is unavailable. The caller pre-sorts demands so both
+    paths place in the same order."""
+    import os
+
+    if os.environ.get("RAY_TPU_NATIVE_SCHED", "1") == "0":
+        return None
+    try:
+        from ray_tpu._native import NativeScheduler
+
+        sched = NativeScheduler()
+    except Exception:
+        return None
+    # everything in fixed-point wire units (demands/pools already are)
+    return sched.bin_pack(
+        list(demands), list(existing_available),
+        {t: {"resources": ResourceSet(
+                 dict(spec.get("resources", {}))).to_wire(),
+             "max_workers": spec.get("max_workers", max_workers)}
+         for t, spec in node_types.items()},
+        max_workers, total_workers, dict(existing_counts))
+
+
 def _fit_on(demand: ResourceSet, pools: List[ResourceSet]) -> bool:
     """Try to place `demand` on one of `pools` (mutating the winner)."""
     for pool in pools:
@@ -37,6 +62,13 @@ def get_nodes_to_launch(
     existing_available: wire-format available pools of alive nodes
     existing_counts: current worker count per type
     """
+    # FFD ordering decided ONCE here so the native kernel and the Python
+    # fallback see identical demand order and make identical decisions
+    demands = sorted(demands, key=lambda w: -sum(w.values()))
+    native = _native_pack(node_types, demands, existing_available,
+                          existing_counts, max_workers, total_workers)
+    if native is not None:
+        return native
     pools = [ResourceSet.from_wire(w) for w in existing_available]
     unfulfilled: List[ResourceSet] = []
     for wire in demands:
@@ -45,10 +77,6 @@ def get_nodes_to_launch(
             unfulfilled.append(demand)
     if not unfulfilled:
         return {}
-
-    # largest demands first so big requests claim fresh nodes before small
-    # ones fragment them
-    unfulfilled.sort(key=lambda r: -sum(r.to_wire().values()))
 
     to_launch: Dict[str, int] = {}
     counts = dict(existing_counts)

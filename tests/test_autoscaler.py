@@ -14,46 +14,55 @@ def _w(d):
     return ResourceSet(d).to_wire()
 
 
+@pytest.fixture(params=["native", "python"])
+def sched_backend(request, monkeypatch):
+    """Both the C++ kernel (sched.cc) and the pure-Python fallback must
+    produce the same packing decisions."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_SCHED",
+                       "1" if request.param == "native" else "0")
+    return request.param
+
+
 class TestBinPacking:
     NODE_TYPES = {
         "cpu4": {"resources": {"CPU": 4}, "max_workers": 10},
         "tpu_slice": {"resources": {"TPU": 4, "CPU": 8}, "max_workers": 4},
     }
 
-    def test_no_demand_no_launch(self):
+    def test_no_demand_no_launch(self, sched_backend):
         assert get_nodes_to_launch(self.NODE_TYPES, [], [], {}, 8, 0) == {}
 
-    def test_demand_fits_existing(self):
+    def test_demand_fits_existing(self, sched_backend):
         out = get_nodes_to_launch(
             self.NODE_TYPES, [_w({"CPU": 2})], [_w({"CPU": 4})], {}, 8, 1)
         assert out == {}
 
-    def test_launch_for_unfulfilled(self):
+    def test_launch_for_unfulfilled(self, sched_backend):
         out = get_nodes_to_launch(
             self.NODE_TYPES, [_w({"CPU": 2})], [], {}, 8, 0)
         assert out == {"cpu4": 1}
 
-    def test_pack_multiple_onto_one_node(self):
+    def test_pack_multiple_onto_one_node(self, sched_backend):
         out = get_nodes_to_launch(
             self.NODE_TYPES, [_w({"CPU": 2})] * 2, [], {}, 8, 0)
         assert out == {"cpu4": 1}
 
-    def test_tpu_demand_picks_tpu_type(self):
+    def test_tpu_demand_picks_tpu_type(self, sched_backend):
         out = get_nodes_to_launch(
             self.NODE_TYPES, [_w({"TPU": 4})], [_w({"CPU": 4})], {}, 8, 1)
         assert out == {"tpu_slice": 1}
 
-    def test_max_workers_cap(self):
+    def test_max_workers_cap(self, sched_backend):
         out = get_nodes_to_launch(
             self.NODE_TYPES, [_w({"CPU": 4})] * 5, [], {}, 2, 0)
         assert sum(out.values()) <= 2
 
-    def test_infeasible_demand_ignored(self):
+    def test_infeasible_demand_ignored(self, sched_backend):
         out = get_nodes_to_launch(
             self.NODE_TYPES, [_w({"GPU": 1})], [], {}, 8, 0)
         assert out == {}
 
-    def test_per_type_max(self):
+    def test_per_type_max(self, sched_backend):
         types = {"cpu4": {"resources": {"CPU": 4}, "max_workers": 1}}
         out = get_nodes_to_launch(
             types, [_w({"CPU": 4})] * 3, [], {}, 8, 0)
@@ -126,3 +135,69 @@ class TestAutoscalingCluster:
         finally:
             ray_tpu.shutdown()
             cluster.shutdown()
+
+
+class TestNativeSchedulerKernel:
+    def test_best_node_prefers_low_utilization(self):
+        pytest.importorskip("ray_tpu._native")
+        from ray_tpu._native import NativeScheduler, get_native_lib
+
+        if get_native_lib() is None:
+            pytest.skip("native toolchain unavailable")
+        s = NativeScheduler()
+        idx = s.best_node(
+            avail_rows=[{"CPU": 1}, {"CPU": 4}],
+            total_rows=[{"CPU": 4}, {"CPU": 4}],
+            request={"CPU": 1})
+        assert idx == 1  # emptier node wins
+        assert s.best_node([{"CPU": 1}], [{"CPU": 1}], {"GPU": 1}) == -1
+
+    def test_fuzz_native_matches_python(self, monkeypatch):
+        """Random demand sets: the C++ kernel and the Python fallback must
+        launch the same node counts."""
+        import random
+
+        from ray_tpu._native import get_native_lib
+
+        if get_native_lib() is None:
+            pytest.skip("native toolchain unavailable")
+        rng = random.Random(7)
+        types = {
+            "small": {"resources": {"CPU": 2}, "max_workers": 5},
+            "big": {"resources": {"CPU": 8, "TPU": 4}, "max_workers": 3},
+        }
+        for trial in range(25):
+            demands = [
+                _w({"CPU": rng.choice([1, 2, 4]),
+                    **({"TPU": rng.choice([1, 2])} if rng.random() < 0.3
+                       else {})})
+                for _ in range(rng.randint(0, 6))
+            ]
+            pools = [_w({"CPU": rng.choice([0, 2, 4])})
+                     for _ in range(rng.randint(0, 2))]
+            args = (types, list(demands), list(pools), {}, 6, 0)
+            monkeypatch.setenv("RAY_TPU_NATIVE_SCHED", "1")
+            native = get_nodes_to_launch(*args)
+            monkeypatch.setenv("RAY_TPU_NATIVE_SCHED", "0")
+            python = get_nodes_to_launch(*args)
+            assert sum(native.values()) == sum(python.values()), \
+                (trial, demands, pools, native, python)
+
+    def test_review_repro_native_python_agree(self, monkeypatch):
+        """Regression: mixed demand sizes + partial pool previously made the
+        two paths disagree ({'small': 2} vs {'big': 1})."""
+        from ray_tpu._native import get_native_lib
+
+        if get_native_lib() is None:
+            pytest.skip("native toolchain unavailable")
+        types = {
+            "small": {"resources": {"CPU": 2}, "max_workers": 5},
+            "big": {"resources": {"CPU": 8, "TPU": 4}, "max_workers": 3},
+        }
+        demands = [_w({"CPU": 2}), _w({"CPU": 2}), _w({"CPU": 4})]
+        pools = [_w({"CPU": 4})]
+        monkeypatch.setenv("RAY_TPU_NATIVE_SCHED", "1")
+        native = get_nodes_to_launch(types, list(demands), list(pools), {}, 6, 0)
+        monkeypatch.setenv("RAY_TPU_NATIVE_SCHED", "0")
+        python = get_nodes_to_launch(types, list(demands), list(pools), {}, 6, 0)
+        assert native == python, (native, python)
