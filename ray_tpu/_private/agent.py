@@ -165,6 +165,34 @@ class NodeAgent:
             monitor = LogMonitor(os.path.join(self.session_dir, "logs"),
                                  self.node_id, publish)
             loop.create_task(monitor.run())
+        if os.environ.get("RAY_TPU_MEMORY_MONITOR", "1") != "0":
+            from ray_tpu._private.memory_monitor import (
+                MemoryMonitor,
+                OomKiller,
+            )
+
+            def list_leases():
+                return [
+                    {"lease": lid, "worker": w,
+                     "retriable": getattr(w, "lease_retriable", True),
+                     "owner": getattr(w, "lease_owner", ""),
+                     "start": getattr(w, "lease_start", 0.0)}
+                    for lid, w in self.leases.items()
+                    if w.alive and not w.is_actor
+                ]
+
+            def kill(victim):
+                w = victim["worker"]
+                try:
+                    w.proc.terminate()  # owner sees the failure and retries
+                except Exception:
+                    pass
+
+            threshold = float(
+                os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.95"))
+            self.oom_killer = OomKiller(
+                MemoryMonitor(usage_threshold=threshold), list_leases, kill)
+            loop.create_task(self.oom_killer.run())
         if CONFIG.prestart_workers:
             loop.create_task(self._prestart())
 
@@ -492,6 +520,9 @@ class NodeAgent:
         lease_id = f"{self.node_id[:8]}-{self._lease_counter}"
         worker.leased_to = lease_id
         worker.assigned_resources = request
+        worker.lease_owner = req["p"].get("owner", "")
+        worker.lease_start = time.monotonic()
+        worker.lease_retriable = bool(req["p"].get("retriable", True))
         self.leases[lease_id] = worker
         worker.meta_pg = list(pg_key) if pg_key else None
         fut: asyncio.Future = req["fut"]

@@ -1,0 +1,120 @@
+"""Core-runtime microbenchmarks (reference: python/ray/_private/ray_perf.py:93
+— the suite behind the release microbenchmark numbers in BASELINE.md:
+single-client sync/async tasks, 1:1 and n:n actor calls, put/get).
+
+Run: ``python -m ray_tpu._private.ray_perf [--filter substr]``
+Prints one line per benchmark: ``name: N ops/s`` plus a JSON summary.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: int = 1,
+           min_time_s: float = 2.0) -> float:
+    """Run fn repeatedly for ~min_time_s; returns ops/s
+    (reference: ray_perf.py timeit)."""
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time_s:
+        fn()
+        count += 1
+    took = time.perf_counter() - start
+    rate = count * multiplier / took
+    print(f"{name}: {rate:.1f} ops/s")
+    return rate
+
+
+def main(filter_substr: str = "") -> Dict[str, float]:
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+
+    results: Dict[str, float] = {}
+
+    def bench(name, fn, multiplier=1):
+        if filter_substr and filter_substr not in name:
+            return
+        results[name] = timeit(name, fn, multiplier)
+
+    # ---------------------------------------------------------------- tasks
+    @ray_tpu.remote
+    def noop():
+        pass
+
+    ray_tpu.get(noop.remote(), timeout=60)  # prime worker pool
+
+    bench("single client tasks sync",
+          lambda: ray_tpu.get(noop.remote()))
+
+    N_ASYNC = 100
+    bench("single client tasks async",
+          lambda: ray_tpu.get([noop.remote() for _ in range(N_ASYNC)]),
+          multiplier=N_ASYNC)
+
+    # ----------------------------------------------------------------- puts
+    bench("single client put small",
+          lambda: ray_tpu.put(b"x" * 100))
+
+    arr = np.zeros((5 << 18,), np.float32)  # 5 MiB
+
+    def put_large():
+        for _ in range(10):
+            ray_tpu.put(arr)
+
+    t0 = time.perf_counter()
+    if not filter_substr or filter_substr in "single client put gigabytes":
+        n = 0
+        while time.perf_counter() - t0 < 2.0:
+            put_large()
+            n += 1
+        gbps = n * 10 * arr.nbytes / (time.perf_counter() - t0) / 1e9
+        print(f"single client put gigabytes: {gbps:.2f} GB/s")
+        results["single client put gigabytes"] = gbps
+
+    ref = ray_tpu.put(arr)
+    bench("single client get large",
+          lambda: ray_tpu.get(ref))
+
+    # ---------------------------------------------------------------- actors
+    @ray_tpu.remote
+    class Actor:
+        def noop(self):
+            pass
+
+    a = Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+    bench("1:1 actor calls sync", lambda: ray_tpu.get(a.noop.remote()))
+    bench("1:1 actor calls async",
+          lambda: ray_tpu.get([a.noop.remote() for _ in range(N_ASYNC)]),
+          multiplier=N_ASYNC)
+
+    actors = [Actor.remote() for _ in range(4)]
+    for act in actors:
+        ray_tpu.get(act.noop.remote(), timeout=60)
+    bench("n:n actor calls async",
+          lambda: ray_tpu.get(
+              [act.noop.remote() for act in actors for _ in range(25)]),
+          multiplier=100)
+    for act in actors + [a]:
+        ray_tpu.kill(act)
+
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--filter", default="")
+    args = parser.parse_args()
+    main(args.filter)

@@ -109,4 +109,7 @@ class TaskSpec:
             self.placement_group_id,
             repr(self.scheduling_strategy),
             runtime_env_key(self.runtime_env),
+            # retriability rides the key so the OOM killing policy can
+            # prefer killing leases whose tasks will be retried
+            self.max_retries > 0,
         )

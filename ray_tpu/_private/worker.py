@@ -250,6 +250,7 @@ class WorkerConn:
         self.client: Optional[AsyncRpcClient] = None
         self.idle_since = 0.0
         self.dead = False
+        self.inflight = 0  # tasks pushed and not yet replied (pipelining)
 
 
 class Worker:
@@ -1219,6 +1220,12 @@ class _LeasePool:
 
     IDLE_TTL = 0.25
     MAX_WORKERS = 256
+    # Depth 1: a task committed to a busy worker cannot be stolen back, so
+    # deeper pipelining would strand a short task behind a long one even
+    # when the cluster could lease a fresh worker. The dispatch-loop
+    # restructure (single idle transition per drain instead of per task)
+    # is what buys the throughput; raise this only with task stealing.
+    PIPELINE_DEPTH = 1
 
     def __init__(self, worker: Worker, key, spec: TaskSpec):
         self.worker = worker
@@ -1232,6 +1239,7 @@ class _LeasePool:
         # agents only hand this lease workers whose applied runtime_env
         # matches (or pristine ones) — see agent._pop_idle_worker
         self.env_key = runtime_env_key(spec.runtime_env)
+        self.retriable = spec.max_retries > 0
         self.pending: deque = deque()
         self.conns: List[WorkerConn] = []
         self.idle: List[WorkerConn] = []
@@ -1242,12 +1250,25 @@ class _LeasePool:
         self._pump()
 
     def _pump(self) -> None:
-        while self.pending and self.idle:
-            conn = self.idle.pop()
-            if conn.dead:
-                continue
-            record = self.pending.popleft()
-            asyncio.get_running_loop().create_task(self._run_task(conn, record))
+        # Pipeline up to PIPELINE_DEPTH tasks per leased worker: the worker
+        # executes one at a time (its task pool is 1 thread, so the resource
+        # grant is respected) while the queued task overlaps RPC transport
+        # with execution (reference: direct task submitter pipelining).
+        if self.pending:
+            ready = sorted(
+                (c for c in self.conns
+                 if not c.dead and c.inflight < self.PIPELINE_DEPTH),
+                key=lambda c: c.inflight)
+            for conn in ready:
+                while self.pending and conn.inflight < self.PIPELINE_DEPTH:
+                    if conn in self.idle:
+                        self.idle.remove(conn)
+                    conn.inflight += 1
+                    record = self.pending.popleft()
+                    asyncio.get_running_loop().create_task(
+                        self._run_task(conn, record))
+                if not self.pending:
+                    break
         want = len(self.pending)
         cap = CONFIG.max_pending_lease_requests_per_scheduling_category
         while (
@@ -1295,6 +1316,7 @@ class _LeasePool:
                 "pg": self.pg,
                 "owner": w.worker_id.hex(),
                 "env_key": self.env_key,
+                "retriable": self.retriable,
             }
             agent_addr = None
             if self.pg:
@@ -1378,13 +1400,17 @@ class _LeasePool:
             self._pump()
 
     def _after_task(self, conn: WorkerConn) -> None:
+        conn.inflight -= 1
         if self.pending:
+            conn.inflight += 1
             record = self.pending.popleft()
             asyncio.get_running_loop().create_task(self._run_task(conn, record))
             return
-        conn.idle_since = time.monotonic()
-        self.idle.append(conn)
-        asyncio.get_running_loop().create_task(self._idle_return_later(conn))
+        if conn.inflight == 0 and conn not in self.idle:
+            conn.idle_since = time.monotonic()
+            self.idle.append(conn)
+            asyncio.get_running_loop().create_task(
+                self._idle_return_later(conn))
 
     async def _idle_return_later(self, conn: WorkerConn) -> None:
         await asyncio.sleep(self.IDLE_TTL)

@@ -144,9 +144,12 @@ class TestObjectStoreLocal:
         view = c.get_view(oid)
         assert bytes(view[:4096]) == data
 
-    def test_eviction_and_spill(self, tmp_path):
+    def test_eviction_and_spill(self, tmp_path, monkeypatch):
         from ray_tpu._private.object_store import StoreDirectory
 
+        # spilling is the tmpfs backend's mechanism; the native arena
+        # evicts internally instead (covered by test_native_store.py)
+        monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
         d = StoreDirectory(str(tmp_path / "store"), capacity=10_000)
         ids = []
         for i in range(5):
