@@ -59,6 +59,7 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16      # activation dtype
     param_dtype: Any = jnp.float32
     remat: bool = True             # checkpoint each layer (HBM↔FLOPs trade)
+    remat_policy: str = "dots"     # dots (save matmuls) | full (recompute all)
     attn_impl: str = "auto"        # auto | flash | reference | ring_seq
 
     @staticmethod
@@ -260,8 +261,16 @@ def llama_forward(
         return y, None
 
     if cfg.remat:
-        scan_fn = jax.checkpoint(
-            scan_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        # "dots": keep matmul outputs, recompute elementwise — near-zero
+        # extra MXU work for most of full remat's memory win. "full":
+        # recompute everything (longest-context fallback).
+        if cfg.remat_policy not in ("dots", "full"):
+            raise ValueError(
+                f"remat_policy {cfg.remat_policy!r}: expected 'dots'|'full'")
+        policy = (jax.checkpoint_policies.nothing_saveable
+                  if cfg.remat_policy == "full"
+                  else jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        scan_fn = jax.checkpoint(scan_fn, policy=policy)
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"].astype(cfg.dtype))

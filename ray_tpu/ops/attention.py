@@ -65,6 +65,7 @@ def attention(
     valid_kv_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """impl: auto (flash on TPU when shapes allow, else reference), flash,
+    blockwise (scan over KV blocks; memory-efficient fwd AND bwd),
     reference. Ring attention is invoked explicitly via ops.ring_attention
     by the seq-parallel layer, not through this dispatcher."""
     if impl == "auto":
@@ -77,9 +78,20 @@ def attention(
     if impl == "flash":
         from ray_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal)
+    if impl == "blockwise":
+        # pure-JAX memory-efficient path (scan over KV blocks); used as the
+        # GQA-backward fallback of the Pallas flash kernel and available
+        # explicitly. Decode-time kwargs are not supported here.
+        if q_offset is not None or valid_kv_len is not None:
+            raise NotImplementedError(
+                "blockwise attention does not support q_offset/"
+                "valid_kv_len; use impl='reference' for cached decode")
+        from ray_tpu.ops.blockwise_attention import blockwise_attention
+        return blockwise_attention(q, k, v, causal=causal)
     if impl != "reference":
         raise ValueError(
-            f"unknown attention impl {impl!r}; expected auto|flash|reference "
+            f"unknown attention impl {impl!r}; expected "
+            "auto|flash|blockwise|reference "
             "(ring attention is the model layer's 'ring_seq' path)")
     return reference_attention(q, k, v, causal=causal, q_offset=q_offset,
                                valid_kv_len=valid_kv_len)

@@ -1,0 +1,104 @@
+"""Blockwise (memory-efficient) attention for training.
+
+Online-softmax over KV blocks via ``lax.scan`` with per-block remat — the
+Rabe-Staats / blockwise-attention formulation (same math the ring-attention
+shards use, ops/ring_attention.py). Neither pass materializes the [S, S]
+score matrix; the block body is rematted so its scores are recomputed in
+the backward.
+
+Memory honesty: the scan CARRY (o_acc/m/l) is still saved per block as a
+vjp residual, so backward residuals are O(S^2 * D / block_k) — a
+block_k/D (~4x at 512/128) reduction over the fp32 score matrix, not the
+full O(S*block) ideal; chunking the query axis too (or a custom vjp) is
+the known upgrade if longer-than-8k single-device sequences ever matter.
+
+Role: the GQA (n_rep > 1) backward fallback for the Pallas flash kernel —
+whose own dq/dkv kernels (ops/pallas/flash_attention.py) are the primary
+training path — and an explicitly selectable ``attn_impl='blockwise'``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_step(q, k_blk, v_blk, carry, q_pos0, k_pos0, scale, causal,
+                block_k):
+    """Online-softmax update for one KV block.
+
+    q [B,S,H,D]; k_blk/v_blk [B,Bk,H,D] (kv heads pre-repeated);
+    carry = (o_acc fp32 [B,S,H,D], m [B,H,S], l [B,H,S]).
+    """
+    o_acc, m, l = carry
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_pos0 + jnp.arange(q.shape[1])
+        k_pos = k_pos0 + jnp.arange(block_k)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (m_new == -inf)
+    safe = m_new > _NEG_INF / 2
+    p = jnp.exp(s - jnp.where(safe, m_new, 0.0)[..., None])
+    p = jnp.where(mask[None, None] if causal else True, p, 0.0)
+    correction = jnp.where(safe, jnp.exp(m - m_new), 0.0)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    o_blk = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    o_new = o_acc * jnp.transpose(correction, (0, 2, 1))[..., None] \
+        + o_blk.astype(jnp.float32)
+    return o_new, m_new, l_new
+
+
+def blockwise_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, causal: bool = True, block_k: int = 512,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,KVH,D] -> [B,S,H,D]; O(S*block_k) memory."""
+    from ray_tpu.ops.attention import _repeat_kv
+
+    B, S, H, D = q.shape
+    n_rep = H // k.shape[2]
+    if n_rep > 1:
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+    scale = scale if scale is not None else D ** -0.5
+    block_k = min(block_k, S)
+    if S % block_k:
+        block_k = next(b for b in (256, 128, 64, 32, 16, 8, 4, 2, 1)
+                       if S % b == 0)
+    n_blocks = S // block_k
+
+    kb = k.reshape(B, n_blocks, block_k, H, D)
+    vb = v.reshape(B, n_blocks, block_k, H, D)
+
+    body = functools.partial(_block_step, scale=scale, causal=causal,
+                             block_k=block_k)
+    # remat the block body: backward recomputes scores per block instead of
+    # saving [S, block_k] residuals for every block (=> S^2 again)
+    body = jax.checkpoint(
+        body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_fn(carry, inp):
+        j, k_blk, v_blk = inp
+        carry = body(q, k_blk, v_blk, carry, 0, j * block_k)
+        return carry, None
+
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    m0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    (o, m, l), _ = lax.scan(
+        scan_fn, (o0, m0, l0),
+        (jnp.arange(n_blocks), jnp.moveaxis(kb, 1, 0),
+         jnp.moveaxis(vb, 1, 0)))
+    l = jnp.maximum(l, 1e-20)
+    out = o / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)

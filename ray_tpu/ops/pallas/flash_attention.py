@@ -1,4 +1,4 @@
-"""Flash attention (forward) as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
 Online-softmax blocked attention: the kv axis is the innermost grid dim, and
 running (max, sum, acc) state lives in VMEM scratch that persists across the
@@ -7,10 +7,15 @@ Pallas. Causal blocks above the diagonal are skipped with ``pl.when`` (zero
 MXU work, the DMA still runs; a fused skip via index_map is a later
 optimization).
 
-GQA is handled in the index maps (kv head = q head // n_rep) — no kv
-materialization. Backward currently recomputes through the XLA reference path
-under ``jax.custom_vjp`` (correct; Pallas dq/dkv kernels are the planned
-upgrade).
+The forward also emits the per-row logsumexp; the backward recomputes block
+scores against it in two kernels (dq with kv innermost; dk/dv with q
+innermost), so neither pass materializes [S, S] in HBM — this is what makes
+flash usable for TRAINING, where the naive vjp through reference attention
+would dominate the step at seq >= 2k.
+
+GQA is handled in the index maps (kv head = q head // n_rep) for the
+forward; the backward requires n_rep == 1 (callers fall back to blockwise
+attention otherwise — ops/attention.py).
 """
 
 from __future__ import annotations
@@ -26,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, block_q: int, block_k: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
@@ -73,17 +78,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     def _finalize():
         l = l_scr[:, :1]
         o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # logsumexp row stats for the backward (lse layout [bq, 128]: the
+        # row value broadcast across lanes — keeps stores 2D/tiled)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30)),
+            lse_ref[0, 0].shape)
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
-               causal: bool, block_q: int, block_k: int) -> jax.Array:
-    """q [B,H,S,D], k/v [B,KVH,S,D] → o [B,H,S,D]."""
+               causal: bool, block_q: int, block_k: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """q [B,H,S,D], k/v [B,KVH,S,D] → (o [B,H,S,D], lse [B,H,S,128])."""
     B, H, Sq, D = q.shape
     KVH, Skv = k.shape[1], k.shape[2]
     n_rep = H // KVH
     scale = D ** -0.5
-    block_q = next(b for b in (block_q, 256, 128) if Sq % b == 0 or b == 128)
-    block_k = next(b for b in (block_k, 256, 128) if Skv % b == 0 or b == 128)
+    block_q = next(b for b in (block_q, 512, 256, 128)
+                   if Sq % b == 0 or b == 128)
+    block_k = next(b for b in (block_k, 512, 256, 128)
+                   if Skv % b == 0 or b == 128)
     if Sq % block_q or Skv % block_k:
         raise ValueError(f"seq lens ({Sq},{Skv}) must divide by 128")
     grid = (B, H, Sq // block_q, Skv // block_k)
@@ -103,9 +116,16 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, iq, ik: (b, h // n_rep, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, iq, ik: (b, h, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
@@ -118,6 +138,180 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
     )(q, k, v)
 
 
+# ----------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale: float, causal: bool, block_q: int,
+               block_k: int):
+    """Grid (B, H, iq, ik): kv innermost, accumulate dq for one q block."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                 # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                 # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)                 # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)               # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                          # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                      # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [bq, bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, bk]
+        ds = p * (dp - delta) * scale                       # [bq, bk]
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, d]
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, block_q: int, block_k: int):
+    """Grid (B, H, ik, iq): q innermost, accumulate dk/dv per kv block."""
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:  # block needed iff some q row >= first k row
+        run = iq * block_q + block_q - 1 >= ik * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                 # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                 # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)                 # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)               # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                          # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                      # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [bq, bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                # [bq, bk]
+        # dv += p^T do
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, bk]
+        ds = p * (dp - delta) * scale                       # [bq, bk]
+        # dk += ds^T q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bk, d]
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, block_q: int,
+               block_k: int):
+    """All tensors [B,H,S,D] (lse [B,H,S,128]); returns (dq, dk, dv)."""
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    scale = D ** -0.5
+    block_q = next(b for b in (block_q, 512, 256, 128)
+                   if Sq % b == 0 or b == 128)
+    block_k = next(b for b in (block_k, 512, 256, 128)
+                   if Skv % b == 0 or b == 128)
+
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, stays in XLA;
+    # broadcast across 128 lanes to match the lse layout
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 128),
+                            lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(B, H, Sq // block_q, Skv // block_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            q_spec, row_spec, row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=jax.devices()[0].platform != "tpu",
+    )(q, k, v, do, lse, delta)
+
+    kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(B, H, Skv // block_k, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=jax.devices()[0].platform != "tpu",
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 # Kernel takes [B,H,S,D]; public API is [B,S,H,D] to match ops.attention.
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -126,21 +320,39 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash_fwd(qt, kt, vt, causal=causal, block_q=256, block_k=256)
+    o, _ = _flash_fwd(qt, kt, vt, causal=causal, block_q=512, block_k=512)
     return jnp.swapaxes(o, 1, 2)
 
 
 def _fa_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o, lse = _flash_fwd(qt, kt, vt, causal=causal, block_q=512, block_k=512)
+    return jnp.swapaxes(o, 1, 2), (qt, kt, vt, o, lse)
 
 
 def _fa_bwd(causal, res, g):
-    from ray_tpu.ops.attention import reference_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
-        q, k, v)
-    return vjp(g)
+    qt, kt, vt, o, lse = res
+    n_rep = qt.shape[1] // kt.shape[1]
+    if n_rep != 1:
+        # GQA backward not implemented in Pallas: recompute via the
+        # memory-efficient blockwise path instead of reference (no S^2)
+        from ray_tpu.ops.blockwise_attention import blockwise_attention
+
+        q = jnp.swapaxes(qt, 1, 2)
+        k = jnp.swapaxes(kt, 1, 2)
+        v = jnp.swapaxes(vt, 1, 2)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(q_, k_, v_,
+                                                   causal=causal),
+            q, k, v)
+        return vjp(g)
+    do = jnp.swapaxes(g, 1, 2)
+    dq, dk, dv = _flash_bwd(qt, kt, vt, o, lse, do, causal=causal,
+                            block_q=512, block_k=512)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
