@@ -10,6 +10,7 @@ CPU actors.
 """
 
 from ray_tpu.rllib.algorithms import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -21,6 +22,7 @@ from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "BC", "BCConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "Learner",
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
     "SingleAgentEnvRunner",

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig
+
+__all__ = ["BC", "BCConfig"]

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.offline.json_io import JsonReader, JsonWriter
+
+__all__ = ["JsonReader", "JsonWriter"]

@@ -1,0 +1,12 @@
+"""Shared helpers for train backends."""
+
+from __future__ import annotations
+
+
+def find_free_port() -> int:
+    """A free TCP port on this host, for backend rendezvous addresses."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -36,14 +36,6 @@ class JaxConfig:
         return JaxBackend
 
 
-def _find_free_port() -> int:
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
-
-
 def _setup_worker(rank: int, world_size: int, coordinator: str,
                   cfg_wire: dict) -> None:
     import os
@@ -74,7 +66,9 @@ class JaxBackend(Backend):
 
     def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
         metas = worker_group.node_metas()
-        port = worker_group.execute_single(0, _find_free_port)
+        from ray_tpu.train._internal.util import find_free_port
+
+        port = worker_group.execute_single(0, find_free_port)
         coordinator = f"{metas[0]['hostname']}:{port}"
         import uuid
 

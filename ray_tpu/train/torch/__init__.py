@@ -1,0 +1,9 @@
+from ray_tpu.train.torch.config import TorchConfig
+from ray_tpu.train.torch.torch_trainer import TorchTrainer
+from ray_tpu.train.torch.train_loop_utils import (
+    prepare_data_loader,
+    prepare_model,
+)
+
+__all__ = ["TorchConfig", "TorchTrainer", "prepare_model",
+           "prepare_data_loader"]

@@ -1,0 +1,79 @@
+"""Torch backend: rendezvous + process group init (reference:
+python/ray/train/torch/config.py:129 _TorchBackend — rank-0 address
+broadcast then ``dist.init_process_group`` :91).
+
+This image ships CPU torch, so gloo is the default (and only sensible)
+backend; the TPU-native story remains JaxTrainer — TorchTrainer exists so
+torch training code ports over unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.train._internal.backend_executor import Backend
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class TorchConfig:
+    backend: str = "gloo"
+    timeout_s: int = 1800
+
+    @property
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _setup_torch_process_group(rank: int, world_size: int, master_addr: str,
+                               master_port: int, backend: str,
+                               timeout_s: int) -> None:
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    if not dist.is_initialized():
+        dist.init_process_group(
+            backend=backend, rank=rank, world_size=world_size,
+            timeout=datetime.timedelta(seconds=timeout_s))
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: TorchConfig) -> None:
+        import ray_tpu
+
+        metas = worker_group.node_metas()
+        master_addr = metas[0]["hostname"]
+        from ray_tpu.train._internal.util import find_free_port
+
+        master_port = worker_group.execute_single(0, find_free_port)
+        ray_tpu.get([
+            w.execute.remote(_setup_torch_process_group, i,
+                             len(worker_group), master_addr, master_port,
+                             backend_config.backend,
+                             backend_config.timeout_s)
+            for i, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: TorchConfig) -> None:
+        def teardown():
+            try:
+                import torch.distributed as dist
+
+                if dist.is_initialized():
+                    dist.destroy_process_group()
+            except Exception:
+                pass
+
+        try:
+            worker_group.execute(teardown)
+        except Exception:
+            pass

@@ -1,0 +1,40 @@
+"""TorchTrainer (reference: python/ray/train/torch/torch_trainer.py:14 —
+DataParallelTrainer with the torch process-group backend)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.torch.config import TorchConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Distributed torch training over a worker group of actors; gradient
+    traffic flows through torch.distributed (gloo on this image), the
+    control plane through the framework — the reference split."""
+
+    _backend_config_cls = TorchConfig
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict], None],
+        *,
+        train_loop_config: Optional[Dict] = None,
+        torch_config: Optional[TorchConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            datasets=datasets,
+        )

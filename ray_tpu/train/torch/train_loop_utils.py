@@ -1,0 +1,41 @@
+"""Torch train-loop helpers (reference:
+python/ray/train/torch/train_loop_utils.py — ``prepare_model`` wraps in
+DDP, ``prepare_data_loader`` adds a DistributedSampler)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def prepare_model(model: Any, *, wrap_ddp: bool = True) -> Any:
+    """Move to the right device and wrap in DDP when distributed."""
+    import torch
+    import torch.distributed as dist
+
+    device = torch.device("cpu")  # CPU torch image; TPU path is JaxTrainer
+    model = model.to(device)
+    if wrap_ddp and dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        model = DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader: Any, *, add_dist_sampler: bool = True
+                        ) -> Any:
+    """Re-create the DataLoader with a DistributedSampler per worker."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, DistributedSampler
+
+    if not (add_dist_sampler and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )

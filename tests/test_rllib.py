@@ -316,3 +316,58 @@ def test_impala_async_mechanics(ray4):
         assert np.isfinite(r2["entropy"])
     finally:
         algo.stop()
+
+
+# ------------------------------------------------------------- offline / BC
+def test_json_offline_io_roundtrip(tmp_path):
+    from ray_tpu.rllib.offline import JsonReader, JsonWriter
+
+    w = JsonWriter(str(tmp_path))
+    for i in range(3):
+        w.write({"obs": np.random.rand(10, 4).astype(np.float32),
+                 "actions": np.full(10, i, np.int64)})
+    w.close()
+    r = JsonReader(str(tmp_path))
+    full = r.concat_all()
+    assert full["obs"].shape == (30, 4)
+    assert sorted(set(full["actions"])) == [0, 1, 2]
+    sample = r.sample(16)
+    assert sample["obs"].shape == (16, 4)
+
+
+def test_bc_imitates_scripted_policy(ray4, tmp_path):
+    """BC on a dataset from a deterministic scripted policy must reproduce
+    that policy (reference: BC learning tests in rllib/algorithms/bc)."""
+    from ray_tpu.rllib import BCConfig
+    from ray_tpu.rllib.offline import JsonWriter
+
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(2000, 4)).astype(np.float32)
+    actions = (obs[:, 0] + obs[:, 2] > 0).astype(np.int64)  # scripted rule
+    w = JsonWriter(str(tmp_path))
+    for s in range(0, 2000, 500):
+        w.write({"obs": obs[s:s + 500], "actions": actions[s:s + 500]})
+    w.close()
+
+    cfg = (BCConfig()
+           .training(lr=3e-3, train_batch_size=256, num_epochs=2,
+                     obs_dim=4, action_dim=2, discrete=True,
+                     dataset_epochs_per_iter=2)
+           .offline(offline_data=str(tmp_path)))
+    algo = cfg.build()
+    try:
+        for _ in range(8):
+            result = algo.step()
+        assert np.isfinite(result["bc_loss"])
+        # imitation accuracy on held-out states
+        test_obs = rng.normal(size=(500, 4)).astype(np.float32)
+        want = (test_obs[:, 0] + test_obs[:, 2] > 0).astype(np.int64)
+        import jax.numpy as jnp
+
+        module = algo._module_spec.build()
+        out = module.forward(algo.get_weights(), jnp.asarray(test_obs))
+        got = np.asarray(jnp.argmax(out["logits"], axis=-1))
+        acc = (got == want).mean()
+        assert acc > 0.9, f"BC accuracy {acc}"
+    finally:
+        algo.stop()
