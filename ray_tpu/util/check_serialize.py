@@ -1,0 +1,75 @@
+"""Serializability inspection (reference: python/ray/util/check_serialize.py
+``inspect_serializability`` — recursively finds which closure variables or
+attributes make an object unpicklable, instead of a bare pickle error)."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+
+class FailureTuple:
+    """One offending object found while descending."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self) -> str:
+        return f"FailureTuple(obj={self.obj!r}, name={self.name})"
+
+
+def _serializable(obj: Any) -> bool:
+    from ray_tpu._private import serialization as ser
+
+    try:
+        ser.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _descend(obj: Any, name: str, parent: Any, failures: list,
+             seen: Set[int], depth: int) -> None:
+    """Record the deepest reachable causes of unserializability under
+    ``obj`` (which the CALLER has already determined to be unserializable —
+    no re-pickling here). Guarantees at least one FailureTuple per call, so
+    cycles and the depth cutoff can never yield a 'failed with no offending
+    objects' verdict."""
+    if id(obj) in seen or depth > 4:
+        failures.append(FailureTuple(obj, name, parent))
+        return
+    seen.add(id(obj))
+    children: list = []
+    if inspect.isfunction(obj):
+        closure = inspect.getclosurevars(obj)
+        children = [*closure.nonlocals.items(), *closure.globals.items()]
+    elif hasattr(obj, "__dict__") and not inspect.isclass(obj):
+        children = list(vars(obj).items())
+    before = len(failures)
+    for child_name, child in children:
+        if not _serializable(child):
+            _descend(child, f"{name}.{child_name}", obj, failures, seen,
+                     depth + 1)
+    if len(failures) == before:
+        # no child explains it: this object itself is the leaf cause
+        failures.append(FailureTuple(obj, name, parent))
+
+
+def inspect_serializability(
+    obj: Any, name: Optional[str] = None,
+    print_failures: bool = True,
+) -> Tuple[bool, Set[FailureTuple]]:
+    """Returns (is_serializable, failure set); prints a readable trace of
+    the offending closure variables / attributes when it is not."""
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    if _serializable(obj):
+        return True, set()
+    failures: list = []
+    _descend(obj, name, None, failures, set(), 0)
+    if print_failures:
+        print(f"{name!r} is not serializable. Offending objects:")
+        for f in failures:
+            print(f"  - {f.name}: {type(f.obj).__name__} = {f.obj!r:.80}")
+    return False, set(failures)
