@@ -24,6 +24,8 @@ from ray_tpu._private import worker as _worker_mod
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from ray_tpu._private.node import Node
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.streaming import (
+    DynamicObjectRefGenerator, ObjectRefGenerator)
 from ray_tpu.actor import ActorClass, ActorHandle, method, exit_actor
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
@@ -35,6 +37,7 @@ __all__ = [
     "kill", "cancel", "get_actor", "method", "exit_actor", "nodes",
     "cluster_resources", "available_resources", "ObjectRef", "ActorHandle",
     "get_runtime_context", "exceptions", "timeline", "__version__",
+    "ObjectRefGenerator", "DynamicObjectRefGenerator",
 ]
 
 _init_lock = threading.Lock()

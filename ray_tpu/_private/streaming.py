@@ -1,0 +1,83 @@
+"""Streaming generator returns (reference: the core_worker streaming
+generator path — ``num_returns="streaming"`` tasks report each yielded
+value to the owner as it is produced via ``ReportGeneratorItemReturns``;
+``src/ray/core_worker/task_manager.h`` streaming-generator state and
+``python/ray/_raylet.pyx`` ObjectRefGenerator).
+
+Owner side: each reported item becomes an owned ObjectRef pushed into a
+thread-safe queue; the user thread iterates the ``ObjectRefGenerator``,
+blocking until the next item (or task completion) arrives. The executor
+awaits the owner's ack per item, which gives natural backpressure — a slow
+consumer's owner loop throttles the producer's reporting, not memory.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Optional
+
+_END = object()
+
+
+class ObjectRefGenerator:
+    """Iterates ObjectRefs of a streaming task's yields, in yield order
+    (reference: _raylet.pyx ObjectRefGenerator / DynamicObjectRefGenerator).
+    """
+
+    def __init__(self, task_id_hex: str):
+        self._task_id_hex = task_id_hex
+        self._queue: "queue.Queue" = queue.Queue()
+        self._num_yielded = 0
+        self._done = False
+        self._error: Optional[Exception] = None
+
+    # ------------------------------------------------------- owner plumbing
+    def _push(self, ref) -> None:
+        self._queue.put(ref)
+
+    def _finish(self, error: Optional[Exception] = None) -> None:
+        self._error = error
+        self._queue.put(_END)
+
+    # --------------------------------------------------------- user surface
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self):
+        return self._next_internal(timeout=None)
+
+    def _next_internal(self, timeout: Optional[float]):
+        if self._done:
+            raise StopIteration
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"no streaming item within {timeout}s "
+                f"(task {self._task_id_hex})")
+        if item is _END:
+            self._done = True
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        self._num_yielded += 1
+        return item
+
+    def next_with_timeout(self, timeout: float):
+        """Next ref, raising TimeoutError if none arrives in time."""
+        return self._next_internal(timeout=timeout)
+
+    @property
+    def task_id_hex(self) -> str:
+        return self._task_id_hex
+
+    def completed(self) -> bool:
+        return self._done
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(task={self._task_id_hex}, "
+                f"yielded={self._num_yielded}, done={self._done})")
+
+
+# Reference exposes this alias for dynamic generators.
+DynamicObjectRefGenerator = ObjectRefGenerator

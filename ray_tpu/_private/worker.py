@@ -226,7 +226,7 @@ class ReferenceCounter:
 
 class TaskRecord:
     __slots__ = ("spec", "attempts", "return_ids", "future", "cancelled",
-                 "submitted_at", "completed")
+                 "submitted_at", "completed", "streaming_gen")
 
     def __init__(self, spec: TaskSpec, return_ids: List[ObjectID]):
         self.spec = spec
@@ -235,6 +235,8 @@ class TaskRecord:
         self.cancelled = False
         self.completed = False
         self.submitted_at = time.time()
+        # ObjectRefGenerator for num_returns=-1 streaming tasks
+        self.streaming_gen = None
 
 
 class WorkerConn:
@@ -419,7 +421,23 @@ class Worker:
         r("AddBorrow", self._handle_add_borrow)
         r("RemoveBorrow", self._handle_remove_borrow)
         r("ObjectLocationAdded", self._handle_location_added)
+        r("StreamingReturn", self._handle_streaming_return)
         r("Ping", self._handle_ping)
+
+    async def _handle_streaming_return(self, conn, p) -> Dict:
+        """One yielded item of a streaming-generator task (reference:
+        core_worker ReportGeneratorItemReturns). The executor awaits this
+        ack per item — backpressure for free."""
+        task_binary = bytes.fromhex(p["task_id"])
+        record = self._tasks.get(task_binary)
+        if record is None or record.streaming_gen is None:
+            return {"accepted": False}
+        oid = ObjectID.for_task_return(TaskID(task_binary), p["index"])
+        self.reference_counter.register_owned(oid)
+        self._resolve_return(oid, p["ret"])
+        record.return_ids.append(oid)
+        record.streaming_gen._push(ObjectRef(oid, self.direct_addr()))
+        return {"accepted": True}
 
     async def _handle_ping(self, conn, p):
         return {"worker_id": self.worker_id.hex()}
@@ -782,7 +800,12 @@ class Worker:
             if self.connected:
                 self._spawn(free_remote())
         self.reference_counter.drop_owned(binary)
-        self._tasks.pop(ObjectID(binary).task_id().binary(), None)
+        task_binary = ObjectID(binary).task_id().binary()
+        record = self._tasks.get(task_binary)
+        # a live streaming task's record must outlive early freed yields —
+        # it routes the still-arriving StreamingReturn items
+        if record is None or record.streaming_gen is None or record.completed:
+            self._tasks.pop(task_binary, None)
 
     # =================================================================== tasks
     def submit_task(
@@ -835,6 +858,16 @@ class Worker:
             placement_group_bundle_index=(pg[1] if pg else -1),
             runtime_env=runtime_env,
         )
+        if num_returns == -1:  # streaming generator
+            record = TaskRecord(spec, [])
+            from ray_tpu._private.streaming import ObjectRefGenerator
+
+            record.streaming_gen = ObjectRefGenerator(task_id.hex())
+            self._tasks[task_id.binary()] = record
+            self._pin_args(spec)
+            self._record_task_event(spec, "PENDING")
+            self._spawn(self._submit_to_pool(record))
+            return record.streaming_gen
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         refs = []
         for oid in return_ids:
@@ -891,6 +924,8 @@ class Worker:
             and spec.retry_exceptions
             and record.attempts < spec.max_retries
             and not record.cancelled
+            # streaming: consumed yields can't be replayed transparently
+            and record.streaming_gen is None
         ):
             record.attempts += 1
             self._record_task_event(spec, "RETRYING")
@@ -898,19 +933,29 @@ class Worker:
             return
         record.completed = True
         self._unpin_args(spec)
+        if record.streaming_gen is not None:
+            # items already arrived via StreamingReturn; the reply only
+            # closes the stream (a pre-generator error closes it broken)
+            err = None
+            if reply.get("error"):
+                blob = reply.get("error_inline")
+                if blob is not None:
+                    try:
+                        err = self.serialization_context.deserialize(
+                            memoryview(blob))
+                    except Exception:
+                        err = None
+                if err is None:
+                    err = RayTaskError(
+                        spec.function_name,
+                        "streaming task failed before yielding")
+            record.streaming_gen._finish(err)
+            self._record_task_event(
+                spec, "FINISHED" if not reply.get("error") else "FAILED")
+            return
         returns = reply.get("returns", [])
         for oid, ret in zip(record.return_ids, returns):
-            if ret.get("inline") is not None:
-                flags = EXC if ret.get("is_exception") else VAL
-                self.memory_store.put(oid.binary(), ret["inline"], flags)
-                self.reference_counter.set_resolved(
-                    oid.binary(), "error" if flags == EXC else "inline"
-                )
-            else:
-                self.memory_store.put(oid.binary(), b"", IN_PLASMA)
-                self.reference_counter.set_resolved(
-                    oid.binary(), "plasma", [ret.get("node_addr")]
-                )
+            self._resolve_return(oid, ret)
         self._record_task_event(spec, "FINISHED" if not reply.get("error")
                                 else "FAILED")
         if spec.task_type == NORMAL_TASK and not reply.get("error"):
@@ -919,12 +964,35 @@ class Worker:
             if all(r.get("inline") is not None for r in returns):
                 self._tasks.pop(spec.task_id, None)
 
+    def _resolve_return(self, oid: ObjectID, ret: Dict) -> None:
+        if ret.get("inline") is not None:
+            flags = EXC if ret.get("is_exception") else VAL
+            self.memory_store.put(oid.binary(), ret["inline"], flags)
+            self.reference_counter.set_resolved(
+                oid.binary(), "error" if flags == EXC else "inline"
+            )
+        else:
+            self.memory_store.put(oid.binary(), b"", IN_PLASMA)
+            self.reference_counter.set_resolved(
+                oid.binary(), "plasma", [ret.get("node_addr")]
+            )
+
     def _on_task_failure(self, record: TaskRecord, error: Exception,
                          retriable: bool = True) -> None:
         if record.completed:
             return
         spec = record.spec
         record.attempts += 1
+        if record.streaming_gen is not None:
+            # no retries for streaming generators: already-consumed yields
+            # can't be replayed transparently (reference restriction too)
+            record.completed = True
+            self._unpin_args(spec)
+            err = error if isinstance(error, Exception) else RayTaskError(
+                spec.function_name, str(error))
+            record.streaming_gen._finish(err)
+            self._record_task_event(spec, "FAILED")
+            return
         if retriable and record.attempts <= spec.max_retries and not record.cancelled:
             self._record_task_event(spec, "RETRYING")
             self._spawn(self._submit_to_pool(record))
@@ -1112,6 +1180,15 @@ class Worker:
             actor_method=method_name,
             seq=seq,
         )
+        if num_returns == -1:  # streaming actor method
+            record = TaskRecord(spec, [])
+            from ray_tpu._private.streaming import ObjectRefGenerator
+
+            record.streaming_gen = ObjectRefGenerator(task_id.hex())
+            self._tasks[task_id.binary()] = record
+            self._pin_args(spec)
+            self._loop_call(st.enqueue, self, record)
+            return record.streaming_gen
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         refs = []
         for oid in return_ids:

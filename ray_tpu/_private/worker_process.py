@@ -150,6 +150,7 @@ class Executor:
             data = self.worker._serialize_value(err).to_bytes()
             return {
                 "error": True,
+                "error_inline": data,  # streaming tasks have no return slots
                 "returns": [
                     {"inline": data, "is_exception": True}
                     for _ in range(spec.num_returns)
@@ -194,13 +195,34 @@ class Executor:
             data = self.worker._serialize_value(err).to_bytes()
             return {
                 "error": True,
+                "error_inline": data,
                 "returns": [
                     {"inline": data, "is_exception": True}
                     for _ in range(spec.num_returns)
                 ],
             }
 
+    def _package_one(self, spec: TaskSpec, i: int, value: Any,
+                     is_exception: bool = False) -> Dict:
+        sobj = self.worker._serialize_value(value)
+        size = sobj.total_size()
+        if size <= CONFIG.inline_object_max_size_bytes:
+            return {"inline": sobj.to_bytes(), "is_exception": is_exception}
+        oid = ObjectID(spec.task_id + _u32(i))
+        view, handle = self.worker.store.create(oid, size)
+        used = sobj.write_into(view)
+        self.worker.store.seal(oid, handle)
+        self.worker._acall(
+            self.worker.agent.call(
+                "ObjectSealed", {"object_id": oid.hex(), "size": used}
+            )
+        )
+        return {"plasma": True, "size": used,
+                "node_addr": self.worker.agent_tcp_addr}
+
     def _package_returns(self, spec: TaskSpec, result: Any) -> Dict:
+        if spec.num_returns == -1:
+            return self._package_streaming(spec, result)
         if spec.num_returns == 0:
             return {"returns": []}
         if spec.num_returns == 1:
@@ -212,27 +234,36 @@ class Executor:
                     f"task declared num_returns={spec.num_returns} but returned "
                     f"{len(values)} values"
                 )
-        returns = []
-        for i, value in enumerate(values):
-            sobj = self.worker._serialize_value(value)
-            size = sobj.total_size()
-            if size <= CONFIG.inline_object_max_size_bytes:
-                returns.append({"inline": sobj.to_bytes(), "is_exception": False})
-            else:
-                oid = ObjectID(spec.task_id + _u32(i))
-                view, handle = self.worker.store.create(oid, size)
-                used = sobj.write_into(view)
-                self.worker.store.seal(oid, handle)
-                self.worker._acall(
-                    self.worker.agent.call(
-                        "ObjectSealed", {"object_id": oid.hex(), "size": used}
-                    )
-                )
-                returns.append(
-                    {"plasma": True, "size": used,
-                     "node_addr": self.worker.agent_tcp_addr}
-                )
-        return {"returns": returns}
+        return {"returns": [self._package_one(spec, i, v)
+                            for i, v in enumerate(values)]}
+
+    def _package_streaming(self, spec: TaskSpec, result: Any) -> Dict:
+        """Consume a generator, reporting each yield to the owner as it is
+        produced (reference: core_worker streaming generator path,
+        ReportGeneratorItemReturns). The per-item ack round-trip is the
+        backpressure: a wedged owner stalls the producer, not memory."""
+        owner = spec.owner_addr
+
+        def report(i: int, ret: Dict) -> None:
+            async def call():
+                client = await self.worker._owner_client(owner)
+                return await client.call(
+                    "StreamingReturn",
+                    {"task_id": spec.task_id.hex(), "index": i, "ret": ret})
+
+            self.worker._acall(call())
+
+        count = 0
+        try:
+            for value in result:
+                report(count, self._package_one(spec, count, value))
+                count += 1
+        except BaseException as e:  # noqa: BLE001 — becomes the next item
+            err = RayTaskError.from_exception(e, spec.function_name)
+            report(count, self._package_one(spec, count, err,
+                                            is_exception=True))
+            count += 1
+        return {"returns": [], "streaming_count": count}
 
     # --------------------------------------------------------------- actors
     async def become_actor(self, payload: Dict) -> None:

@@ -52,6 +52,10 @@ class ActorMethod:
     def _remote(self, args, kwargs, opts):
         w = worker_mod.global_worker
         num_returns = opts.get("num_returns", self._num_returns)
+        if isinstance(num_returns, str):
+            if num_returns not in ("streaming", "dynamic"):
+                raise ValueError(f"bad num_returns {num_returns!r}")
+            num_returns = -1
         refs = w.submit_actor_task(
             self._handle._actor_id,
             self._method_name,
@@ -59,6 +63,8 @@ class ActorMethod:
             kwargs,
             num_returns=num_returns,
         )
+        if num_returns == -1:
+            return refs  # ObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs

@@ -83,7 +83,11 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
-    stop: Optional[Dict[str, Any]] = None
+    # dict {metric: threshold}, callable (trial_id, result) -> bool, or a
+    # ray_tpu.tune.stopper.Stopper
+    stop: Optional[Any] = None
+    # list of ray_tpu.tune.logger.Callback (loggers are added by default)
+    callbacks: Optional[list] = None
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:

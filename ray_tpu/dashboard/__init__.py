@@ -46,11 +46,21 @@ class DashboardActor:
                 method, target, _ = line.decode("latin1").strip().split(" ", 2)
             except ValueError:
                 return
+            content_length = 0
             while True:
                 h = await reader.readline()
                 if not h or h in (b"\r\n", b"\n"):
                     break
-            status, payload, ctype = await self._dispatch(method, target)
+                name, _, value = h.decode("latin1").partition(":")
+                if name.strip().lower() == "content-length":
+                    try:
+                        content_length = int(value.strip())
+                    except ValueError:
+                        pass
+            body = (await reader.readexactly(content_length)
+                    if content_length else b"")
+            status, payload, ctype = await self._dispatch(
+                method, target, body)
             writer.write(
                 f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
@@ -65,8 +75,8 @@ class DashboardActor:
             except Exception:
                 pass
 
-    async def _dispatch(self, method: str,
-                        target: str) -> Tuple[str, bytes, str]:
+    async def _dispatch(self, method: str, target: str,
+                        body: bytes = b"") -> Tuple[str, bytes, str]:
         path = urllib.parse.urlsplit(target).path
         try:
             if path == "/healthz":
@@ -76,6 +86,12 @@ class DashboardActor:
 
                 text = await asyncio.to_thread(prometheus_text)
                 return "200 OK", text.encode(), "text/plain"
+            if path == "/api/serve/applications" and method == "PUT":
+                # declarative deploy (reference: dashboard serve REST
+                # PUT /api/serve/applications/ consuming ServeDeploySchema)
+                config = json.loads(body or b"{}")
+                await asyncio.to_thread(self._serve_deploy, config)
+                return "200 OK", b"{}", "application/json"
             if path.startswith("/api/"):
                 data = await asyncio.to_thread(self._api, path)
                 if data is None:
@@ -90,10 +106,31 @@ class DashboardActor:
                     json.dumps({"error": repr(e)}).encode(),
                     "application/json")
 
+    def _serve_deploy(self, config: dict) -> None:
+        from ray_tpu import serve
+
+        serve.run_config(config, _blocking=False)
+
+    def _serve_status(self):
+        from ray_tpu import serve
+
+        try:
+            routes = ray_tpu.get(
+                serve._controller().get_routes.remote(), timeout=10)
+        except Exception:
+            return {"applications": {}}
+        return {"applications": {
+            app: {**serve.status(app), "route_prefix": prefix,
+                  "ingress": ingress}
+            for prefix, (app, ingress) in routes.items()}}
+
     def _api(self, path: str):
         from ray_tpu.util import state as state_api
 
         parts = [p for p in path.split("/") if p][1:]  # drop "api"
+        if parts[0] == "serve" and len(parts) > 1 \
+                and parts[1] == "applications":
+            return self._serve_status()
         if parts[0] == "nodes":
             return state_api.list_nodes()
         if parts[0] == "actors":

@@ -4,6 +4,7 @@ python/ray/data/read_api.py public surface).
 
 from __future__ import annotations
 
+import builtins
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -22,6 +23,7 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "from_blocks", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "read_datasource",
+    "read_tfrecords", "read_images", "from_torch",
 ]
 
 
@@ -97,3 +99,31 @@ def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
 def read_numpy(paths, *, parallelism: int = 8) -> Dataset:
     return read_datasource(_ds.NumpyDatasource(paths),
                            parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
+    return read_datasource(_ds.TFRecordDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                parallelism: int = 8) -> Dataset:
+    return read_datasource(_ds.ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """Materialize a (map-style) torch Dataset (reference:
+    data/read_api.py from_torch)."""
+    items = []
+    for i in builtins.range(len(torch_dataset)):
+        sample = torch_dataset[i]
+        if isinstance(sample, tuple) and len(sample) == 2:
+            items.append({"item": np.asarray(sample[0]),
+                          "label": np.asarray(sample[1])})
+        else:
+            items.append({"item": np.asarray(sample)})
+    return from_blocks([
+        {k: np.stack([it[k] for it in items[s:s + 1000]])
+         for k in items[0]}
+        for s in builtins.range(0, len(items), 1000)])

@@ -184,6 +184,14 @@ class Dataset:
 
         self._consume_write(write_json_fn(path), "WriteJSON")
 
+    def write_tfrecords(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_tfrecords_fn
+
+        self._consume_write(write_tfrecords_fn(path), "WriteTFRecords")
+
+    def iter_torch_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_torch_batches(**kw)
+
     def _consume_write(self, write_fn, name: str) -> None:
         ds = self._append(L.Write(self._last_op, write_fn, name))
         for _ in ds._execute_bundles():

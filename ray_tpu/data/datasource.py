@@ -167,6 +167,62 @@ class NumpyDatasource(FileDatasource):
         return {"data": np.load(path)}
 
 
+class TFRecordDatasource(FileDatasource):
+    """tf.train.Example TFRecords via the built-in pure-Python codec
+    (reference: data/datasource/tfrecords_datasource.py, minus the
+    tensorflow dependency)."""
+
+    name = "ReadTFRecords"
+    suffix = ".tfrecord"
+
+    def read_file(self, path: str):
+        from ray_tpu.data._internal.tfrecord import read_tfrecord_file
+        from ray_tpu.data.block import BlockAccessor
+
+        rows = []
+        for row in read_tfrecord_file(path):
+            flat = {}
+            for k, v in row.items():
+                if isinstance(v, list):  # BytesList
+                    flat[k] = v[0] if len(v) == 1 else v
+                elif isinstance(v, np.ndarray) and v.size == 1:
+                    flat[k] = v[0]
+                else:
+                    flat[k] = v
+            rows.append(flat)
+        return BlockAccessor.rows_to_block(rows)
+
+
+class ImageDatasource(FileDatasource):
+    """Image files via PIL (reference: data/datasource/
+    image_datasource.py): columns ``image`` (HWC uint8) + ``path``."""
+
+    name = "ReadImages"
+    suffix = None
+
+    IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, paths, size=None, mode: str = "RGB", **kw):
+        super().__init__(paths, **kw)
+        self.paths = [p for p in self.paths
+                      if p.lower().endswith(self.IMAGE_EXTS)]
+        if not self.paths:
+            raise FileNotFoundError(f"no image files in {paths!r}")
+        self.size = size
+        self.mode = mode
+
+    def read_file(self, path: str):
+        from PIL import Image
+
+        img = Image.open(path)
+        if self.mode:
+            img = img.convert(self.mode)
+        if self.size:
+            img = img.resize((self.size[1], self.size[0]))
+        arr = np.asarray(img)
+        return {"image": arr[None], "path": np.asarray([path], object)}
+
+
 # ------------------------------------------------------------------ writers
 def write_parquet_fn(path: str):
     os.makedirs(path, exist_ok=True)
@@ -183,6 +239,24 @@ def write_parquet_fn(path: str):
         pq.write_table(table, fn)
         return {"path": np.asarray([fn], dtype=object),
                 "num_rows": np.asarray([table.num_rows])}
+
+    return write
+
+
+def write_tfrecords_fn(path: str):
+    os.makedirs(path, exist_ok=True)
+
+    def write(batch):
+        import uuid
+
+        from ray_tpu.data._internal.tfrecord import write_tfrecord_file
+        from ray_tpu.data.block import BlockAccessor
+
+        acc = BlockAccessor(BlockAccessor.batch_to_block(batch))
+        fn = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.tfrecord")
+        n = write_tfrecord_file(fn, acc.iter_rows())
+        return {"path": np.asarray([fn], dtype=object),
+                "num_rows": np.asarray([n])}
 
     return write
 

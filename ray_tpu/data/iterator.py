@@ -149,6 +149,41 @@ class DataIterator:
             prefetch_batches=0)
         return _prefetch(map(to_device, it), prefetch_batches)
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: Optional[str] = None,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        prefetch_batches: int = 2,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference: iterator.py
+        iter_torch_batches; torch is CPU-only in this image)."""
+        import torch
+
+        def to_torch(batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+            out = {}
+            for k, v in batch.items():
+                if v.dtype == object:
+                    out[k] = v  # strings/bytes stay numpy
+                    continue
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            return out
+
+        it = self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            prefetch_batches=0)
+        return _prefetch(map(to_torch, it), prefetch_batches)
+
     def materialize(self):
         from ray_tpu.data.dataset import from_blocks
 

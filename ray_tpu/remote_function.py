@@ -41,8 +41,16 @@ def validate_options(opts: Dict[str, Any]) -> None:
             "namespace", "get_if_exists", "max_pending_calls",
         ):
             raise ValueError(f"invalid option '{k}'")
-    if opts.get("num_returns") is not None and opts["num_returns"] < 0:
-        raise ValueError("num_returns must be >= 0")
+    nr = opts.get("num_returns")
+    if nr is not None:
+        if isinstance(nr, str):
+            if nr not in ("streaming", "dynamic"):
+                raise ValueError(
+                    "num_returns must be an int >= 0, 'streaming', or "
+                    f"'dynamic', got {nr!r}")
+            opts["num_returns"] = -1  # wire sentinel for streaming
+        elif nr < 0:
+            raise ValueError("num_returns must be >= 0")
     num_tpus = opts.get("num_tpus")
     if num_tpus:
         from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
@@ -114,6 +122,8 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             name=opts.get("name", ""),
         )
+        if opts.get("num_returns", 1) == -1:
+            return refs  # ObjectRefGenerator
         if opts.get("num_returns", 1) == 1:
             return refs[0]
         return refs

@@ -10,9 +10,15 @@ CPU actors.
 """
 
 from ray_tpu.rllib.algorithms import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner, PPOLearner
@@ -22,7 +28,9 @@ from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "BC", "BCConfig",
+    "BC", "BCConfig", "A2C", "A2CConfig", "APPO", "APPOConfig",
+    "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
+    "ES", "ESConfig", "MARWIL", "MARWILConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "Learner",
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
     "SingleAgentEnvRunner",

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
+
+__all__ = ["APPO", "APPOConfig"]

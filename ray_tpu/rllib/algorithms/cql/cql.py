@@ -1,0 +1,144 @@
+"""CQL — conservative Q-learning for offline RL (reference:
+rllib/algorithms/cql/cql.py + cql_torch_learner: SAC machinery plus a
+conservative penalty pushing Q down on out-of-distribution actions and up
+on dataset actions; Kumar 2020, the CQL(H) variant).
+
+Data source is logged JSONL transitions (offline/json_io.py) with
+``obs, actions, rewards, next_obs, dones``; there are no env runners.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac.sac import (
+    SAC, SACConfig, SACLearner, SACModuleSpec)
+from ray_tpu.rllib.offline import JsonReader
+
+
+class CQLLearner(SACLearner):
+    def _losses(self, params, target_params, batch, k1, k2):
+        # independent subkeys: SAC's target-action sampling must not share
+        # noise with the CQL proposal actions
+        k_sac, kr, kp, kn = jax.random.split(k1, 4)
+        total, metrics = super()._losses(params, target_params, batch,
+                                         k_sac, k2)
+        cfg = self.config
+        n = cfg.get("cql_n_actions", 4)
+        cql_alpha = cfg.get("cql_alpha", 1.0)
+        obs, next_obs = batch["obs"], batch["next_obs"]
+        B = obs.shape[0]
+        act_dim = self.module.spec.action_dim
+        # uniform proposals in the squashed action box
+        rand_a = jax.random.uniform(kr, (n, B, act_dim), minval=-1.0,
+                                    maxval=1.0)
+        log_unif = -act_dim * jnp.log(2.0)  # density of U[-1,1]^d
+        # current-policy proposals at s and s' (importance-corrected)
+        pi_a, pi_logp, _ = jax.vmap(
+            lambda k: self.module.pi(params, obs, k))(
+                jax.random.split(kp, n))
+        nxt_a, nxt_logp, _ = jax.vmap(
+            lambda k: self.module.pi(params, next_obs, k))(
+                jax.random.split(kn, n))
+
+        def cat_q(q_key):
+            def q_of(a_batch, o):
+                x = jnp.concatenate([o, a_batch], axis=-1)
+                return self.module._tower(params[q_key], x)[..., 0]
+
+            q_rand = jax.vmap(lambda a: q_of(a, obs))(rand_a) - log_unif
+            q_pi = jax.vmap(lambda a: q_of(a, obs))(pi_a) - \
+                jax.lax.stop_gradient(pi_logp)
+            q_nxt = jax.vmap(lambda a: q_of(a, next_obs))(nxt_a) - \
+                jax.lax.stop_gradient(nxt_logp)
+            cat = jnp.concatenate([q_rand, q_pi, q_nxt], axis=0)
+            return jax.scipy.special.logsumexp(cat, axis=0)
+
+        q1_data, q2_data = self.module.q(params, obs, batch["actions"])
+        gap1 = jnp.mean(cat_q("q1") - q1_data)
+        gap2 = jnp.mean(cat_q("q2") - q2_data)
+        cql_loss = cql_alpha * (gap1 + gap2)
+        metrics["cql_loss"] = cql_loss
+        metrics["cql_gap"] = 0.5 * (gap1 + gap2)
+        return total + cql_loss, metrics
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or CQL)
+        self.offline_data: Optional[str] = None
+        self.cql_alpha = 1.0
+        self.cql_n_actions = 4
+        self.dataset_epochs_per_iter = 1
+        self.num_env_runners = 0
+        self.obs_dim: Optional[int] = None
+        self.action_dim: Optional[int] = None
+
+    def _training_keys(self):
+        return super()._training_keys() | {
+            "offline_data", "cql_alpha", "cql_n_actions",
+            "dataset_epochs_per_iter", "obs_dim", "action_dim"}
+
+    def offline(self, *, offline_data: str) -> "CQLConfig":
+        self.offline_data = offline_data
+        return self
+
+    def learner_config_dict(self) -> Dict:
+        d = super().learner_config_dict()
+        d.update({"cql_alpha": self.cql_alpha,
+                  "cql_n_actions": self.cql_n_actions})
+        return d
+
+    def module_spec(self) -> SACModuleSpec:
+        if self.obs_dim is not None and self.action_dim is not None:
+            return SACModuleSpec(
+                obs_dim=self.obs_dim, action_dim=self.action_dim,
+                hiddens=tuple(self.model.get("hiddens", (256, 256))),
+                activation=self.model.get("activation", "relu"))
+        return super().module_spec()
+
+
+class CQL(Algorithm):
+    learner_cls = CQLLearner
+
+    @classmethod
+    def get_default_config(cls):
+        return CQLConfig(algo_class=cls)
+
+    def setup(self, _config) -> None:
+        cfg = self._algo_config
+        if not cfg.offline_data:
+            raise ValueError("CQL requires config.offline(offline_data=...)")
+        super().setup(_config)
+        self.reader = JsonReader(cfg.offline_data, seed=cfg.seed)
+        full = self.reader.concat_all()
+        need = {"obs", "actions", "rewards", "next_obs", "dones"}
+        if not need <= set(full):
+            raise ValueError(f"CQL offline data needs {sorted(need)}, "
+                             f"got {sorted(full.keys())}")
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        learner = self.learner_group.local_learner()
+        full = self.reader.concat_all()
+        n = len(full["obs"])
+        steps = max(1, int(cfg.dataset_epochs_per_iter * n
+                           / cfg.train_batch_size))
+        metrics: Dict = {}
+        for _ in range(steps):
+            b = self.reader.sample(cfg.train_batch_size)
+            metrics = learner.update({
+                "obs": b["obs"].astype(np.float32),
+                "actions": b["actions"].astype(np.float32),
+                "rewards": b["rewards"].astype(np.float32),
+                "next_obs": b["next_obs"].astype(np.float32),
+                "dones": b["dones"].astype(np.float32),
+            })
+        metrics["env_steps_this_iter"] = 0
+        metrics["dataset_rows"] = n
+        return metrics

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.ddpg.ddpg import (
+    DDPG, DDPGConfig, TD3, TD3Config)
+
+__all__ = ["DDPG", "DDPGConfig", "TD3", "TD3Config"]

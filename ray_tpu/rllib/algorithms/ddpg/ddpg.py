@@ -1,9 +1,11 @@
-"""SAC — soft actor-critic with twin Q critics, polyak targets, and
-auto-tuned temperature (reference: rllib/algorithms/sac/sac.py +
-sac/torch/sac_torch_learner.py; Haarnoja 2018).
+"""DDPG + TD3 — deterministic-policy-gradient continuous control
+(reference: rllib/algorithms/ddpg/ddpg.py and td3.py, externalized to
+rllib_contrib in the snapshot; Lillicrap 2015, Fujimoto 2018).
 
-One jitted update covers critic, actor, and alpha steps — three
-value_and_grads fused by XLA into a single HBM-resident graph.
+One module/learner pair covers both: TD3 is DDPG with (a) twin critics
+taking the min for the target, (b) target-policy smoothing noise, and
+(c) delayed actor updates — all config flags here, defaulted per paper in
+``TD3Config``. Target networks for actor and critics use polyak averaging.
 """
 
 from __future__ import annotations
@@ -21,26 +23,26 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
 
-LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
-
 
 # ------------------------------------------------------------------- module
 @dataclasses.dataclass
-class SACModuleSpec:
-    """Actor + twin critics (reference: sac/sac_rl_module.py)."""
-
+class DDPGModuleSpec:
     obs_dim: int
     action_dim: int
-    discrete: bool = False  # SAC here is continuous-only
+    discrete: bool = False
     hiddens: Tuple[int, ...] = (256, 256)
     activation: str = "relu"
+    exploration_noise: float = 0.1  # sigma of the behavior Gaussian
 
-    def build(self) -> "SACModule":
-        return SACModule(self)
+    def build(self) -> "DDPGModule":
+        return DDPGModule(self)
 
 
-class SACModule:
-    def __init__(self, spec: SACModuleSpec):
+class DDPGModule:
+    """tanh deterministic actor + twin Q towers (the second tower is
+    ignored when twin_q=False)."""
+
+    def __init__(self, spec: DDPGModuleSpec):
         self.spec = spec
         self._act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[spec.activation]
 
@@ -59,10 +61,9 @@ class SACModule:
         h = self.spec.hiddens
         obs, act = self.spec.obs_dim, self.spec.action_dim
         return {
-            "actor": self._mlp(ka, (obs, *h, 2 * act)),
+            "actor": self._mlp(ka, (obs, *h, act)),
             "q1": self._mlp(k1, (obs + act, *h, 1)),
             "q2": self._mlp(k2, (obs + act, *h, 1)),
-            "log_alpha": jnp.asarray(0.0, jnp.float32),
         }
 
     def _tower(self, layers, x):
@@ -71,22 +72,8 @@ class SACModule:
         last = layers[-1]
         return x @ last["w"] + last["b"]
 
-    # squashed-Gaussian policy
-    def pi(self, params, obs, rng):
-        out = self._tower(params["actor"], obs)
-        mean, log_std = jnp.split(out, 2, axis=-1)
-        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
-        std = jnp.exp(log_std)
-        raw = mean + std * jax.random.normal(rng, mean.shape)
-        action = jnp.tanh(raw)
-        # log-prob with tanh-squash correction (SAC appendix C)
-        logp_raw = jnp.sum(
-            -0.5 * ((raw - mean) / std) ** 2 - log_std
-            - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
-        logp = logp_raw - jnp.sum(
-            2.0 * (jnp.log(2.0) - raw - jax.nn.softplus(-2.0 * raw)),
-            axis=-1)
-        return action, logp, jnp.tanh(mean)
+    def pi(self, params, obs):
+        return jnp.tanh(self._tower(params["actor"], obs))
 
     def q(self, params, obs, action):
         x = jnp.concatenate([obs, action], axis=-1)
@@ -95,106 +82,106 @@ class SACModule:
 
     # env-runner interface
     def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
-        out = self._tower(params["actor"], obs)
-        mean, _ = jnp.split(out, 2, axis=-1)
-        action = jnp.tanh(mean)
+        action = self.pi(params, obs)
         q1, _ = self.q(params, obs, action)
-        return {"logits": out, "vf": q1}
+        return {"logits": action, "vf": q1}
 
     def explore_action(self, params, obs, rng):
-        action, logp, _ = self.pi(params, obs, rng)
-        q1, _ = self.q(params, obs, action)
-        return action, logp, q1
+        a = self.pi(params, obs)
+        noise = self.spec.exploration_noise * \
+            jax.random.normal(rng, a.shape)
+        a = jnp.clip(a + noise, -1.0, 1.0)
+        q1, _ = self.q(params, obs, a)
+        return a, jnp.zeros(a.shape[:-1]), q1
 
     def greedy_action(self, params, obs):
-        out = self._tower(params["actor"], obs)
-        mean, _ = jnp.split(out, 2, axis=-1)
-        action = jnp.tanh(mean)
-        q1, _ = self.q(params, obs, action)
-        return action, jnp.zeros(action.shape[:-1]), q1
+        a = self.pi(params, obs)
+        q1, _ = self.q(params, obs, a)
+        return a, jnp.zeros(a.shape[:-1]), q1
 
 
 # ------------------------------------------------------------------ learner
-class SACLearner:
-    """Critic + actor + temperature updates (reference:
-    sac_torch_learner.py compute_loss_for_module). Drives its own optax
-    chains per component, so it implements the Learner duck-type rather
-    than subclassing the PG Learner."""
+class DDPGLearner:
+    """Critic TD step + (possibly delayed) deterministic actor step
+    (Learner duck-type like SACLearner)."""
 
-    def __init__(self, module_spec: SACModuleSpec, config: Dict,
+    def __init__(self, module_spec: DDPGModuleSpec, config: Dict,
                  use_mesh: bool = True):
         self.module = module_spec.build()
         self.config = config
         self._rng = jax.random.key(config.get("seed", 0))
         self._rng, init_key = jax.random.split(self._rng)
         self.params = self.module.init(init_key)
-        self.target_params = jax.tree.map(
-            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
-        lr = config.get("lr", 3e-4)
-        self.tx = optax.adam(lr)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(config.get("lr", 1e-3))
         self.opt_state = self.tx.init(self.params)
-        self.target_entropy = config.get(
-            "target_entropy", -float(module_spec.action_dim))
+        self._n_updates = 0
         self._update = self._build_update()
 
-    def _losses(self, params, target_params, batch, k1, k2):
-        """Joint critic+actor+alpha loss; overridable (CQL adds its
-        conservative penalty on top, reference: cql_torch_learner)."""
-        gamma = self.config.get("gamma", 0.99)
-        target_entropy = self.target_entropy
-        alpha = jnp.exp(params["log_alpha"])
-        # ---- critic target
-        next_a, next_logp, _ = self.module.pi(params, batch["next_obs"], k1)
-        tq1, tq2 = self.module.q(
-            {**params, "q1": target_params["q1"],
-             "q2": target_params["q2"]},
-            batch["next_obs"], next_a)
-        q_next = jnp.minimum(tq1, tq2) - \
-            jax.lax.stop_gradient(alpha) * next_logp
-        target = batch["rewards"] + gamma * (1 - batch["dones"]) * q_next
-        target = jax.lax.stop_gradient(target)
-        q1, q2 = self.module.q(params, batch["obs"], batch["actions"])
-        critic_loss = jnp.mean((q1 - target) ** 2) + \
-            jnp.mean((q2 - target) ** 2)
-        # ---- actor
-        new_a, logp, _ = self.module.pi(params, batch["obs"], k2)
-        pq1, pq2 = self.module.q(jax.lax.stop_gradient(params),
-                                 batch["obs"], new_a)
-        actor_loss = jnp.mean(
-            jax.lax.stop_gradient(alpha) * logp - jnp.minimum(pq1, pq2))
-        # ---- temperature
-        alpha_loss = -jnp.mean(
-            params["log_alpha"] *
-            jax.lax.stop_gradient(logp + target_entropy))
-        total = critic_loss + actor_loss + alpha_loss
-        return total, {
-            "critic_loss": critic_loss, "actor_loss": actor_loss,
-            "alpha_loss": alpha_loss, "alpha": alpha,
-            "qf_mean": jnp.mean(q1), "entropy": -jnp.mean(logp),
-        }
-
     def _build_update(self):
-        tau = self.config.get("tau", 0.005)
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        tau = cfg.get("tau", 0.005)
+        twin_q = cfg.get("twin_q", False)
+        smooth = cfg.get("target_noise", 0.0)
+        noise_clip = cfg.get("noise_clip", 0.5)
 
-        def update(params, target_params, opt_state, batch, rng):
-            rng, k1, k2 = jax.random.split(rng, 3)
-            (loss, metrics), grads = jax.value_and_grad(
-                self._losses, has_aux=True)(params, target_params, batch,
-                                            k1, k2)
+        def critic_loss(params, target_params, batch, key):
+            next_a = self.module.pi(target_params, batch["next_obs"])
+            if smooth > 0:
+                eps = jnp.clip(
+                    smooth * jax.random.normal(key, next_a.shape),
+                    -noise_clip, noise_clip)
+                next_a = jnp.clip(next_a + eps, -1.0, 1.0)
+            tq1, tq2 = self.module.q(target_params, batch["next_obs"],
+                                     next_a)
+            q_next = jnp.minimum(tq1, tq2) if twin_q else tq1
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1 - batch["dones"]) * q_next)
+            q1, q2 = self.module.q(params, batch["obs"], batch["actions"])
+            loss = jnp.mean((q1 - target) ** 2)
+            if twin_q:
+                loss = loss + jnp.mean((q2 - target) ** 2)
+            return loss, {"critic_loss": loss, "qf_mean": jnp.mean(q1)}
+
+        def actor_loss(params, batch):
+            a = self.module.pi(params, batch["obs"])
+            q1, _ = self.module.q(jax.lax.stop_gradient(params),
+                                  batch["obs"], a)
+            return -jnp.mean(q1)
+
+        def update(params, target_params, opt_state, batch, rng,
+                   do_actor):
+            rng, key = jax.random.split(rng)
+            (_, metrics), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(params, target_params, batch,
+                                           key)
+            a_loss, a_grads = jax.value_and_grad(actor_loss)(params, batch)
+            # delayed policy update: zero the actor grads on off ticks
+            # (static branch would recompile; a where keeps one program)
+            scale = jnp.where(do_actor, 1.0, 0.0)
+            grads = {
+                "actor": jax.tree.map(lambda g: g * scale,
+                                      a_grads["actor"]),
+                "q1": c_grads["q1"], "q2": c_grads["q2"],
+            }
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             target_params = jax.tree.map(
-                lambda t, o: (1 - tau) * t + tau * o, target_params,
-                {"q1": params["q1"], "q2": params["q2"]})
-            metrics["total_loss"] = loss
+                lambda t, o: (1 - tau) * t + tau * o, target_params, params)
+            metrics["actor_loss"] = a_loss
             return params, target_params, opt_state, metrics, rng
 
         return jax.jit(update)
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        self.params, self.target_params, self.opt_state, metrics, self._rng \
-            = self._update(self.params, self.target_params, self.opt_state,
-                           batch, self._rng)
+        self._n_updates += 1
+        delay = self.config.get("policy_delay", 1)
+        do_actor = (self._n_updates % delay) == 0
+        self.params, self.target_params, self.opt_state, metrics, \
+            self._rng = self._update(self.params, self.target_params,
+                                     self.opt_state, batch, self._rng,
+                                     do_actor)
         return {k: float(v) for k, v in metrics.items()}
 
     # Learner duck-type
@@ -207,57 +194,76 @@ class SACLearner:
     def get_state(self) -> Dict:
         return {"params": jax.device_get(self.params),
                 "target_params": jax.device_get(self.target_params),
-                "opt_state": jax.device_get(self.opt_state)}
+                "opt_state": jax.device_get(self.opt_state),
+                "n_updates": self._n_updates}
 
     def set_state(self, state: Dict) -> None:
         self.params = state["params"]
         self.target_params = state["target_params"]
         self.opt_state = state["opt_state"]
+        self._n_updates = state.get("n_updates", 0)
 
 
 # ---------------------------------------------------------------- algorithm
-class SACConfig(AlgorithmConfig):
+class DDPGConfig(AlgorithmConfig):
     def __init__(self, algo_class=None):
-        super().__init__(algo_class=algo_class or SAC)
-        self.lr = 3e-4
+        super().__init__(algo_class=algo_class or DDPG)
+        self.lr = 1e-3
         self.train_batch_size = 256
         self.replay_buffer_capacity = 100_000
         self.num_steps_sampled_before_learning_starts = 1500
         self.tau = 0.005
-        self.target_entropy = None  # None -> -action_dim
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.noise_clip = 0.5
+        self.exploration_noise = 0.1
         self.training_intensity = 1.0
         self.rollout_fragment_length = 1
         self.num_env_runners = 1
         self.model = {"hiddens": (256, 256), "activation": "relu"}
 
     def _training_keys(self):
-        return {"replay_buffer_capacity", "tau", "target_entropy",
+        return {"replay_buffer_capacity", "tau", "twin_q", "policy_delay",
+                "target_noise", "noise_clip", "exploration_noise",
                 "num_steps_sampled_before_learning_starts",
                 "training_intensity"}
 
     def learner_config_dict(self) -> Dict:
         d = super().learner_config_dict()
-        d["tau"] = self.tau
-        if self.target_entropy is not None:
-            d["target_entropy"] = self.target_entropy
+        d.update({"tau": self.tau, "twin_q": self.twin_q,
+                  "policy_delay": self.policy_delay,
+                  "target_noise": self.target_noise,
+                  "noise_clip": self.noise_clip})
         return d
 
-    def module_spec(self) -> SACModuleSpec:
+    def module_spec(self) -> DDPGModuleSpec:
         base = super().module_spec()
         if base.discrete:
-            raise ValueError("this SAC implements continuous control only")
-        return SACModuleSpec(
+            raise ValueError("DDPG/TD3 are continuous-control only")
+        return DDPGModuleSpec(
             obs_dim=base.obs_dim, action_dim=base.action_dim,
             hiddens=tuple(self.model.get("hiddens", (256, 256))),
-            activation=self.model.get("activation", "relu"))
+            activation=self.model.get("activation", "relu"),
+            exploration_noise=self.exploration_noise)
 
 
-class SAC(Algorithm):
-    learner_cls = SACLearner
+class TD3Config(DDPGConfig):
+    """Fujimoto 2018 defaults (reference: rllib td3.py)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or TD3)
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2
+
+
+class DDPG(Algorithm):
+    learner_cls = DDPGLearner
 
     @classmethod
     def get_default_config(cls):
-        return SACConfig(algo_class=cls)
+        return DDPGConfig(algo_class=cls)
 
     def setup(self, _config) -> None:
         super().setup(_config)
@@ -296,11 +302,15 @@ class SAC(Algorithm):
         metrics: Dict = {"env_steps_this_iter": new_steps}
         if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
             return metrics
-        # training_intensity = replayed/sampled step ratio (same semantics
-        # as DQN): updates * batch_size ~= new_steps * intensity
         num_updates = max(1, int(new_steps * cfg.training_intensity /
                                  max(cfg.train_batch_size, 1)))
         for _ in range(num_updates):
             metrics.update(learner.update(
                 self.replay.sample(cfg.train_batch_size)))
         return metrics
+
+
+class TD3(DDPG):
+    @classmethod
+    def get_default_config(cls):
+        return TD3Config(algo_class=cls)

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.es.es import ES, ESConfig
+
+__all__ = ["ES", "ESConfig"]

@@ -34,7 +34,12 @@ class SingleAgentEnvRunner:
         # off-policy algos (DQN/SAC) need (s, a, r, s') tuples
         self._collect_next_obs = collect_next_obs
 
-        self._jit_explore = jax.jit(self.module.explore_action)
+        if explore:
+            self._jit_explore = jax.jit(self.module.explore_action)
+        else:
+            # greedy/deterministic inference (ES candidate evaluation,
+            # evaluation rollouts): mode of the action distribution
+            self._jit_explore = jax.jit(self._greedy_action)
         self._jit_forward = jax.jit(self.module.forward)
 
         obs, _ = self.env.reset(seed=seed)
@@ -46,6 +51,31 @@ class SingleAgentEnvRunner:
         # episode ends (the action there is ignored, reward is 0) — those
         # transitions are bogus training samples and get masked out
         self._prev_done = np.zeros(num_envs, dtype=bool)
+
+    def _greedy_action(self, weights, obs, rng):
+        """Deterministic action with the explore_action signature: argmax
+        for discrete modules, distribution mode / deterministic policy
+        output for continuous ones."""
+        import jax.numpy as jnp
+
+        if hasattr(self.module, "greedy_action"):
+            return self.module.greedy_action(weights, obs)
+        out = self.module.forward(weights, obs)
+        logits = out["logits"]
+        if getattr(self.module.spec, "discrete", False):
+            action = jnp.argmax(logits, axis=-1)
+            logp = self.module.dist.logp(logits, action) \
+                if hasattr(self.module, "dist") else jnp.zeros(obs.shape[0])
+        elif hasattr(self.module, "dist"):
+            action = self.module.dist.split(logits)[0] \
+                if hasattr(self.module.dist, "split") else logits
+            logp = self.module.dist.logp(logits, action)
+        else:
+            # deterministic continuous modules (SAC/DDPG forward already
+            # returns the greedy action as "logits")
+            action = logits
+            logp = jnp.zeros(obs.shape[0])
+        return action, logp, out["vf"]
 
     def ping(self) -> bool:
         return True

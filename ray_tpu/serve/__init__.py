@@ -22,13 +22,18 @@ from ray_tpu.serve._private.controller import (
     CONTROLLER_NAME, SERVE_NAMESPACE, ServeController)
 from ray_tpu.serve._private.proxy import ProxyActor, Request
 from ray_tpu.serve._private.replica import _HandlePlaceholder
+from ray_tpu.serve.schema import (
+    DeploymentSchema, HTTPOptionsSchema, ServeApplicationSchema,
+    ServeDeploySchema, build_app_schema)
 
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "DeploymentHandle", "DeploymentResponse", "Request",
     "start", "run", "shutdown", "delete", "status", "get_app_handle",
     "get_deployment_handle", "batch", "pad_batch", "multiplexed",
-    "get_multiplexed_model_id",
+    "get_multiplexed_model_id", "build", "run_config",
+    "DeploymentSchema", "ServeApplicationSchema", "ServeDeploySchema",
+    "HTTPOptionsSchema",
 ]
 
 PROXY_NAME = "SERVE_PROXY"
@@ -137,6 +142,34 @@ def run(target: Application, *, name: str = "default",
                     f"{wait_timeout_s}s: {st}")
             time.sleep(0.1)
     return DeploymentHandle(name, ingress)
+
+
+def build(target: Application, *, name: str = "default",
+          route_prefix: str = "/",
+          import_path: str = "") -> Dict:
+    """Snapshot an Application into the declarative config dict that
+    ``run_config`` / ``PUT /api/serve/applications`` consume (reference:
+    `serve build` CLI emitting ServeDeploySchema YAML)."""
+    app_schema = build_app_schema(target, name=name,
+                                  route_prefix=route_prefix,
+                                  import_path=import_path)
+    return ServeDeploySchema(applications=[app_schema]).to_dict()
+
+
+def run_config(config, *, _blocking: bool = True) -> Dict[str, Any]:
+    """Deploy every application in a ServeDeploySchema-shaped dict
+    (reference: `serve deploy` → controller deploy_config path). Returns
+    {app_name: ingress handle}."""
+    schema = (config if isinstance(config, ServeDeploySchema)
+              else ServeDeploySchema.from_dict(config))
+    start(http_options=schema.http_options.to_dict())
+    handles: Dict[str, Any] = {}
+    for app_schema in schema.applications:
+        app = app_schema.resolve()
+        handles[app_schema.name] = run(
+            app, name=app_schema.name,
+            route_prefix=app_schema.route_prefix, _blocking=_blocking)
+    return handles
 
 
 def status(name: str = "default") -> Dict:

@@ -1,0 +1,167 @@
+"""Serve config schema (reference: python/ray/serve/schema.py — pydantic
+ServeDeploySchema / ServeApplicationSchema / DeploymentSchema; dataclasses
+here, same shape on the wire).
+
+The declarative path mirrors the reference's ``serve build`` →
+``serve deploy``: an application is named by an ``import_path``
+("module:attr" resolving to a bound ``Application``), with per-deployment
+option overrides applied at deploy time. ``serve.build()`` emits this
+schema from a live ``Application``; ``serve.run_config()`` (and the
+dashboard's ``PUT /api/serve/applications``) consumes it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class DeploymentSchema:
+    """Option overrides for one deployment (reference: DeploymentSchema)."""
+
+    name: str
+    num_replicas: Optional[int] = None
+    max_ongoing_requests: Optional[int] = None
+    user_config: Optional[Dict] = None
+    autoscaling_config: Optional[Dict] = None
+    ray_actor_options: Optional[Dict] = None
+    health_check_period_s: Optional[float] = None
+    graceful_shutdown_timeout_s: Optional[float] = None
+
+    def to_dict(self) -> Dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DeploymentSchema":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class ServeApplicationSchema:
+    """One application (reference: ServeApplicationSchema)."""
+
+    import_path: str = ""
+    name: str = "default"
+    route_prefix: str = "/"
+    args: Optional[Dict] = None
+    runtime_env: Optional[Dict] = None
+    deployments: List[DeploymentSchema] = dataclasses.field(
+        default_factory=list)
+
+    def to_dict(self) -> Dict:
+        d = {"name": self.name, "route_prefix": self.route_prefix,
+             "import_path": self.import_path,
+             "deployments": [dp.to_dict() for dp in self.deployments]}
+        if self.args:
+            d["args"] = self.args
+        if self.runtime_env:
+            d["runtime_env"] = self.runtime_env
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ServeApplicationSchema":
+        return cls(
+            import_path=d.get("import_path", ""),
+            name=d.get("name", "default"),
+            route_prefix=d.get("route_prefix", "/"),
+            args=d.get("args"),
+            runtime_env=d.get("runtime_env"),
+            deployments=[DeploymentSchema.from_dict(x)
+                         for x in d.get("deployments", [])],
+        )
+
+    def resolve(self):
+        """Import and return the bound Application, applying overrides."""
+        from ray_tpu.serve.deployment import Application
+
+        if not self.import_path:
+            raise ValueError(
+                f"application {self.name!r} has no import_path; "
+                "serve.build() output needs import_path=\"module:attr\" "
+                "filled in before it can be deployed declaratively")
+        if ":" in self.import_path:
+            mod_name, attr = self.import_path.split(":", 1)
+        else:
+            mod_name, attr = self.import_path.rsplit(".", 1)
+        target = getattr(importlib.import_module(mod_name), attr)
+        if callable(target) and not isinstance(target, Application):
+            target = target(**(self.args or {}))  # app builder function
+        if not isinstance(target, Application):
+            raise TypeError(
+                f"{self.import_path} resolved to {type(target).__name__}, "
+                "expected a bound Application (deployment.bind(...))")
+        overrides = {d.name: d for d in self.deployments}
+        for node in target.walk():
+            ov = overrides.get(node.deployment.name)
+            if ov is None:
+                continue
+            opts = {k: v for k, v in ov.to_dict().items() if k != "name"}
+            if opts:
+                node.deployment = node.deployment.options(**opts)
+        return target
+
+
+@dataclasses.dataclass
+class HTTPOptionsSchema:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "HTTPOptionsSchema":
+        return cls(host=d.get("host", "127.0.0.1"),
+                   port=d.get("port", 8000))
+
+
+@dataclasses.dataclass
+class ServeDeploySchema:
+    """Top-level multi-app config (reference: ServeDeploySchema — the
+    ``serve deploy`` document)."""
+
+    applications: List[ServeApplicationSchema] = dataclasses.field(
+        default_factory=list)
+    http_options: HTTPOptionsSchema = dataclasses.field(
+        default_factory=HTTPOptionsSchema)
+
+    def to_dict(self) -> Dict:
+        return {"http_options": self.http_options.to_dict(),
+                "applications": [a.to_dict() for a in self.applications]}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ServeDeploySchema":
+        return cls(
+            applications=[ServeApplicationSchema.from_dict(a)
+                          for a in d.get("applications", [])],
+            http_options=HTTPOptionsSchema.from_dict(
+                d.get("http_options", {})),
+        )
+
+
+def build_app_schema(app, *, name: str = "default",
+                     route_prefix: str = "/",
+                     import_path: str = "") -> ServeApplicationSchema:
+    """``serve.build`` analog: snapshot a bound Application's deployment
+    options into a declarative schema (reference: serve build CLI)."""
+    deployments = []
+    for node in app.walk():
+        d = node.deployment
+        auto = d.autoscaling_config
+        deployments.append(DeploymentSchema(
+            name=d.name,
+            num_replicas=d.num_replicas,
+            max_ongoing_requests=d.max_ongoing_requests,
+            user_config=d.user_config,
+            autoscaling_config=dict(auto.__dict__) if auto else None,
+            ray_actor_options=d.ray_actor_options or None,
+            health_check_period_s=d.health_check_period_s,
+            graceful_shutdown_timeout_s=d.graceful_shutdown_timeout_s,
+        ))
+    return ServeApplicationSchema(
+        import_path=import_path, name=name, route_prefix=route_prefix,
+        deployments=deployments)

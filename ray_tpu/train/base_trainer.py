@@ -32,6 +32,8 @@ class Result:
     error: Optional[Exception] = None
     metrics_dataframe: Optional[Any] = None
     best_checkpoints: Optional[list] = None
+    # the trial's hyperparameter config (reference: Result.config)
+    config: Optional[Dict[str, Any]] = None
 
 
 class BaseTrainer:

@@ -14,9 +14,17 @@ from typing import Dict, Optional, Union
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.tune.experiment import Trial
 from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune import stopper
+from ray_tpu.tune.logger import (
+    Callback, CSVLoggerCallback, JsonLoggerCallback, LoggerCallback,
+    TBXLoggerCallback)
 from ray_tpu.tune.schedulers import (
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    MedianStoppingRule, PopulationBasedTraining, TrialScheduler)
+    HyperBandScheduler, MedianStoppingRule, PopulationBasedTraining,
+    TrialScheduler)
+from ray_tpu.tune.stopper import (
+    CombinedStopper, ExperimentPlateauStopper, FunctionStopper,
+    MaximumIterationStopper, Stopper, TimeoutStopper, TrialPlateauStopper)
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
 from ray_tpu.tune.search.sample import (
     choice, grid_search, lograndint, loguniform, quniform, randint,
@@ -32,8 +40,13 @@ __all__ = [
     "uniform", "quniform", "loguniform", "randint", "lograndint", "choice",
     "sample_from", "grid_search", "Searcher", "ConcurrencyLimiter",
     "BasicVariantGenerator", "TrialScheduler", "FIFOScheduler",
-    "ASHAScheduler", "AsyncHyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "run",
+    "ASHAScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining", "run", "stopper", "Stopper",
+    "CombinedStopper", "ExperimentPlateauStopper", "FunctionStopper",
+    "MaximumIterationStopper", "TimeoutStopper", "TrialPlateauStopper",
+    "Callback", "LoggerCallback", "CSVLoggerCallback",
+    "JsonLoggerCallback", "TBXLoggerCallback",
 ]
 
 

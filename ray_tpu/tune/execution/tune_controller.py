@@ -82,6 +82,15 @@ class TuneController:
                                   or CheckpointConfig())
         self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
 
+        # callbacks: default file loggers + user callbacks (reference:
+        # tune/logger — CSV/JSON written for every trial by default)
+        from ray_tpu.tune.logger import (
+            CSVLoggerCallback, JsonLoggerCallback)
+
+        self.callbacks = [JsonLoggerCallback(), CSVLoggerCallback()]
+        self.callbacks.extend(self.run_config.callbacks or [])
+        self._iteration = 0
+
         self.trials: List[Trial] = []
         self._actors: Dict[str, object] = {}       # trial_id -> ActorHandle
         self._inflight: Dict[object, Trial] = {}   # train() ref -> trial
@@ -131,9 +140,29 @@ class TuneController:
                     time.monotonic() - start > self.time_budget_s:
                 self._stop_all("time budget exhausted")
                 break
+            from ray_tpu.tune.stopper import Stopper
+
+            if isinstance(self.run_config.stop, Stopper) and \
+                    self.run_config.stop.stop_all():
+                self._stop_all("stopper.stop_all()")
+                break
             self._maybe_save_state()
         self._save_state()
+        for cb in self.callbacks:
+            try:
+                cb.on_experiment_end(self.trials)
+            except Exception:
+                pass
         return self.trials
+
+    def _fire(self, hook: str, trial, *args) -> None:
+        self._iteration += 1
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(self._iteration, self.trials, trial,
+                                  *args)
+            except Exception:
+                pass
 
     def _reached_sample_cap(self) -> bool:
         return (self.num_samples_cap is not None
@@ -157,11 +186,16 @@ class TuneController:
 
     def _maybe_start_trials(self) -> None:
         running = len(self._actors)
+        may_resume = getattr(self.scheduler, "may_resume", None)
         for trial in self.trials:
             if running >= self.max_concurrent:
                 return
             if trial.status in (Trial.PENDING, Trial.PAUSED) and \
                     trial.trial_id not in self._actors:
+                # scheduler hold (sync HyperBand rung barrier)
+                if trial.status == Trial.PAUSED and may_resume is not None \
+                        and not may_resume(trial):
+                    continue
                 self._start_trial(trial)
                 running += 1
 
@@ -180,6 +214,7 @@ class TuneController:
             self._handle_failure(trial, e)
             return
         trial.status = Trial.RUNNING
+        self._fire("on_trial_start", trial)
         self._submit_train(trial)
 
     def _submit_train(self, trial: Trial) -> None:
@@ -223,6 +258,7 @@ class TuneController:
             result = {**trial.last_result, **result}
         trial.last_result = result
         trial.metric_history.append(result)
+        self._fire("on_trial_result", trial, result)
 
         if done:
             self._complete_trial(trial, result)
@@ -272,6 +308,7 @@ class TuneController:
         self.search_alg.on_trial_complete(trial.trial_id, result, error=False)
         self._teardown_actor(trial)
         trial.status = Trial.TERMINATED
+        self._fire("on_trial_complete", trial)
 
     def _handle_failure(self, trial: Trial, error: Exception) -> None:
         trial.num_failures += 1
@@ -285,6 +322,7 @@ class TuneController:
             return
         trial.status = Trial.ERROR
         trial.error_msg = f"{type(error).__name__}: {error}"
+        self._fire("on_trial_error", trial)
         self.scheduler.on_trial_error(self, trial)
         self.search_alg.on_trial_complete(trial.trial_id, None, error=True)
         if self.failure_config.fail_fast:

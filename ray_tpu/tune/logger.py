@@ -1,0 +1,173 @@
+"""Trial callbacks + result loggers (reference: python/ray/tune/logger/ —
+CSVLoggerCallback, JsonLoggerCallback, TBXLoggerCallback — and
+python/ray/tune/callback.py Callback hooks).
+
+Per-trial files land in the trial's local dir: ``progress.csv``,
+``result.json`` (one JSON object per line), and TensorBoard event files
+when ``tensorboardX``/torch tensorboard is importable (gated — not in
+this image's baked set).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import numbers
+import os
+from typing import Dict, List, Optional, TextIO
+
+
+class Callback:
+    """Experiment-loop hooks (reference: tune/callback.py Callback)."""
+
+    def on_trial_start(self, iteration: int, trials: List, trial) -> None:
+        pass
+
+    def on_trial_result(self, iteration: int, trials: List, trial,
+                        result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, iteration: int, trials: List,
+                          trial) -> None:
+        pass
+
+    def on_trial_error(self, iteration: int, trials: List, trial) -> None:
+        pass
+
+    def on_experiment_end(self, trials: List) -> None:
+        pass
+
+
+class LoggerCallback(Callback):
+    """Base for per-trial file loggers."""
+
+    def log_trial_start(self, trial) -> None:
+        pass
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        pass
+
+    def log_trial_end(self, trial) -> None:
+        pass
+
+    def on_trial_start(self, iteration, trials, trial) -> None:
+        self.log_trial_start(trial)
+
+    def on_trial_result(self, iteration, trials, trial, result) -> None:
+        self.log_trial_result(trial, result)
+
+    def on_trial_complete(self, iteration, trials, trial) -> None:
+        self.log_trial_end(trial)
+
+    def on_trial_error(self, iteration, trials, trial) -> None:
+        # errored trials must still close/flush their files
+        self.log_trial_end(trial)
+
+
+def _flatten(d: Dict, prefix: str = "") -> Dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+class JsonLoggerCallback(LoggerCallback):
+    """One JSON object per result line in ``result.json`` (+ params.json),
+    the format ``tune.ExperimentAnalysis``/ResultGrid re-read."""
+
+    def __init__(self):
+        self._files: Dict[str, TextIO] = {}
+
+    def log_trial_start(self, trial) -> None:
+        os.makedirs(trial.local_dir, exist_ok=True)
+        with open(os.path.join(trial.local_dir, "params.json"), "w") as f:
+            json.dump(trial.config, f, default=str)
+
+    def _fh(self, trial) -> TextIO:
+        if trial.trial_id not in self._files:
+            os.makedirs(trial.local_dir, exist_ok=True)
+            self._files[trial.trial_id] = open(
+                os.path.join(trial.local_dir, "result.json"), "a")
+        return self._files[trial.trial_id]
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        fh = self._fh(trial)
+        fh.write(json.dumps(result, default=str) + "\n")
+        fh.flush()
+
+    def log_trial_end(self, trial) -> None:
+        fh = self._files.pop(trial.trial_id, None)
+        if fh:
+            fh.close()
+
+
+class CSVLoggerCallback(LoggerCallback):
+    """``progress.csv`` with a header from the first result's flat keys."""
+
+    def __init__(self):
+        self._writers: Dict[str, csv.DictWriter] = {}
+        self._files: Dict[str, TextIO] = {}
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        flat = _flatten(result)
+        if trial.trial_id not in self._writers:
+            os.makedirs(trial.local_dir, exist_ok=True)
+            fh = open(os.path.join(trial.local_dir, "progress.csv"), "a")
+            self._files[trial.trial_id] = fh
+            writer = csv.DictWriter(fh, fieldnames=sorted(flat.keys()),
+                                    extrasaction="ignore")
+            writer.writeheader()
+            self._writers[trial.trial_id] = writer
+        self._writers[trial.trial_id].writerow(
+            {k: v for k, v in flat.items()})
+        self._files[trial.trial_id].flush()
+
+    def log_trial_end(self, trial) -> None:
+        fh = self._files.pop(trial.trial_id, None)
+        self._writers.pop(trial.trial_id, None)
+        if fh:
+            fh.close()
+
+
+class TBXLoggerCallback(LoggerCallback):
+    """TensorBoard scalars via tensorboardX (or torch.utils.tensorboard).
+    Gated: raises a clear ImportError if neither backend is available."""
+
+    def __init__(self):
+        self._writer_cls = None
+        try:
+            from tensorboardX import SummaryWriter  # type: ignore
+            self._writer_cls = SummaryWriter
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writer_cls = SummaryWriter
+            except ImportError:
+                raise ImportError(
+                    "TBXLoggerCallback needs `tensorboardX` or torch's "
+                    "tensorboard; neither is installed. Use "
+                    "CSVLoggerCallback/JsonLoggerCallback instead.")
+        self._writers: Dict[str, object] = {}
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        if trial.trial_id not in self._writers:
+            self._writers[trial.trial_id] = self._writer_cls(
+                logdir=trial.local_dir)
+        w = self._writers[trial.trial_id]
+        step = result.get("training_iteration", 0)
+        for k, v in _flatten(result).items():
+            if isinstance(v, numbers.Number):
+                w.add_scalar(k, v, global_step=step)
+        w.flush()
+
+    def log_trial_end(self, trial) -> None:
+        w = self._writers.pop(trial.trial_id, None)
+        if w:
+            w.close()
+
+
+DEFAULT_LOGGERS = (JsonLoggerCallback, CSVLoggerCallback)

@@ -26,6 +26,7 @@ class ResultGrid:
             path=trial.local_dir,
             error=(RuntimeError(trial.error_msg)
                    if trial.error_msg else None),
+            config=trial.config,
         )
 
     def __len__(self) -> int:

@@ -6,11 +6,12 @@ from ray_tpu.tune.schedulers.trial_scheduler import (
     FIFOScheduler, TrialScheduler)
 from ray_tpu.tune.schedulers.async_hyperband import (
     ASHAScheduler, AsyncHyperBandScheduler)
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
 __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
-    "AsyncHyperBandScheduler", "MedianStoppingRule",
+    "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
 ]

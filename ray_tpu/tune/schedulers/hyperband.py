@@ -1,0 +1,156 @@
+"""HyperBand — synchronous successive halving in brackets (reference:
+python/ray/tune/schedulers/hyperband.py HyperBandScheduler; Li 2016).
+
+Unlike ASHA (async_hyperband.py) which promotes/stops trials the moment
+they report, HyperBand synchronizes each bracket at its rung milestone:
+trials PAUSE when they reach the current milestone and the controller
+holds them (via the ``may_resume`` protocol) until every live trial of the
+bracket has reported; then the top 1/eta continue from their checkpoints
+and the rest stop.
+
+Bracket sizing follows Li 2016: bracket s (of s_max..0) admits
+``n_s = ceil((s_max+1)/(s+1) * eta^s)`` trials starting at budget
+``max_t / eta^s``; new trials fill the current bracket and roll over to
+the next template when it's full.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class _SyncBracket:
+    def __init__(self, rung_iters: List[int], quota: int, eta: float):
+        self.rung_iters = rung_iters  # cumulative iteration milestones
+        self.quota = quota
+        self.eta = eta
+        self.rung = 0
+        self.trial_ids: List[str] = []
+        self.scores: Dict[str, float] = {}   # scores at the current rung
+        self.waiting: set = set()            # reached milestone, held
+        self.dropped: set = set()
+
+    @property
+    def milestone(self) -> Optional[int]:
+        return (self.rung_iters[self.rung]
+                if self.rung < len(self.rung_iters) else None)
+
+    @property
+    def full(self) -> bool:
+        return len(self.trial_ids) >= self.quota
+
+    def live(self) -> List[str]:
+        return [t for t in self.trial_ids if t not in self.dropped]
+
+    def all_reported(self) -> bool:
+        live = self.live()
+        return bool(live) and all(t in self.waiting for t in live)
+
+    def cut(self) -> List[str]:
+        """Close the rung: return trial ids to STOP; survivors unheld."""
+        live = self.live()
+        keep_n = max(1, int(len(live) / self.eta))
+        ranked = sorted(live, key=lambda t: self.scores.get(
+            t, float("-inf")), reverse=True)
+        stop = ranked[keep_n:]
+        self.dropped.update(stop)
+        self.rung += 1
+        self.scores.clear()
+        self.waiting.clear()
+        return stop
+
+
+class HyperBandScheduler(TrialScheduler):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None, *,
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # bracket templates, most-aggressive first: (rung milestones, quota)
+        s_max = int(math.log(max_t) / math.log(self.eta))
+        self._templates: List[tuple] = []
+        for s in range(s_max, -1, -1):
+            rungs = [int(round(max_t / (self.eta ** i)))
+                     for i in range(s, -1, -1)]
+            quota = int(math.ceil(
+                (s_max + 1) / (s + 1) * (self.eta ** s)))
+            self._templates.append((rungs, quota))
+        self._brackets: List[_SyncBracket] = []
+        self._next_template = 0
+        self._trial_bracket: Dict[str, _SyncBracket] = {}
+
+    # ------------------------------------------------------------ protocol
+    def may_resume(self, trial) -> bool:
+        """Controller hook: a PAUSED trial stays held while its bracket
+        rung is still filling."""
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return True
+        return trial.trial_id not in bracket.waiting
+
+    # ----------------------------------------------------------- lifecycle
+    def on_trial_add(self, controller, trial) -> None:
+        if not self._brackets or self._brackets[-1].full:
+            rungs, quota = self._templates[
+                self._next_template % len(self._templates)]
+            self._brackets.append(_SyncBracket(list(rungs), quota,
+                                               self.eta))
+            self._next_template += 1
+        bracket = self._brackets[-1]
+        bracket.trial_ids.append(trial.trial_id)
+        self._trial_bracket[trial.trial_id] = bracket
+
+    def _cut_if_ready(self, controller, bracket,
+                      reporting_trial=None) -> str:
+        """When every live bracket member reached the milestone, close the
+        rung: early-stop the laggards, release the survivors."""
+        if not bracket.all_reported():
+            return TrialScheduler.PAUSE
+        stop_ids = bracket.cut()
+        for other in controller.live_trials():
+            if other.trial_id in stop_ids and other is not reporting_trial:
+                controller._complete_trial(  # noqa: SLF001
+                    other, other.last_result, early_stopped=True)
+        if reporting_trial is not None and \
+                reporting_trial.trial_id in stop_ids:
+            return TrialScheduler.STOP
+        return TrialScheduler.CONTINUE
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return TrialScheduler.CONTINUE
+        t = result.get(self.time_attr, 0)
+        milestone = bracket.milestone
+        if milestone is None:
+            # past the last rung: the bracket's budget is spent at max_t
+            return (TrialScheduler.STOP if t >= self.max_t
+                    else TrialScheduler.CONTINUE)
+        if t < milestone:
+            return TrialScheduler.CONTINUE
+        bracket.scores[trial.trial_id] = self._score(result)
+        bracket.waiting.add(trial.trial_id)
+        return self._cut_if_ready(controller, bracket,
+                                  reporting_trial=trial)
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket:
+            bracket.dropped.add(trial.trial_id)
+            bracket.waiting.discard(trial.trial_id)
+            # a finished/errored member must not deadlock the barrier
+            if bracket.live():
+                self._cut_if_ready(controller, bracket)
+
+    def on_trial_error(self, controller, trial) -> None:
+        self.on_trial_complete(controller, trial, trial.last_result or {})
+
+    def debug_string(self) -> str:
+        return (f"HyperBand: {len(self._brackets)} brackets, "
+                f"eta={self.eta}, max_t={self.max_t}")

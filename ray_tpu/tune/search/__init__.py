@@ -1,4 +1,7 @@
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.hyperopt import HyperOptSearch
+from ray_tpu.tune.search.optuna import OptunaSearch
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
 
-__all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator"]
+__all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator",
+           "OptunaSearch", "HyperOptSearch"]

@@ -1,0 +1,84 @@
+"""HyperOptSearch adapter (reference: python/ray/tune/search/hyperopt/
+hyperopt_search.py). Gated: `hyperopt` is not in this image's baked
+package set — construction raises a clear ImportError."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class HyperOptSearch(Searcher):
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 n_initial_points: int = 20, random_state_seed: int = 0,
+                 **kwargs):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires `hyperopt`, which is not "
+                "installed in this environment. Use "
+                "BasicVariantGenerator (random/grid) instead.") from e
+        super().__init__(metric, mode)
+        import numpy as np
+        from hyperopt import hp
+
+        self._hp_space = {}
+        for k, dom in (space or {}).items():
+            if isinstance(dom, Categorical):
+                self._hp_space[k] = hp.choice(k, list(dom.categories))
+            elif isinstance(dom, Integer):
+                self._hp_space[k] = hp.uniformint(k, dom.lower,
+                                                  dom.upper - 1)
+            elif isinstance(dom, Float):
+                if getattr(dom, "log", False):
+                    self._hp_space[k] = hp.loguniform(
+                        k, np.log(dom.lower), np.log(dom.upper))
+                else:
+                    self._hp_space[k] = hp.uniform(k, dom.lower, dom.upper)
+            else:
+                self._hp_space[k] = dom
+        import hyperopt
+
+        self._domain = hyperopt.Domain(lambda c: 0, self._hp_space)
+        self._hpopt_trials = hyperopt.Trials()
+        self._rng = np.random.default_rng(random_state_seed)
+        self._n_initial = n_initial_points
+        self._tid_map: Dict[str, int] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        import hyperopt
+
+        new_id = len(self._hpopt_trials.trials)
+        seed = int(self._rng.integers(2 ** 31 - 1))
+        if new_id < self._n_initial:
+            new = hyperopt.rand.suggest([new_id], self._domain,
+                                        self._hpopt_trials, seed)
+        else:
+            new = hyperopt.tpe.suggest([new_id], self._domain,
+                                       self._hpopt_trials, seed)
+        self._hpopt_trials.insert_trial_docs(new)
+        self._hpopt_trials.refresh()
+        self._tid_map[trial_id] = new_id
+        vals = {k: v[0] for k, v in new[0]["misc"]["vals"].items() if v}
+        return hyperopt.space_eval(self._hp_space, vals)
+
+    def on_trial_complete(self, trial_id, result=None,
+                          error: bool = False) -> None:
+        import hyperopt
+
+        tid = self._tid_map.pop(trial_id, None)
+        if tid is None:
+            return
+        trial = self._hpopt_trials.trials[tid]
+        if error or not result or self.metric not in result:
+            trial["state"] = hyperopt.JOB_STATE_ERROR
+        else:
+            val = float(result[self.metric])
+            loss = -val if self.mode == "max" else val
+            trial["state"] = hyperopt.JOB_STATE_DONE
+            trial["result"] = {"loss": loss, "status": "ok"}
+        self._hpopt_trials.refresh()

@@ -1,0 +1,61 @@
+"""OptunaSearch adapter (reference: python/ray/tune/search/optuna/
+optuna_search.py). Gated: `optuna` is not in this image's baked package
+set, so construction raises a clear ImportError; the adapter logic below
+activates when optuna is importable."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class OptunaSearch(Searcher):
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None,
+                 mode: Optional[str] = None, seed: int = 0, **kwargs):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires `optuna`, which is not installed "
+                "in this environment. Use BasicVariantGenerator (random/"
+                "grid) or HyperOptSearch where available.") from e
+        super().__init__(metric, mode)
+        import optuna
+
+        self._space = space or {}
+        self._study = optuna.create_study(
+            direction="maximize" if (mode or "max") == "max" else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=seed))
+        self._trials: Dict[str, "optuna.trial.Trial"] = {}
+
+    def _suggest_param(self, ot, name, dom):
+        if isinstance(dom, Categorical):
+            return ot.suggest_categorical(name, list(dom.categories))
+        if isinstance(dom, Integer):
+            return ot.suggest_int(name, dom.lower, dom.upper - 1)
+        if isinstance(dom, Float):
+            if getattr(dom, "log", False):
+                return ot.suggest_float(name, dom.lower, dom.upper, log=True)
+            return ot.suggest_float(name, dom.lower, dom.upper)
+        return dom  # constant
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        ot = self._study.ask()
+        self._trials[trial_id] = ot
+        return {k: self._suggest_param(ot, k, v)
+                for k, v in self._space.items()}
+
+    def on_trial_complete(self, trial_id, result=None,
+                          error: bool = False) -> None:
+        import optuna
+
+        ot = self._trials.pop(trial_id, None)
+        if ot is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(ot, state=optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, float(result[self.metric]))

@@ -1,0 +1,145 @@
+"""Stoppers (reference: python/ray/tune/stopper/ — Stopper ABC with
+per-result ``__call__`` and experiment-wide ``stop_all``; the stock
+implementations mirrored here: maximum_iteration, timeout, function,
+trial_plateau, experiment_plateau, combined, noop).
+
+``RunConfig(stop=...)`` accepts a dict, a callable, or a Stopper.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict, deque
+from typing import Callable, Dict, Optional
+
+
+class Stopper:
+    """Decides per-result whether a trial stops; ``stop_all`` ends the
+    whole experiment."""
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class NoopStopper(Stopper):
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return False
+
+
+class FunctionStopper(Stopper):
+    def __init__(self, function: Callable[[str, Dict], bool]):
+        self._fn = function
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return bool(self._fn(trial_id, result))
+
+
+class MaximumIterationStopper(Stopper):
+    def __init__(self, max_iter: int):
+        self._max_iter = max_iter
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return result.get("training_iteration", 0) >= self._max_iter
+
+
+class TimeoutStopper(Stopper):
+    """Stops the whole experiment after a wall-clock budget."""
+
+    def __init__(self, timeout: float):
+        self._deadline = time.monotonic() + timeout
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return False
+
+    def stop_all(self) -> bool:
+        return time.monotonic() >= self._deadline
+
+
+class TrialPlateauStopper(Stopper):
+    """Stops a trial whose metric stopped moving: std of the last
+    ``num_results`` values below ``std`` (after ``grace_period`` results)."""
+
+    def __init__(self, metric: str, *, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 metric_threshold: Optional[float] = None,
+                 mode: str = "min"):
+        self._metric = metric
+        self._std = std
+        self._num_results = num_results
+        self._grace = grace_period
+        self._threshold = metric_threshold
+        self._mode = mode
+        self._history: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=num_results))
+        self._count: Dict[str, int] = defaultdict(int)
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        import numpy as np
+
+        v = result.get(self._metric)
+        if v is None:
+            return False
+        self._history[trial_id].append(float(v))
+        self._count[trial_id] += 1
+        if self._count[trial_id] < max(self._grace, self._num_results):
+            return False
+        if self._threshold is not None:
+            ok = (v > self._threshold if self._mode == "max"
+                  else v < self._threshold)
+            if not ok:
+                return False
+        return float(np.std(self._history[trial_id])) < self._std
+
+
+class ExperimentPlateauStopper(Stopper):
+    """Stops everything when the experiment plateaued: the std of the
+    ``top`` best values of ``metric`` seen so far is below ``std`` for
+    more than ``patience`` consecutive results (reference:
+    tune/stopper/experiment_plateau.py semantics)."""
+
+    def __init__(self, metric: str, *, std: float = 0.001,
+                 top: int = 10, mode: str = "min", patience: int = 0):
+        self._metric = metric
+        self._mode = mode
+        self._top = top
+        self._std = std
+        self._patience = patience
+        self._top_values: list = []
+        self._stale = 0
+        self._stop_all = False
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        import numpy as np
+
+        v = result.get(self._metric)
+        if v is None:
+            return False
+        v = float(v) if self._mode == "max" else -float(v)
+        self._top_values.append(v)
+        self._top_values = sorted(self._top_values,
+                                  reverse=True)[:self._top]
+        if len(self._top_values) == self._top and \
+                float(np.std(self._top_values)) < self._std:
+            self._stale += 1
+            if self._stale > self._patience:
+                self._stop_all = True
+        else:
+            self._stale = 0
+        return False
+
+    def stop_all(self) -> bool:
+        return self._stop_all
+
+
+class CombinedStopper(Stopper):
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return any(s(trial_id, result) for s in self._stoppers)
+
+    def stop_all(self) -> bool:
+        return any(s.stop_all() for s in self._stoppers)

@@ -1,0 +1,82 @@
+"""Data IO extensions: TFRecords (pure-python codec), images,
+iter_torch_batches, from_torch (reference: data/datasource/
+tfrecords_datasource.py, image_datasource.py, iterator.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_tfrecord_roundtrip(ray4, tmp_path):
+    ds = rd.from_items([
+        {"x": float(i), "y": i, "name": f"row{i}"} for i in range(50)])
+    ds.write_tfrecords(str(tmp_path))
+    back = rd.read_tfrecords(str(tmp_path))
+    rows = sorted(back.take_all(), key=lambda r: r["y"])
+    assert len(rows) == 50
+    assert rows[7]["y"] == 7
+    assert abs(rows[7]["x"] - 7.0) < 1e-6
+    assert rows[7]["name"] == b"row7"  # bytes (tf.Example has no str type)
+
+
+def test_tfrecord_negative_ints_and_lists(tmp_path):
+    from ray_tpu.data._internal.tfrecord import (
+        read_tfrecord_file, write_tfrecord_file)
+
+    rows = [{"a": np.array([-5, 3], np.int64),
+             "f": np.array([0.5, -1.5], np.float32)}]
+    write_tfrecord_file(str(tmp_path / "t.tfrecord"), iter(rows))
+    got = list(read_tfrecord_file(str(tmp_path / "t.tfrecord")))[0]
+    assert (got["a"] == [-5, 3]).all()
+    assert np.allclose(got["f"], [0.5, -1.5])
+
+
+def test_read_images(ray4, tmp_path):
+    from PIL import Image
+
+    for i in range(4):
+        arr = np.full((8, 10, 3), i * 10, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path), size=(4, 5))
+    batch = ds.take_batch(4)
+    assert batch["image"].shape == (4, 4, 5, 3)
+    vals = sorted(int(im.mean()) for im in batch["image"])
+    assert vals == [0, 10, 20, 30]
+
+
+def test_iter_torch_batches(ray4):
+    import torch
+
+    ds = rd.range(100)
+    total = 0
+    for batch in ds.iter_torch_batches(batch_size=32):
+        assert isinstance(batch["id"], torch.Tensor)
+        total += len(batch["id"])
+    assert total == 100
+
+
+def test_from_torch(ray4):
+    import torch
+
+    class TinySet(torch.utils.data.Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), i % 2
+
+    ds = rd.from_torch(TinySet())
+    assert ds.count() == 10
+    rows = ds.take(3)
+    assert rows[1]["label"] in (0, 1)
+    assert rows[1]["item"].shape == (3,)

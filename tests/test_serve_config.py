@@ -1,0 +1,120 @@
+"""Serve declarative config: schema round-trip, build(), run_config(),
+dashboard REST deploy (reference: serve/schema.py + `serve build/deploy`
+CLI + dashboard serve REST)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+# module-level so import_path resolution can find it
+@serve.deployment(name="Doubler", num_replicas=1)
+class Doubler:
+    def __call__(self, x):
+        return x * 2
+
+
+doubler_app = Doubler.bind()
+
+
+def make_app(factor: int = 3):
+    @serve.deployment(name="Scaler")
+    class Scaler:
+        def __call__(self, x):
+            return x * factor
+
+    return Scaler.bind()
+
+
+def test_schema_roundtrip():
+    d = serve.build(doubler_app, name="roundtrip", route_prefix="/x",
+                    import_path="tests.test_serve_config:doubler_app")
+    schema = serve.ServeDeploySchema.from_dict(d)
+    assert schema.applications[0].name == "roundtrip"
+    assert schema.applications[0].route_prefix == "/x"
+    assert schema.applications[0].deployments[0].name == "Doubler"
+    assert schema.to_dict() == d
+
+
+def test_run_config_import_path(ray4):
+    config = {
+        "applications": [{
+            "import_path": "tests.test_serve_config:doubler_app",
+            "name": "cfgapp",
+            "route_prefix": "/double",
+            "deployments": [{"name": "Doubler", "num_replicas": 2}],
+        }],
+    }
+    handles = serve.run_config(config)
+    h = handles["cfgapp"]
+    assert h.remote(21).result(timeout_s=60) == 42
+    st = serve.status("cfgapp")
+    assert st["status"] == "RUNNING"
+    # the override took: 2 replicas
+    assert st["deployments"]["Doubler"]["target_replicas"] == 2
+    serve.delete("cfgapp")
+
+
+def test_run_config_app_builder(ray4):
+    """import_path resolving to a builder function taking args."""
+    config = {
+        "applications": [{
+            "import_path": "tests.test_serve_config:make_app",
+            "name": "builderapp",
+            "route_prefix": "/scale",
+            "args": {"factor": 5},
+        }],
+    }
+    handles = serve.run_config(config)
+    assert handles["builderapp"].remote(4).result(timeout_s=60) == 20
+    serve.delete("builderapp")
+
+
+def test_dashboard_serve_rest(ray4):
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard(port=0)
+    config = {
+        "applications": [{
+            "import_path": "tests.test_serve_config:doubler_app",
+            "name": "restapp",
+            "route_prefix": "/rest",
+        }],
+    }
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/serve/applications",
+        data=json.dumps(config).encode(), method="PUT",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+    # poll status via GET until RUNNING
+    import time
+    deadline = time.monotonic() + 60
+    apps = {}
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/serve/applications",
+                timeout=30) as resp:
+            apps = json.loads(resp.read())["applications"]
+        if apps.get("restapp", {}).get("status") == "RUNNING":
+            break
+        time.sleep(0.5)
+    assert apps["restapp"]["status"] == "RUNNING"
+    assert apps["restapp"]["ingress"] == "Doubler"
+    serve.delete("restapp")

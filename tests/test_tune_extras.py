@@ -1,0 +1,200 @@
+"""Tune stoppers / loggers / HyperBand / gated searchers (reference:
+python/ray/tune/tests/test_trial_scheduler.py + tests of tune/stopper and
+tune/logger)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------------- stoppers
+def test_maximum_iteration_stopper():
+    s = tune.MaximumIterationStopper(3)
+    assert not s("t", {"training_iteration": 2})
+    assert s("t", {"training_iteration": 3})
+
+
+def test_trial_plateau_stopper():
+    s = tune.TrialPlateauStopper("loss", std=0.01, num_results=3,
+                                 grace_period=3)
+    assert not s("t", {"loss": 1.0})
+    assert not s("t", {"loss": 0.5})
+    assert not s("t", {"loss": 0.5})  # grace period just met; std high
+    assert s("t", {"loss": 0.5})     # window now flat
+    # different trial: independent history
+    assert not s("u", {"loss": 0.5})
+
+
+def test_combined_and_function_stopper():
+    s = tune.CombinedStopper(
+        tune.FunctionStopper(lambda tid, r: r.get("x", 0) > 10),
+        tune.MaximumIterationStopper(100))
+    assert s("t", {"x": 11})
+    assert not s("t", {"x": 1})
+    assert not s.stop_all()
+
+
+def test_timeout_stopper_stops_all():
+    s = tune.TimeoutStopper(-1.0)  # already expired
+    assert s.stop_all()
+
+
+def test_experiment_plateau_stopper():
+    s = tune.ExperimentPlateauStopper("score", mode="max", top=2,
+                                      patience=1)
+    s("t", {"score": 1.0})
+    assert not s.stop_all()  # top-k not yet full
+    s("t", {"score": 1.0})
+    s("t", {"score": 1.0})
+    assert s.stop_all()
+    # improving metric resets staleness
+    s2 = tune.ExperimentPlateauStopper("score", mode="max", top=2,
+                                       patience=1)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        s2("t", {"score": v})
+    assert not s2.stop_all()
+
+
+# --------------------------------------------------- stopper + loggers e2e
+def _train_fn(config):
+    for i in range(20):
+        tune.report({"score": (i + 1) * config["m"], "loss": 1.0 / (i + 1)})
+
+
+def test_stopper_and_default_loggers_e2e(ray4, tmp_path):
+    tuner = tune.Tuner(
+        _train_fn,
+        param_space={"m": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="stop_e2e",
+            stop=tune.MaximumIterationStopper(5)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    for result in grid:
+        assert result.metrics["training_iteration"] == 5
+    # default loggers wrote result.json / progress.csv / params.json
+    exp_dir = os.path.join(str(tmp_path), "stop_e2e")
+    trial_dirs = [d for d in os.listdir(exp_dir)
+                  if os.path.isdir(os.path.join(exp_dir, d))]
+    assert trial_dirs
+    found_json = found_csv = False
+    for d in trial_dirs:
+        p = os.path.join(exp_dir, d)
+        if os.path.exists(os.path.join(p, "result.json")):
+            found_json = True
+            lines = [json.loads(ln) for ln in
+                     open(os.path.join(p, "result.json")) if ln.strip()]
+            assert len(lines) == 5
+            assert "score" in lines[0]
+        if os.path.exists(os.path.join(p, "progress.csv")):
+            found_csv = True
+    assert found_json and found_csv
+
+
+def test_custom_callback_hooks(ray4, tmp_path):
+    events = []
+
+    class Recorder(tune.Callback):
+        def on_trial_start(self, it, trials, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, it, trials, trial, result):
+            events.append(("result", trial.trial_id))
+
+        def on_trial_complete(self, it, trials, trial):
+            events.append(("complete", trial.trial_id))
+
+    tuner = tune.Tuner(
+        _train_fn, param_space={"m": 1},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="cb",
+                             stop={"training_iteration": 3},
+                             callbacks=[Recorder()]),
+    )
+    tuner.fit()
+    kinds = [e[0] for e in events]
+    assert "start" in kinds and "result" in kinds and "complete" in kinds
+
+
+# ---------------------------------------------------------------- hyperband
+def test_hyperband_stops_bad_trials(ray4, tmp_path):
+    def trainable(config):
+        # checkpoint-aware: HyperBand pauses/resumes trials at rung
+        # barriers, so loop progress must survive the restart
+        import json
+        import os as _os
+        import tempfile
+
+        from ray_tpu.train._checkpoint import Checkpoint
+
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            with open(_os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["i"] + 1
+        for i in range(start, 30):
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "state.json"), "w") as f:
+                json.dump({"i": i}, f)
+            tune.report({"score": config["q"] * (i + 1)},
+                        checkpoint=Checkpoint(d))
+
+    sched = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1, 2, 3, 4, 5, 6])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched),
+        run_config=RunConfig(storage_path=str(tmp_path), name="hb",
+                             stop={"training_iteration": 9}),
+    )
+    grid = tuner.fit()
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in grid)
+    # successive halving must have early-stopped at least one trial
+    assert iters[0] < 9
+    # and the best (q=6) trial must have survived to the end
+    best = max(grid, key=lambda r: r.metrics.get("score", -1))
+    assert best.config["q"] == 6
+    assert best.metrics["training_iteration"] == 9
+
+
+# ----------------------------------------------------------- gated searchers
+def test_gated_searchers_raise_cleanly():
+    with pytest.raises(ImportError, match="optuna"):
+        tune.search.OptunaSearch({"lr": tune.uniform(0, 1)})
+    with pytest.raises(ImportError, match="hyperopt"):
+        tune.search.HyperOptSearch({"lr": tune.uniform(0, 1)})
+
+
+def test_tbx_logger_gated():
+    try:
+        import tensorboardX  # noqa: F401
+        has = True
+    except ImportError:
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # noqa: F401
+            has = True
+        except ImportError:
+            has = False
+    if has:
+        tune.TBXLoggerCallback()
+    else:
+        with pytest.raises(ImportError):
+            tune.TBXLoggerCallback()
