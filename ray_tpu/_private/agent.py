@@ -140,21 +140,11 @@ class NodeAgent:
         await self.server.start_unix(self.unix_path)
         self.tcp_port = await self.server.start_tcp("0.0.0.0", 0)
         self.server.set_disconnect_handler(self._on_disconnect)
-        await self.head.connect_tcp(self.head_host, self.head_port)
-        self.head.set_push_handler(self._on_head_push)
-        reply = await self.head.call(
-            "RegisterNode",
-            {
-                "node_id": self.node_id,
-                "addr": {"host": "127.0.0.1", "port": self.tcp_port},
-                "resources": self.resources.to_wire(),
-            },
-        )
-        CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
-        self.cluster_view = reply.get("cluster_view", {})
+        await self._connect_head()
         loop = asyncio.get_running_loop()
         loop.create_task(self._resource_report_loop())
         loop.create_task(self._worker_reaper_loop())
+        loop.create_task(self._head_watchdog_loop())
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             from ray_tpu._private.log_monitor import LogMonitor
 
@@ -221,6 +211,49 @@ class NodeAgent:
             self._spawn_worker()
 
     # ------------------------------------------------------------ head link
+    async def _connect_head(self) -> None:
+        await self.head.connect_tcp(self.head_host, self.head_port)
+        self.head.set_push_handler(self._on_head_push)
+        reply = await self.head.call(
+            "RegisterNode",
+            {
+                "node_id": self.node_id,
+                "addr": {"host": "127.0.0.1", "port": self.tcp_port},
+                "resources": self.resources.to_wire(),
+            },
+        )
+        CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
+        self.cluster_view = reply.get("cluster_view", {})
+        self._resources_dirty = True
+
+    async def _head_watchdog_loop(self) -> None:
+        """Survive a head restart (reference: GCS fault tolerance —
+        NotifyGCSRestart + raylet resubscribe, node_manager.proto:364):
+        ping the head; on failure reconnect with backoff and re-register
+        under the same node_id so leases/actors on this node carry over."""
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                await asyncio.wait_for(self.head.call("Ping", {}),
+                                       timeout=5.0)
+                continue
+            except Exception:
+                pass
+            delay = 0.2
+            while True:
+                try:
+                    self.head.close()
+                except Exception:
+                    pass
+                try:
+                    # reconnect in place: connect_tcp replaces the broken
+                    # stream and restarts the read loop on self.head
+                    await self._connect_head()
+                    break
+                except Exception:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+
     async def _on_head_push(self, method: str, payload: Any) -> None:
         if method == "ClusterView":
             self.cluster_view = payload

@@ -81,7 +81,8 @@ class ActorInfo:
 class HeadServer:
     """The cluster brain. All state lives here; agents and drivers connect in."""
 
-    def __init__(self, session_dir: str, port: int = 0):
+    def __init__(self, session_dir: str, port: int = 0,
+                 persist_path: Optional[str] = None):
         self.session_dir = session_dir
         self.port = port
         self.server = RpcServer("head")
@@ -95,10 +96,95 @@ class HeadServer:
         self.task_events: List[Dict] = []  # ring buffer of task state transitions
         self.cluster_config = CONFIG.snapshot()
         self._pg_counter = 0
+        # GCS fault tolerance (reference: RedisStoreClient-backed HA,
+        # gcs_server.cc:522-535): durable state snapshots to a file; a
+        # restarted head with the same path resumes KV/jobs/actors/PGs
+        # while agents + drivers re-register through their watchdogs
+        # (NodeManagerService.NotifyGCSRestart analog).
+        self.persist_path = persist_path
+        self._save_pending = False
+        self._driver_conns: Dict[Optional[str], Connection] = {}
+        if persist_path:
+            self._load_state()
         # Strong refs to background tasks: the loop only holds weak refs, so
         # an unreferenced retry task can be GC'd mid-flight (asyncio docs).
         self._bg_tasks: set = set()
         self._register_routes()
+
+    # ------------------------------------------------------- persistence
+    def _load_state(self) -> None:
+        import pickle
+
+        if not os.path.exists(self.persist_path):
+            return
+        with open(self.persist_path, "rb") as f:
+            state = pickle.load(f)
+        self.kv = state.get("kv", {})
+        self.jobs = state.get("jobs", {})
+        self.named_actors = {tuple(k): v for k, v in
+                             state.get("named_actors", [])}
+        self.placement_groups = state.get("placement_groups", {})
+        self._pg_counter = state.get("pg_counter", 0)
+        for rec in state.get("actors", []):
+            info = ActorInfo(rec["actor_id"], rec["spec_wire"],
+                             rec["name"], rec["namespace"],
+                             rec["max_restarts"], None)
+            info.state = rec["state"]
+            info.addr = rec["addr"]
+            info.node_id = rec["node_id"]
+            info.num_restarts = rec["num_restarts"]
+            self.actors[rec["actor_id"]] = info
+
+    def _schedule_save(self) -> None:
+        if not self.persist_path or self._save_pending:
+            return
+        self._save_pending = True
+        loop = asyncio.get_running_loop()
+        loop.call_later(
+            0.05, lambda: self._hold_task(loop.create_task(
+                self._save_state_async())))
+
+    def _snapshot(self) -> Dict:
+        """Shallow-copied state snapshot, built on the loop thread so the
+        (possibly large) pickle+write can run off-loop without racing
+        concurrent mutation."""
+        return {
+            "kv": {ns: dict(table) for ns, table in self.kv.items()},
+            "jobs": {k: dict(v) for k, v in self.jobs.items()},
+            "named_actors": [[list(k), v]
+                             for k, v in self.named_actors.items()],
+            "placement_groups": {k: dict(v)
+                                 for k, v in self.placement_groups.items()},
+            "pg_counter": self._pg_counter,
+            "actors": [
+                {"actor_id": a.actor_id, "spec_wire": a.spec_wire,
+                 "name": a.name, "namespace": a.namespace,
+                 "max_restarts": a.max_restarts,
+                 "state": a.state, "addr": a.addr, "node_id": a.node_id,
+                 "num_restarts": a.num_restarts}
+                for a in self.actors.values()
+            ],
+        }
+
+    async def _save_state_async(self) -> None:
+        self._save_pending = False
+        if not self.persist_path:
+            return
+        state = self._snapshot()
+        await asyncio.to_thread(self._write_snapshot, state)
+
+    def _write_snapshot(self, state: Dict) -> None:
+        import pickle
+
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self.persist_path)
+
+    def _save_state(self) -> None:
+        """Synchronous save (shutdown/teardown paths)."""
+        if self.persist_path:
+            self._write_snapshot(self._snapshot())
 
     def _hold_task(self, task: "asyncio.Task") -> "asyncio.Task":
         self._bg_tasks.add(task)
@@ -145,6 +231,10 @@ class HeadServer:
         r("RegisterJob", self._register_job)
         r("ListJobs", self._list_jobs)
         r("DrainNode", self._drain_node)
+        r("Ping", self._ping)
+
+    async def _ping(self, conn, p) -> Dict:
+        return {"ok": True}
 
     # ------------------------------------------------------ node membership
     async def _register_node(self, conn: Connection, p: Dict) -> Dict:
@@ -160,11 +250,26 @@ class HeadServer:
 
     async def _register_driver(self, conn: Connection, p: Dict) -> Dict:
         conn.meta["role"] = "driver"
-        conn.meta["job_id"] = p.get("job_id")
-        self.jobs[p.get("job_id", "")] = {
-            "job_id": p.get("job_id"), "start_time": time.time(), "state": "RUNNING",
-            "entrypoint": p.get("entrypoint", ""),
-        }
+        job_id = p.get("job_id")
+        conn.meta["job_id"] = job_id
+        # re-registration (driver watchdog after a head restart / link
+        # blip): move actor ownership onto the new connection so the old
+        # connection's disconnect can't reap them
+        old_conn = self._driver_conns.get(job_id)
+        if old_conn is not None and old_conn is not conn:
+            for actor in self.actors.values():
+                if actor.owner_conn is old_conn:
+                    actor.owner_conn = conn
+        self._driver_conns[job_id] = conn
+        existing = self.jobs.get(job_id or "")
+        if existing is not None and existing.get("state") == "RUNNING":
+            pass  # keep original start_time on re-register
+        else:
+            self.jobs[job_id or ""] = {
+                "job_id": job_id, "start_time": time.time(),
+                "state": "RUNNING", "entrypoint": p.get("entrypoint", ""),
+            }
+        self._schedule_save()
         return {"cluster_config": self.cluster_config,
                 "cluster_view": self._cluster_view()}
 
@@ -235,17 +340,25 @@ class HeadServer:
                     await node.conn.push("ClusterView", view)
 
     async def _on_disconnect(self, conn: Connection) -> None:
+        # identity checks: a watchdog reconnect replaces the registered
+        # connection; the stale connection's disconnect must not kill the
+        # freshly re-registered node/driver
         node_id = conn.meta.get("node_id")
-        if node_id and node_id in self.nodes:
+        if node_id and node_id in self.nodes and \
+                self.nodes[node_id].conn is conn:
             await self._mark_node_dead(self.nodes[node_id], "agent disconnected")
         if conn.meta.get("role") == "driver":
             job_id = conn.meta.get("job_id")
-            if job_id in self.jobs:
-                self.jobs[job_id]["state"] = "FINISHED"
-            # Non-detached actors owned by this driver die with it.
-            for actor in list(self.actors.values()):
-                if actor.owner_conn is conn and not actor.detached and actor.state != ACTOR_DEAD:
-                    await self._kill_actor_internal(actor, "owner driver exited")
+            if self._driver_conns.get(job_id) is conn:
+                self._driver_conns.pop(job_id, None)
+                if job_id in self.jobs:
+                    self.jobs[job_id]["state"] = "FINISHED"
+                # Non-detached actors owned by this driver die with it.
+                for actor in list(self.actors.values()):
+                    if actor.owner_conn is conn and not actor.detached \
+                            and actor.state != ACTOR_DEAD:
+                        await self._kill_actor_internal(
+                            actor, "owner driver exited")
         for subs in self.subscribers.values():
             subs.discard(conn)
 
@@ -255,6 +368,7 @@ class HeadServer:
         key = p["key"]
         if p.get("overwrite", True) or key not in ns:
             ns[key] = p["value"]
+            self._schedule_save()
             return True
         return False
 
@@ -267,8 +381,12 @@ class HeadServer:
             keys = [k for k in ns if k.startswith(p["key"])]
             for k in keys:
                 del ns[k]
+            self._schedule_save()
             return len(keys)
-        return 1 if ns.pop(p["key"], None) is not None else 0
+        n = 1 if ns.pop(p["key"], None) is not None else 0
+        if n:
+            self._schedule_save()
+        return n
 
     async def _kv_keys(self, conn, p) -> List[bytes]:
         ns = self.kv.get(p.get("ns", "default"), {})
@@ -297,6 +415,7 @@ class HeadServer:
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
+        self._schedule_save()
         ok = await self._schedule_actor(info)
         if not ok:
             # No feasible node right now; keep PENDING and retry when nodes join
@@ -381,6 +500,7 @@ class HeadServer:
         info.addr = p["addr"]
         info.pid = p.get("pid", 0)
         info.node_id = conn.meta.get("node_id", info.node_id)
+        self._schedule_save()
         await self._publish_event("actor", info.public_view())
 
     async def _actor_died(self, conn: Connection, p: Dict) -> None:
@@ -408,6 +528,7 @@ class HeadServer:
         if (info.namespace, info.name) in self.named_actors:
             if self.named_actors[(info.namespace, info.name)] == info.actor_id:
                 del self.named_actors[(info.namespace, info.name)]
+        self._schedule_save()
         await self._publish_event("actor", info.public_view())
 
     async def _get_actor(self, conn, p) -> Optional[Dict]:
@@ -521,6 +642,7 @@ class HeadServer:
                                      {"pg_id": pg_id, "bundle_index": idx})
             return False
         pg["state"] = "CREATED"
+        self._schedule_save()
         pg["placement"] = placement
         return True
 
@@ -609,6 +731,7 @@ class HeadServer:
                     await node.conn.push("ReturnPGBundle",
                                          {"pg_id": p["pg_id"], "bundle_index": idx})
         pg["state"] = "REMOVED"
+        self._schedule_save()
         return {"ok": True}
 
     async def _get_placement_group(self, conn, p) -> Optional[Dict]:
@@ -634,6 +757,7 @@ class HeadServer:
     # ----------------------------------------------------------------- jobs
     async def _register_job(self, conn, p) -> None:
         self.jobs[p["job_id"]] = p
+        self._schedule_save()
 
     async def _list_jobs(self, conn, p) -> List[Dict]:
         return list(self.jobs.values())
@@ -645,10 +769,13 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--persist", default=os.environ.get(
+        "RAY_TPU_GCS_PERSIST", ""))
     args = parser.parse_args()
 
     async def run():
-        head = HeadServer(args.session_dir, args.port)
+        head = HeadServer(args.session_dir, args.port,
+                          persist_path=args.persist or None)
         port = await head.start()
         # Parent discovers the bound port through this file.
         with open(os.path.join(args.session_dir, "head_port"), "w") as f:

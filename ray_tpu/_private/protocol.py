@@ -211,6 +211,11 @@ class AsyncRpcClient:
             self._pending.clear()
 
     async def call(self, method: str, payload: Any, timeout: Optional[float] = None) -> Any:
+        if not self.connected:
+            # the read loop died (peer gone): a write would be silently
+            # dropped by the dead transport and the reply future would
+            # hang forever — fail fast so callers can retry post-reconnect
+            raise ConnectionLost("not connected")
         self._next_id += 1
         req_id = self._next_id
         fut = asyncio.get_running_loop().create_future()
@@ -234,6 +239,12 @@ class AsyncRpcClient:
         self.connected = False
         if self._read_task:
             self._read_task.cancel()
+        # calls issued after the read loop already died registered futures
+        # nothing will ever resolve; fail them out
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection closed"))
+        self._pending.clear()
         if self._writer:
             try:
                 self._writer.close()

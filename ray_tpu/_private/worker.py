@@ -339,9 +339,20 @@ class Worker:
         self.node_id = reply["node_id"]
         CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
         self.store = make_store_client(reply["store_dir"])
-        head_addr = reply["head_addr"]
+        self._head_addr = reply["head_addr"]
         self.head = AsyncRpcClient()
-        await self.head.connect_tcp(head_addr["host"], head_addr["port"])
+        await self._connect_head()
+        # every process (driver AND executor workers) must survive a head
+        # restart — workers hit the head for actor resolution, pubsub,
+        # task events
+        self._spawn(self._head_watchdog_loop())
+        info = await self.agent.call("GetNodeInfo", {})
+        self.agent_tcp_addr = {"host": node_ip(), "port": info["tcp_port"]}
+        self.ready_event.set()
+
+    async def _connect_head(self) -> None:
+        await self.head.connect_tcp(self._head_addr["host"],
+                                    self._head_addr["port"])
         self.head.set_push_handler(self._on_head_push)
         if self.mode == self.MODE_DRIVER:
             await self.head.call(
@@ -353,9 +364,38 @@ class Worker:
                 # monitors (log_monitor.py) -> "(worker-x) line" output
                 await self.head.call("Subscribe",
                                      {"channels": ["logs:all"]})
-        info = await self.agent.call("GetNodeInfo", {})
-        self.agent_tcp_addr = {"host": node_ip(), "port": info["tcp_port"]}
-        self.ready_event.set()
+
+    async def _head_watchdog_loop(self) -> None:
+        """Driver survives a head restart (GCS fault tolerance): ping, and
+        on failure reconnect + re-register + resubscribe."""
+        # connect() flips self.connected only after _async_connect (which
+        # spawned us) returns — wait for that before monitoring, else the
+        # loop below exits before the runtime is even up
+        for _ in range(600):
+            if self.connected:
+                break
+            await asyncio.sleep(0.1)
+        while self.connected:
+            await asyncio.sleep(2.0)
+            try:
+                await asyncio.wait_for(self.head.call("Ping", {}),
+                                       timeout=5.0)
+                continue
+            except Exception:
+                if not self.connected:
+                    return
+            delay = 0.2
+            while self.connected:
+                try:
+                    self.head.close()
+                except Exception:
+                    pass
+                try:
+                    await self._connect_head()
+                    break
+                except Exception:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
 
     def disconnect(self) -> None:
         if not self.connected:
@@ -952,6 +992,7 @@ class Worker:
             record.streaming_gen._finish(err)
             self._record_task_event(
                 spec, "FINISHED" if not reply.get("error") else "FAILED")
+            self._maybe_drop_streaming_record(record)
             return
         returns = reply.get("returns", [])
         for oid, ret in zip(record.return_ids, returns):
@@ -963,6 +1004,17 @@ class Worker:
             # drop it if every return was inline (nothing to reconstruct).
             if all(r.get("inline") is not None for r in returns):
                 self._tasks.pop(spec.task_id, None)
+
+    def _maybe_drop_streaming_record(self, record: TaskRecord) -> None:
+        """A completed streaming task whose yields were all freed already
+        (the for-loop consumption pattern frees each ref as it goes) gets
+        no later free event to drop its record — check now."""
+        def gone(oid: ObjectID) -> bool:
+            meta = self.reference_counter.get_owned_meta(oid.binary())
+            return meta is None or meta.state == "freed"
+
+        if all(gone(oid) for oid in record.return_ids):
+            self._tasks.pop(record.spec.task_id, None)
 
     def _resolve_return(self, oid: ObjectID, ret: Dict) -> None:
         if ret.get("inline") is not None:
@@ -992,6 +1044,7 @@ class Worker:
                 spec.function_name, str(error))
             record.streaming_gen._finish(err)
             self._record_task_event(spec, "FAILED")
+            self._maybe_drop_streaming_record(record)
             return
         if retriable and record.attempts <= spec.max_retries and not record.cancelled:
             self._record_task_event(spec, "RETRYING")

@@ -11,6 +11,8 @@ Axis conventions (outer → inner, matching ICI locality: the innermost axes
 get the most bandwidth-hungry collectives):
 
 - ``data``   — pure data parallelism (gradient psum; can span DCN)
+- ``stage``  — pipeline parallelism (p2p activation ppermute; low bandwidth,
+  placed outer so inner axes keep the dense-collective ICI links)
 - ``fsdp``   — ZeRO-3 style parameter/optimizer sharding (all-gather weights)
 - ``seq``    — sequence/context parallelism (ring attention ppermute)
 - ``tensor`` — megatron-style tensor parallelism (activation collectives; ICI)
@@ -27,7 +29,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "seq", "tensor", "expert")
+AXIS_ORDER: Tuple[str, ...] = ("data", "stage", "fsdp", "seq", "tensor",
+                               "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,6 +43,7 @@ class MeshConfig:
     """
 
     data: int = -1
+    stage: int = 1
     fsdp: int = 1
     seq: int = 1
     tensor: int = 1

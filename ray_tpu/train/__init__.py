@@ -11,7 +11,9 @@ from ray_tpu.train._checkpoint import (
     save_pytree_orbax)
 from ray_tpu.train._internal.session import TrainContext, get_session, in_session
 from ray_tpu.train.base_trainer import BaseTrainer, Result, TrainingFailedError
+from ray_tpu.train.accelerate import AccelerateTrainer, LightningTrainer
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.jax.config import JaxConfig
 from ray_tpu.train.jax.jax_trainer import JaxTrainer
 
@@ -40,4 +42,6 @@ __all__ = [
     "ScalingConfig", "TrainContext", "TrainingFailedError", "get_checkpoint",
     "get_context", "get_dataset_shard", "report", "save_pytree",
     "load_pytree", "save_pytree_orbax", "load_pytree_orbax",
+    "XGBoostTrainer", "LightGBMTrainer", "AccelerateTrainer",
+    "LightningTrainer",
 ]

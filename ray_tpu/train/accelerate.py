@@ -1,0 +1,68 @@
+"""AccelerateTrainer (reference: python/ray/train/huggingface/
+accelerate/accelerate_trainer.py — runs a HF `accelerate`-driven loop on
+each train worker; the torch backend's process group doubles as
+accelerate's).
+
+Workers call ``accelerate.Accelerator()`` inside their loop; env vars set
+by the torch backend rendezvous (RANK/WORLD_SIZE/MASTER_ADDR) are what
+accelerate reads, so no extra config plumbing is needed on this image's
+CPU/gloo path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.torch.config import TorchConfig
+from ray_tpu.train.torch.torch_trainer import TorchTrainer
+
+
+class AccelerateTrainer(TorchTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict], None],
+        *,
+        train_loop_config: Optional[Dict] = None,
+        accelerate_config: Optional[Dict] = None,
+        torch_config: Optional[TorchConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        try:
+            import accelerate  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "AccelerateTrainer requires the `accelerate` package"
+            ) from e
+        cfg = dict(train_loop_config or {})
+        if accelerate_config:
+            cfg["_accelerate_config"] = accelerate_config
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=cfg,
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            datasets=datasets,
+        )
+
+
+class LightningTrainer(TorchTrainer):
+    """Gated stub: `lightning` is not in this image's baked package set
+    (reference: train/lightning/lightning_trainer.py + the
+    RayDDPStrategy/RayFSDPStrategy utilities)."""
+
+    def __init__(self, *args, **kwargs):
+        try:
+            import lightning  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "LightningTrainer requires `lightning`, which is not "
+                "installed in this environment. Use TorchTrainer with a "
+                "plain torch loop, or JaxTrainer on TPU.") from e
+        super().__init__(*args, **kwargs)

@@ -1,0 +1,116 @@
+"""GBDT trainers (reference: python/ray/train/gbdt_trainer.py:98 —
+XGBoostTrainer / LightGBMTrainer running on xgboost-ray/lightgbm-ray
+actors).
+
+Gated: neither ``xgboost`` nor ``lightgbm`` is in this image's baked
+package set. When the library IS importable, training runs single-process
+on the worker group's rank-0 actor (distributed tree building needs the
+library's own rabit/network layer, out of scope here); otherwise
+construction raises a clear ImportError naming the missing dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.air import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.base_trainer import BaseTrainer, Result
+
+
+class _GBDTTrainer(BaseTrainer):
+    _lib_name = ""
+    _lib_hint = ""
+
+    def __init__(
+        self,
+        *,
+        datasets: Dict[str, Any],
+        label_column: str,
+        params: Optional[Dict] = None,
+        num_boost_round: int = 10,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._require_lib()
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint,
+                         datasets=datasets)
+        self.label_column = label_column
+        self.params = params or {}
+        self.num_boost_round = num_boost_round
+
+    @classmethod
+    def _require_lib(cls):
+        import importlib
+
+        try:
+            importlib.import_module(cls._lib_name)
+        except ImportError as e:
+            raise ImportError(
+                f"{cls.__name__} requires `{cls._lib_name}`, which is not "
+                f"installed in this environment. {cls._lib_hint}") from e
+
+    def _to_matrix(self, ds):
+        df = ds.to_pandas()
+        y = df[self.label_column]
+        X = df.drop(columns=[self.label_column])
+        return X, y
+
+
+class XGBoostTrainer(_GBDTTrainer):
+    _lib_name = "xgboost"
+    _lib_hint = ("Use JaxTrainer/TorchTrainer for neural models, or "
+                 "install xgboost for tree models.")
+
+    def training_loop(self) -> Result:
+        import os
+        import tempfile
+
+        import xgboost as xgb
+
+        X, y = self._to_matrix(self.datasets["train"])
+        dtrain = xgb.DMatrix(X, label=y)
+        evals = []
+        if "valid" in self.datasets:
+            Xv, yv = self._to_matrix(self.datasets["valid"])
+            evals = [(xgb.DMatrix(Xv, label=yv), "valid")]
+        results: Dict = {}
+        booster = xgb.train(self.params, dtrain,
+                            num_boost_round=self.num_boost_round,
+                            evals=evals, evals_result=results)
+        d = tempfile.mkdtemp(prefix="xgb_ckpt_")
+        booster.save_model(os.path.join(d, "model.json"))
+        metrics = {"num_boost_round": self.num_boost_round}
+        for name, hist in results.items():
+            for metric, vals in hist.items():
+                metrics[f"{name}-{metric}"] = vals[-1]
+        return Result(metrics=metrics, checkpoint=Checkpoint(d), path=d)
+
+
+class LightGBMTrainer(_GBDTTrainer):
+    _lib_name = "lightgbm"
+    _lib_hint = ("Use JaxTrainer/TorchTrainer for neural models, or "
+                 "install lightgbm for tree models.")
+
+    def training_loop(self) -> Result:
+        import os
+        import tempfile
+
+        import lightgbm as lgb
+
+        X, y = self._to_matrix(self.datasets["train"])
+        train_set = lgb.Dataset(X, label=y)
+        valid_sets = []
+        if "valid" in self.datasets:
+            Xv, yv = self._to_matrix(self.datasets["valid"])
+            valid_sets = [lgb.Dataset(Xv, label=yv)]
+        booster = lgb.train(self.params, train_set,
+                            num_boost_round=self.num_boost_round,
+                            valid_sets=valid_sets)
+        d = tempfile.mkdtemp(prefix="lgbm_ckpt_")
+        booster.save_model(os.path.join(d, "model.txt"))
+        return Result(metrics={"num_boost_round": self.num_boost_round},
+                      checkpoint=Checkpoint(d), path=d)

@@ -1,0 +1,4 @@
+from ray_tpu.train.huggingface.transformers_trainer import (
+    TransformersTrainer, prepare_trainer)
+
+__all__ = ["TransformersTrainer", "prepare_trainer"]

@@ -1,0 +1,91 @@
+"""TransformersTrainer — HuggingFace transformers on the worker group
+(reference: python/ray/train/huggingface/transformers — the
+TransformersTrainer wrapper + prepare_trainer/RayTrainReportCallback; the
+reference SURVEY §2.4 Train row lists it among the framework trainers).
+
+The torch path: the user's ``train_loop_per_worker`` builds a
+``transformers.Trainer`` and calls :func:`prepare_trainer` on it, which
+injects a report callback bridging HF logging into ``session.report`` so
+checkpointing/metrics flow through the framework like every other trainer.
+Process-group setup is inherited from the torch backend (gloo on this
+image; the JAX path is ``JaxTrainer`` — preferred on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.torch.config import TorchConfig
+from ray_tpu.train.torch.torch_trainer import TorchTrainer
+
+
+def _require_transformers():
+    try:
+        import transformers  # noqa: F401
+    except ImportError as e:  # pragma: no cover - transformers is baked in
+        raise ImportError(
+            "TransformersTrainer requires the `transformers` package"
+        ) from e
+
+
+class RayTrainReportCallback:
+    """transformers.TrainerCallback that forwards HF logs to
+    session.report (reference: transformers/_transformers_utils.py
+    RayTrainReportCallback)."""
+
+    def __new__(cls):
+        _require_transformers()
+        import transformers
+
+        class _Callback(transformers.TrainerCallback):
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                from ray_tpu.train._internal.session import get_session
+
+                session = get_session()
+                if session is None or not logs:
+                    return
+                metrics = {k: v for k, v in logs.items()
+                           if isinstance(v, (int, float))}
+                metrics["step"] = state.global_step
+                metrics["epoch"] = float(state.epoch or 0)
+                session.report(metrics)
+
+        return _Callback()
+
+
+def prepare_trainer(trainer):
+    """Attach the report callback to a transformers.Trainer (reference:
+    ray.train.huggingface.transformers.prepare_trainer)."""
+    _require_transformers()
+    trainer.add_callback(RayTrainReportCallback())
+    return trainer
+
+
+class TransformersTrainer(TorchTrainer):
+    """Runs a HF transformers training loop on each worker; DDP via the
+    gloo process group the torch backend initializes (reference:
+    train/huggingface/transformers/transformers_trainer.py)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Dict], None],
+        *,
+        train_loop_config: Optional[Dict] = None,
+        torch_config: Optional[TorchConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        _require_transformers()
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            datasets=datasets,
+        )

@@ -20,7 +20,7 @@ from ray_tpu.tune.logger import (
     TBXLoggerCallback)
 from ray_tpu.tune.schedulers import (
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    HyperBandScheduler, MedianStoppingRule, PopulationBasedTraining,
+    HyperBandScheduler, MedianStoppingRule, PB2, PopulationBasedTraining,
     TrialScheduler)
 from ray_tpu.tune.stopper import (
     CombinedStopper, ExperimentPlateauStopper, FunctionStopper,
@@ -41,7 +41,7 @@ __all__ = [
     "sample_from", "grid_search", "Searcher", "ConcurrencyLimiter",
     "BasicVariantGenerator", "TrialScheduler", "FIFOScheduler",
     "ASHAScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
-    "MedianStoppingRule",
+    "MedianStoppingRule", "PB2",
     "PopulationBasedTraining", "run", "stopper", "Stopper",
     "CombinedStopper", "ExperimentPlateauStopper", "FunctionStopper",
     "MaximumIterationStopper", "TimeoutStopper", "TrialPlateauStopper",

@@ -1,0 +1,137 @@
+"""PB2 — population based bandits (reference: python/ray/tune/schedulers/
+pb2.py PB2 + pb2_utils; Parker-Holder 2020).
+
+PBT's random perturbation explore step is replaced by a GP-bandit
+suggestion: fit a Gaussian process on (hyperparams -> reward improvement)
+observations from the whole population and pick the exploring trial's new
+config by maximizing UCB within the declared bounds. The exploit path
+(copy a top trial's checkpoint) is inherited from PBT unchanged.
+
+Uses scikit-learn's GaussianProcessRegressor (baked into this image) in
+place of the reference's GPy dependency.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class PB2(PopulationBasedTraining):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: float = 4,
+                 hyperparam_bounds: Optional[Dict[str, Tuple[float, float]]]
+                 = None,
+                 quantile_fraction: float = 0.25,
+                 log_scale_keys: Optional[List[str]] = None,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("hyperparam_bounds is required for PB2: "
+                             "{key: (min, max)}")
+        # feed PBT a resample-style mutation table so its machinery stays
+        # valid if the GP path has too little data
+        mutations = {k: (lambda lo=lo, hi=hi:
+                         float(np.random.uniform(lo, hi)))
+                     for k, (lo, hi) in hyperparam_bounds.items()}
+        super().__init__(metric, mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=mutations,
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.hyperparam_bounds = {k: (float(lo), float(hi))
+                                  for k, (lo, hi) in
+                                  hyperparam_bounds.items()}
+        self._log_keys = set(log_scale_keys or ())
+        self._np_rng = np.random.default_rng(seed)
+        # observations: rows of (normalized config vector, score delta)
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._prev_score: Dict[str, float] = {}
+
+    # ------------------------------------------------------------ encoding
+    def _keys(self) -> List[str]:
+        return sorted(self.hyperparam_bounds)
+
+    def _encode(self, config: Dict) -> List[float]:
+        row = []
+        for k in self._keys():
+            lo, hi = self.hyperparam_bounds[k]
+            v = float(config.get(k, lo))
+            if k in self._log_keys:
+                v = math.log(max(v, 1e-12))
+                lo, hi = math.log(max(lo, 1e-12)), math.log(max(hi, 1e-12))
+            row.append((v - lo) / max(hi - lo, 1e-12))
+        return row
+
+    def _decode(self, row: np.ndarray) -> Dict[str, float]:
+        out = {}
+        for k, u in zip(self._keys(), row):
+            lo, hi = self.hyperparam_bounds[k]
+            if k in self._log_keys:
+                llo, lhi = math.log(max(lo, 1e-12)), math.log(max(hi, 1e-12))
+                out[k] = float(math.exp(llo + u * (lhi - llo)))
+            else:
+                out[k] = float(lo + u * (hi - lo))
+        return out
+
+    # -------------------------------------------------------- observations
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        score = self._score(result)
+        prev = self._prev_score.get(trial.trial_id)
+        if prev is not None:
+            self._X.append(self._encode(trial.config))
+            self._y.append(score - prev)
+        self._prev_score[trial.trial_id] = score
+        decision = super().on_trial_result(controller, trial, result)
+        if decision == TrialScheduler.RESTART:
+            # exploit: the trial resumes from the donor's checkpoint, so
+            # the next score jump is the copy, not the new config's doing —
+            # keep it out of the GP observations
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        self._prev_score.pop(trial.trial_id, None)
+        super().on_trial_complete(controller, trial, result)
+
+    # ------------------------------------------------------------- explore
+    def _explore(self, config: Dict) -> Dict:
+        new = dict(config)
+        suggestion = self._gp_suggest()
+        if suggestion is None:
+            # not enough data for a GP: uniform resample inside bounds
+            for k, (lo, hi) in self.hyperparam_bounds.items():
+                new[k] = float(self._np_rng.uniform(lo, hi))
+            return new
+        new.update(suggestion)
+        return new
+
+    def _gp_suggest(self) -> Optional[Dict[str, float]]:
+        if len(self._y) < max(4, len(self._keys()) + 2):
+            return None
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        X = np.asarray(self._X[-256:], float)
+        y = np.asarray(self._y[-256:], float)
+        scale = np.std(y) or 1.0
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), alpha=1e-4, normalize_y=True,
+            random_state=int(self._np_rng.integers(2 ** 31 - 1)))
+        gp.fit(X, y / scale)
+        # UCB over random candidates (reference optimizes the acquisition
+        # with gradient steps; random search is ample for <=8 dims)
+        cand = self._np_rng.random((256, len(self._keys())))
+        mu, sd = gp.predict(cand, return_std=True)
+        best = cand[int(np.argmax(mu + 2.0 * sd))]
+        return self._decode(best)
+
+    def debug_string(self) -> str:
+        return (f"PB2: {self._exploits} exploits, "
+                f"{len(self._y)} GP observations")

@@ -1,0 +1,102 @@
+"""GCS (head) fault tolerance (reference:
+python/ray/tests/test_gcs_fault_tolerance.py — GCS restart with
+redis-backed state; here a file snapshot is the durable store and agents/
+drivers re-register through their watchdogs)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def persistent_cluster(tmp_path, monkeypatch):
+    persist = str(tmp_path / "head_state.bin")
+    monkeypatch.setenv("RAY_TPU_GCS_PERSIST", persist)
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(_node=cluster.head_node)
+    yield cluster, persist
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _restart_head(node, persist: str) -> None:
+    node.head_proc.kill()
+    node.head_proc.wait()
+    log = open(os.path.join(node.session_dir, "logs", "head2.log"), "ab")
+    env = dict(os.environ, RAY_TPU_GCS_PERSIST=persist)
+    node.head_proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.gcs",
+         "--session-dir", node.session_dir,
+         "--port", str(node.head_port)],
+        stdout=log, stderr=log, env=env,
+        start_new_session=True)  # node.stop() killpg must not hit us
+
+
+def test_head_restart_preserves_state_and_recovers(persistent_cluster):
+    cluster, persist = persistent_cluster
+    from ray_tpu.experimental import internal_kv
+
+    internal_kv._internal_kv_put(b"durable_key", b"durable_value")
+
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    keeper = Keeper.options(name="keeper", lifetime="detached").remote()
+    assert ray_tpu.get(keeper.bump.remote(), timeout=60) == 1
+    time.sleep(0.3)  # let the debounced snapshot flush
+
+    _restart_head(cluster.head_node, persist)
+    # wait for agent + driver watchdogs to reconnect to the new head
+    deadline = time.monotonic() + 30
+    recovered = False
+    while time.monotonic() < deadline:
+        try:
+            if internal_kv._internal_kv_get(b"durable_key") == \
+                    b"durable_value":
+                recovered = True
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert recovered, "KV not readable after head restart"
+
+    # named detached actor survives: the restored actor table still routes
+    # to the live actor process
+    handle = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            handle = ray_tpu.get_actor("keeper")
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert handle is not None, "named actor not resolvable after restart"
+    assert ray_tpu.get(handle.bump.remote(), timeout=60) == 2  # state kept
+
+    # normal tasks still run (agent re-registered under the same node id)
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            assert ray_tpu.get(add.remote(2, 3), timeout=30) == 5
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(1.0)

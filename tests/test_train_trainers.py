@@ -1,0 +1,117 @@
+"""TransformersTrainer + gated GBDT trainers (reference:
+train/huggingface/transformers tests + gbdt_trainer tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.air import ScalingConfig
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_transformers_trainer_tiny(ray4):
+    """Tiny random-weight transformer fine-tune: metrics must flow from HF
+    Trainer logs through session.report back to the driver."""
+    from ray_tpu.train.huggingface import TransformersTrainer
+
+    def loop(config):
+        import torch
+        import transformers
+
+        from ray_tpu.train.huggingface import prepare_trainer
+
+        cfg = transformers.GPT2Config(
+            n_layer=1, n_head=2, n_embd=32, vocab_size=128,
+            n_positions=32)
+        model = transformers.GPT2LMHeadModel(cfg)
+
+        class DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                ids = torch.randint(0, 128, (16,))
+                return {"input_ids": ids, "labels": ids}
+
+        args = transformers.TrainingArguments(
+            output_dir="/tmp/hf_out", num_train_epochs=1,
+            per_device_train_batch_size=4, logging_steps=1,
+            report_to=[], max_steps=3, use_cpu=True,
+            disable_tqdm=True)
+        trainer = transformers.Trainer(model=model, args=args,
+                                       train_dataset=DS())
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+
+    t = TransformersTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1))
+    result = t.fit()
+    assert result.error is None
+    assert result.metrics is not None
+    assert "loss" in result.metrics or "train_loss" in result.metrics
+
+
+def test_accelerate_trainer_tiny(ray4):
+    """Tiny model trained through accelerate.Accelerator on one worker."""
+    from ray_tpu.train.accelerate import AccelerateTrainer
+
+    def loop(config):
+        import torch
+        from accelerate import Accelerator
+
+        from ray_tpu import train
+
+        acc = Accelerator(cpu=True)
+        model = torch.nn.Linear(4, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+        x = torch.randn(64, 4)
+        y = x.sum(dim=1, keepdim=True)
+        for _ in range(10):
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()
+        train.report({"loss": float(loss)})
+
+    result = AccelerateTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert result.error is None
+    assert result.metrics["loss"] < 5.0
+
+
+def test_lightning_trainer_gated():
+    from ray_tpu.train import LightningTrainer
+
+    try:
+        import lightning  # noqa: F401
+        pytest.skip("lightning installed; gate not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="lightning"):
+        LightningTrainer(lambda c: None)
+
+
+def test_gbdt_trainers_gated():
+    from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+
+    def has(lib):
+        try:
+            __import__(lib)
+            return True
+        except ImportError:
+            return False
+
+    if not has("xgboost"):
+        with pytest.raises(ImportError, match="xgboost"):
+            XGBoostTrainer(datasets={}, label_column="y")
+    if not has("lightgbm"):
+        with pytest.raises(ImportError, match="lightgbm"):
+            LightGBMTrainer(datasets={}, label_column="y")
