@@ -199,6 +199,7 @@ class NodeAgent:
         r("UnpinObject", self._unpin_object)
         r("GetStoreStats", self._get_store_stats)
         r("GetNodeInfo", self._get_node_info)
+        r("SetResource", self._set_resource)
         r("RestoreSpilled", self._restore_spilled)
         # remote agents
         r("FetchObjectMeta", self._fetch_object_meta)
@@ -935,6 +936,25 @@ class NodeAgent:
             "num_idle": len(self.idle_workers),
             "cluster_view": self.cluster_view,
         }
+
+    async def _set_resource(self, conn: Connection, p: Dict) -> Dict:
+        """Dynamically re-declare a custom resource's total (reference:
+        experimental/dynamic_resources.py set_resource). The available
+        amount shifts by the same delta, so in-flight leases keep their
+        accounting."""
+        name = p["resource"]
+        new_total = float(p["capacity"])
+        delta = new_total - self.resources.total.get(name)
+        shift = ResourceSet({name: abs(delta)})
+        if delta >= 0:
+            self.resources.total.add(shift)
+            self.resources.available.add(shift)
+        else:
+            self.resources.total.subtract(shift, allow_negative=True)
+            self.resources.available.subtract(shift, allow_negative=True)
+        self._resources_dirty = True
+        await self._drain_pending_leases()
+        return {"total": self.resources.total.get(name)}
 
 
 class _ForeignProc:

@@ -59,6 +59,7 @@ class ActorInfo:
         self.num_restarts = 0
         self.death_cause = ""
         self.owner_conn = owner_conn
+        self.owner_job: Optional[str] = None  # job_id of the owning driver
         self.detached = bool(spec_wire.get("detached"))
         self.class_name = spec_wire.get("class_name", "")
         self.pid: int = 0
@@ -103,6 +104,7 @@ class HeadServer:
         # (NodeManagerService.NotifyGCSRestart analog).
         self.persist_path = persist_path
         self._save_pending = False
+        self._save_lock = asyncio.Lock()
         self._driver_conns: Dict[Optional[str], Connection] = {}
         if persist_path:
             self._load_state()
@@ -117,8 +119,16 @@ class HeadServer:
 
         if not os.path.exists(self.persist_path):
             return
-        with open(self.persist_path, "rb") as f:
-            state = pickle.load(f)
+        try:
+            with open(self.persist_path, "rb") as f:
+                state = pickle.load(f)
+        except Exception as e:
+            import logging
+
+            logging.getLogger("ray_tpu").error(
+                "head persistence snapshot unreadable (%s); starting "
+                "with empty state", e)
+            return
         self.kv = state.get("kv", {})
         self.jobs = state.get("jobs", {})
         self.named_actors = {tuple(k): v for k, v in
@@ -133,6 +143,7 @@ class HeadServer:
             info.addr = rec["addr"]
             info.node_id = rec["node_id"]
             info.num_restarts = rec["num_restarts"]
+            info.owner_job = rec.get("owner_job")
             self.actors[rec["actor_id"]] = info
 
     def _schedule_save(self) -> None:
@@ -161,7 +172,7 @@ class HeadServer:
                  "name": a.name, "namespace": a.namespace,
                  "max_restarts": a.max_restarts,
                  "state": a.state, "addr": a.addr, "node_id": a.node_id,
-                 "num_restarts": a.num_restarts}
+                 "num_restarts": a.num_restarts, "owner_job": a.owner_job}
                 for a in self.actors.values()
             ],
         }
@@ -170,13 +181,17 @@ class HeadServer:
         self._save_pending = False
         if not self.persist_path:
             return
-        state = self._snapshot()
-        await asyncio.to_thread(self._write_snapshot, state)
+        # serialize writers: a second debounced save during a slow write
+        # must not race the same file
+        async with self._save_lock:
+            state = self._snapshot()
+            await asyncio.to_thread(self._write_snapshot, state)
 
     def _write_snapshot(self, state: Dict) -> None:
         import pickle
+        import uuid
 
-        tmp = self.persist_path + ".tmp"
+        tmp = f"{self.persist_path}.{uuid.uuid4().hex[:8]}.tmp"
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, self.persist_path)
@@ -256,10 +271,15 @@ class HeadServer:
         # blip): move actor ownership onto the new connection so the old
         # connection's disconnect can't reap them
         old_conn = self._driver_conns.get(job_id)
-        if old_conn is not None and old_conn is not conn:
-            for actor in self.actors.values():
-                if actor.owner_conn is old_conn:
-                    actor.owner_conn = conn
+        for actor in self.actors.values():
+            if actor.owner_conn is old_conn and old_conn is not None \
+                    and old_conn is not conn:
+                actor.owner_conn = conn
+            elif actor.owner_conn is None and actor.owner_job and \
+                    actor.owner_job == job_id:
+                # restored from a snapshot: re-adopt so driver-exit
+                # cleanup reaches these actors again
+                actor.owner_conn = conn
         self._driver_conns[job_id] = conn
         existing = self.jobs.get(job_id or "")
         if existing is not None and existing.get("state") == "RUNNING":
@@ -412,6 +432,7 @@ class HeadServer:
                     raise ValueError(f"actor name '{name}' already taken")
         info = ActorInfo(actor_id, spec, name, namespace,
                          p.get("max_restarts", 0), conn)
+        info.owner_job = conn.meta.get("job_id")
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
@@ -774,13 +795,21 @@ def main() -> None:
     args = parser.parse_args()
 
     async def run():
+        import signal
+
         head = HeadServer(args.session_dir, args.port,
                           persist_path=args.persist or None)
         port = await head.start()
         # Parent discovers the bound port through this file.
         with open(os.path.join(args.session_dir, "head_port"), "w") as f:
             f.write(str(port))
-        await asyncio.Event().wait()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        # flush the last debounce window so a clean stop loses nothing
+        head._save_state()
 
     asyncio.run(run())
 

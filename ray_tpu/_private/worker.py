@@ -364,6 +364,10 @@ class Worker:
                 # monitors (log_monitor.py) -> "(worker-x) line" output
                 await self.head.call("Subscribe",
                                      {"channels": ["logs:all"]})
+        # a restarted head has an empty subscriber table: re-subscribe the
+        # actor channel so restart/death/address events keep flowing
+        if self._actor_sub_started:
+            await self.head.call("Subscribe", {"channels": ["actor"]})
 
     async def _head_watchdog_loop(self) -> None:
         """Driver survives a head restart (GCS fault tolerance): ping, and

@@ -1,0 +1,48 @@
+"""Dynamic custom resources (reference: python/ray/experimental/
+dynamic_resources.py set_resource — adjust a node's custom resource
+capacity at runtime; used for quota-style admission control).
+
+The agent owns the node's resource totals; this asks it to re-declare one,
+which then gossips to the head and into scheduling decisions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import ray_tpu
+
+
+def set_resource(resource_name: str, capacity: float,
+                 node_id: Optional[str] = None) -> None:
+    """Set a custom resource's total on a node (default: the local node).
+    Capacity 0 deletes the resource."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() first")
+    if resource_name in ("CPU", "GPU", "TPU", "memory"):
+        raise ValueError(
+            f"{resource_name} is a built-in resource; only custom "
+            "resources can be set dynamically (reference restriction)")
+    payload = {"resource": resource_name, "capacity": float(capacity)}
+    if node_id is None or node_id == w.node_id:
+        w._acall(w.agent.call("SetResource", payload), timeout=30)
+        return
+    # route to the target node's agent through the head's cluster view
+    view = w._acall(w.head.call("GetClusterView", {}), timeout=30)
+    info = view.get(node_id)
+    if info is None:
+        raise ValueError(f"no alive node {node_id!r}")
+    from ray_tpu._private.protocol import AsyncRpcClient
+
+    async def call_remote():
+        client = AsyncRpcClient()
+        await client.connect_tcp(info["addr"]["host"], info["addr"]["port"])
+        try:
+            return await client.call("SetResource", payload)
+        finally:
+            client.close()
+
+    w._acall(call_remote(), timeout=30)

@@ -19,12 +19,15 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
-from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.ppo import (
+    MultiAgentPPO, MultiAgentPPOConfig, PPO, PPOConfig)
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner, PPOLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.multi_agent_env import (
+    MultiAgentEnv, MultiAgentEnvRunner)
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -33,5 +36,6 @@ __all__ = [
     "ES", "ESConfig", "MARWIL", "MARWILConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "Learner",
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
-    "SingleAgentEnvRunner",
+    "SingleAgentEnvRunner", "MultiAgentEnv", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "MultiAgentPPOConfig",
 ]

@@ -1,3 +1,5 @@
+from ray_tpu.rllib.algorithms.ppo.multi_agent import (
+    MultiAgentPPO, MultiAgentPPOConfig)
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig"]
+__all__ = ["PPO", "PPOConfig", "MultiAgentPPO", "MultiAgentPPOConfig"]

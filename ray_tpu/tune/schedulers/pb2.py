@@ -35,10 +35,12 @@ class PB2(PopulationBasedTraining):
         if not hyperparam_bounds:
             raise ValueError("hyperparam_bounds is required for PB2: "
                              "{key: (min, max)}")
-        # feed PBT a resample-style mutation table so its machinery stays
-        # valid if the GP path has too little data
-        mutations = {k: (lambda lo=lo, hi=hi:
-                         float(np.random.uniform(lo, hi)))
+        # PBT's constructor demands a non-empty mutation table; PB2 fully
+        # overrides _explore, so these seeded uniform resamplers only run
+        # if PBT machinery is invoked directly
+        _rng = np.random.default_rng(seed)
+        mutations = {k: (lambda lo=lo, hi=hi, r=_rng:
+                         float(r.uniform(lo, hi)))
                      for k, (lo, hi) in hyperparam_bounds.items()}
         super().__init__(metric, mode, time_attr=time_attr,
                          perturbation_interval=perturbation_interval,

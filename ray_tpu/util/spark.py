@@ -1,0 +1,35 @@
+"""Spark-on-ray_tpu launcher (reference: python/ray/util/spark/ —
+setup_ray_cluster starting cluster nodes inside spark executors; here the
+direction is inverted like `raydp`: run spark over the framework's
+cluster).
+
+Gated: `pyspark` is not in this image's baked package set; construction
+raises a clear ImportError. The supported surface mirrors the reference's
+module entry points so callers can feature-detect."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "ray_tpu.util.spark requires `pyspark`, which is not "
+            "installed in this environment. Use ray_tpu.data for "
+            "dataframe-style distributed processing instead.") from e
+
+
+def setup_ray_cluster(num_worker_nodes: int,
+                      num_cpus_per_node: Optional[int] = None,
+                      **kwargs) -> Dict:
+    """Reference: util/spark/cluster_init.py setup_ray_cluster."""
+    _require_pyspark()
+    raise NotImplementedError(
+        "spark cluster integration requires a spark deployment")
+
+
+def shutdown_ray_cluster() -> None:
+    _require_pyspark()

@@ -1,0 +1,123 @@
+"""Multi-agent RLlib tests (reference: rllib multi-agent test suite —
+policy mapping, per-policy learning, shared-policy self-play)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MultiAgentEnv, MultiAgentPPOConfig
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class SignGame(MultiAgentEnv):
+    """Each agent sees a 2-dim obs; action 1 is rewarded iff obs[0] > 0.
+    Agents are independent — a clean probe that each policy learns from
+    exactly its own agents' experience."""
+
+    possible_agents = ["a0", "a1"]
+
+    def __init__(self, episode_len=10, seed=0):
+        import gymnasium as gym
+
+        self._spaces = {
+            a: gym.spaces.Box(-1, 1, (2,), np.float32)
+            for a in self.possible_agents}
+        self._aspaces = {a: gym.spaces.Discrete(2)
+                         for a in self.possible_agents}
+        self._rng = np.random.default_rng(seed)
+        self._len = episode_len
+        self._t = 0
+
+    @property
+    def observation_spaces(self):
+        return self._spaces
+
+    @property
+    def action_spaces(self):
+        return self._aspaces
+
+    def _obs(self):
+        return {a: self._rng.uniform(-1, 1, 2).astype(np.float32)
+                for a in self.possible_agents}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        self._cur = self._obs()
+        return dict(self._cur), {}
+
+    def step(self, action_dict):
+        rewards = {}
+        for a, act in action_dict.items():
+            correct = int(self._cur[a][0] > 0)
+            rewards[a] = 1.0 if int(act) == correct else 0.0
+        self._t += 1
+        done = self._t >= self._len
+        self._cur = self._obs()
+        obs = dict(self._cur)
+        terms = {a: done for a in action_dict}
+        terms["__all__"] = done
+        truncs = {"__all__": False}
+        return obs, rewards, terms, truncs, {}
+
+
+def test_multi_agent_ppo_learns_per_policy(ray4):
+    cfg = (MultiAgentPPOConfig()
+           .environment(lambda cfg=None: SignGame())
+           .multi_agent(policies=["p0", "p1"],
+                        policy_mapping_fn=lambda aid: "p" + aid[-1])
+           .env_runners(num_env_runners=2, rollout_fragment_length=64)
+           .training(lr=5e-3, train_batch_size=256, minibatch_size=128,
+                     num_epochs=6, entropy_coeff=0.0))
+    algo = cfg.build()
+    try:
+        for i in range(7):
+            r = algo.step()
+        # both policies must act correctly on held-out observations
+        for pid in ("p0", "p1"):
+            correct = 0
+            rng = np.random.default_rng(7)
+            for _ in range(40):
+                obs = rng.uniform(-1, 1, 2).astype(np.float32)
+                act = algo.compute_single_action(obs, policy_id=pid)
+                correct += int(act == int(obs[0] > 0))
+            assert correct >= 30, f"{pid}: {correct}/40"
+        assert any(k.startswith("p0/") for k in r)
+        assert any(k.startswith("p1/") for k in r)
+    finally:
+        algo.stop()
+
+
+def test_shared_policy_self_play(ray4):
+    cfg = (MultiAgentPPOConfig()
+           .environment(lambda cfg=None: SignGame())
+           .multi_agent(policies=["shared"],
+                        policy_mapping_fn=lambda aid: "shared")
+           .env_runners(num_env_runners=1, rollout_fragment_length=64)
+           .training(lr=3e-3, train_batch_size=128, minibatch_size=128,
+                     num_epochs=4))
+    algo = cfg.build()
+    try:
+        r = algo.step()
+        assert r["env_steps_this_iter"] >= 128
+        assert any(k.startswith("shared/") for k in r)
+        # the shared policy saw BOTH agents' rows: 2 rows per env step
+        ckpt_metrics = r["shared/total_loss"]
+        assert np.isfinite(ckpt_metrics)
+    finally:
+        algo.stop()
+
+
+def test_policy_mapping_validation(ray4):
+    cfg = (MultiAgentPPOConfig()
+           .environment(lambda cfg=None: SignGame())
+           .multi_agent(policies=["p0", "orphan"],
+                        policy_mapping_fn=lambda aid: "p0"))
+    with pytest.raises(ValueError, match="orphan"):
+        cfg.build()
