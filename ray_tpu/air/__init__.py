@@ -4,4 +4,7 @@ python/ray/air/__init__.py)."""
 from ray_tpu.air.config import (
     CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
 
-__all__ = ["CheckpointConfig", "FailureConfig", "RunConfig", "ScalingConfig"]
+from ray_tpu.air import integrations
+
+__all__ = ["CheckpointConfig", "FailureConfig", "RunConfig",
+           "ScalingConfig", "integrations"]

@@ -1,0 +1,144 @@
+"""Experiment-tracker integrations (reference: python/ray/air/
+integrations/{wandb,mlflow,comet}.py — logger callbacks streaming trial
+results to the tracking service).
+
+All three are gated: none of the client libraries are in this image's
+baked package set, so constructing a callback raises a clear ImportError;
+when the library IS present the callback streams per-trial metrics.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Dict, Optional
+
+from ray_tpu.tune.logger import LoggerCallback
+
+
+def _flat_numbers(d: Dict, prefix: str = "") -> Dict[str, float]:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flat_numbers(v, key))
+        elif isinstance(v, numbers.Number):
+            out[key] = float(v)
+    return out
+
+
+class WandbLoggerCallback(LoggerCallback):
+    """reference: air/integrations/wandb.py WandbLoggerCallback."""
+
+    def __init__(self, project: Optional[str] = None,
+                 group: Optional[str] = None, **kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbLoggerCallback requires `wandb`, which is not "
+                "installed in this environment. CSV/JSON loggers run by "
+                "default; TBXLoggerCallback works with torch's "
+                "tensorboard.") from e
+        self.project = project
+        self.group = group
+        self.kwargs = kwargs
+        self._runs: Dict[str, object] = {}
+
+    def log_trial_start(self, trial) -> None:
+        import wandb
+
+        self._runs[trial.trial_id] = wandb.init(
+            project=self.project, group=self.group, name=trial.trial_id,
+            config=dict(trial.config), reinit=True, **self.kwargs)
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log(_flat_numbers(result))
+
+    def log_trial_end(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish()
+
+
+class MLflowLoggerCallback(LoggerCallback):
+    """reference: air/integrations/mlflow.py MLflowLoggerCallback.
+
+    Uses MlflowClient with explicit run ids (NOT the global active-run
+    stack) so concurrent trials can't cross-write each other's runs."""
+
+    def __init__(self, tracking_uri: Optional[str] = None,
+                 experiment_name: Optional[str] = None, **kwargs):
+        try:
+            import mlflow  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "MLflowLoggerCallback requires `mlflow`, which is not "
+                "installed in this environment.") from e
+        from mlflow.tracking import MlflowClient
+
+        self._client = MlflowClient(tracking_uri=tracking_uri)
+        self._experiment_id = "0"
+        if experiment_name:
+            exp = self._client.get_experiment_by_name(experiment_name)
+            self._experiment_id = (exp.experiment_id if exp else
+                                   self._client.create_experiment(
+                                       experiment_name))
+        self._runs: Dict[str, str] = {}  # trial_id -> mlflow run_id
+
+    def log_trial_start(self, trial) -> None:
+        run = self._client.create_run(
+            self._experiment_id, run_name=trial.trial_id)
+        self._runs[trial.trial_id] = run.info.run_id
+        for k, v in trial.config.items():
+            self._client.log_param(run.info.run_id, k, str(v))
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        run_id = self._runs.get(trial.trial_id)
+        if run_id is None:
+            return
+        step = int(result.get("training_iteration", 0))
+        for k, v in _flat_numbers(result).items():
+            self._client.log_metric(run_id, k.replace("/", "."), v,
+                                    step=step)
+
+    def log_trial_end(self, trial) -> None:
+        run_id = self._runs.pop(trial.trial_id, None)
+        if run_id is not None:
+            self._client.set_terminated(run_id)
+
+
+class CometLoggerCallback(LoggerCallback):
+    """reference: air/integrations/comet.py CometLoggerCallback."""
+
+    def __init__(self, project_name: Optional[str] = None, **kwargs):
+        try:
+            import comet_ml  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "CometLoggerCallback requires `comet_ml`, which is not "
+                "installed in this environment.") from e
+        self.project_name = project_name
+        self.kwargs = kwargs
+        self._experiments: Dict[str, object] = {}
+
+    def log_trial_start(self, trial) -> None:
+        import comet_ml
+
+        exp = comet_ml.Experiment(project_name=self.project_name,
+                                  **self.kwargs)
+        exp.set_name(trial.trial_id)
+        exp.log_parameters(dict(trial.config))
+        self._experiments[trial.trial_id] = exp
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        exp = self._experiments.get(trial.trial_id)
+        if exp is not None:
+            exp.log_metrics(_flat_numbers(result),
+                            step=int(result.get("training_iteration", 0)))
+
+    def log_trial_end(self, trial) -> None:
+        exp = self._experiments.pop(trial.trial_id, None)
+        if exp is not None:
+            exp.end()

@@ -1,0 +1,6 @@
+from ray_tpu.autoscaler.v2.instance_manager import (
+    Instance, InstanceManager, InstanceStorage, Reconciler)
+from ray_tpu.autoscaler.v2.sdk import ClusterStatus, get_cluster_status
+
+__all__ = ["Instance", "InstanceManager", "InstanceStorage", "Reconciler",
+           "ClusterStatus", "get_cluster_status"]

@@ -1,0 +1,155 @@
+"""Autoscaler v2 — instance lifecycle tracking (reference:
+python/ray/autoscaler/v2/instance_manager/instance_manager.py:22
+InstanceManager + Reconciler + instance_storage: every cloud instance is
+a versioned record walked through an explicit state machine instead of
+v1's implicit provider polling).
+
+State machine (subset of the reference's):
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+           -> TERMINATING -> TERMINATED
+The Reconciler drives transitions by diffing three sources: desired
+counts (from the demand scheduler), the provider's live node list, and
+the head's cluster membership.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    instance_type: str
+    status: str = QUEUED
+    cloud_instance_id: Optional[str] = None  # provider's id
+    node_id: Optional[str] = None            # runtime node id once joined
+    version: int = 0
+    updated_at: float = dataclasses.field(default_factory=time.time)
+
+    def transition(self, new_status: str) -> None:
+        self.status = new_status
+        self.version += 1
+        self.updated_at = time.time()
+
+
+class InstanceStorage:
+    """Versioned in-memory instance table (reference:
+    instance_manager/instance_storage.py); optimistic-concurrency updates
+    keyed by version."""
+
+    def __init__(self):
+        self._instances: Dict[str, Instance] = {}
+
+    def upsert(self, instance: Instance,
+               expected_version: Optional[int] = None) -> bool:
+        cur = self._instances.get(instance.instance_id)
+        if expected_version is not None and cur is not None and \
+                cur.version != expected_version:
+            return False
+        self._instances[instance.instance_id] = instance
+        return True
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        return self._instances.get(instance_id)
+
+    def list(self, status: Optional[str] = None) -> List[Instance]:
+        out = list(self._instances.values())
+        if status is not None:
+            out = [i for i in out if i.status == status]
+        return out
+
+    def delete(self, instance_id: str) -> None:
+        self._instances.pop(instance_id, None)
+
+
+class InstanceManager:
+    """Owns the instance table; exposes the reference's
+    update_instance_manager_state-shaped operations."""
+
+    def __init__(self, storage: Optional[InstanceStorage] = None):
+        self.storage = storage or InstanceStorage()
+
+    def request_instances(self, instance_type: str, count: int
+                          ) -> List[Instance]:
+        out = []
+        for _ in range(count):
+            inst = Instance(instance_id=uuid.uuid4().hex[:12],
+                            instance_type=instance_type)
+            self.storage.upsert(inst)
+            out.append(inst)
+        return out
+
+    def terminate_instance(self, instance_id: str) -> None:
+        inst = self.storage.get(instance_id)
+        if inst and inst.status not in (TERMINATING, TERMINATED):
+            inst.transition(TERMINATING)
+
+    def instances(self, status: Optional[str] = None) -> List[Instance]:
+        return self.storage.list(status)
+
+
+class Reconciler:
+    """One reconciliation pass (reference: v2/instance_manager/
+    reconciler.py Reconciler.reconcile): push QUEUED instances to the
+    provider, sync ALLOCATED/RAY_RUNNING against provider + cluster
+    state, and finish terminations."""
+
+    def __init__(self, manager: InstanceManager, provider,
+                 list_cluster_node_ids: Callable[[], List[str]]):
+        self.manager = manager
+        self.provider = provider
+        self._list_cluster_node_ids = list_cluster_node_ids
+
+    def reconcile(self) -> Dict[str, int]:
+        transitions: Dict[str, int] = {}
+
+        def count(name):
+            transitions[name] = transitions.get(name, 0) + 1
+
+        # 1. launch queued instances
+        for inst in self.manager.instances(QUEUED):
+            created = self.provider.create_node(inst.instance_type, 1)
+            if created:
+                inst.cloud_instance_id = created[0]
+                inst.transition(REQUESTED)
+                count("launched")
+
+        live = set(self.provider.non_terminated_nodes())
+        cluster_nodes = set(self._list_cluster_node_ids())
+
+        for inst in self.manager.instances():
+            if inst.status == REQUESTED and \
+                    inst.cloud_instance_id in live:
+                inst.transition(ALLOCATED)
+                count("allocated")
+            if inst.status == ALLOCATED:
+                node_id = None
+                if hasattr(self.provider, "runtime_node_id"):
+                    node_id = self.provider.runtime_node_id(
+                        inst.cloud_instance_id)
+                if node_id and node_id in cluster_nodes:
+                    inst.node_id = node_id
+                    inst.transition(RAY_RUNNING)
+                    count("running")
+            if inst.status == RAY_RUNNING and \
+                    inst.cloud_instance_id not in live:
+                # died underneath us
+                inst.transition(TERMINATED)
+                count("lost")
+            if inst.status == TERMINATING:
+                if inst.cloud_instance_id in live:
+                    self.provider.terminate_node(inst.cloud_instance_id)
+                inst.transition(TERMINATED)
+                count("terminated")
+        return transitions

@@ -1,0 +1,54 @@
+"""Autoscaler v2 SDK (reference: python/ray/autoscaler/v2/sdk.py —
+get_cluster_status returning the typed ClusterStatus the dashboard and
+`ray status` render)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+
+@dataclasses.dataclass
+class NodeState:
+    node_id: str
+    alive: bool
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float]
+    labels: Dict[str, str]
+
+
+@dataclasses.dataclass
+class ClusterStatus:
+    nodes: List[NodeState]
+    pending_demand: List[Dict]
+    total_resources: Dict[str, float]
+    available_resources: Dict[str, float]
+
+    def active_nodes(self) -> List[NodeState]:
+        return [n for n in self.nodes if n.alive]
+
+
+def get_cluster_status() -> ClusterStatus:
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu.init() first")
+    nodes_raw = w._acall(w.head.call("ListNodes", {}), timeout=30)
+    view = w._acall(w.head.call("GetClusterView", {}), timeout=30)
+    nodes = [NodeState(
+        node_id=n["node_id"], alive=n["alive"],
+        resources_total=n["resources_total"],
+        resources_available=n["resources_available"],
+        labels=n.get("labels", {})) for n in nodes_raw]
+    pending: List[Dict] = []
+    for info in view.values():
+        pending.extend(info.get("pending", []))
+    return ClusterStatus(
+        nodes=nodes,
+        pending_demand=pending,
+        total_resources=ray_tpu.cluster_resources(),
+        available_resources=ray_tpu.available_resources(),
+    )

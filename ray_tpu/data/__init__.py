@@ -11,6 +11,7 @@ import numpy as np
 
 from ray_tpu.data import aggregate
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (
     ActorPoolStrategy, Dataset, GroupedData, MaterializedDataset, from_blocks)
 from ray_tpu.data.iterator import DataIterator
@@ -23,29 +24,33 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "from_blocks", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "read_datasource",
-    "read_tfrecords", "read_images", "from_torch",
+    "read_tfrecords", "read_images", "from_torch", "DataContext",
 ]
 
 
 
 def read_datasource(source: _ds.Datasource, *,
-                    parallelism: int = 8) -> Dataset:
+                    parallelism: Optional[int] = None) -> Dataset:
+    if parallelism is None:
+        from ray_tpu.data.context import DataContext
+
+        parallelism = DataContext.get_current().read_parallelism
     return Dataset(Read(source.get_read_tasks(parallelism),
                         name=source.name))
 
 
-def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+def range(n: int, *, parallelism: Optional[int] = None) -> Dataset:  # noqa: A001
     return read_datasource(_ds.RangeDatasource(n), parallelism=parallelism)
 
 
 def range_tensor(n: int, *, shape: tuple = (1,),
-                 parallelism: int = 8) -> Dataset:
+                 parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(
         _ds.RangeDatasource(n, tensor_shape=tuple(shape), column="data"),
         parallelism=parallelism)
 
 
-def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+def from_items(items: List[Any], *, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.ItemsDatasource(list(items)),
                            parallelism=parallelism)
 
@@ -71,43 +76,43 @@ def from_arrow(tables) -> Dataset:
     return from_blocks(list(tables))
 
 
-def read_parquet(paths, *, parallelism: int = 8, **kw) -> Dataset:
+def read_parquet(paths, *, parallelism: Optional[int] = None, **kw) -> Dataset:
     return read_datasource(_ds.ParquetDatasource(paths, **kw),
                            parallelism=parallelism)
 
 
-def read_csv(paths, *, parallelism: int = 8, **kw) -> Dataset:
+def read_csv(paths, *, parallelism: Optional[int] = None, **kw) -> Dataset:
     return read_datasource(_ds.CSVDatasource(paths, **kw),
                            parallelism=parallelism)
 
 
-def read_json(paths, *, parallelism: int = 8, **kw) -> Dataset:
+def read_json(paths, *, parallelism: Optional[int] = None, **kw) -> Dataset:
     return read_datasource(_ds.JSONDatasource(paths, **kw),
                            parallelism=parallelism)
 
 
-def read_text(paths, *, parallelism: int = 8) -> Dataset:
+def read_text(paths, *, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.TextDatasource(paths),
                            parallelism=parallelism)
 
 
-def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
+def read_binary_files(paths, *, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.BinaryDatasource(paths),
                            parallelism=parallelism)
 
 
-def read_numpy(paths, *, parallelism: int = 8) -> Dataset:
+def read_numpy(paths, *, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.NumpyDatasource(paths),
                            parallelism=parallelism)
 
 
-def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
+def read_tfrecords(paths, *, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.TFRecordDatasource(paths),
                            parallelism=parallelism)
 
 
 def read_images(paths, *, size=None, mode: str = "RGB",
-                parallelism: int = 8) -> Dataset:
+                parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.ImageDatasource(paths, size=size, mode=mode),
                            parallelism=parallelism)
 

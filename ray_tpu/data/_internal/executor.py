@@ -63,6 +63,11 @@ class StreamingExecutor:
     POLL_INTERVAL = 0.003
 
     def __init__(self, topology: Topology, stats: Optional[ExecutorStats] = None):
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        self.OUTPUT_BUFFER = ctx.output_buffer
+        self.PER_OP_BUFFER = ctx.per_op_buffer
         self.topology = topology
         self.out: "queue.Queue[Optional[RefBundle]]" = queue.Queue()
         self.error: Optional[BaseException] = None

@@ -176,10 +176,15 @@ class TaskPoolMapOperator(PhysicalOperator):
 
     def __init__(self, name: str, specs: List[MapSpec],
                  read_tasks: Optional[List[Callable]] = None,
-                 max_concurrency: int = 8,
+                 max_concurrency: Optional[int] = None,
                  ray_remote_args: Optional[Dict] = None):
         super().__init__(name)
         self.specs = specs
+        if max_concurrency is None:
+            from ray_tpu.data.context import DataContext
+
+            max_concurrency = \
+                DataContext.get_current().max_tasks_in_flight_per_op
         self.max_concurrency = max_concurrency
         self.ray_remote_args = dict(ray_remote_args or {})
         self._inflight: List[Tuple[Any, Any]] = []  # (block_ref, meta_ref)

@@ -1,0 +1,41 @@
+"""DataContext — per-process execution configuration (reference:
+python/ray/data/context.py DataContext / DatasetContext: a thread-safe
+singleton of tunables read by the planner and streaming executor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    """Knobs for the streaming execution engine.
+
+    - ``read_parallelism``: default number of read tasks per datasource
+    - ``max_tasks_in_flight_per_op``: bounded concurrent tasks per map op
+    - ``per_op_buffer``: bundles buffered between operators (backpressure)
+    - ``output_buffer``: bundles buffered at the consumer edge
+    """
+
+    read_parallelism: int = 8
+    max_tasks_in_flight_per_op: int = 8
+    per_op_buffer: int = 32
+    output_buffer: int = 16
+
+    _lock = threading.Lock()
+    _current: Optional["DataContext"] = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = cls()
+            return cls._current
+
+    @classmethod
+    def _set_current(cls, ctx: "DataContext") -> None:
+        with cls._lock:
+            cls._current = ctx

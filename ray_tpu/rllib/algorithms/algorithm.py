@@ -68,7 +68,7 @@ class Algorithm(Trainable):
                 cfg.make_env(), cfg.num_envs_per_env_runner,
                 cfg.rollout_fragment_length, self._module_spec,
                 seed=cfg.seed + idx * 1000 + 1, explore=cfg.explore,
-                gamma=cfg.gamma)
+                gamma=cfg.gamma, connector=cfg.connector)
 
     # ---------------------------------------------------------------- train
     def step(self) -> Dict:

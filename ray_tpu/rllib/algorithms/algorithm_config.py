@@ -38,6 +38,8 @@ class AlgorithmConfig:
         self.framework_str = "jax"
         # fault tolerance (reference: restart_failed_env_runners)
         self.restart_failed_env_runners = True
+        # obs/action connector pipeline (reference: rllib/connectors/)
+        self.connector = None
 
     # ------------------------------------------------------- fluent setters
     def environment(self, env=None, *, env_config: Optional[Dict] = None
@@ -51,7 +53,8 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
-                    explore: Optional[bool] = None) -> "AlgorithmConfig":
+                    explore: Optional[bool] = None,
+                    connector=None) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
@@ -60,6 +63,8 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if explore is not None:
             self.explore = explore
+        if connector is not None:
+            self.connector = connector
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -142,6 +147,13 @@ class AlgorithmConfig:
             obs_space = probe.observation_space
             act_space = probe.action_space
             obs_dim = int(obs_space.shape[0])
+            if self.connector is not None:
+                # FrameStack-style connectors widen the feature dim
+                # (pipelines expose obs_multiplier; bare connectors
+                # obs_dim_multiplier)
+                obs_dim *= getattr(
+                    self.connector, "obs_multiplier",
+                    getattr(self.connector, "obs_dim_multiplier", 1))
             if isinstance(act_space, gym.spaces.Discrete):
                 return RLModuleSpec(
                     obs_dim=obs_dim, action_dim=int(act_space.n),

@@ -279,7 +279,8 @@ class DDPG(Algorithm):
                 cfg.make_env(), cfg.num_envs_per_env_runner,
                 cfg.rollout_fragment_length, self._module_spec,
                 seed=cfg.seed + idx * 1000 + 1, explore=cfg.explore,
-                gamma=cfg.gamma, collect_next_obs=True)
+                gamma=cfg.gamma, collect_next_obs=True,
+                connector=cfg.connector)
 
     def training_step(self) -> Dict:
         cfg = self.config

@@ -246,7 +246,8 @@ class DQN(Algorithm):
                 cfg.make_env(), cfg.num_envs_per_env_runner,
                 cfg.rollout_fragment_length, self._module_spec,
                 seed=cfg.seed + idx * 1000 + 1, explore=cfg.explore,
-                gamma=cfg.gamma, collect_next_obs=True)
+                gamma=cfg.gamma, collect_next_obs=True,
+                connector=cfg.connector)
 
     def _epsilon_at(self, step: int) -> float:
         sched = self.config.epsilon

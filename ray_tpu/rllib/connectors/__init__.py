@@ -1,0 +1,6 @@
+from ray_tpu.rllib.connectors.connector import (
+    ActionClip, Connector, ConnectorPipeline, FlattenObs, FrameStack,
+    NormalizeObs)
+
+__all__ = ["Connector", "ConnectorPipeline", "NormalizeObs", "FrameStack",
+           "FlattenObs", "ActionClip"]

@@ -19,7 +19,8 @@ class SingleAgentEnvRunner:
     def __init__(self, env_creator: Callable, num_envs: int,
                  rollout_fragment_length: int, module_spec,
                  seed: int = 0, explore: bool = True,
-                 gamma: float = 0.99, collect_next_obs: bool = False):
+                 gamma: float = 0.99, collect_next_obs: bool = False,
+                 connector=None):
         import gymnasium as gym
         import jax
 
@@ -31,6 +32,8 @@ class SingleAgentEnvRunner:
         self.module = module_spec.build()
         self._rng = jax.random.key(seed)
         self._explore = explore
+        # obs/action transform pipeline (reference: rllib/connectors/)
+        self.connector = connector
         # off-policy algos (DQN/SAC) need (s, a, r, s') tuples
         self._collect_next_obs = collect_next_obs
 
@@ -43,6 +46,9 @@ class SingleAgentEnvRunner:
         self._jit_forward = jax.jit(self.module.forward)
 
         obs, _ = self.env.reset(seed=seed)
+        if self.connector is not None:
+            self.connector.on_episode_start()
+            obs = self.connector.on_obs(obs)
         self._obs = obs.astype(np.float32)
         self._ep_return = np.zeros(num_envs)
         self._ep_len = np.zeros(num_envs, dtype=np.int64)
@@ -107,12 +113,21 @@ class SingleAgentEnvRunner:
             logp_buf[t] = np.asarray(logp)
             vf_buf[t] = np.asarray(vf)
             env_action = action
+            if self.connector is not None:
+                env_action = self.connector.on_action(env_action)
             if not self.module.spec.discrete:
                 low = self.env.single_action_space.low
                 high = self.env.single_action_space.high
-                env_action = np.clip(action, low, high)
+                env_action = np.clip(env_action, low, high)
             valid_buf[t] = ~self._prev_done
             obs, rew, term, trunc, _ = self.env.step(env_action)
+            if self.connector is not None:
+                # transform BEFORE any forward pass so vf bootstraps and
+                # the stored next obs see the same features as inference.
+                # prev_done envs just autoreset: this obs begins a fresh
+                # episode, so stateful connectors clear those rows
+                obs = self.connector.on_obs(obs,
+                                            reset_mask=self._prev_done)
             done = np.logical_or(term, trunc)
             rew = np.asarray(rew, np.float32)
             rew_raw = rew

@@ -22,6 +22,7 @@ from ray_tpu.serve._private.controller import (
     CONTROLLER_NAME, SERVE_NAMESPACE, ServeController)
 from ray_tpu.serve._private.proxy import ProxyActor, Request
 from ray_tpu.serve._private.replica import _HandlePlaceholder
+from ray_tpu.serve.grpc_util import ServeGrpcClient
 from ray_tpu.serve.schema import (
     DeploymentSchema, HTTPOptionsSchema, ServeApplicationSchema,
     ServeDeploySchema, build_app_schema)
@@ -33,17 +34,20 @@ __all__ = [
     "get_deployment_handle", "batch", "pad_batch", "multiplexed",
     "get_multiplexed_model_id", "build", "run_config",
     "DeploymentSchema", "ServeApplicationSchema", "ServeDeploySchema",
-    "HTTPOptionsSchema",
+    "HTTPOptionsSchema", "ServeGrpcClient", "get_grpc_port",
 ]
 
 PROXY_NAME = "SERVE_PROXY"
 _http_port: Optional[int] = None
+_grpc_port: Optional[int] = None
 
 
-def start(http_options: Optional[Dict] = None, detached: bool = True):
-    """Start the Serve control plane: controller + HTTP proxy
-    (reference: serve.start / _private/api.py)."""
-    global _http_port
+def start(http_options: Optional[Dict] = None, detached: bool = True,
+          grpc_options: Optional[Dict] = None):
+    """Start the Serve control plane: controller + HTTP (+ gRPC) proxy
+    (reference: serve.start / _private/api.py; gRPC ingress via
+    grpc_options={"port": ...})."""
+    global _http_port, _grpc_port
     http_options = http_options or {}
     try:
         ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
@@ -52,18 +56,26 @@ def start(http_options: Optional[Dict] = None, detached: bool = True):
         pass
     port = http_options.get("port", 8000)
     host = http_options.get("host", "127.0.0.1")
+    grpc_port = (grpc_options or {}).get("port")
     ray_tpu.remote(ServeController).options(
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
         max_concurrency=64, num_cpus=0.1).remote(http_port=port)
     proxy = ray_tpu.remote(ProxyActor).options(
         name=PROXY_NAME, namespace=SERVE_NAMESPACE,
-        max_concurrency=64, num_cpus=0.1).remote(port=port, host=host)
+        max_concurrency=64, num_cpus=0.1).remote(
+            port=port, host=host, grpc_port=grpc_port)
     _http_port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    if grpc_port is not None:
+        _grpc_port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=30)
 
 
 def get_http_port() -> Optional[int]:
     """The proxy's bound port (0 in http_options picks a free one)."""
     return _http_port
+
+
+def get_grpc_port() -> Optional[int]:
+    return _grpc_port
 
 
 def _controller():
@@ -199,7 +211,8 @@ def get_deployment_handle(deployment_name: str,
 
 def shutdown() -> None:
     """Tear down all applications + the control plane."""
-    global _http_port
+    global _http_port, _grpc_port
+    _grpc_port = None
     try:
         ctrl = _controller()
     except Exception:

@@ -38,13 +38,16 @@ class Request:
 
 
 class ProxyActor:
-    def __init__(self, port: int = 8000, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 8000, host: str = "127.0.0.1",
+                 grpc_port: Optional[int] = None):
         self.port = port
         self.host = host
+        self.grpc_port = grpc_port
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._handles: Dict[Tuple[str, str], Any] = {}
         self._routes_snapshot = 0
         self._server: Optional[asyncio.AbstractServer] = None
+        self._grpc_server = None
         self._poll_task = None
 
     async def ready(self) -> int:
@@ -55,7 +58,26 @@ class ProxyActor:
             self.port = self._server.sockets[0].getsockname()[1]
             loop = asyncio.get_running_loop()
             self._poll_task = loop.create_task(self._poll_routes())
+            if self.grpc_port is not None:
+                await self._start_grpc()
         return self.port
+
+    async def _start_grpc(self) -> None:
+        """gRPC ingress next to HTTP (reference: the grpc server in
+        serve/_private/proxy.py, generic service in grpc_util.py)."""
+        import grpc
+
+        from ray_tpu.serve.grpc_util import make_generic_handler
+
+        self._grpc_server = grpc.aio.server()
+        self._grpc_server.add_generic_rpc_handlers(
+            (make_generic_handler(self._get_handle, lambda: self._routes),))
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"{self.host}:{self.grpc_port}")
+        await self._grpc_server.start()
+
+    async def get_grpc_port(self) -> Optional[int]:
+        return self.grpc_port
 
     def _controller(self):
         from ray_tpu.serve._private.controller import (

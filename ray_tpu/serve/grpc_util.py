@@ -1,0 +1,107 @@
+"""gRPC ingress (reference: python/ray/serve/grpc_util.py + the gRPC
+server inside _private/proxy.py ProxyActor — requests routed to
+applications by the ``application`` invocation metadata key).
+
+The reference serves user-generated protobuf servicers; here the ingress
+is a generic byte service so no generated stubs are needed:
+
+- method: ``/ray_tpu.serve.ServeAPIService/Predict`` (unary-unary, raw
+  bytes in/out)
+- metadata: ``application`` (required) — the target app;
+  ``multiplexed_model_id`` (optional) — forwarded to the handle
+- request bytes are cloudpickle-deserialized and passed to the ingress
+  deployment's ``__call__``; the return value is cloudpickle'd back
+
+``ServeGrpcClient`` wraps the channel plumbing for Python callers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+HEALTH_METHOD = "/ray_tpu.serve.ServeAPIService/Healthz"
+PREDICT_METHOD = "/ray_tpu.serve.ServeAPIService/Predict"
+
+
+def make_generic_handler(get_handle, list_routes):
+    """A grpc GenericRpcHandler serving Predict/Healthz without generated
+    stubs (raw-bytes serializers)."""
+    import cloudpickle
+    import grpc
+
+    async def predict(request: bytes, context) -> bytes:
+        md = dict(context.invocation_metadata())
+        app = md.get("application")
+        if not app:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "missing 'application' metadata")
+        routes = list_routes()
+        target = None
+        for prefix, (app_name, ingress) in routes.items():
+            if app_name == app:
+                target = (app_name, ingress)
+                break
+        if target is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no application {app!r}")
+        payload = cloudpickle.loads(request) if request else None
+        handle = get_handle(*target)
+        model_id = md.get("multiplexed_model_id")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        import asyncio
+
+        # honor the client's RPC deadline instead of a fixed 60s so a
+        # timed-out call doesn't pin a to_thread worker afterwards
+        remaining = context.time_remaining()
+        timeout_s = remaining if remaining is not None else 60.0
+        response = handle.remote(payload)
+        try:
+            result = await asyncio.to_thread(
+                response.result, max(0.1, timeout_s))
+        except TimeoutError:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                "backend timed out")
+        return cloudpickle.dumps(result)
+
+    async def healthz(request: bytes, context) -> bytes:
+        return b"success"
+
+    class _Handler(grpc.GenericRpcHandler):
+        def service(self, call_details):
+            if call_details.method == PREDICT_METHOD:
+                return grpc.unary_unary_rpc_method_handler(predict)
+            if call_details.method == HEALTH_METHOD:
+                return grpc.unary_unary_rpc_method_handler(healthz)
+            return None
+
+    return _Handler()
+
+
+class ServeGrpcClient:
+    """Convenience client for the generic gRPC ingress."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self._predict = self._channel.unary_unary(PREDICT_METHOD)
+        self._healthz = self._channel.unary_unary(HEALTH_METHOD)
+
+    def predict(self, application: str, payload: Any,
+                multiplexed_model_id: Optional[str] = None,
+                timeout: float = 60.0) -> Any:
+        import cloudpickle
+
+        md = [("application", application)]
+        if multiplexed_model_id:
+            md.append(("multiplexed_model_id", multiplexed_model_id))
+        out = self._predict(cloudpickle.dumps(payload), metadata=md,
+                            timeout=timeout)
+        return cloudpickle.loads(out)
+
+    def healthz(self, timeout: float = 10.0) -> bool:
+        return self._healthz(b"", timeout=timeout) == b"success"
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,99 @@
+"""Autoscaler v2 — instance state machine + reconciler + status SDK
+(reference: python/ray/autoscaler/v2/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.v2 import (
+    Instance, InstanceManager, Reconciler, get_cluster_status)
+from ray_tpu.autoscaler.v2.instance_manager import (
+    ALLOCATED, QUEUED, RAY_RUNNING, REQUESTED, TERMINATED, TERMINATING)
+
+
+class FakeProvider:
+    def __init__(self):
+        self.nodes = {}
+        self._n = 0
+        self.joined = {}
+
+    def create_node(self, node_type, count):
+        out = []
+        for _ in range(count):
+            self._n += 1
+            cid = f"cloud-{self._n}"
+            self.nodes[cid] = node_type
+            out.append(cid)
+        return out
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+    def terminate_node(self, cid):
+        self.nodes.pop(cid, None)
+
+    def runtime_node_id(self, cid):
+        return self.joined.get(cid)
+
+
+def test_instance_lifecycle_and_reconcile():
+    provider = FakeProvider()
+    mgr = InstanceManager()
+    cluster_nodes = []
+    rec = Reconciler(mgr, provider, lambda: cluster_nodes)
+
+    mgr.request_instances("worker", 2)
+    assert len(mgr.instances(QUEUED)) == 2
+
+    t = rec.reconcile()
+    assert t.get("launched") == 2
+    # launched instances become ALLOCATED on the next pass (they appear in
+    # the provider's live list)
+    rec.reconcile()
+    assert len(mgr.instances(ALLOCATED)) == 2
+
+    # nodes join the cluster -> RAY_RUNNING
+    for inst in mgr.instances(ALLOCATED):
+        provider.joined[inst.cloud_instance_id] = \
+            "node-" + inst.cloud_instance_id
+        cluster_nodes.append("node-" + inst.cloud_instance_id)
+    rec.reconcile()
+    assert len(mgr.instances(RAY_RUNNING)) == 2
+
+    # terminate one
+    victim = mgr.instances(RAY_RUNNING)[0]
+    mgr.terminate_instance(victim.instance_id)
+    assert victim.status == TERMINATING
+    rec.reconcile()
+    assert victim.status == TERMINATED
+    assert victim.cloud_instance_id not in provider.nodes
+
+    # the other dies underneath us
+    other = mgr.instances(RAY_RUNNING)[0]
+    provider.nodes.pop(other.cloud_instance_id)
+    t = rec.reconcile()
+    assert t.get("lost") == 1
+    assert other.status == TERMINATED
+
+
+def test_instance_storage_versioning():
+    mgr = InstanceManager()
+    (inst,) = mgr.request_instances("worker", 1)
+    v0 = inst.version
+    inst.transition(REQUESTED)
+    assert inst.version == v0 + 1
+    # optimistic concurrency: stale version rejected
+    clone = Instance(instance_id=inst.instance_id, instance_type="worker")
+    assert not mgr.storage.upsert(clone, expected_version=v0)
+    assert mgr.storage.upsert(clone, expected_version=inst.version)
+
+
+def test_get_cluster_status():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=2)
+    try:
+        st = get_cluster_status()
+        assert len(st.active_nodes()) >= 1
+        assert st.total_resources.get("CPU") == 2.0
+        assert "CPU" in st.available_resources
+    finally:
+        ray_tpu.shutdown()

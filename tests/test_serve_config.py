@@ -86,6 +86,28 @@ def test_run_config_app_builder(ray4):
     serve.delete("builderapp")
 
 
+def test_grpc_ingress(ray4):
+    """Generic gRPC ingress: predict by application metadata, healthz,
+    NOT_FOUND for unknown apps (reference: serve gRPC proxy)."""
+    import grpc
+
+    serve.shutdown()  # fresh control plane so grpc_options take effect
+    serve.start(http_options={"port": 0}, grpc_options={"port": 0})
+    serve.run(Doubler.bind(), name="grpcapp", route_prefix="/grpc")
+    port = serve.get_grpc_port()
+    assert port
+    client = serve.ServeGrpcClient(f"127.0.0.1:{port}")
+    try:
+        assert client.healthz()
+        assert client.predict("grpcapp", 21) == 42
+        with pytest.raises(grpc.RpcError) as err:
+            client.predict("missing-app", 1)
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        client.close()
+        serve.delete("grpcapp")
+
+
 def test_dashboard_serve_rest(ray4):
     from ray_tpu.dashboard import start_dashboard
 

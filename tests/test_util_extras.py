@@ -101,6 +101,21 @@ def test_remote_pdb_drives_session():
     conn.close()
 
 
+def test_gated_tracking_integrations():
+    from ray_tpu.air.integrations import (
+        CometLoggerCallback, MLflowLoggerCallback, WandbLoggerCallback)
+
+    for cls, lib in ((WandbLoggerCallback, "wandb"),
+                     (MLflowLoggerCallback, "mlflow"),
+                     (CometLoggerCallback, "comet_ml")):
+        try:
+            __import__(lib)
+            cls()  # constructible when the client is present
+        except ImportError:
+            with pytest.raises(ImportError, match=lib):
+                cls()
+
+
 def test_gated_dask_spark():
     from ray_tpu.util import dask as rdask
     from ray_tpu.util import spark as rspark
