@@ -12,18 +12,12 @@ from __future__ import annotations
 import numbers
 from typing import Dict, Optional
 
-from ray_tpu.tune.logger import LoggerCallback
+from ray_tpu.tune.logger import LoggerCallback, _flatten
 
 
-def _flat_numbers(d: Dict, prefix: str = "") -> Dict[str, float]:
-    out = {}
-    for k, v in d.items():
-        key = f"{prefix}/{k}" if prefix else str(k)
-        if isinstance(v, dict):
-            out.update(_flat_numbers(v, key))
-        elif isinstance(v, numbers.Number):
-            out[key] = float(v)
-    return out
+def _flat_numbers(d: Dict) -> Dict[str, float]:
+    return {k: float(v) for k, v in _flatten(d).items()
+            if isinstance(v, numbers.Number)}
 
 
 class WandbLoggerCallback(LoggerCallback):

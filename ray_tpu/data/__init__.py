@@ -24,7 +24,8 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "from_blocks", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "read_datasource",
-    "read_tfrecords", "read_images", "from_torch", "DataContext",
+    "read_tfrecords", "read_images", "read_webdataset", "from_torch",
+    "DataContext",
 ]
 
 
@@ -114,6 +115,11 @@ def read_tfrecords(paths, *, parallelism: Optional[int] = None) -> Dataset:
 def read_images(paths, *, size=None, mode: str = "RGB",
                 parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(_ds.WebDatasetDatasource(paths),
                            parallelism=parallelism)
 
 

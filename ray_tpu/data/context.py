@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Optional
+from typing import ClassVar, Optional
 
 
 @dataclasses.dataclass
@@ -25,8 +25,8 @@ class DataContext:
     per_op_buffer: int = 32
     output_buffer: int = 16
 
-    _lock = threading.Lock()
-    _current: Optional["DataContext"] = None
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+    _current: ClassVar[Optional["DataContext"]] = None
 
     @classmethod
     def get_current(cls) -> "DataContext":

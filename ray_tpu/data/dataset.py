@@ -34,8 +34,13 @@ class ActorPoolStrategy:
 
 class Dataset:
     def __init__(self, last_op: L.LogicalOperator,
-                 max_concurrency: int = 8):
+                 max_concurrency: Optional[int] = None):
         self._last_op = last_op
+        if max_concurrency is None:
+            from ray_tpu.data.context import DataContext
+
+            max_concurrency = \
+                DataContext.get_current().max_tasks_in_flight_per_op
         self._max_concurrency = max_concurrency
         self._last_stats: Optional[ExecutorStats] = None
 

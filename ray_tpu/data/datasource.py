@@ -193,6 +193,58 @@ class TFRecordDatasource(FileDatasource):
         return BlockAccessor.rows_to_block(rows)
 
 
+class WebDatasetDatasource(FileDatasource):
+    """WebDataset tar shards (reference: data/datasource/
+    webdataset_datasource.py): samples are groups of tar members sharing
+    a basename — ``0001.jpg`` + ``0001.cls`` -> one row with columns
+    ``jpg``, ``cls`` (+ ``__key__``). Decoding: .json -> object,
+    common image suffixes -> HWC uint8 via PIL, text suffixes -> str,
+    everything else raw bytes."""
+
+    name = "ReadWebDataset"
+    suffix = ".tar"
+
+    IMAGE_EXTS = ("jpg", "jpeg", "png", "bmp", "webp")
+    TEXT_EXTS = ("txt", "cls", "text")
+
+    def _decode(self, ext: str, data: bytes):
+        import io
+        import json as _json
+
+        if ext == "json":
+            return _json.loads(data)
+        if ext in self.IMAGE_EXTS:
+            from PIL import Image
+
+            return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        if ext in self.TEXT_EXTS:
+            return data.decode()
+        return data
+
+    def read_file(self, path: str):
+        import tarfile
+
+        from ray_tpu.data.block import BlockAccessor
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                base, _, ext = member.name.rpartition(".")
+                if not base:
+                    base, ext = member.name, ""
+                if base not in samples:
+                    samples[base] = {"__key__": base}
+                    order.append(base)
+                data = tf.extractfile(member).read()
+                samples[base][ext.lower()] = self._decode(ext.lower(),
+                                                          data)
+        return BlockAccessor.rows_to_block(
+            [samples[k] for k in order])
+
+
 class ImageDatasource(FileDatasource):
     """Image files via PIL (reference: data/datasource/
     image_datasource.py): columns ``image`` (HWC uint8) + ``path``."""

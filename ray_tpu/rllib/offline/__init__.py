@@ -1,3 +1,7 @@
+from ray_tpu.rllib.offline.estimators import (
+    DirectMethod, DoublyRobust, ImportanceSampling,
+    WeightedImportanceSampling)
 from ray_tpu.rllib.offline.json_io import JsonReader, JsonWriter
 
-__all__ = ["JsonReader", "JsonWriter"]
+__all__ = ["JsonReader", "JsonWriter", "ImportanceSampling",
+           "WeightedImportanceSampling", "DirectMethod", "DoublyRobust"]

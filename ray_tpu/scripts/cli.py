@@ -101,6 +101,8 @@ def cmd_stop(args) -> int:
 def _connect():
     import ray_tpu
 
+    if ray_tpu.is_initialized():
+        return ray_tpu
     if not os.path.exists(ADDR_FILE):
         print("no running head (start one with: "
               "python -m ray_tpu.scripts.cli start --head)")
@@ -179,6 +181,49 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_serve_deploy(args) -> int:
+    """Declarative deploy from a JSON config file (reference: `serve
+    deploy config.yaml`; JSON here — no yaml dep in the image)."""
+    import json as _json
+
+    from ray_tpu import serve
+
+    _connect()
+    with open(args.config_file) as f:
+        config = _json.load(f)
+    serve.run_config(config)
+    print(f"deployed {len(config.get('applications', []))} application(s)")
+    return 0
+
+
+def cmd_serve_status(args) -> int:
+    import json as _json
+
+    from ray_tpu import serve
+
+    _connect()
+    try:
+        ctrl = serve._controller()
+        routes = __import__("ray_tpu").get(
+            ctrl.get_routes.remote(), timeout=30)
+    except Exception:
+        print(_json.dumps({"applications": {}}, indent=2))
+        return 0
+    out = {app: {**serve.status(app), "route_prefix": prefix}
+           for prefix, (app, _ingress) in routes.items()}
+    print(_json.dumps({"applications": out}, indent=2))
+    return 0
+
+
+def cmd_serve_shutdown(args) -> int:
+    from ray_tpu import serve
+
+    _connect()
+    serve.shutdown()
+    print("serve shut down")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -216,6 +261,16 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("metrics", help="Prometheus metrics dump")
     s.set_defaults(fn=cmd_metrics)
+
+    serve_p = sub.add_parser("serve", help="serve control")
+    serve_sub = serve_p.add_subparsers(dest="serve_cmd", required=True)
+    s = serve_sub.add_parser("deploy", help="deploy a JSON config")
+    s.add_argument("config_file")
+    s.set_defaults(fn=cmd_serve_deploy)
+    s = serve_sub.add_parser("status", help="application status")
+    s.set_defaults(fn=cmd_serve_status)
+    s = serve_sub.add_parser("shutdown", help="tear serve down")
+    s.set_defaults(fn=cmd_serve_shutdown)
 
     args = p.parse_args(argv)
     try:

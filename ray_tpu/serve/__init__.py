@@ -51,7 +51,14 @@ def start(http_options: Optional[Dict] = None, detached: bool = True,
     http_options = http_options or {}
     try:
         ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        if (grpc_options or {}).get("port") is not None and \
+                _grpc_port is None:
+            raise RuntimeError(
+                "serve is already running without a gRPC ingress; call "
+                "serve.shutdown() first to start with grpc_options")
         return
+    except RuntimeError:
+        raise
     except Exception:
         pass
     port = http_options.get("port", 8000)

@@ -72,8 +72,13 @@ class ProxyActor:
         self._grpc_server = grpc.aio.server()
         self._grpc_server.add_generic_rpc_handlers(
             (make_generic_handler(self._get_handle, lambda: self._routes),))
-        self.grpc_port = self._grpc_server.add_insecure_port(
+        bound = self._grpc_server.add_insecure_port(
             f"{self.host}:{self.grpc_port}")
+        if bound == 0:
+            raise RuntimeError(
+                f"gRPC ingress could not bind {self.host}:{self.grpc_port}"
+                " (port in use or not permitted)")
+        self.grpc_port = bound
         await self._grpc_server.start()
 
     async def get_grpc_port(self) -> Optional[int]:

@@ -123,6 +123,74 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         *args, **(kwargs or {}))
 
 
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              args: tuple = (), kwargs: Optional[Dict] = None):
+    """Non-blocking run (reference: workflow/api.py:177 run_async) —
+    returns a concurrent.futures.Future of the workflow result."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    init()
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000)}"
+    pool = ThreadPoolExecutor(max_workers=1,
+                              thread_name_prefix=f"wf-{workflow_id}")
+    fut = pool.submit(
+        lambda: _DurableExecutor(workflow_id, dag).run(
+            *args, **(kwargs or {})))
+    fut.add_done_callback(lambda _: pool.shutdown(wait=False))
+    fut.workflow_id = workflow_id
+    return fut
+
+
+# ------------------------------------------------------------------ events
+class EventListener:
+    """Event source ABC (reference: workflow/event_system —
+    EventListener.poll_for_event; the HTTPEventProvider is an
+    implementation detail of its hosted variant). ``poll_for_event``
+    blocks until the event arrives and returns its payload."""
+
+    def poll_for_event(self) -> Any:
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires after ``seconds`` (reference: the timer event example)."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def poll_for_event(self) -> float:
+        time.sleep(self.seconds)
+        return time.time()
+
+
+class FileEventListener(EventListener):
+    """Fires when ``path`` exists; payload is its contents (a minimal
+    external-event provider usable across processes)."""
+
+    def __init__(self, path: str, poll_interval: float = 0.1):
+        self.path = path
+        self.poll_interval = poll_interval
+
+    def poll_for_event(self) -> bytes:
+        while not os.path.exists(self.path):
+            time.sleep(self.poll_interval)
+        with open(self.path, "rb") as f:
+            return f.read()
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
+    """A DAG step that completes when the listener's event arrives
+    (reference: workflow.wait_for_event). Like any step, the received
+    payload is checkpointed — a resumed workflow does NOT wait again."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def __wait_for_event__():
+        return listener_cls(*args, **kwargs).poll_for_event()
+
+    return __wait_for_event__.bind()
+
+
 def resume(workflow_id: str, dag: DAGNode, *, args: tuple = (),
            kwargs: Optional[Dict] = None) -> Any:
     """Re-run a workflow; completed steps are served from checkpoints.

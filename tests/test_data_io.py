@@ -54,6 +54,56 @@ def test_read_images(ray4, tmp_path):
     assert vals == [0, 10, 20, 30]
 
 
+def test_read_webdataset(ray4, tmp_path):
+    import io
+    import json
+    import tarfile
+
+    from PIL import Image
+
+    tar_path = tmp_path / "shard-000.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(3):
+            img = Image.fromarray(np.full((4, 4, 3), i * 20, np.uint8))
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+
+            def add(name, data):
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+
+            add(f"{i:04d}.png", buf.getvalue())
+            add(f"{i:04d}.cls", str(i % 2).encode())
+            add(f"{i:04d}.json", json.dumps({"idx": i}).encode())
+    rows = sorted(rd.read_webdataset(str(tar_path)).take_all(),
+                  key=lambda r: r["__key__"])
+    assert len(rows) == 3
+    assert rows[1]["png"].shape == (4, 4, 3)
+    assert int(rows[1]["png"][0, 0, 0]) == 20
+    assert rows[1]["cls"] == "1"
+    assert rows[2]["json"]["idx"] == 2
+
+
+def test_cli_serve_commands(ray4, tmp_path):
+    """`ray-tpu serve deploy/status/shutdown` (reference: serve CLI)."""
+    import json
+
+    from ray_tpu.scripts.cli import main as cli_main
+
+    cfg = {"applications": [{
+        "import_path": "tests.test_serve_config:doubler_app",
+        "name": "cliapp", "route_prefix": "/cli"}]}
+    cfg_file = tmp_path / "serve.json"
+    cfg_file.write_text(json.dumps(cfg))
+    assert cli_main(["serve", "deploy", str(cfg_file)]) == 0
+    assert cli_main(["serve", "status"]) == 0
+    from ray_tpu import serve
+
+    assert serve.status("cliapp")["status"] == "RUNNING"
+    assert cli_main(["serve", "shutdown"]) == 0
+
+
 def test_iter_torch_batches(ray4):
     import torch
 

@@ -205,6 +205,35 @@ def test_workflow_run_and_resume(ray4, tmp_path):
         workflow.list_all()
 
 
+def test_workflow_run_async_and_events(ray4, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def combine(payload, x):
+        return (bytes(payload), x)
+
+    event_file = str(tmp_path / "event_payload")
+    with InputNode() as inp:
+        dag = combine.bind(
+            workflow.wait_for_event(
+                workflow.FileEventListener, event_file), inp)
+
+    fut = workflow.run_async(dag, workflow_id="wf_evt", args=(7,))
+    assert not fut.done()  # blocked on the event
+    with open(event_file, "wb") as f:
+        f.write(b"fired")
+    payload, x = fut.result(timeout=120)
+    assert payload == b"fired" and x == 7
+    assert workflow.get_status("wf_evt") == "SUCCESSFUL"
+    # resume does NOT wait again: the event payload was checkpointed
+    os.remove(event_file)
+    payload2, _ = workflow.resume("wf_evt", dag, args=(7,))
+    assert payload2 == b"fired"
+
+
 # ----------------------------------------------------------- job submission
 def test_job_submission(ray4, tmp_path):
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
