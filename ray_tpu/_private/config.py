@@ -25,6 +25,7 @@ _flag("scheduler_spread_threshold", 0.5)  # hybrid policy: prefer local below th
 _flag("scheduler_top_k_fraction", 0.2)
 _flag("max_pending_lease_requests_per_scheduling_category", 10)
 _flag("worker_lease_timeout_ms", 30_000)
+_flag("lease_pipeline_depth", 2)  # tasks in flight per leased worker
 _flag("actor_creation_timeout_ms", 120_000)
 
 # --- object store -----------------------------------------------------------

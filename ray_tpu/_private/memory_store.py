@@ -4,6 +4,11 @@ Parity with the reference's core-worker memory store (reference:
 ``src/ray/core_worker/store_provider/memory_store/memory_store.h``): small
 task returns and errors skip shared memory entirely and resolve ``get``/
 ``wait`` directly in the owner process.
+
+Waits are targeted: each waiter registers the exact ids it is missing, and a
+``put`` wakes only waiters it satisfies. The naive broadcast alternative wakes
+every blocked ``get`` on every unrelated ``put`` — O(n²) context switches when
+a driver gathers a large batch of task returns on a loaded box.
 """
 
 from __future__ import annotations
@@ -21,16 +26,38 @@ class _Entry:
         self.is_exception = is_exception
 
 
+class _Waiter:
+    __slots__ = ("missing", "need_more", "event")
+
+    def __init__(self, missing: set, need_more: int):
+        self.missing = missing      # ids not yet present
+        self.need_more = need_more  # how many more arrivals satisfy the wait
+        self.event = threading.Event()
+
+
 class MemoryStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._objects: Dict[bytes, _Entry] = {}
-        self._cv = threading.Condition(self._lock)
+        self._waiters: List[_Waiter] = []
 
     def put(self, object_id: bytes, data: bytes, is_exception: bool = False) -> None:
-        with self._cv:
+        wake: List[_Waiter] = []
+        with self._lock:
             self._objects[object_id] = _Entry(data, is_exception)
-            self._cv.notify_all()
+            if self._waiters:
+                still = []
+                for w in self._waiters:
+                    if object_id in w.missing:
+                        w.missing.discard(object_id)
+                        w.need_more -= 1
+                        if w.need_more <= 0:
+                            wake.append(w)
+                            continue
+                    still.append(w)
+                self._waiters = still
+        for w in wake:
+            w.event.set()
 
     def contains(self, object_id: bytes) -> bool:
         with self._lock:
@@ -49,22 +76,41 @@ class MemoryStore:
         self, object_ids: List[bytes], num_returns: int, timeout: Optional[float]
     ) -> Tuple[List[bytes], List[bytes]]:
         """Block until num_returns of object_ids are present (or timeout)."""
+        if len(object_ids) > 1 and len(set(object_ids)) != len(object_ids):
+            # duplicates would double-count toward need_more and hang the wait
+            object_ids = list(dict.fromkeys(object_ids))
+            num_returns = min(num_returns, len(object_ids))
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cv:
-            while True:
+        while True:
+            with self._lock:
                 ready = [oid for oid in object_ids if oid in self._objects]
                 if len(ready) >= num_returns:
                     ready = ready[:num_returns]
-                    remaining = [oid for oid in object_ids if oid not in set(ready)]
+                    ready_set = set(ready)
+                    remaining = [o for o in object_ids if o not in ready_set]
                     return ready, remaining
-                if deadline is not None:
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        remaining = [oid for oid in object_ids if oid not in set(ready)]
-                        return ready, remaining
-                    self._cv.wait(left)
-                else:
-                    self._cv.wait()
+                waiter = _Waiter(
+                    {o for o in object_ids if o not in self._objects},
+                    num_returns - len(ready),
+                )
+                self._waiters.append(waiter)
+            left = None if deadline is None else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                satisfied = False
+            else:
+                satisfied = waiter.event.wait(left)
+            with self._lock:
+                if waiter in self._waiters:
+                    self._waiters.remove(waiter)
+            if not satisfied and (deadline is not None
+                                  and time.monotonic() >= deadline):
+                with self._lock:
+                    ready = [oid for oid in object_ids if oid in self._objects]
+                ready = ready[:num_returns]
+                ready_set = set(ready)
+                remaining = [o for o in object_ids if o not in ready_set]
+                return ready, remaining
+            # satisfied (or spurious): loop re-checks under the lock
 
     def size(self) -> int:
         with self._lock:

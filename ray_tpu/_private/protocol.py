@@ -45,22 +45,65 @@ class ConnectionLost(RpcError):
 # ---------------------------------------------------------------------------
 
 
+# Frames above this size await transport drain (flow control); smaller frames
+# ride the write-combining buffer without touching the socket until the next
+# loop tick, so replies/pushes issued in one scheduling burst become one send.
+_DRAIN_THRESHOLD = 64 * 1024
+
+
 class Connection:
-    """One accepted connection on the server side."""
+    """One accepted connection on the server side.
+
+    Writes are combined: frames queue on a list and one `call_soon` flushes
+    them in a single socket send (reference batches via gRPC's own transport;
+    here coalescing replaces per-reply write+drain syscalls).
+    """
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self.reader = reader
         self.writer = writer
         self.meta: Dict[str, Any] = {}  # handshake info (worker id, role, ...)
         self.closed = False
-        self._send_lock = asyncio.Lock()
+        self._loop = asyncio.get_event_loop()
+        self._outbuf: list = []
+        self._buffered = 0
+        self._flush_scheduled = False
+
+    def send_nowait(self, msg: Any) -> None:
+        if self.closed:
+            return
+        self._outbuf.append(pack(msg))
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_out)
+
+    def _flush_out(self) -> None:
+        self._flush_scheduled = False
+        self._buffered = 0
+        if not self._outbuf or self.closed:
+            self._outbuf.clear()
+            return
+        data = self._outbuf[0] if len(self._outbuf) == 1 else b"".join(self._outbuf)
+        self._outbuf.clear()
+        try:
+            self.writer.write(data)
+        except (ConnectionError, RuntimeError):
+            self.closed = True
 
     async def send(self, msg: Any) -> None:
         if self.closed:
             return
-        async with self._send_lock:
+        body = pack(msg)
+        self._outbuf.append(body)
+        self._buffered += len(body)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_out)
+        if len(body) >= _DRAIN_THRESHOLD or self._buffered >= 4 * _DRAIN_THRESHOLD:
+            # flush NOW so drain sees the bytes (a call_soon flush would run
+            # after drain returned un-paused), then apply real backpressure
+            self._flush_out()
             try:
-                self.writer.write(pack(msg))
                 await self.writer.drain()
             except (ConnectionError, RuntimeError):
                 self.closed = True
@@ -167,7 +210,10 @@ class AsyncRpcClient:
         self._next_id = 0
         self._push_handler: Optional[Callable[[str, Any], Awaitable[None]]] = None
         self._read_task: Optional[asyncio.Task] = None
-        self._send_lock = asyncio.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._outbuf: list = []
+        self._buffered = 0
+        self._flush_scheduled = False
         self.connected = False
 
     async def connect_tcp(self, host: str, port: int) -> None:
@@ -180,7 +226,29 @@ class AsyncRpcClient:
 
     def _start(self):
         self.connected = True
-        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._loop = asyncio.get_running_loop()
+        self._read_task = self._loop.create_task(self._read_loop())
+
+    # ------------------------------------------------------ write combining
+    def _queue_frame(self, data: bytes) -> None:
+        self._outbuf.append(data)
+        self._buffered += len(data)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_out)
+
+    def _flush_out(self) -> None:
+        self._flush_scheduled = False
+        self._buffered = 0
+        if not self._outbuf or self._writer is None:
+            self._outbuf.clear()
+            return
+        data = self._outbuf[0] if len(self._outbuf) == 1 else b"".join(self._outbuf)
+        self._outbuf.clear()
+        try:
+            self._writer.write(data)
+        except (ConnectionError, RuntimeError):
+            self.connected = False
 
     def set_push_handler(self, fn) -> None:
         self._push_handler = fn
@@ -210,30 +278,61 @@ class AsyncRpcClient:
                     fut.set_exception(ConnectionLost("connection lost"))
             self._pending.clear()
 
-    async def call(self, method: str, payload: Any, timeout: Optional[float] = None) -> Any:
+    def call_future(self, method: str, payload: Any) -> asyncio.Future:
+        """Issue a request and return the reply future without awaiting.
+
+        Loop-thread only. Lets callers attach done-callbacks instead of
+        spawning a coroutine per request (the driver's task-dispatch hot loop).
+        """
+        fut = self._loop.create_future()
         if not self.connected:
             # the read loop died (peer gone): a write would be silently
             # dropped by the dead transport and the reply future would
             # hang forever — fail fast so callers can retry post-reconnect
+            fut.set_exception(ConnectionLost("not connected"))
+            return fut
+        self._next_id += 1
+        req_id = self._next_id
+        self._pending[req_id] = fut
+        fut.add_done_callback(lambda _f, rid=req_id: self._pending.pop(rid, None))
+        self._queue_frame(pack({"m": method, "i": req_id, "p": payload}))
+        return fut
+
+    async def call(self, method: str, payload: Any, timeout: Optional[float] = None) -> Any:
+        if not self.connected:
             raise ConnectionLost("not connected")
         self._next_id += 1
         req_id = self._next_id
-        fut = asyncio.get_running_loop().create_future()
+        fut = self._loop.create_future()
         self._pending[req_id] = fut
         try:
-            async with self._send_lock:
-                self._writer.write(pack({"m": method, "i": req_id, "p": payload}))
-                await self._writer.drain()
+            body = pack({"m": method, "i": req_id, "p": payload})
+            self._queue_frame(body)
+            if len(body) >= _DRAIN_THRESHOLD or self._buffered >= 4 * _DRAIN_THRESHOLD:
+                self._flush_out()
+                try:
+                    await self._writer.drain()
+                except (ConnectionError, RuntimeError):
+                    raise ConnectionLost("connection lost")
             if timeout:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
         finally:
             self._pending.pop(req_id, None)
 
+    def push_nowait(self, method: str, payload: Any) -> None:
+        """One-way fire-and-forget push; loop-thread only, write-combined."""
+        self._queue_frame(pack({"m": method, "i": 0, "p": payload}))
+
     async def push(self, method: str, payload: Any) -> None:
-        async with self._send_lock:
-            self._writer.write(pack({"m": method, "i": 0, "p": payload}))
-            await self._writer.drain()
+        body = pack({"m": method, "i": 0, "p": payload})
+        self._queue_frame(body)
+        if len(body) >= _DRAIN_THRESHOLD or self._buffered >= 4 * _DRAIN_THRESHOLD:
+            self._flush_out()
+            try:
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError):
+                self.connected = False
 
     def close(self) -> None:
         self.connected = False

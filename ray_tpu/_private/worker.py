@@ -253,6 +253,10 @@ class WorkerConn:
         self.idle_since = 0.0
         self.dead = False
         self.inflight = 0  # tasks pushed and not yet replied (pipelining)
+        # Monotonic dispatch timestamps of in-flight tasks (FIFO: the worker
+        # executes and replies in push order). Used to detect a long-running
+        # head-of-line task so new work is not pipelined behind it.
+        self.dispatch_times: deque = deque()
 
 
 class Worker:
@@ -289,6 +293,14 @@ class Worker:
         self.task_events: List[Dict] = []
         self.actor_instance = None  # set in actor workers
         self.log_prefix = ""
+        # Coalesced main-thread → loop-thread doorbell: N submissions in one
+        # burst become one loop wakeup (reference batches this boundary via
+        # the Cython-held io_service post in core_worker.cc; pure-Python pays
+        # ~1ms per run_coroutine_threadsafe under CPU contention without it).
+        self._inbox: deque = deque()
+        self._inbox_mu = threading.Lock()
+        self._inbox_armed = False
+        self._direct_addr_cache: Optional[Dict] = None
 
     # ------------------------------------------------------------- lifecycle
     def connect(
@@ -431,8 +443,12 @@ class Worker:
             global_worker = None
 
     def direct_addr(self) -> Dict:
-        return {"host": node_ip(), "port": self.direct_port,
-                "worker_id": self.worker_id.hex()}
+        addr = self._direct_addr_cache
+        if addr is None or addr["port"] != self.direct_port:
+            addr = {"host": node_ip(), "port": self.direct_port,
+                    "worker_id": self.worker_id.hex()}
+            self._direct_addr_cache = addr
+        return addr
 
     # ------------------------------------------------------------ loop utils
     def _acall(self, coro, timeout: Optional[float] = None):
@@ -441,6 +457,37 @@ class Worker:
 
     def _loop_call(self, fn, *args):
         self.loop.call_soon_threadsafe(fn, *args)
+
+    def _post(self, fn, *args) -> None:
+        """Run fn(*args) on the loop thread, coalescing wakeups across a
+        burst of submissions from the main thread."""
+        with self._inbox_mu:
+            self._inbox.append((fn, args))
+            if self._inbox_armed:
+                return
+            self._inbox_armed = True
+        try:
+            self.loop.call_soon_threadsafe(self._drain_inbox)
+        except RuntimeError:
+            pass  # loop shut down
+
+    def _drain_inbox(self) -> None:
+        while True:
+            with self._inbox_mu:
+                if not self._inbox:
+                    self._inbox_armed = False
+                    return
+                batch = list(self._inbox)
+                self._inbox.clear()
+            for fn, args in batch:
+                try:
+                    fn(*args)
+                except Exception:
+                    import logging
+                    import traceback
+
+                    logging.getLogger("ray_tpu").error(
+                        "inbox callback failed:\n%s", traceback.format_exc())
 
     def _spawn(self, coro):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
@@ -596,9 +643,12 @@ class Worker:
             view, handle = self.store.create(object_id, size)
             used = sobj.write_into(view)
             self.store.seal(object_id, handle)
-            self._acall(self.agent.call(
-                "ObjectSealed", {"object_id": object_id.hex(), "size": used}
-            ))
+            # Fire-and-forget: the seal notification rides the agent socket
+            # ahead of any later lease/pin request (frame order on one
+            # connection preserves happens-before), so the blocking round
+            # trip the old path paid per put is unnecessary.
+            self._post(self.agent.push_nowait,
+                       "ObjectSealed", {"object_id": object_id.hex(), "size": used})
             self.memory_store.put(object_id.binary(), b"", IN_PLASMA)
             self.reference_counter.set_resolved(
                 object_id.binary(), "plasma", [self.agent_tcp_addr]
@@ -747,7 +797,7 @@ class Worker:
             meta.state = "pending"
             meta.locations = []
         self.memory_store.delete(ref.binary())
-        self._spawn(self._submit_to_pool(record))
+        self._post(self._submit_to_pool_sync, record)
         return True
 
     # ----------------------------------------------------------------- wait
@@ -871,13 +921,12 @@ class Worker:
 
         task_id = TaskID.from_random()
         fid, blob, fname = function_descriptor(function, self)
+        from ray_tpu._private.resources import ResourceSet
         wire_args = self._build_args(args)
         wire_kwargs = {k: v for k, v in zip(kwargs.keys(),
                                             self._build_args(tuple(kwargs.values())))}
         if max_retries < 0:
             max_retries = CONFIG.task_max_retries_default
-        from ray_tpu._private.resources import ResourceSet
-
         resources = dict(resources or {})
         resources.setdefault("CPU", 1.0)
         pg = None
@@ -910,7 +959,7 @@ class Worker:
             self._tasks[task_id.binary()] = record
             self._pin_args(spec)
             self._record_task_event(spec, "PENDING")
-            self._spawn(self._submit_to_pool(record))
+            self._post(self._submit_to_pool_sync, record)
             return record.streaming_gen
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         refs = []
@@ -921,7 +970,7 @@ class Worker:
         self._tasks[task_id.binary()] = record
         self._pin_args(spec)
         self._record_task_event(spec, "PENDING")
-        self._spawn(self._submit_to_pool(record))
+        self._post(self._submit_to_pool_sync, record)
         return refs
 
     def _build_args(self, args: tuple) -> List:
@@ -950,7 +999,7 @@ class Worker:
             if entry[0] == "r":
                 self.reference_counter.unpin_for_task(entry[1])
 
-    async def _submit_to_pool(self, record: TaskRecord) -> None:
+    def _submit_to_pool_sync(self, record: TaskRecord) -> None:
         key = record.spec.scheduling_key()
         pool = self._lease_pools.get(key)
         if pool is None:
@@ -973,7 +1022,7 @@ class Worker:
         ):
             record.attempts += 1
             self._record_task_event(spec, "RETRYING")
-            self._spawn(self._submit_to_pool(record))
+            self._submit_to_pool_sync(record)
             return
         record.completed = True
         self._unpin_args(spec)
@@ -1052,7 +1101,7 @@ class Worker:
             return
         if retriable and record.attempts <= spec.max_retries and not record.cancelled:
             self._record_task_event(spec, "RETRYING")
-            self._spawn(self._submit_to_pool(record))
+            self._submit_to_pool_sync(record)
             return
         record.completed = True
         self._unpin_args(spec)
@@ -1244,7 +1293,7 @@ class Worker:
             record.streaming_gen = ObjectRefGenerator(task_id.hex())
             self._tasks[task_id.binary()] = record
             self._pin_args(spec)
-            self._loop_call(st.enqueue, self, record)
+            self._post(st.enqueue, self, record)
             return record.streaming_gen
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         refs = []
@@ -1254,7 +1303,7 @@ class Worker:
         record = TaskRecord(spec, return_ids)
         self._tasks[task_id.binary()] = record
         self._pin_args(spec)
-        self._loop_call(st.enqueue, self, record)
+        self._post(st.enqueue, self, record)
         return refs
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -1354,12 +1403,11 @@ class _LeasePool:
 
     IDLE_TTL = 0.25
     MAX_WORKERS = 256
-    # Depth 1: a task committed to a busy worker cannot be stolen back, so
-    # deeper pipelining would strand a short task behind a long one even
-    # when the cluster could lease a fresh worker. The dispatch-loop
-    # restructure (single idle transition per drain instead of per task)
-    # is what buys the throughput; raise this only with task stealing.
-    PIPELINE_DEPTH = 1
+    # Pipelining: tasks committed to a busy worker cannot be stolen back, so
+    # depth >1 can strand a short task behind a long one — but it overlaps
+    # RPC transport with execution (reference pipelines the same way in
+    # direct_task_transport.h). Configurable via lease_pipeline_depth.
+    PIPELINE_DEPTH = CONFIG.lease_pipeline_depth
 
     def __init__(self, worker: Worker, key, spec: TaskSpec):
         self.worker = worker
@@ -1378,6 +1426,32 @@ class _LeasePool:
         self.conns: List[WorkerConn] = []
         self.idle: List[WorkerConn] = []
         self.inflight_leases = 0
+        self._exec_ms_ema: Optional[float] = None
+        self._reaper: Optional[asyncio.Task] = None
+
+    def _depth(self) -> int:
+        """Adaptive pipelining: short tasks go deep so one worker wakeup
+        drains a batch of frames (amortizing context switches); long tasks
+        stay shallow so queued work can spread onto fresh leases."""
+        e = self._exec_ms_ema
+        if e is None:
+            return self.PIPELINE_DEPTH
+        if e < 2.0:
+            return max(self.PIPELINE_DEPTH, 16)
+        if e < 10.0:
+            return max(self.PIPELINE_DEPTH, 4)
+        return self.PIPELINE_DEPTH
+
+    def _conn_depth(self, conn: WorkerConn, now: float, depth: int) -> int:
+        """A task committed to a busy worker cannot be stolen back. If this
+        conn's head-of-line task has already run well past the pool's typical
+        duration (a surprise straggler — e.g. an abandoned get-timeout task),
+        stop stacking work behind it and let _pump lease fresh workers."""
+        if conn.dispatch_times:
+            limit = max(0.05, ((self._exec_ms_ema or 0.0) * 4.0) / 1000.0)
+            if now - conn.dispatch_times[0] > limit:
+                return 0 if conn.inflight else 1
+        return depth
 
     def submit(self, record: TaskRecord) -> None:
         self.pending.append(record)
@@ -1389,18 +1463,20 @@ class _LeasePool:
         # grant is respected) while the queued task overlaps RPC transport
         # with execution (reference: direct task submitter pipelining).
         if self.pending:
+            depth = self._depth()
+            now = time.monotonic()
             ready = sorted(
                 (c for c in self.conns
-                 if not c.dead and c.inflight < self.PIPELINE_DEPTH),
+                 if not c.dead and c.inflight < self._conn_depth(c, now, depth)),
                 key=lambda c: c.inflight)
             for conn in ready:
-                while self.pending and conn.inflight < self.PIPELINE_DEPTH:
+                while self.pending and conn.inflight < self._conn_depth(
+                        conn, now, depth):
                     if conn in self.idle:
                         self.idle.remove(conn)
                     conn.inflight += 1
                     record = self.pending.popleft()
-                    asyncio.get_running_loop().create_task(
-                        self._run_task(conn, record))
+                    self._dispatch(conn, record)
                 if not self.pending:
                     break
         want = len(self.pending)
@@ -1492,7 +1568,7 @@ class _LeasePool:
             self.idle.append(conn)
             # A grant can arrive after the queue drained; make sure an unused
             # lease is returned rather than pinning resources forever.
-            asyncio.get_running_loop().create_task(self._idle_return_later(conn))
+            self._ensure_reaper()
             self._pump()
         except _PlacementGroupGone as e:
             # Unschedulable forever: fail every queued task, don't retry.
@@ -1511,46 +1587,85 @@ class _LeasePool:
                 await asyncio.sleep(0.2)
                 self._pump()
 
-    async def _run_task(self, conn: WorkerConn, record: TaskRecord) -> None:
-        w = self.worker
+    def _dispatch(self, conn: WorkerConn, record: TaskRecord) -> None:
+        """Send PushTask via the client's write-combined frame queue and
+        resolve the reply through a future callback — no per-task coroutine
+        (this is the submit→push hot loop; reference keeps it in C++)."""
         if record.cancelled:
             self._after_task(conn)
             return
         try:
             wire = record.spec.to_wire()
             wire["assigned_instances"] = getattr(conn, "assigned_instances", {})
-            reply = await conn.client.call("PushTask", wire)
-            w._on_task_reply(record, reply)
-            self._after_task(conn)
+            fut = conn.client.call_future("PushTask", wire)
         except Exception:
-            conn.dead = True
-            await self._drop_conn(conn, worker_exited=True)
-            w._on_task_failure(
-                record, WorkerCrashedError(
-                    f"worker died while running {record.spec.function_name}"
-                ),
-                retriable=True,
-            )
-            self._pump()
+            self._on_push_failed(conn, record)
+            return
+        conn.dispatch_times.append(time.monotonic())
+        fut.add_done_callback(
+            lambda f: self._on_push_done(conn, record, f))
+
+    def _on_push_done(self, conn: WorkerConn, record: TaskRecord,
+                      fut: "asyncio.Future") -> None:
+        if conn.dispatch_times:
+            conn.dispatch_times.popleft()
+        if fut.cancelled() or fut.exception() is not None:
+            self._on_push_failed(conn, record)
+            return
+        reply = fut.result()
+        ms = reply.get("exec_ms") if isinstance(reply, dict) else None
+        if ms is not None:
+            prev = self._exec_ms_ema
+            self._exec_ms_ema = ms if prev is None else 0.8 * prev + 0.2 * ms
+        self.worker._on_task_reply(record, reply)
+        self._after_task(conn)
+
+    def _on_push_failed(self, conn: WorkerConn, record: TaskRecord) -> None:
+        conn.dead = True
+        asyncio.get_running_loop().create_task(
+            self._drop_conn(conn, worker_exited=True))
+        self.worker._on_task_failure(
+            record, WorkerCrashedError(
+                f"worker died while running {record.spec.function_name}"
+            ),
+            retriable=True,
+        )
+        self._pump()
 
     def _after_task(self, conn: WorkerConn) -> None:
         conn.inflight -= 1
-        if self.pending:
-            conn.inflight += 1
-            record = self.pending.popleft()
-            asyncio.get_running_loop().create_task(self._run_task(conn, record))
+        if self.pending and not conn.dead:
+            if conn.inflight < self._conn_depth(
+                    conn, time.monotonic(), self._depth()):
+                conn.inflight += 1
+                record = self.pending.popleft()
+                self._dispatch(conn, record)
+            else:
+                self._pump()  # stragglers here; spread onto fresh leases
             return
         if conn.inflight == 0 and conn not in self.idle:
             conn.idle_since = time.monotonic()
             self.idle.append(conn)
-            asyncio.get_running_loop().create_task(
-                self._idle_return_later(conn))
+            self._ensure_reaper()
 
-    async def _idle_return_later(self, conn: WorkerConn) -> None:
-        await asyncio.sleep(self.IDLE_TTL)
-        if conn in self.idle and time.monotonic() - conn.idle_since >= self.IDLE_TTL:
-            self.idle.remove(conn)
-            await self._drop_conn(conn)
+    def _ensure_reaper(self) -> None:
+        if self._reaper is None or self._reaper.done():
+            self._reaper = asyncio.get_running_loop().create_task(
+                self._reap_idle_loop())
+
+    async def _reap_idle_loop(self) -> None:
+        """One periodic sweep per pool instead of one timer task per idle
+        transition (the bench churns thousands of those)."""
+        while self.idle:
+            await asyncio.sleep(self.IDLE_TTL)
+            now = time.monotonic()
+            for conn in [c for c in self.idle
+                         if now - c.idle_since >= self.IDLE_TTL]:
+                # _drop_conn awaits: a _pump on the loop may have re-claimed
+                # this conn (or a later one in the snapshot) meanwhile
+                if conn in self.idle and                         time.monotonic() - conn.idle_since >= self.IDLE_TTL:
+                    self.idle.remove(conn)
+                    await self._drop_conn(conn)
 
     async def _drop_conn(self, conn: WorkerConn, worker_exited: bool = False) -> None:
         if conn in self.conns:
@@ -1631,7 +1746,7 @@ class _ActorState:
             return
         while self.queue:
             record = self.queue.popleft()
-            asyncio.get_running_loop().create_task(self._push(worker, record))
+            self._push_nowait(worker, record)
 
     async def _connect_then_flush(self, worker: Worker) -> None:
         addr = self.addr
@@ -1650,25 +1765,39 @@ class _ActorState:
         if self.queue:
             self._flush(worker)
 
-    async def _push(self, worker: Worker, record: TaskRecord) -> None:
+    def _push_nowait(self, worker: Worker, record: TaskRecord) -> None:
+        """Pipelined, sequenced push over the write-combined client; the
+        receiver orders by seq (reference: direct_actor_task_submitter.h)."""
         try:
-            reply = await self.client.call("PushTask", record.spec.to_wire())
-            worker._on_task_reply(record, reply)
+            fut = self.client.call_future("PushTask", record.spec.to_wire())
         except Exception:
-            # Connection broke with the task in flight. It may have executed:
-            # do NOT resend (reference semantics: actor tasks are not retried
-            # by default; max_task_retries opts in). Queued-but-unsent tasks
-            # stay queued for the restarted actor.
-            if self.state == "ALIVE":
-                self.state = "RESTARTING"
-            worker._on_task_failure(
-                record,
-                ActorDiedError(
-                    self.actor_id.hex(),
-                    self.death_cause or "actor died while this call was in flight",
-                ),
-                retriable=False,
-            )
+            self._on_push_broken(worker, record)
+            return
+        fut.add_done_callback(
+            lambda f: self._on_push_reply(worker, record, f))
+
+    def _on_push_reply(self, worker: Worker, record: TaskRecord,
+                       fut: "asyncio.Future") -> None:
+        if not fut.cancelled() and fut.exception() is None:
+            worker._on_task_reply(record, fut.result())
+        else:
+            self._on_push_broken(worker, record)
+
+    def _on_push_broken(self, worker: Worker, record: TaskRecord) -> None:
+        # Connection broke with the task in flight. It may have executed:
+        # do NOT resend (reference semantics: actor tasks are not retried
+        # by default; max_task_retries opts in). Queued-but-unsent tasks
+        # stay queued for the restarted actor.
+        if self.state == "ALIVE":
+            self.state = "RESTARTING"
+        worker._on_task_failure(
+            record,
+            ActorDiedError(
+                self.actor_id.hex(),
+                self.death_cause or "actor died while this call was in flight",
+            ),
+            retriable=False,
+        )
 
     def _fail_all(self, worker: Worker) -> None:
         while self.queue:

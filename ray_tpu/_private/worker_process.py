@@ -18,6 +18,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -47,12 +48,24 @@ class Executor:
         self._actor_cls = None
         self._actor_id: Optional[ActorID] = None
         self._max_concurrency = 1
+        self._actor_has_async = False
         # Per-caller-connection execution chains. TCP delivers one caller's
         # pushes in submission order; chaining on the connection preserves
         # that order through execution and is naturally restart-safe (a
         # reconnecting caller starts a fresh chain) — the role the seq-based
         # ActorSchedulingQueue plays in the reference.
         self._chain_tail: Dict[int, asyncio.Future] = {}
+        # Batched execution drainer: queued specs run FIFO on one pool thread
+        # and results post back through a coalesced doorbell, so a burst of
+        # pipelined pushes costs two thread handoffs total instead of two per
+        # task (reference keeps this loop in C++; see scheduling queues in
+        # src/ray/core_worker/transport/).
+        self._exec_mu = threading.Lock()
+        self._exec_queue: deque = deque()
+        self._drainer_active = False
+        self._res_mu = threading.Lock()
+        self._results: List = []
+        self._res_armed = False
 
     # ------------------------------------------------------------- dispatch
     async def handle_push_task(self, conn, wire: Dict) -> Dict:
@@ -60,9 +73,85 @@ class Executor:
             await self.worker.ready_event.wait()
         spec = TaskSpec.from_wire({k: wire[k] for k in TaskSpec.__slots__ if k in wire})
         assigned = wire.get("assigned_instances") or {}
+        start = time.monotonic()
         if spec.task_type == ACTOR_TASK and self._max_concurrency == 1:
-            return await self._ordered_actor_task(conn, spec)
-        return await self._execute_async(spec, assigned)
+            if self._actor_has_async:
+                # chain per caller so sync and async methods stay ordered
+                reply = await self._ordered_actor_task(conn, spec)
+            else:
+                reply = await self._run_on_drainer(spec, {})
+        elif spec.task_type == ACTOR_TASK:
+            reply = await self._execute_async(spec, assigned)
+        else:
+            reply = await self._run_on_drainer(spec, assigned)
+        # Execution duration feeds the owner's adaptive pipelining (short
+        # tasks pipeline deep to amortize wakeups; long tasks stay shallow).
+        if isinstance(reply, dict) and "exec_ms" not in reply:
+            reply["exec_ms"] = (time.monotonic() - start) * 1000.0
+        return reply
+
+    # ---------------------------------------------------- batched execution
+    def _run_on_drainer(self, spec: TaskSpec, assigned: Dict) -> "asyncio.Future":
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        with self._exec_mu:
+            self._exec_queue.append((spec, assigned, fut, loop))
+            start_drainer = not self._drainer_active
+            if start_drainer:
+                self._drainer_active = True
+        if start_drainer:
+            # actor instances carry thread-affine state (sqlite handles,
+            # threading.local set in __init__): drain on the same pool the
+            # constructor ran on
+            pool = self._actor_pool if self._actor_pool is not None                 else self._task_pool
+            pool.submit(self._drain_exec)
+        return fut
+
+    def _drain_exec(self) -> None:
+        while True:
+            with self._exec_mu:
+                if not self._exec_queue:
+                    self._drainer_active = False
+                    return
+                spec, assigned, fut, loop = self._exec_queue.popleft()
+            t0 = time.monotonic()
+            try:
+                reply = self._execute_sync(spec, assigned)
+                err = None
+                if isinstance(reply, dict):
+                    # pure execution time (queue wait excluded) so the
+                    # owner's adaptive-pipelining EMA doesn't self-inflate
+                    reply["exec_ms"] = (time.monotonic() - t0) * 1000.0
+            except BaseException as e:  # noqa: BLE001 — incl. SystemExit
+                reply, err = None, e
+            self._post_result(loop, fut, reply, err)
+
+    def _post_result(self, loop, fut, reply, err) -> None:
+        with self._res_mu:
+            self._results.append((fut, reply, err))
+            if self._res_armed:
+                return
+            self._res_armed = True
+        try:
+            loop.call_soon_threadsafe(self._flush_results)
+        except RuntimeError:
+            pass  # loop closed during shutdown
+
+    def _flush_results(self) -> None:
+        while True:
+            with self._res_mu:
+                if not self._results:
+                    self._res_armed = False
+                    return
+                batch = list(self._results)
+                self._results.clear()
+            for fut, reply, err in batch:
+                if fut.done():
+                    continue
+                if err is not None:
+                    fut.set_exception(err)
+                else:
+                    fut.set_result(reply)
 
     async def _ordered_actor_task(self, conn, spec: TaskSpec) -> Dict:
         key = id(conn)
@@ -142,6 +231,10 @@ class Executor:
             else:
                 fn = load_function(spec.function_id, spec.function_blob, self.worker)
                 result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                # async callable that evaded static detection (e.g. attached
+                # via __getattr__): run it to completion on this thread
+                result = asyncio.run(result)
             return self._package_returns(spec, result)
         except SystemExit:
             raise
@@ -212,11 +305,11 @@ class Executor:
         view, handle = self.worker.store.create(oid, size)
         used = sobj.write_into(view)
         self.worker.store.seal(oid, handle)
-        self.worker._acall(
-            self.worker.agent.call(
-                "ObjectSealed", {"object_id": oid.hex(), "size": used}
-            )
-        )
+        # Fire-and-forget (ordering rides the agent socket); the reply to the
+        # owner races the seal notification only through the agent, and reads
+        # hit tmpfs directly, so the blocking round trip is unnecessary.
+        self.worker._post(self.worker.agent.push_nowait,
+                          "ObjectSealed", {"object_id": oid.hex(), "size": used})
         return {"plasma": True, "size": used,
                 "node_addr": self.worker.agent_tcp_addr}
 
@@ -293,6 +386,15 @@ class Executor:
 
         try:
             await loop.run_in_executor(self._actor_pool, construct)
+            inst = self.worker.actor_instance
+            self._actor_has_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(
+                    type(inst), predicate=callable)
+            ) or any(
+                inspect.iscoroutinefunction(v)
+                for v in list(vars(inst).values())
+                if callable(v))
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             try:
