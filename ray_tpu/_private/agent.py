@@ -802,10 +802,16 @@ class NodeAgent:
                 wait_timeout = deadline - time.monotonic()
                 if wait_timeout <= 0:
                     break
+            # Cap each wait to re-poll the (filesystem-authoritative) store:
+            # seal notifications are fire-and-forget and can be lost if the
+            # sealing worker dies right after store.seal — the object is
+            # still on disk, so the poll keeps waiters from hanging forever.
+            poll = 0.2 if wait_timeout is None else min(wait_timeout, 0.2)
             done, _ = await asyncio.wait(
-                pending, timeout=wait_timeout, return_when=asyncio.FIRST_COMPLETED
+                pending, timeout=poll, return_when=asyncio.FIRST_COMPLETED
             )
-            if not done:
+            if not done and deadline is not None \
+                    and time.monotonic() >= deadline:
                 break
         ready = [h for h in ids if self.store.contains(h)]
         not_ready = [h for h in ids if h not in set(ready)]

@@ -72,7 +72,9 @@ class Connection:
     def send_nowait(self, msg: Any) -> None:
         if self.closed:
             return
-        self._outbuf.append(pack(msg))
+        body = pack(msg)
+        self._outbuf.append(body)
+        self._buffered += len(body)
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_out)
@@ -99,14 +101,25 @@ class Connection:
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_out)
-        if len(body) >= _DRAIN_THRESHOLD or self._buffered >= 4 * _DRAIN_THRESHOLD:
+        if (len(body) >= _DRAIN_THRESHOLD
+                or self._buffered >= 4 * _DRAIN_THRESHOLD
+                or self._transport_backlog(self.writer) >= 4 * _DRAIN_THRESHOLD):
             # flush NOW so drain sees the bytes (a call_soon flush would run
-            # after drain returned un-paused), then apply real backpressure
+            # after drain returned un-paused), then apply real backpressure.
+            # The transport-backlog check catches slow peers accumulating
+            # small frames across many ticks (per-tick _buffered resets).
             self._flush_out()
             try:
                 await self.writer.drain()
             except (ConnectionError, RuntimeError):
                 self.closed = True
+
+    @staticmethod
+    def _transport_backlog(writer) -> int:
+        try:
+            return writer.transport.get_write_buffer_size()
+        except Exception:
+            return 0
 
     async def push(self, method: str, payload: Any) -> None:
         await self.send({"m": method, "i": 0, "p": payload})
@@ -327,7 +340,10 @@ class AsyncRpcClient:
     async def push(self, method: str, payload: Any) -> None:
         body = pack({"m": method, "i": 0, "p": payload})
         self._queue_frame(body)
-        if len(body) >= _DRAIN_THRESHOLD or self._buffered >= 4 * _DRAIN_THRESHOLD:
+        if (len(body) >= _DRAIN_THRESHOLD
+                or self._buffered >= 4 * _DRAIN_THRESHOLD
+                or Connection._transport_backlog(self._writer)
+                >= 4 * _DRAIN_THRESHOLD):
             self._flush_out()
             try:
                 await self._writer.drain()

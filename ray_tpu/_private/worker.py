@@ -1617,7 +1617,16 @@ class _LeasePool:
         if ms is not None:
             prev = self._exec_ms_ema
             self._exec_ms_ema = ms if prev is None else 0.8 * prev + 0.2 * ms
-        self.worker._on_task_reply(record, reply)
+        try:
+            self.worker._on_task_reply(record, reply)
+        except Exception as e:  # a reply-processing bug must not leak
+            # conn.inflight (the lease would wedge) or hang the caller
+            import logging
+
+            logging.getLogger("ray_tpu").exception(
+                "error processing task reply for %s",
+                record.spec.function_name)
+            self.worker._on_task_failure(record, e, retriable=False)
         self._after_task(conn)
 
     def _on_push_failed(self, conn: WorkerConn, record: TaskRecord) -> None:
@@ -1779,7 +1788,15 @@ class _ActorState:
     def _on_push_reply(self, worker: Worker, record: TaskRecord,
                        fut: "asyncio.Future") -> None:
         if not fut.cancelled() and fut.exception() is None:
-            worker._on_task_reply(record, fut.result())
+            try:
+                worker._on_task_reply(record, fut.result())
+            except Exception as e:
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "error processing actor reply for %s",
+                    record.spec.function_name)
+                worker._on_task_failure(record, e, retriable=False)
         else:
             self._on_push_broken(worker, record)
 

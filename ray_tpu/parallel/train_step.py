@@ -76,9 +76,18 @@ def _opt_state_shardings(tx, params, p_shardings, mesh):
     params_treedef = jax.tree.structure(params)
     repl = NamedSharding(mesh, P())
 
+    def leaf_sharding(shape_leaf, sharding):
+        # factored optimizers (adafactor v_row/v_col) mirror the params'
+        # STRUCTURE with reduced-rank leaves; a param spec longer than the
+        # leaf's rank is invalid, so replicate those
+        spec_len = len([a for a in sharding.spec])
+        if getattr(shape_leaf, "ndim", 0) < spec_len:
+            return repl
+        return sharding
+
     def assign(node):
         if jax.tree.structure(node) == params_treedef:
-            return p_shardings
+            return jax.tree.map(leaf_sharding, node, p_shardings)
         if isinstance(node, tuple):
             vals = [assign(c) for c in node]
             return type(node)(*vals) if hasattr(node, "_fields") \
