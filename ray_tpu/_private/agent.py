@@ -199,6 +199,8 @@ class NodeAgent:
         r("UnpinObject", self._unpin_object)
         r("GetStoreStats", self._get_store_stats)
         r("GetNodeInfo", self._get_node_info)
+        r("ListWorkers", self._list_workers)
+        r("ListStoreObjects", self._list_store_objects)
         r("SetResource", self._set_resource)
         r("RestoreSpilled", self._restore_spilled)
         # remote agents
@@ -277,23 +279,37 @@ class NodeAgent:
             pass
 
     async def _resource_report_loop(self) -> None:
+        """Versioned delta gossip (reference: ray_syncer.h:88 — versioned
+        per-node RESOURCE_VIEW snapshots over bidi streams). A full snapshot
+        goes out only when the node's view changed; unchanged ticks send a
+        tiny heartbeat frame, so head ingress per tick is O(changed nodes)
+        plus O(n) constant-size liveness probes."""
         period = max(CONFIG.gossip_period_ms, 50) / 1000
+        last_sent: Optional[Dict] = None
+        version = 0
         while True:
             await asyncio.sleep(period)
+            dirty = self._resources_dirty
             self._resources_dirty = False
+            snapshot = {
+                "resources": self.resources.to_wire(),
+                "pending": [r["resources"].to_wire()
+                            for r in self._pending_leases],
+            }
             try:
-                # doubles as heartbeat; `pending` is the autoscaler's demand
-                # signal (reference: raylet resource reports feeding
-                # GcsAutoscalerStateManager / monitor.py)
-                await self.head.call(
-                    "UpdateResources",
-                    {"node_id": self.node_id,
-                     "resources": self.resources.to_wire(),
-                     "pending": [r["resources"].to_wire()
-                                 for r in self._pending_leases]},
-                )
+                if dirty or snapshot != last_sent:
+                    version += 1
+                    await self.head.call(
+                        "UpdateResources",
+                        {"node_id": self.node_id, "v": version, **snapshot})
+                    last_sent = snapshot
+                else:
+                    await self.head.call(
+                        "UpdateResources",
+                        {"node_id": self.node_id, "hb": True, "v": version})
             except Exception:
-                pass
+                # head unreachable or restarted: resend full on recovery
+                last_sent = None
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self, actor_spec: Optional[Dict] = None) -> WorkerHandle:
@@ -823,6 +839,7 @@ class NodeAgent:
         chunks from that node's agent, or the inline value from the owner."""
         try:
             deadline = time.monotonic() + 600
+            dead_rounds = 0
             while time.monotonic() < deadline:
                 if self.store.contains(hex_id):
                     return
@@ -843,13 +860,17 @@ class NodeAgent:
                     self.store.on_sealed(hex_id, len(data))
                     self._notify_sealed(hex_id)
                     return
-                for node_addr in loc.get("locations", []):
-                    if (
-                        node_addr.get("host") == "127.0.0.1"
-                        and node_addr.get("port") == self.tcp_port
-                    ):
-                        continue
-                    if await self._fetch_from_node(hex_id, node_addr):
+                remote_locs = [
+                    a for a in loc.get("locations", [])
+                    if not (a.get("host") == "127.0.0.1"
+                            and a.get("port") == self.tcp_port)]
+                statuses = []
+                done = False
+                for node_addr in remote_locs:
+                    st = await self._fetch_from_node(hex_id, node_addr)
+                    statuses.append(st)
+                    if st == "ok":
+                        done = True
                         self._notify_sealed(hex_id)
                         # Tell the owner we now hold a copy.
                         try:
@@ -860,7 +881,24 @@ class NodeAgent:
                             )
                         except Exception:
                             pass
+                        break
+                if done:
+                    return
+                if remote_locs and all(st == "conn" for st in statuses):
+                    # Every advertised holder is connection-dead (not merely
+                    # missing the object or a local hiccup). After a few
+                    # rounds, fail the wait so the owner's lineage recovery
+                    # can resubmit the creating task instead of burning the
+                    # caller's whole get deadline (reference: pull_manager
+                    # hands off to reconstruction on location death).
+                    dead_rounds += 1
+                    if dead_rounds >= 5:
+                        for fut in self._object_waits.pop(hex_id, []):
+                            if not fut.done():
+                                fut.set_result(False)
                         return
+                else:
+                    dead_rounds = 0
                 await asyncio.sleep(0.2)
         finally:
             self._pulls_inflight.pop(hex_id, None)
@@ -870,37 +908,44 @@ class NodeAgent:
             if not fut.done():
                 fut.set_result(True)
 
-    async def _fetch_from_node(self, hex_id: str, addr: Dict) -> bool:
+    async def _fetch_from_node(self, hex_id: str, addr: Dict) -> str:
+        """Returns 'ok' | 'absent' (holder alive, object not there) |
+        'conn' (holder unreachable) | 'local' (local store error). Only
+        'conn' counts toward the pull loop's dead-holder fast-fail."""
         try:
             client = await self.pool.get(addr["host"], addr["port"])
             meta = await client.call("FetchObjectMeta", {"object_id": hex_id}, timeout=15)
-            if not meta or not meta.get("exists"):
-                return False
-            size = meta["size"]
-            oid = ObjectID.from_hex(hex_id)
-            view, handle = self.store.client.create(oid, size)
-            try:
-                chunk = CONFIG.object_chunk_size_bytes
-                off = 0
-                while off < size:
-                    n = min(chunk, size - off)
-                    data = await client.call(
-                        "FetchObjectChunk",
-                        {"object_id": hex_id, "offset": off, "length": n},
-                        timeout=60,
-                    )
-                    if data is None:
-                        raise IOError("remote chunk missing")
-                    view[off : off + len(data)] = data
-                    off += len(data)
-                self.store.client.seal(oid, handle)
-                self.store.on_sealed(hex_id, size)
-                return True
-            except Exception:
-                self.store.client.abort(handle)
-                return False
         except Exception:
-            return False
+            self.pool.drop(addr["host"], addr["port"])
+            return "conn"
+        if not meta or not meta.get("exists"):
+            return "absent"
+        size = meta["size"]
+        oid = ObjectID.from_hex(hex_id)
+        try:
+            view, handle = self.store.client.create(oid, size)
+        except Exception:
+            return "local"
+        try:
+            chunk = CONFIG.object_chunk_size_bytes
+            off = 0
+            while off < size:
+                n = min(chunk, size - off)
+                data = await client.call(
+                    "FetchObjectChunk",
+                    {"object_id": hex_id, "offset": off, "length": n},
+                    timeout=60,
+                )
+                if data is None:
+                    raise IOError("remote chunk missing")
+                view[off : off + len(data)] = data
+                off += len(data)
+            self.store.client.seal(oid, handle)
+            self.store.on_sealed(hex_id, size)
+            return "ok"
+        except Exception:
+            self.store.client.abort(handle)
+            return "conn"
 
     async def _fetch_object_meta(self, conn: Connection, p: Dict) -> Dict:
         hex_id = p["object_id"]
@@ -942,6 +987,30 @@ class NodeAgent:
             "num_idle": len(self.idle_workers),
             "cluster_view": self.cluster_view,
         }
+
+    async def _list_workers(self, conn: Connection, p) -> List[Dict]:
+        """Live worker-table query (reference: the state API pairs GCS data
+        with NodeManager::QueryAllWorkerStates, node_manager.h:217)."""
+        out = []
+        for w in self.workers.values():
+            out.append({
+                "worker_id": w.worker_id,
+                "node_id": self.node_id,
+                "pid": w.proc.pid if w.proc else None,
+                "state": ("ACTOR" if w.is_actor
+                          else "LEASED" if w.leased_to else "IDLE"),
+                "actor_id": w.actor_id,
+                "env_key": w.env_key,
+                "alive": w.alive,
+            })
+        return out
+
+    async def _list_store_objects(self, conn: Connection, p) -> List[Dict]:
+        """Per-node object-store contents (reference: list_objects in
+        util/state/api.py aggregating core-worker object views)."""
+        limit = int(p.get("limit", 1000)) if isinstance(p, dict) else 1000
+        return [dict(row, node_id=self.node_id)
+                for row in self.store.list_entries(limit)]
 
     async def _set_resource(self, conn: Connection, p: Dict) -> Dict:
         """Dynamically re-declare a custom resource's total (reference:

@@ -88,6 +88,7 @@ class HeadServer:
         self.port = port
         self.server = RpcServer("head")
         self.nodes: Dict[str, NodeInfo] = {}
+        self.report_stats = {}
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
         self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> key -> value
@@ -220,6 +221,7 @@ class HeadServer:
         r = self.server.add_handler
         r("RegisterNode", self._register_node)
         r("UpdateResources", self._update_resources)
+        r("GetReportStats", self._get_report_stats)
         r("GetClusterView", self._get_cluster_view)
         r("RegisterDriver", self._register_driver)
         r("KvPut", self._kv_put)
@@ -295,10 +297,22 @@ class HeadServer:
 
     async def _update_resources(self, conn: Connection, p: Dict) -> None:
         node = self.nodes.get(p["node_id"])
-        if node:
-            node.resources = NodeResources.from_wire(p["resources"])
-            node.last_heartbeat = time.monotonic()
-            node.pending_demand = p.get("pending", [])
+        if node is None:
+            return
+        node.last_heartbeat = time.monotonic()
+        if p.get("hb"):
+            # unchanged-view heartbeat (versioned delta gossip): liveness
+            # only, no payload to apply
+            self.report_stats["heartbeats"] = \
+                self.report_stats.get("heartbeats", 0) + 1
+            return
+        self.report_stats["full_reports"] = \
+            self.report_stats.get("full_reports", 0) + 1
+        node.resources = NodeResources.from_wire(p["resources"])
+        node.pending_demand = p.get("pending", [])
+
+    async def _get_report_stats(self, conn: Connection, p) -> Dict:
+        return dict(self.report_stats)
 
     def _cluster_view(self) -> Dict:
         return {

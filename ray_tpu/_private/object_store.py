@@ -275,6 +275,26 @@ class StoreDirectory:
             else:
                 self._pins[object_id_hex] = n
 
+    def list_entries(self, limit: int = 1000) -> list:
+        """Snapshot of resident + spilled objects (state API). Filters
+        through contains() so native-arena LRU evictions the directory
+        hasn't observed yet are not reported."""
+        with self._lock:
+            resident = list(self._objects.items())[:limit]
+            spilled = list(self._spilled.items())[:max(0, limit - len(resident))]
+            pins = set(self._pins)
+        rows = [
+            {"object_id": h, "size_bytes": size, "pinned": h in pins,
+             "spilled": False}
+            for h, size in resident if self.contains(h)
+        ]
+        rows += [
+            {"object_id": h, "size_bytes": size, "pinned": False,
+             "spilled": True}
+            for h, size in spilled
+        ]
+        return rows
+
     def contains(self, object_id_hex: str) -> bool:
         if self.native:
             # the C++ arena is authoritative — it may have LRU-evicted the

@@ -393,6 +393,14 @@ class Worker:
             await asyncio.sleep(0.1)
         while self.connected:
             await asyncio.sleep(2.0)
+            # periodic task-event flush: observers (state API, dashboard)
+            # must see this process's transitions without it having to
+            # query (reference: TaskEventBuffer's periodic GCS flush,
+            # task_event_buffer.h:206)
+            try:
+                self.flush_task_events()
+            except Exception:
+                pass
             try:
                 await asyncio.wait_for(self.head.call("Ping", {}),
                                        timeout=5.0)
@@ -790,13 +798,16 @@ class Worker:
         record = self._tasks.get(ref.id().task_id().binary())
         if record is None or record.spec.task_type != NORMAL_TASK:
             return False
-        if attempt > max(1, record.spec.max_retries):
-            return False
+        if record.spec.max_retries <= 0 or attempt > record.spec.max_retries:
+            return False  # max_retries=0 opts out of lineage reconstruction
         meta = self.reference_counter.get_owned_meta(ref.binary())
         if meta:
             meta.state = "pending"
             meta.locations = []
         self.memory_store.delete(ref.binary())
+        # the record finished once already; reopen it or the reconstruction
+        # attempt's reply would be dropped as a stale late reply
+        record.completed = False
         self._post(self._submit_to_pool_sync, record)
         return True
 

@@ -139,6 +139,8 @@ def cmd_list(args) -> int:
         "tasks": state_api.list_tasks,
         "placement-groups": state_api.list_placement_groups,
         "jobs": state_api.list_jobs,
+        "workers": state_api.list_workers,
+        "objects": state_api.list_objects,
     }[args.resource]
     print(json.dumps(fn(), indent=1, default=str))
     return 0
@@ -149,7 +151,8 @@ def cmd_summary(args) -> int:
 
     _connect()
     fn = {"tasks": state_api.summarize_tasks,
-          "actors": state_api.summarize_actors}[args.resource]
+          "actors": state_api.summarize_actors,
+          "objects": state_api.summarize_objects}[args.resource]
     print(json.dumps(fn(), indent=1))
     return 0
 
@@ -245,11 +248,12 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("list", help="list cluster state")
     s.add_argument("resource", choices=[
-        "actors", "nodes", "tasks", "placement-groups", "jobs"])
+        "actors", "nodes", "tasks", "placement-groups", "jobs",
+        "workers", "objects"])
     s.set_defaults(fn=cmd_list)
 
     s = sub.add_parser("summary", help="summarize tasks/actors")
-    s.add_argument("resource", choices=["tasks", "actors"])
+    s.add_argument("resource", choices=["tasks", "actors", "objects"])
     s.set_defaults(fn=cmd_summary)
 
     s = sub.add_parser("timeline", help="dump chrome-trace task timeline")

