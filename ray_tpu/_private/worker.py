@@ -1054,8 +1054,11 @@ class Worker:
                         spec.function_name,
                         "streaming task failed before yielding")
             record.streaming_gen._finish(err)
-            self._record_task_event(
-                spec, "FINISHED" if not reply.get("error") else "FAILED")
+            # streaming_failed: mid-stream exception was delivered as the
+            # final ref (stream itself closed cleanly) — observability must
+            # still record the task as FAILED
+            ok = not reply.get("error") and not reply.get("streaming_failed")
+            self._record_task_event(spec, "FINISHED" if ok else "FAILED")
             self._maybe_drop_streaming_record(record)
             return
         returns = reply.get("returns", [])

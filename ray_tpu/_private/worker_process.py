@@ -347,6 +347,7 @@ class Executor:
             self.worker._acall(call())
 
         count = 0
+        failed = False
         try:
             for value in result:
                 report(count, self._package_one(spec, count, value))
@@ -356,7 +357,12 @@ class Executor:
             report(count, self._package_one(spec, count, err,
                                             is_exception=True))
             count += 1
-        return {"returns": [], "streaming_count": count}
+            failed = True
+        # streaming_failed: the stream still finishes cleanly (the exception
+        # is delivered as the last ref) but task-event observability must
+        # record FAILED, not FINISHED
+        return {"returns": [], "streaming_count": count,
+                "streaming_failed": failed}
 
     # --------------------------------------------------------------- actors
     async def become_actor(self, payload: Dict) -> None:

@@ -20,8 +20,79 @@ def _flat_numbers(d: Dict) -> Dict[str, float]:
             if isinstance(v, numbers.Number)}
 
 
+def _wandb_logging_proc(queue, ack, init_kwargs) -> None:
+    """Entry point of the per-trial wandb process: owns exactly one
+    wandb.init() for its whole life, so concurrent trials can never finish
+    or cross-wire each other's runs (reference: air/integrations/wandb.py
+    runs a _WandbLoggingActor per trial for the same reason)."""
+    import wandb
+
+    try:
+        run = wandb.init(**init_kwargs)
+    except BaseException as e:  # noqa: BLE001 — surfaced in the driver
+        ack.put(("error", repr(e)))
+        return
+    ack.put(("ready", None))
+    try:
+        while True:
+            cmd, payload = queue.get()
+            if cmd == "log":
+                try:
+                    run.log(payload)
+                except Exception:
+                    pass
+            else:
+                break
+    finally:
+        run.finish()
+
+
+class _WandbTrialProcess:
+    """One forked process + command queue per trial. Fork (not spawn) on
+    POSIX: spawn re-imports __main__, which re-executes unguarded user tune
+    scripts inside the logging child."""
+
+    def __init__(self, init_kwargs: Dict):
+        import multiprocessing as mp
+        import os as _os
+
+        ctx = mp.get_context("fork" if _os.name == "posix" else "spawn")
+        self.queue = ctx.Queue()
+        ack = ctx.Queue()
+        self.proc = ctx.Process(
+            target=_wandb_logging_proc,
+            args=(self.queue, ack, init_kwargs), daemon=True)
+        self.proc.start()
+        # surface init failures (bad API key, no network) in the driver,
+        # like the pre-process-isolation code did
+        import queue as _qmod
+
+        try:
+            status, detail = ack.get(timeout=120)
+        except _qmod.Empty:
+            self.proc.terminate()
+            raise RuntimeError("wandb.init did not complete within 120s")
+        if status == "error":
+            raise RuntimeError(f"wandb.init failed in logging process: {detail}")
+
+    def log(self, metrics: Dict) -> None:
+        self.queue.put(("log", metrics))
+
+    def finish(self) -> None:
+        try:
+            self.queue.put(("finish", None))
+            self.proc.join(timeout=60)
+        finally:
+            if self.proc.is_alive():
+                self.proc.terminate()
+
+
 class WandbLoggerCallback(LoggerCallback):
-    """reference: air/integrations/wandb.py WandbLoggerCallback."""
+    """reference: air/integrations/wandb.py WandbLoggerCallback.
+
+    Each trial logs through its own spawned wandb process — wandb.init in
+    the shared driver process is not concurrency-safe (a second init
+    finishes the first trial's active run)."""
 
     def __init__(self, project: Optional[str] = None,
                  group: Optional[str] = None, **kwargs):
@@ -36,14 +107,12 @@ class WandbLoggerCallback(LoggerCallback):
         self.project = project
         self.group = group
         self.kwargs = kwargs
-        self._runs: Dict[str, object] = {}
+        self._runs: Dict[str, _WandbTrialProcess] = {}
 
     def log_trial_start(self, trial) -> None:
-        import wandb
-
-        self._runs[trial.trial_id] = wandb.init(
+        self._runs[trial.trial_id] = _WandbTrialProcess(dict(
             project=self.project, group=self.group, name=trial.trial_id,
-            config=dict(trial.config), reinit=True, **self.kwargs)
+            config=dict(trial.config), **self.kwargs))
 
     def log_trial_result(self, trial, result: Dict) -> None:
         run = self._runs.get(trial.trial_id)

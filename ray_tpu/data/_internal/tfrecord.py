@@ -216,12 +216,20 @@ def read_tfrecord_file(path: str) -> Iterator[Dict[str, Any]]:
     with open(path, "rb") as f:
         while True:
             header = f.read(12)
-            if len(header) < 12:
+            if not header:
                 return
+            if len(header) < 12:
+                raise ValueError(
+                    f"truncated TFRecord header in {path}: "
+                    f"{len(header)} of 12 bytes")
             (length,) = struct.unpack("<Q", header[:8])
             (crc,) = struct.unpack("<I", header[8:12])
             if _masked_crc(header[:8]) != crc:
                 raise ValueError(f"corrupt TFRecord length crc in {path}")
             data = f.read(length)
+            if len(data) < length:
+                raise ValueError(
+                    f"truncated TFRecord in {path}: record declares "
+                    f"{length} bytes, file had {len(data)}")
             f.read(4)  # data crc (skipped on read, like TF's default)
             yield decode_example(data)

@@ -51,11 +51,20 @@ def start(http_options: Optional[Dict] = None, detached: bool = True,
     http_options = http_options or {}
     try:
         ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
-        if (grpc_options or {}).get("port") is not None and \
-                _grpc_port is None:
-            raise RuntimeError(
-                "serve is already running without a gRPC ingress; call "
-                "serve.shutdown() first to start with grpc_options")
+        if (grpc_options or {}).get("port") is not None:
+            # only reject when the live proxy DEFINITIVELY reports no gRPC
+            # ingress — a failed/slow port query must not produce a false
+            # "running without gRPC" error
+            try:
+                proxy = ray_tpu.get_actor(PROXY_NAME,
+                                          namespace=SERVE_NAMESPACE)
+                port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=10)
+            except Exception:
+                port = True  # unknown: assume configured
+            if port is None:
+                raise RuntimeError(
+                    "serve is already running without a gRPC ingress; call "
+                    "serve.shutdown() first to start with grpc_options")
         return
     except RuntimeError:
         raise
@@ -76,13 +85,41 @@ def start(http_options: Optional[Dict] = None, detached: bool = True,
         _grpc_port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=30)
 
 
+_PORT_UNQUERIED = object()  # distinct from "queried, ingress absent"
+
+
 def get_http_port() -> Optional[int]:
-    """The proxy's bound port (0 in http_options picks a free one)."""
+    """The proxy's bound port (0 in http_options picks a free one).
+    Queried from the live proxy actor when this process didn't start
+    Serve itself (a second driver connecting to a running cluster)."""
+    global _http_port
+    if _http_port is None:
+        _http_port = _proxy_port("ready", default=None)
     return _http_port
 
 
 def get_grpc_port() -> Optional[int]:
+    global _grpc_port
+    if _grpc_port is None:
+        _grpc_port = _proxy_port("get_grpc_port", default=None)
     return _grpc_port
+
+
+_port_cache: dict = {}
+
+
+def _proxy_port(method: str, default=None):
+    # cache definitive answers (including "no such ingress") so pollers
+    # don't pay an actor round trip per call; failures are NOT cached
+    if method in _port_cache:
+        return _port_cache[method]
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=SERVE_NAMESPACE)
+        value = ray_tpu.get(getattr(proxy, method).remote(), timeout=10)
+    except Exception:
+        return default
+    _port_cache[method] = value
+    return value
 
 
 def _controller():
@@ -220,6 +257,7 @@ def shutdown() -> None:
     """Tear down all applications + the control plane."""
     global _http_port, _grpc_port
     _grpc_port = None
+    _port_cache.clear()
     try:
         ctrl = _controller()
     except Exception:

@@ -112,13 +112,24 @@ class HyperBandScheduler(TrialScheduler):
         rung: early-stop the laggards, release the survivors."""
         if not bracket.all_reported():
             return TrialScheduler.PAUSE
-        stop_ids = bracket.cut()
+        stop_ids = set(bracket.cut())
+        # Final rung closed (no further milestone): the bracket's budget is
+        # spent, so survivors finish now instead of training one extra
+        # iteration past max_t before the milestone-is-None check catches
+        # them on their next report.
+        survivors_done = set(bracket.live()) if bracket.milestone is None \
+            else set()
         for other in controller.live_trials():
-            if other.trial_id in stop_ids and other is not reporting_trial:
+            if other is reporting_trial:
+                continue
+            if other.trial_id in stop_ids:
                 controller._complete_trial(  # noqa: SLF001
                     other, other.last_result, early_stopped=True)
+            elif other.trial_id in survivors_done:
+                controller._complete_trial(  # noqa: SLF001
+                    other, other.last_result, early_stopped=False)
         if reporting_trial is not None and \
-                reporting_trial.trial_id in stop_ids:
+                reporting_trial.trial_id in (stop_ids | survivors_done):
             return TrialScheduler.STOP
         return TrialScheduler.CONTINUE
 
