@@ -20,71 +20,60 @@ def _flat_numbers(d: Dict) -> Dict[str, float]:
             if isinstance(v, numbers.Number)}
 
 
-def _wandb_logging_proc(queue, ack, init_kwargs) -> None:
-    """Entry point of the per-trial wandb process: owns exactly one
-    wandb.init() for its whole life, so concurrent trials can never finish
-    or cross-wire each other's runs (reference: air/integrations/wandb.py
-    runs a _WandbLoggingActor per trial for the same reason)."""
-    import wandb
+class _WandbLoggingActorImpl:
+    """Owns exactly one wandb.init() for its whole life, so concurrent
+    trials can never finish or cross-wire each other's runs (reference:
+    air/integrations/wandb.py runs a _WandbLoggingActor per trial). An
+    actor — a clean worker process — avoids both os.fork of the
+    multithreaded tune driver (copied held locks can deadlock the child)
+    and spawn's __main__ re-import of unguarded user scripts."""
 
-    try:
-        run = wandb.init(**init_kwargs)
-    except BaseException as e:  # noqa: BLE001 — surfaced in the driver
-        ack.put(("error", repr(e)))
-        return
-    ack.put(("ready", None))
-    try:
-        while True:
-            cmd, payload = queue.get()
-            if cmd == "log":
-                try:
-                    run.log(payload)
-                except Exception:
-                    pass
-            else:
-                break
-    finally:
-        run.finish()
+    def __init__(self, init_kwargs: Dict):
+        import wandb
+
+        self._run = wandb.init(**init_kwargs)
+
+    def ready(self) -> bool:
+        return True
+
+    def log(self, metrics: Dict) -> None:
+        try:
+            self._run.log(metrics)
+        except Exception:
+            pass
+
+    def finish(self) -> bool:
+        self._run.finish()
+        return True
 
 
 class _WandbTrialProcess:
-    """One forked process + command queue per trial. Fork (not spawn) on
-    POSIX: spawn re-imports __main__, which re-executes unguarded user tune
-    scripts inside the logging child."""
+    """One logging actor per trial."""
 
     def __init__(self, init_kwargs: Dict):
-        import multiprocessing as mp
-        import os as _os
+        import ray_tpu
 
-        ctx = mp.get_context("fork" if _os.name == "posix" else "spawn")
-        self.queue = ctx.Queue()
-        ack = ctx.Queue()
-        self.proc = ctx.Process(
-            target=_wandb_logging_proc,
-            args=(self.queue, ack, init_kwargs), daemon=True)
-        self.proc.start()
+        self._actor = ray_tpu.remote(_WandbLoggingActorImpl).options(
+            num_cpus=0).remote(init_kwargs)
         # surface init failures (bad API key, no network) in the driver,
         # like the pre-process-isolation code did
-        import queue as _qmod
-
-        try:
-            status, detail = ack.get(timeout=120)
-        except _qmod.Empty:
-            self.proc.terminate()
-            raise RuntimeError("wandb.init did not complete within 120s")
-        if status == "error":
-            raise RuntimeError(f"wandb.init failed in logging process: {detail}")
+        ray_tpu.get(self._actor.ready.remote(), timeout=180)
 
     def log(self, metrics: Dict) -> None:
-        self.queue.put(("log", metrics))
+        self._actor.log.remote(metrics)  # fire and forget, ordered
 
     def finish(self) -> None:
+        import ray_tpu
+
         try:
-            self.queue.put(("finish", None))
-            self.proc.join(timeout=60)
+            ray_tpu.get(self._actor.finish.remote(), timeout=60)
+        except Exception:
+            pass
         finally:
-            if self.proc.is_alive():
-                self.proc.terminate()
+            try:
+                ray_tpu.kill(self._actor)
+            except Exception:
+                pass
 
 
 class WandbLoggerCallback(LoggerCallback):

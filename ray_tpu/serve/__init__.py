@@ -22,6 +22,9 @@ from ray_tpu.serve._private.controller import (
     CONTROLLER_NAME, SERVE_NAMESPACE, ServeController)
 from ray_tpu.serve._private.proxy import ProxyActor, Request
 from ray_tpu.serve._private.replica import _HandlePlaceholder
+from ray_tpu.serve.asgi import Response, StreamingResponse, ingress
+from ray_tpu.serve.drivers import (
+    DAGDriver, InputNode, json_request, starlette_request)
 from ray_tpu.serve.grpc_util import ServeGrpcClient
 from ray_tpu.serve.schema import (
     DeploymentSchema, HTTPOptionsSchema, ServeApplicationSchema,
@@ -35,6 +38,8 @@ __all__ = [
     "get_multiplexed_model_id", "build", "run_config",
     "DeploymentSchema", "ServeApplicationSchema", "ServeDeploySchema",
     "HTTPOptionsSchema", "ServeGrpcClient", "get_grpc_port",
+    "ingress", "Response", "StreamingResponse",
+    "DAGDriver", "InputNode", "json_request", "starlette_request",
 ]
 
 PROXY_NAME = "SERVE_PROXY"
@@ -126,6 +131,17 @@ def _controller():
     return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
 
 
+def _transform_graph(value, fn):
+    from ray_tpu.serve.deployment import map_graph_values
+
+    def leaf(a):
+        if isinstance(a, (Application, _HandlePlaceholder)):
+            return fn(a)
+        return a
+
+    return map_graph_values(value, leaf)
+
+
 def _build_specs(app: Application):
     """Flatten the bind graph into wire specs; nested Applications become
     handle placeholders (reference: deployment_graph_build.py)."""
@@ -141,8 +157,10 @@ def _build_specs(app: Application):
                 return _HandlePlaceholder("__APP__", a.deployment.name)
             return a
 
-        args = tuple(to_placeholder(a) for a in node.args)
-        kwargs = {k: to_placeholder(v) for k, v in node.kwargs.items()}
+        args = tuple(_transform_graph(a, to_placeholder)
+                     for a in node.args)
+        kwargs = {k: _transform_graph(v, to_placeholder)
+                  for k, v in node.kwargs.items()}
         auto = d.autoscaling_config
         specs.append({
             "name": d.name,
@@ -177,8 +195,8 @@ def run(target: Application, *, name: str = "default",
                 a.app_name = name
             return a
 
-        args = tuple(fix(a) for a in args)
-        kwargs = {k: fix(v) for k, v in kwargs.items()}
+        args = tuple(_transform_graph(a, fix) for a in args)
+        kwargs = {k: _transform_graph(v, fix) for k, v in kwargs.items()}
         spec["init_blob"] = cloudpickle.dumps((args, kwargs))
     ingress = target.deployment.name
     ctrl = _controller()

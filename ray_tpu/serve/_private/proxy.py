@@ -37,6 +37,47 @@ class Request:
         return self.body.decode()
 
 
+_REASONS = {200: "OK", 201: "Created", 204: "No Content",
+            301: "Moved Permanently", 302: "Found", 400: "Bad Request",
+            401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+            405: "Method Not Allowed", 409: "Conflict",
+            422: "Unprocessable Entity", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+
+
+class _StreamOut:
+    """A streaming response being relayed to the HTTP client."""
+
+    def __init__(self, status: str, ctype: str, headers: Dict[str, str],
+                 stream):
+        self.status = status
+        self.ctype = ctype
+        self.headers = headers
+        self._stream = stream
+
+    async def chunks(self):
+        it = iter(self._stream)
+        while True:
+            # each pull can block on the replica's next yield: off-loop
+            chunk = await asyncio.to_thread(next, it, _DONE)
+            if chunk is _DONE:
+                return
+            yield chunk
+
+
+_DONE = object()
+
+# the proxy computes message framing itself; relayed app headers must not
+# carry their own (duplicate Content-Length is an RFC 7230 violation)
+_FRAMING_HEADERS = {"content-length", "transfer-encoding", "connection",
+                    "content-type"}
+
+
+def _clean_headers(headers):
+    return [(k, v) for k, v in (headers or {}).items()
+            if k.lower() not in _FRAMING_HEADERS]
+
+
 class ProxyActor:
     def __init__(self, port: int = 8000, host: str = "127.0.0.1",
                  grpc_port: Optional[int] = None):
@@ -134,15 +175,44 @@ class ProxyActor:
                 length = int(headers.get("content-length", 0) or 0)
                 if length:
                     body = await reader.readexactly(length)
-                status, payload, ctype = await self._dispatch(
-                    method, target, headers, body)
-                writer.write(
-                    f"HTTP/1.1 {status}\r\n"
-                    f"Content-Type: {ctype}\r\n"
-                    f"Content-Length: {len(payload)}\r\n"
-                    f"Connection: keep-alive\r\n\r\n".encode("latin1"))
-                writer.write(payload)
-                await writer.drain()
+                out = await self._dispatch(method, target, headers, body)
+                if isinstance(out, _StreamOut):
+                    # chunked transfer-encoding: flush each chunk as the
+                    # replica yields it (reference: proxy streaming path)
+                    hdrs = "".join(f"{k}: {v}\r\n"
+                                   for k, v in _clean_headers(out.headers))
+                    writer.write(
+                        f"HTTP/1.1 {out.status}\r\n"
+                        f"Content-Type: {out.ctype}\r\n"
+                        f"Transfer-Encoding: chunked\r\n{hdrs}"
+                        f"Connection: keep-alive\r\n\r\n".encode("latin1"))
+                    await writer.drain()
+                    try:
+                        async for chunk in out.chunks():
+                            data = (chunk if isinstance(chunk, bytes)
+                                    else str(chunk).encode())
+                            writer.write(
+                                f"{len(data):x}\r\n".encode("latin1")
+                                + data + b"\r\n")
+                            await writer.drain()
+                    except Exception:
+                        # mid-stream failure: close WITHOUT the 0-length
+                        # terminator so the client sees truncation, not a
+                        # clean end-of-response
+                        break
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
+                else:
+                    status, payload, ctype, extra = out
+                    hdrs = "".join(f"{k}: {v}\r\n"
+                                   for k, v in _clean_headers(extra))
+                    writer.write(
+                        f"HTTP/1.1 {status}\r\n"
+                        f"Content-Type: {ctype}\r\n"
+                        f"Content-Length: {len(payload)}\r\n{hdrs}"
+                        f"Connection: keep-alive\r\n\r\n".encode("latin1"))
+                    writer.write(payload)
+                    await writer.drain()
                 if headers.get("connection", "").lower() == "close":
                     break
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -154,21 +224,20 @@ class ProxyActor:
                 pass
 
     async def _dispatch(self, method: str, target: str,
-                        headers: Dict[str, str],
-                        body: bytes) -> Tuple[str, bytes, str]:
+                        headers: Dict[str, str], body: bytes):
         parsed = urllib.parse.urlsplit(target)
         path = parsed.path
         query = dict(urllib.parse.parse_qsl(parsed.query))
         if path == "/-/healthz":
-            return "200 OK", b"success", "text/plain"
+            return "200 OK", b"success", "text/plain", None
         if path == "/-/routes":
             return ("200 OK",
                     json.dumps({p: a for p, (a, _) in self._routes.items()}
-                               ).encode(), "application/json")
+                               ).encode(), "application/json", None)
         match = self._match_route(path)
         if match is None:
             return "404 Not Found", b'{"error": "no route"}', \
-                "application/json"
+                "application/json", None
         prefix, (app_name, ingress) = match
         # strip the normalized prefix so request.path keeps its leading "/"
         sub_path = path[len(prefix.rstrip("/")):] or "/"
@@ -177,15 +246,24 @@ class ProxyActor:
             handle = self._get_handle(app_name, ingress)
             response = handle.remote(request)
             result = await asyncio.to_thread(response.result, 60.0)
+            from ray_tpu.serve.handle import _BufferedStream
+
+            if isinstance(result, _BufferedStream):
+                meta = result.meta
+                code = meta.get("status_code", 200)
+                return _StreamOut(
+                    f"{code} {_REASONS.get(code, 'OK')}",
+                    meta.get("media_type") or "application/octet-stream",
+                    meta.get("headers") or {}, result)
             return self._encode(result)
         except TimeoutError as e:
             return ("503 Service Unavailable",
                     json.dumps({"error": str(e)}).encode(),
-                    "application/json")
+                    "application/json", None)
         except Exception as e:
             return ("500 Internal Server Error",
                     json.dumps({"error": f"{type(e).__name__}: {e}"}
-                               ).encode(), "application/json")
+                               ).encode(), "application/json", None)
 
     def _match_route(self, path: str):
         best = None
@@ -205,10 +283,16 @@ class ProxyActor:
         return self._handles[key]
 
     @staticmethod
-    def _encode(result: Any) -> Tuple[str, bytes, str]:
+    def _encode(result: Any):
+        from ray_tpu.serve.asgi import Response
+
+        if isinstance(result, Response):
+            return (f"{result.status_code} "
+                    f"{_REASONS.get(result.status_code, 'OK')}",
+                    result.body, result.media_type, result.headers)
         if isinstance(result, bytes):
-            return "200 OK", result, "application/octet-stream"
+            return "200 OK", result, "application/octet-stream", None
         if isinstance(result, str):
-            return "200 OK", result.encode(), "text/plain"
+            return "200 OK", result.encode(), "text/plain", None
         return ("200 OK", json.dumps(result, default=str).encode(),
-                "application/json")
+                "application/json", None)

@@ -39,8 +39,8 @@ class Replica:
 
         func_or_class = cloudpickle.loads(blob)
         args, kwargs = cloudpickle.loads(init_blob)
-        args = tuple(self._resolve(a) for a in args)
-        kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+        args = tuple(self._resolve_deep(a) for a in args)
+        kwargs = {k: self._resolve_deep(v) for k, v in kwargs.items()}
 
         if isinstance(func_or_class, type):
             self._callable = func_or_class(*args, **kwargs)
@@ -58,6 +58,14 @@ class Replica:
 
             return DeploymentHandle(arg.app_name, arg.dep_name)
         return arg
+
+    @classmethod
+    def _resolve_deep(cls, arg):
+        """Placeholders can sit inside graph nodes / containers
+        (deployment-graph init args), not just at the top level."""
+        from ray_tpu.serve.deployment import map_graph_values
+
+        return map_graph_values(arg, cls._resolve)
 
     def _apply_user_config(self, cfg):
         fn = getattr(self._callable, "reconfigure", None)
@@ -88,6 +96,11 @@ class Replica:
             await asyncio.sleep(0.02)
         return True
 
+    def _target(self, method_name: Optional[str]):
+        if self._is_function:
+            return self._callable
+        return getattr(self._callable, method_name or "__call__")
+
     # ------------------------------------------------------------- requests
     async def handle_request(self, method_name: Optional[str], args: Tuple,
                              kwargs: Dict, multiplexed_model_id: str = ""):
@@ -99,10 +112,13 @@ class Replica:
 
             if multiplexed_model_id:
                 multiplex._set_request_model_id(multiplexed_model_id)
-            if self._is_function:
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name or "__call__")
+            target = self._target(method_name)
+            if inspect.isgeneratorfunction(target) or \
+                    inspect.isasyncgenfunction(target):
+                # generator endpoint: the caller must re-issue through the
+                # streaming path (checked BEFORE calling, so user code does
+                # not run twice); reference replicas always stream (ASGI)
+                return ("stream", None)
             if inspect.iscoroutinefunction(target):
                 result = await target(*args, **kwargs)
             else:
@@ -111,7 +127,78 @@ class Replica:
                 result = await asyncio.to_thread(target, *args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = await result
+            from ray_tpu.serve.asgi import StreamingResponse, iterate_sync
+
+            if isinstance(result, StreamingResponse) or \
+                    inspect.isgenerator(result):
+                # lazily-built stream object: drain it OFF-LOOP (this
+                # coroutine runs on the replica's event loop; a sync drain
+                # would stall concurrent requests, and iterate_sync spins a
+                # private loop for async iterables which must not nest in a
+                # running one). Bounded by the handle's 60s request budget;
+                # declare the endpoint as a generator function for true
+                # incremental streaming.
+                if isinstance(result, StreamingResponse):
+                    chunks = await asyncio.to_thread(
+                        lambda: list(iterate_sync(result.content)))
+                    return ("stream_buffered",
+                            {"chunks": chunks,
+                             "status_code": result.status_code,
+                             "media_type": result.media_type,
+                             "headers": result.headers})
+                chunks = await asyncio.to_thread(lambda: list(result))
+                return ("stream_buffered",
+                        {"chunks": chunks, "status_code": 200,
+                         "media_type": "application/octet-stream",
+                         "headers": {}})
             return ("ok", result)
+        finally:
+            self._ongoing -= 1
+            if multiplexed_model_id:
+                multiplex._set_request_model_id("")
+
+    def handle_request_streaming(self, method_name: Optional[str],
+                                 args: Tuple, kwargs: Dict,
+                                 multiplexed_model_id: str = ""):
+        """Streaming execution path (reference: replica.py:471): a sync
+        generator method — called with num_returns='streaming', each yield
+        becomes an ObjectRef at the caller as it is produced. First item is
+        the admission handshake."""
+        if self._ongoing >= self._max_ongoing or self._draining:
+            yield (REJECTED, self._ongoing)
+            return
+        self._ongoing += 1
+        try:
+            from ray_tpu.serve import multiplex
+            from ray_tpu.serve.asgi import StreamingResponse, iterate_sync
+
+            if multiplexed_model_id:
+                multiplex._set_request_model_id(multiplexed_model_id)
+            target = self._target(method_name)
+            if inspect.isasyncgenfunction(target):
+                result = target(*args, **kwargs)
+            elif inspect.iscoroutinefunction(target):
+                result = asyncio.run(target(*args, **kwargs))
+            else:
+                result = target(*args, **kwargs)
+            if isinstance(result, StreamingResponse):
+                yield ("start", {"status_code": result.status_code,
+                                 "media_type": result.media_type,
+                                 "headers": result.headers})
+                for chunk in iterate_sync(result.content):
+                    yield ("chunk", chunk)
+            elif inspect.isgenerator(result) or hasattr(result, "__aiter__"):
+                yield ("start", {"status_code": 200,
+                                 "media_type": "application/octet-stream",
+                                 "headers": {}})
+                for chunk in iterate_sync(result):
+                    yield ("chunk", chunk)
+            else:
+                # non-streaming endpoint called through the streaming path:
+                # a single-chunk stream
+                yield ("start", {"status_code": 200, "media_type": None,
+                                 "headers": {}})
+                yield ("chunk", result)
         finally:
             self._ongoing -= 1
             if multiplexed_model_id:

@@ -1,0 +1,190 @@
+"""ASGI ingress (reference: python/ray/serve/api.py:170 @serve.ingress —
+wraps a deployment class so an ASGI app (FastAPI or any ASGI3 callable)
+serves its HTTP traffic; reference's ASGIAppReplicaWrapper in
+_private/http_util.py drives the app with starlette's protocol).
+
+No uvicorn/starlette in this image: the proxy parses HTTP itself and hands
+replicas a ``Request``; this module translates that into an ASGI scope,
+drives the app, and returns a ``Response`` (or streams body chunks).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, AsyncIterator, Callable, Dict, Iterable, Optional
+
+__all__ = ["ingress", "Response", "StreamingResponse"]
+
+
+class Response:
+    """Explicit HTTP response from a deployment (starlette.Response analog):
+    carries status/headers/body through the handle back to the proxy."""
+
+    def __init__(self, content: Any = b"", status_code: int = 200,
+                 headers: Optional[Dict[str, str]] = None,
+                 media_type: Optional[str] = None):
+        self.status_code = status_code
+        self.headers = dict(headers or {})
+        if isinstance(content, bytes):
+            self.body = content
+            default_type = "application/octet-stream"
+        elif isinstance(content, str):
+            self.body = content.encode()
+            default_type = "text/plain"
+        else:
+            import json
+
+            self.body = json.dumps(content, default=str).encode()
+            default_type = "application/json"
+        self.media_type = media_type or default_type
+
+    def __reduce__(self):
+        r = Response.__new__(Response)
+        state = {"status_code": self.status_code, "headers": self.headers,
+                 "body": self.body, "media_type": self.media_type}
+        return (_rebuild_response, (state,))
+
+
+def _rebuild_response(state: Dict) -> "Response":
+    r = Response.__new__(Response)
+    r.__dict__.update(state)
+    return r
+
+
+class StreamingResponse:
+    """Chunked-transfer response: wraps a (sync or async) iterator of
+    str/bytes chunks (reference: starlette StreamingResponse served through
+    replica.py:471's streaming path)."""
+
+    def __init__(self, content: Iterable, status_code: int = 200,
+                 media_type: str = "application/octet-stream",
+                 headers: Optional[Dict[str, str]] = None):
+        self.content = content
+        self.status_code = status_code
+        self.media_type = media_type
+        self.headers = dict(headers or {})
+
+
+async def _run_asgi(app: Callable, request) -> Response:
+    """Drive one request through an ASGI3 app, buffering the response."""
+    query = "&".join(f"{k}={v}" for k, v in request.query_params.items())
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method.upper(),
+        "scheme": "http",
+        "path": request.path,
+        "raw_path": request.path.encode(),
+        "root_path": "",
+        "query_string": query.encode(),
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in request.headers.items()],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 0),
+    }
+    body = request.body or b""
+    sent_body = False
+
+    async def receive():
+        nonlocal sent_body
+        if not sent_body:
+            sent_body = True
+            return {"type": "http.request", "body": body, "more_body": False}
+        return {"type": "http.disconnect"}
+
+    status = 500
+    headers: Dict[str, str] = {}
+    chunks = []
+
+    async def send(message):
+        nonlocal status, headers
+        if message["type"] == "http.response.start":
+            status = message["status"]
+            headers = {k.decode(): v.decode()
+                       for k, v in message.get("headers", [])}
+        elif message["type"] == "http.response.body":
+            chunks.append(message.get("body", b""))
+
+    await app(scope, receive, send)
+    media_type = headers.pop("content-type", None)
+    return Response(b"".join(chunks), status_code=status, headers=headers,
+                    media_type=media_type)
+
+
+def _bind_fastapi_routes(app, instance) -> None:
+    """FastAPI class-based views: route endpoints defined as methods of the
+    ingress class captured the UNBOUND function at decoration time; rebind
+    them to the replica's instance (reference:
+    _private/http_util.py make_fastapi_class_based_view)."""
+    try:
+        routes = app.routes
+    except AttributeError:
+        return
+    cls = type(instance)
+    for route in routes:
+        endpoint = getattr(route, "endpoint", None)
+        if endpoint is None:
+            continue
+        for name, member in inspect.getmembers(cls):
+            if member is endpoint or getattr(member, "__func__", None) is endpoint:
+                bound = getattr(instance, name)
+                route.endpoint = bound
+                # FastAPI resolves the handler through the dependant graph
+                dependant = getattr(route, "dependant", None)
+                if dependant is not None:
+                    dependant.call = bound
+                break
+
+
+def ingress(app_or_func: Callable):
+    """Class decorator: route all HTTP traffic for this deployment through
+    an ASGI app. ``@serve.deployment`` + ``@serve.ingress(asgi_app)``.
+
+    Works with any ASGI3 callable (FastAPI instances included); with
+    FastAPI, endpoint methods defined on the decorated class are rebound to
+    the replica instance at construction.
+    """
+    asgi_app = app_or_func
+
+    def decorator(cls):
+        if not isinstance(cls, type):
+            raise TypeError("@serve.ingress decorates a class; for plain "
+                            "functions use @serve.deployment directly")
+
+        class ASGIIngress(cls):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                _bind_fastapi_routes(asgi_app, self)
+                self.__asgi_app = asgi_app
+
+            async def __call__(self, request):
+                return await _run_asgi(asgi_app, request)
+
+        ASGIIngress.__name__ = cls.__name__
+        ASGIIngress.__qualname__ = cls.__qualname__
+        ASGIIngress.__module__ = cls.__module__
+        ASGIIngress.__serve_asgi_ingress__ = True
+        return ASGIIngress
+
+    return decorator
+
+
+def iterate_sync(content) -> Iterable:
+    """Normalize StreamingResponse content / generators to a sync iterator
+    (async generators are drained on a private event loop)."""
+    if hasattr(content, "__aiter__"):
+        import asyncio
+
+        agen: AsyncIterator = content.__aiter__()
+        loop = asyncio.new_event_loop()
+        try:
+            while True:
+                try:
+                    yield loop.run_until_complete(agen.__anext__())
+                except StopAsyncIteration:
+                    return
+        finally:
+            loop.close()
+    else:
+        yield from content

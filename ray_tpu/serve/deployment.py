@@ -81,18 +81,51 @@ class Application:
         self.args = args
         self.kwargs = kwargs
 
+    def __getattr__(self, item):
+        if item.startswith("_") or item in ("deployment", "args", "kwargs"):
+            raise AttributeError(item)
+        return _MethodBinder(self, item)
+
     def walk(self) -> List["Application"]:
-        """All nodes, dependencies first, deduped by deployment name."""
+        """All nodes, dependencies first, deduped by deployment name.
+        Recurses through graph method nodes and containers (reference:
+        deployment_graph_build.py collecting DeploymentNodes)."""
         seen: Dict[str, Application] = {}
 
         def visit(node: "Application"):
-            for a in list(node.args) + list(node.kwargs.values()):
+            def leaf(a):
                 if isinstance(a, Application):
                     visit(a)
+                return a
+
+            for a in list(node.args) + list(node.kwargs.values()):
+                map_graph_values(a, leaf)
             seen.setdefault(node.deployment.name, node)
 
         visit(self)
         return list(seen.values())
+
+
+class _MethodBinder:
+    def __init__(self, app: Application, method_name: str):
+        self._app = app
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "DeploymentMethodNode":
+        return DeploymentMethodNode(self._app, self._method_name, args,
+                                    kwargs)
+
+
+class DeploymentMethodNode:
+    """A bound method call on a deployment inside a serve graph
+    (reference: dag DeploymentMethodNode consumed by DAGDriver)."""
+
+    def __init__(self, app: Application, method_name: str, args: Tuple,
+                 kwargs: Dict):
+        self.app = app
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
 
 
 def deployment(func_or_class=None, *, name: Optional[str] = None, **options):
@@ -108,3 +141,23 @@ def deployment(func_or_class=None, *, name: Optional[str] = None, **options):
                 "factory, not together with the function/class positionally")
         return wrap(func_or_class)
     return wrap
+
+
+def map_graph_values(value, fn):
+    """Recursively rewrite leaves of a serve graph value: descends through
+    DeploymentMethodNode and list/tuple/dict containers, applying ``fn`` to
+    every other leaf (Applications, placeholders, plain values). The single
+    traversal shared by graph build, replica resolution, and walk()."""
+    if isinstance(value, DeploymentMethodNode):
+        new = DeploymentMethodNode.__new__(DeploymentMethodNode)
+        new.app = map_graph_values(value.app, fn)
+        new.method_name = value.method_name
+        new.args = tuple(map_graph_values(a, fn) for a in value.args)
+        new.kwargs = {k: map_graph_values(v, fn)
+                      for k, v in value.kwargs.items()}
+        return new
+    if isinstance(value, (list, tuple)):
+        return type(value)(map_graph_values(v, fn) for v in value)
+    if isinstance(value, dict):
+        return {k: map_graph_values(v, fn) for k, v in value.items()}
+    return fn(value)

@@ -1,0 +1,108 @@
+"""Deployment-graph driver (reference: python/ray/serve/drivers.py
+DAGDriver + serve/_private/deployment_graph_build.py): serves a graph of
+bound deployment method calls as one HTTP application.
+
+Usage::
+
+    with serve.InputNode() as inp:
+        m1 = Model.bind(1)          # Application
+        out = Combiner.bind(): ...  # graph built from .method.bind(...)
+        graph = combiner.combine.bind(m1.forward.bind(inp), inp)
+    serve.run(DAGDriver.bind(graph, http_adapter=json_request))
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.serve.deployment import (
+    Application, DeploymentMethodNode, deployment)
+
+
+class InputNode:
+    """Placeholder for the per-request input (reference: dag InputNode).
+    Context-manager form mirrors the reference API."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __reduce__(self):
+        return (InputNode, ())
+
+
+def json_request(request) -> Any:
+    """Default http_adapter: parse the request body as JSON."""
+    return request.json()
+
+
+def starlette_request(request):
+    """Pass the raw Request through."""
+    return request
+
+
+class _GraphExecutor:
+    """Executes a (pickled) graph whose Applications were replaced by
+    DeploymentHandles at build time."""
+
+    def __init__(self, root):
+        self.root = root
+
+    def execute(self, request_input) -> Any:
+        cache: Dict[int, Any] = {}
+        return self._resolve(self.root, request_input, cache)
+
+    def _resolve(self, node, request_input, cache):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        if isinstance(node, InputNode):
+            return request_input
+        if isinstance(node, DeploymentMethodNode):
+            key = id(node)
+            if key in cache:
+                return cache[key]
+            args = [self._resolve(a, request_input, cache)
+                    for a in node.args]
+            kwargs = {k: self._resolve(v, request_input, cache)
+                      for k, v in node.kwargs.items()}
+            handle = node.app  # replaced by a handle at build time
+            if not isinstance(handle, DeploymentHandle):
+                raise RuntimeError(
+                    "graph node was not bound to a deployment handle — "
+                    "run the graph through serve.run(DAGDriver.bind(...))")
+            method = getattr(handle, node.method_name)
+            result = method.remote(*args, **kwargs).result(60.0)
+            cache[key] = result
+            return result
+        if isinstance(node, (list, tuple)):
+            return type(node)(self._resolve(v, request_input, cache)
+                              for v in node)
+        if isinstance(node, dict):
+            return {k: self._resolve(v, request_input, cache)
+                    for k, v in node.items()}
+        return node
+
+
+@deployment(name="DAGDriver")
+class DAGDriver:
+    """Ingress deployment executing a deployment graph per request."""
+
+    def __init__(self, graph, http_adapter: Optional[Callable] = None):
+        self._executor = _GraphExecutor(graph)
+        self._adapter = http_adapter or starlette_request
+
+    async def __call__(self, request):
+        import asyncio
+        import inspect
+
+        payload = self._adapter(request)
+        if inspect.iscoroutine(payload):
+            payload = await payload
+        # graph execution blocks on handle results: run off-loop
+        return await asyncio.to_thread(self._executor.execute, payload)
+
+    def predict(self, request_input):
+        """Direct (non-HTTP) graph execution for handle callers."""
+        return self._executor.execute(request_input)

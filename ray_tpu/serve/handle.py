@@ -196,7 +196,104 @@ class Router:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.5)
                 continue
+            if isinstance(reply, tuple) and len(reply) == 2 and \
+                    reply[0] == "stream":
+                # generator endpoint: re-issue through the streaming path
+                # (the replica detected this before running user code)
+                return _BufferedStream(
+                    self.assign_streaming(method_name, args, kwargs,
+                                          multiplexed_model_id, timeout))
+            if isinstance(reply, tuple) and len(reply) == 2 and \
+                    reply[0] == "stream_buffered":
+                meta = reply[1]
+                return _BufferedStream(
+                    iter([("start", {k: meta[k] for k in
+                                     ("status_code", "media_type",
+                                      "headers")})] +
+                         [("chunk", c) for c in meta["chunks"]]))
             return reply[1]
+
+    def assign_streaming(self, method_name: Optional[str], args, kwargs,
+                         multiplexed_model_id: str = "",
+                         timeout: Optional[float] = None):
+        """Streaming variant: yields ('start', meta) then ('chunk', value)
+        items as the replica produces them (reference: router.py streaming
+        assignment feeding DeploymentResponseGenerator)."""
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else 60.0)
+        backoff = 0.02
+        while True:
+            handles = self.replica_set.handles()
+            if not handles:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no replicas for {self.replica_set.app_name}#"
+                        f"{self.replica_set.dep_name}")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                self.replica_set.refresh(force=True)
+                continue
+            replica = self._pick(handles)
+            try:
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        method_name, args, kwargs, multiplexed_model_id)
+                it = iter(gen)
+                first_ref = next(it)
+                first = ray_tpu.get(first_ref,
+                                    timeout=max(0.5,
+                                                deadline - time.monotonic()))
+            except RayTaskError:
+                raise
+            except StopIteration:
+                raise RuntimeError("streaming replica produced no handshake")
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                self.replica_set.refresh(force=True)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                continue
+            if isinstance(first, tuple) and first[0] == REJECTED:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{self.replica_set.dep_name}: all replicas busy")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+                continue
+
+            def stream():
+                yield first
+                for ref in it:
+                    yield ray_tpu.get(ref)
+
+            return stream()
+
+
+class _BufferedStream:
+    """Iterator over ('start', meta)/('chunk', value) items exposing the
+    response metadata and plain chunk values."""
+
+    def __init__(self, items):
+        self._items = iter(items)
+        first = next(self._items, None)
+        if first is not None and first[0] == "start":
+            self.meta = first[1]
+            self._pending = None
+        else:
+            self.meta = {"status_code": 200, "media_type": None,
+                         "headers": {}}
+            self._pending = first
+
+    def __iter__(self):
+        if self._pending is not None:
+            kind, value = self._pending
+            self._pending = None
+            if kind == "chunk":
+                yield value
+        for kind, value in self._items:
+            if kind == "chunk":
+                yield value
 
 
 class DeploymentResponse:
@@ -230,14 +327,40 @@ class DeploymentResponse:
         return asyncio.to_thread(self.result).__await__()
 
 
+class DeploymentResponseGenerator:
+    """Streaming result of ``handle.options(stream=True).remote()``
+    (reference: handle.py DeploymentResponseGenerator): a sync iterator of
+    chunk values, produced as the replica yields them."""
+
+    def __init__(self, router: Router, method_name: Optional[str],
+                 args, kwargs, multiplexed_model_id: str = ""):
+        self._future = _get_request_pool().submit(
+            router.assign_streaming, method_name, args, kwargs,
+            multiplexed_model_id)
+        self._stream = None
+
+    def _ensure(self, timeout_s: Optional[float] = 60.0):
+        if self._stream is None:
+            self._stream = _BufferedStream(self._future.result(timeout_s))
+        return self._stream
+
+    @property
+    def meta(self) -> Dict:
+        return self._ensure().meta
+
+    def __iter__(self):
+        return iter(self._ensure())
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, dep_name: str,
                  method_name: Optional[str] = None,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.app_name = app_name
         self.deployment_name = dep_name
         self._method_name = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._router: Optional[Router] = None
 
     def _get_router(self) -> Router:
@@ -246,13 +369,14 @@ class DeploymentHandle:
         return self._router
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.app_name, self.deployment_name,
             method_name or self._method_name,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self._model_id)
+            else self._model_id,
+            self._stream if stream is None else stream)
         h._router = self._router
         return h
 
@@ -260,9 +384,13 @@ class DeploymentHandle:
         if item.startswith("_"):
             raise AttributeError(item)
         return DeploymentHandle(self.app_name, self.deployment_name, item,
-                                self._model_id)
+                                self._model_id, self._stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return DeploymentResponseGenerator(
+                self._get_router(), self._method_name, args, kwargs,
+                self._model_id)
         return DeploymentResponse(
             self._get_router(), self._method_name, args, kwargs,
             self._model_id)
@@ -270,4 +398,4 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.app_name, self.deployment_name, self._method_name,
-                 self._model_id))
+                 self._model_id, self._stream))

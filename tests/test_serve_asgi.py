@@ -1,0 +1,232 @@
+"""Serve ASGI ingress, streaming responses, deployment graph (reference:
+python/ray/serve/api.py:170 @serve.ingress, _private/replica.py:471
+streaming, deployment_graph_build.py + drivers.py DAGDriver; VERDICT r1
+item 5)."""
+
+import http.client
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    serve.start(http_options={"port": 0})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http(method, path, body=None, port=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path, body=body)
+    resp = conn.getresponse()
+    data = resp.read()
+    status, headers = resp.status, dict(resp.getheaders())
+    conn.close()
+    return status, headers, data
+
+
+# a minimal ASGI3 app (the protocol FastAPI speaks) — no framework needed
+async def toy_asgi_app(scope, receive, send):
+    assert scope["type"] == "http"
+    body = b""
+    while True:
+        msg = await receive()
+        body += msg.get("body", b"")
+        if not msg.get("more_body"):
+            break
+    if scope["path"] == "/hello":
+        payload = json.dumps({
+            "method": scope["method"],
+            "query": scope["query_string"].decode(),
+            "got": body.decode(),
+        }).encode()
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"application/json"),
+                                (b"x-toy", b"1")]})
+        await send({"type": "http.response.body", "body": payload})
+    else:
+        await send({"type": "http.response.start", "status": 404,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"nope"})
+
+
+def test_asgi_ingress_routes_and_status(serve_cluster):
+    @serve.deployment
+    @serve.ingress(toy_asgi_app)
+    class AsgiApp:
+        pass
+
+    serve.run(AsgiApp.bind(), name="asgi", route_prefix="/asgi")
+    port = serve.get_http_port()
+
+    status, headers, data = _http(
+        "POST", "/asgi/hello?x=1", body=b"ping", port=port)
+    assert status == 200
+    assert headers.get("X-Toy") == "1" or headers.get("x-toy") == "1"
+    payload = json.loads(data)
+    assert payload == {"method": "POST", "query": "x=1", "got": "ping"}
+
+    status, _, data = _http("GET", "/asgi/missing", port=port)
+    assert status == 404 and data == b"nope"
+    serve.delete("asgi")
+
+
+def test_response_object_controls_status_and_headers(serve_cluster):
+    @serve.deployment
+    def responder(request):
+        return serve.Response({"made": "it"}, status_code=201,
+                              headers={"X-Custom": "yes"})
+
+    serve.run(responder.bind(), name="resp", route_prefix="/resp")
+    port = serve.get_http_port()
+    status, headers, data = _http("GET", "/resp", port=port)
+    assert status == 201
+    assert headers.get("X-Custom") == "yes"
+    assert json.loads(data) == {"made": "it"}
+    serve.delete("resp")
+
+
+def test_streaming_generator_endpoint_chunked(serve_cluster):
+    @serve.deployment
+    def stream_numbers(request):
+        # declared below as generator: this wrapper exists to show plain
+        # functions still work; actual streamer:
+        raise AssertionError("unused")
+
+    @serve.deployment
+    def streamer(request):
+        yield "first|"
+        yield "second|"
+        yield "third"
+
+    serve.run(streamer.bind(), name="stream", route_prefix="/stream")
+    port = serve.get_http_port()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", "/stream")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Transfer-Encoding") == "chunked"
+    data = resp.read()
+    conn.close()
+    assert data == b"first|second|third"
+    serve.delete("stream")
+
+
+def test_streaming_response_object(serve_cluster):
+    @serve.deployment
+    def eventsource(request):
+        def gen():
+            for i in range(3):
+                yield f"data: {i}\n\n"
+        return serve.StreamingResponse(gen(), media_type="text/event-stream")
+
+    serve.run(eventsource.bind(), name="sse", route_prefix="/sse")
+    port = serve.get_http_port()
+    status, headers, data = _http("GET", "/sse", port=port)
+    assert status == 200
+    assert data == b"data: 0\n\ndata: 1\n\ndata: 2\n\n"
+    serve.delete("sse")
+
+
+def test_handle_level_streaming(serve_cluster):
+    @serve.deployment
+    class Tokens:
+        def generate(self, n):
+            for i in range(int(n)):
+                yield f"tok{i}"
+
+    serve.run(Tokens.bind(), name="tok", route_prefix="/tok")
+    handle = serve.get_app_handle("tok")
+    gen = handle.options(method_name="generate", stream=True).remote(4)
+    assert list(gen) == ["tok0", "tok1", "tok2", "tok3"]
+    serve.delete("tok")
+
+
+def test_deployment_graph_dagdriver(serve_cluster):
+    @serve.deployment
+    class Adder:
+        def __init__(self, delta):
+            self.delta = delta
+
+        def add(self, x):
+            return x + self.delta
+
+    @serve.deployment
+    class Combiner:
+        def combine(self, a, b):
+            return {"sum": a + b}
+
+    with serve.InputNode() as inp:
+        a1 = Adder.bind(1)
+        a2 = Adder.options(name="Adder2").bind(100)
+        graph = Combiner.bind().combine.bind(
+            a1.add.bind(inp), a2.add.bind(inp))
+
+    serve.run(serve.DAGDriver.bind(graph,
+                                   http_adapter=serve.json_request),
+              name="graph", route_prefix="/graph")
+    port = serve.get_http_port()
+    status, _, data = _http("POST", "/graph", body=b"5", port=port)
+    assert status == 200
+    assert json.loads(data) == {"sum": 111}  # (5+1) + (5+100)
+
+    # direct handle execution through the driver
+    handle = serve.get_app_handle("graph")
+    assert handle.options(method_name="predict").remote(7).result(60) == \
+        {"sum": 115}
+    serve.delete("graph")
+
+
+def test_graph_applications_inside_containers(serve_cluster):
+    """Applications nested in a list arg must still be deployed (walk()
+    descends containers the same way graph build does)."""
+    @serve.deployment
+    class Member:
+        def __init__(self, v):
+            self.v = v
+
+        def get(self, _x):
+            return self.v
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, members):
+            self.members = members
+
+        def vote(self, x):
+            return sum(m.get.remote(x).result(30) for m in self.members)
+
+    m1 = Member.bind(10)
+    m2 = Member.options(name="Member2").bind(32)
+    serve.run(Ensemble.bind([m1, m2]), name="ens", route_prefix="/ens")
+    handle = serve.get_app_handle("ens")
+    assert handle.options(method_name="vote").remote(0).result(60) == 42
+    serve.delete("ens")
+
+
+def test_streaming_failure_truncates_chunked_body(serve_cluster):
+    @serve.deployment
+    def broken(request):
+        yield "good|"
+        raise RuntimeError("mid-stream boom")
+
+    serve.run(broken.bind(), name="broken", route_prefix="/broken")
+    port = serve.get_http_port()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", "/broken")
+    resp = conn.getresponse()
+    assert resp.getheader("Transfer-Encoding") == "chunked"
+    # the error must NOT look like a clean end-of-response: the connection
+    # closes without the chunked terminator
+    with pytest.raises(http.client.IncompleteRead) as exc_info:
+        resp.read()
+    assert b"good|" in (exc_info.value.partial or b"")
+    conn.close()
+    serve.delete("broken")
