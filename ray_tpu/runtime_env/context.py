@@ -19,6 +19,11 @@ class RuntimeEnvContext:
     def __init__(self, spec: Dict, cache_root: str):
         self.spec = spec
         self.cache_root = cache_root
+        # sys.path entries added by user-code plugins (working_dir,
+        # py_modules) this setup; pip venv site-packages slots BELOW these
+        # (user code shadows env packages, reference precedence) but above
+        # the system site-packages
+        self.user_paths: list = []
 
 
 _applied: Optional[Dict] = None

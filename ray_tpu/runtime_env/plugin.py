@@ -119,6 +119,7 @@ class WorkingDirPlugin(RuntimeEnvPlugin):
         os.chdir(staged)
         if staged not in sys.path:
             sys.path.insert(0, staged)
+            context.user_paths.append(staged)
 
 
 class PyModulesPlugin(RuntimeEnvPlugin):
@@ -132,42 +133,156 @@ class PyModulesPlugin(RuntimeEnvPlugin):
                 path = os.path.dirname(path)
             if path not in sys.path:
                 sys.path.insert(0, path)
+                context.user_paths.append(path)
 
 
-class PipCheckPlugin(RuntimeEnvPlugin):
-    """No-install policy: verify the requested packages are already
-    importable instead of calling pip (reference behavior installs via
-    _private/runtime_env/pip.py; this image forbids installs)."""
+class PipPlugin(RuntimeEnvPlugin):
+    """Per-env virtualenv with content-addressed caching (reference:
+    python/ray/_private/runtime_env/pip.py:425 — virtualenv + install keyed
+    by requirement hash; worker-pool env_key affinity keeps processes
+    pinned to one env).
+
+    ``{"pip": [reqs...]}`` or ``{"pip": {"packages": [...],
+    "pip_install_options": [...]}}``. The venv is created with
+    --system-site-packages (the worker still needs jax/numpy); its
+    site-packages is prepended to sys.path so env packages shadow system
+    ones. In this zero-egress image, requirements must resolve offline
+    (local wheels/dirs with --no-index); PyPI names that are already
+    importable system-wide pass through without an install attempt.
+    """
 
     name = "pip"
     priority = 3
 
-    def setup(self, value, context) -> None:
+    @staticmethod
+    def _normalize(value):
+        options: list = []
         if isinstance(value, dict):
+            options = list(value.get("pip_install_options", []))
             value = value.get("packages", [])
         if isinstance(value, str):
             raise RuntimeEnvSetupError(
-                "pip requirements files are not supported in the no-install "
-                "deployment; list packages explicitly")
-        import importlib.metadata as im
+                "pip requirements files are not supported; list packages "
+                "explicitly")
+        return sorted(str(v) for v in value), options
 
-        missing = []
-        for req in value:
-            dist = (req.split("==")[0].split(">=")[0].split("<=")[0]
-                    .split("[")[0].strip())
+    @staticmethod
+    def _already_satisfied(packages) -> bool:
+        import importlib.metadata as im
+        import re
+
+        for req in packages:
+            if "/" in req or req.endswith(".whl"):
+                return False  # local artifact: version unknowable up front
+            m = re.match(r"^([A-Za-z0-9._-]+)(\[[^\]]*\])?(.*)$", req.strip())
+            if not m:
+                return False
+            dist, _extras, constraint = m.group(1), m.group(2), \
+                m.group(3).strip()
+            have = None
             try:
-                im.version(dist)  # distribution name (handles scikit-learn)
-                continue
+                have = im.version(dist)
             except im.PackageNotFoundError:
-                pass
-            try:  # fall back: module name given directly (e.g. "sklearn")
-                importlib.import_module(dist.replace("-", "_"))
-            except ImportError:
-                missing.append(req)
-        if missing:
+                # module name given directly (e.g. "sklearn" for
+                # scikit-learn): bare names pass if importable
+                if not constraint:
+                    try:
+                        importlib.import_module(dist.replace("-", "_"))
+                        continue
+                    except ImportError:
+                        return False
+                return False
+            if not constraint:
+                continue
+            try:
+                from packaging.requirements import Requirement
+
+                if have not in Requirement(req).specifier:
+                    return False
+            except Exception:
+                # can't evaluate the constraint (no packaging lib or
+                # unparseable): only an exact == pin is checkable by string
+                if constraint.startswith("==") and \
+                        have != constraint[2:].strip():
+                    return False
+                if not constraint.startswith("=="):
+                    return False  # range constraint: conservatively install
+        return True
+
+    def _venv_site(self, venv_dir: str) -> str:
+        import glob
+
+        hits = glob.glob(os.path.join(venv_dir, "lib", "python*",
+                                      "site-packages"))
+        if not hits:
             raise RuntimeEnvSetupError(
-                f"packages {missing} are not pre-installed and this "
-                "deployment forbids network installs")
+                f"venv {venv_dir} has no site-packages")
+        return hits[0]
+
+    def _create_venv(self, venv_dir: str, packages, options) -> None:
+        import subprocess
+
+        tmp = venv_dir + f".tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp], capture_output=True, text=True, timeout=300)
+            if r.returncode != 0:
+                raise RuntimeEnvSetupError(
+                    f"venv creation failed:\n{r.stdout}\n{r.stderr}")
+            vpy = os.path.join(tmp, "bin", "python")
+            r = subprocess.run(
+                [vpy, "-m", "pip", "install", "--no-input",
+                 "--disable-pip-version-check", *options, *packages],
+                capture_output=True, text=True, timeout=600)
+            if r.returncode != 0:
+                raise RuntimeEnvSetupError(
+                    f"pip install failed for {packages}:\n{r.stdout}\n"
+                    f"{r.stderr}\n(note: this deployment has no network "
+                    "egress — use local wheels/dirs with --no-index in "
+                    "pip_install_options)")
+            os.rename(tmp, venv_dir)
+        except RuntimeEnvSetupError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        except Exception as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeEnvSetupError(f"venv creation failed: {e}") from e
+
+    def setup(self, value, context) -> None:
+        packages, options = self._normalize(value)
+        if not packages:
+            return
+        if self._already_satisfied(packages):
+            return  # no-op fast path: env already matches system packages
+        digest = hashlib.sha256(
+            "\x00".join(packages + ["--"] + options).encode()
+        ).hexdigest()[:16]
+        envs_root = os.path.join(context.cache_root, "pip_envs")
+        os.makedirs(envs_root, exist_ok=True)
+        venv_dir = os.path.join(envs_root, digest)
+        if not os.path.isdir(venv_dir):
+            # serialize concurrent workers materializing the same env
+            import fcntl
+
+            lock_path = os.path.join(envs_root, f".{digest}.lock")
+            with open(lock_path, "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                try:
+                    if not os.path.isdir(venv_dir):
+                        self._create_venv(venv_dir, packages, options)
+                finally:
+                    fcntl.flock(lock, fcntl.LOCK_UN)
+        site = self._venv_site(venv_dir)
+        if site not in sys.path:
+            # below working_dir/py_modules paths (user code shadows env
+            # packages — reference precedence), above system site-packages
+            sys.path.insert(len(context.user_paths), site)
+        os.environ["VIRTUAL_ENV"] = venv_dir
+        os.environ["PATH"] = (os.path.join(venv_dir, "bin") + os.pathsep +
+                              os.environ.get("PATH", ""))
+        importlib.invalidate_caches()
 
 
 class CondaGatePlugin(RuntimeEnvPlugin):
@@ -181,5 +296,5 @@ class CondaGatePlugin(RuntimeEnvPlugin):
 
 
 for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-           PipCheckPlugin(), CondaGatePlugin()):
+           PipPlugin(), CondaGatePlugin()):
     register_plugin(_p)

@@ -84,12 +84,16 @@ class TestTaskRuntimeEnv:
         assert ray_tpu.get(ok.remote(), timeout=60) == "has-numpy"
 
     def test_pip_missing_package_fails(self, ray_start_regular):
-        @ray_tpu.remote(runtime_env={"pip": ["surely_not_installed_pkg_xyz"]})
+        # not preinstalled -> a real install is attempted, which fails in
+        # this zero-egress image with a clear message
+        @ray_tpu.remote(runtime_env={
+            "pip": {"packages": ["surely_not_installed_pkg_xyz"],
+                    "pip_install_options": ["--no-index"]}})
         def nope():
             return 1
 
-        with pytest.raises(RuntimeEnvSetupError, match="not pre-installed"):
-            ray_tpu.get(nope.remote(), timeout=60)
+        with pytest.raises(RuntimeEnvSetupError, match="pip install failed"):
+            ray_tpu.get(nope.remote(), timeout=300)
 
 
 class TestActorRuntimeEnv:
@@ -121,3 +125,95 @@ class TestInProcessSetup:
         setup_runtime_env({"env_vars": {"A": "1"}}, str(tmp_path))
         with pytest.raises(RuntimeEnvSetupError):
             setup_runtime_env({"env_vars": {"A": "2"}}, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# pip/venv plugin (reference: _private/runtime_env/pip.py:425; VERDICT r1
+# item 6: two actors in one cluster import different versions of the same
+# package)
+# ---------------------------------------------------------------------------
+
+def _make_wheel(out_dir, name, version, body):
+    """Hand-crafted pure-python wheel: zero-egress-safe (no pypi, no
+    setuptools build)."""
+    import zipfile
+
+    os.makedirs(out_dir, exist_ok=True)
+    whl = os.path.join(out_dir, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", body)
+        z.writestr(f"{dist}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name}\n"
+                   f"Version: {version}\n")
+        z.writestr(f"{dist}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\n"
+                   "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        z.writestr(f"{dist}/RECORD", "")
+    return whl
+
+
+class TestPipVenvPlugin:
+    def test_two_actors_different_versions(self, ray_start_regular, tmp_path):
+        w1 = _make_wheel(str(tmp_path), "rtenv_demo_pkg", "1.0",
+                         'VERSION = "1.0"\n')
+        w2 = _make_wheel(str(tmp_path), "rtenv_demo_pkg", "2.0",
+                         'VERSION = "2.0"\n')
+
+        @ray_tpu.remote
+        class Prober:
+            def version(self):
+                import rtenv_demo_pkg
+
+                return rtenv_demo_pkg.VERSION
+
+        a1 = Prober.options(runtime_env={
+            "pip": {"packages": [w1],
+                    "pip_install_options": ["--no-index"]}}).remote()
+        a2 = Prober.options(runtime_env={
+            "pip": {"packages": [w2],
+                    "pip_install_options": ["--no-index"]}}).remote()
+        v1 = ray_tpu.get(a1.version.remote(), timeout=300)
+        v2 = ray_tpu.get(a2.version.remote(), timeout=300)
+        assert (v1, v2) == ("1.0", "2.0")
+        ray_tpu.kill(a1)
+        ray_tpu.kill(a2)
+
+    def test_venv_cached_across_tasks(self, ray_start_regular, tmp_path):
+        w = _make_wheel(str(tmp_path), "rtenv_cache_pkg", "3.1",
+                        'VERSION = "3.1"\n')
+        env = {"pip": {"packages": [w],
+                       "pip_install_options": ["--no-index"]}}
+
+        @ray_tpu.remote(runtime_env=env)
+        def probe():
+            import os as _os
+
+            import rtenv_cache_pkg
+
+            return rtenv_cache_pkg.VERSION, _os.environ.get("VIRTUAL_ENV")
+
+        (v1, venv1), (v2, venv2) = ray_tpu.get(
+            [probe.remote(), probe.remote()], timeout=300)
+        assert v1 == v2 == "3.1"
+        assert venv1 and venv1 == venv2  # same content-addressed env
+
+    def test_preinstalled_requirement_fast_path(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+        def use_numpy():
+            import numpy as np
+
+            return int(np.sum(np.arange(4)))
+
+        assert ray_tpu.get(use_numpy.remote(), timeout=120) == 6
+
+    def test_missing_offline_package_fails_clearly(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={
+            "pip": {"packages": ["definitely-not-a-real-pkg-xyz==9.9"],
+                    "pip_install_options": ["--no-index"]}})
+        def f():
+            return 1
+
+        with pytest.raises(Exception) as exc_info:
+            ray_tpu.get(f.remote(), timeout=300)
+        assert "pip install failed" in str(exc_info.value)
