@@ -144,6 +144,7 @@ class NodeAgent:
         loop = asyncio.get_running_loop()
         loop.create_task(self._resource_report_loop())
         loop.create_task(self._worker_reaper_loop())
+        loop.create_task(self._node_stats_loop())
         loop.create_task(self._head_watchdog_loop())
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             from ray_tpu._private.log_monitor import LogMonitor
@@ -200,6 +201,7 @@ class NodeAgent:
         r("GetStoreStats", self._get_store_stats)
         r("GetNodeInfo", self._get_node_info)
         r("ListWorkers", self._list_workers)
+        r("GetNodeStats", self._get_node_stats)
         r("ListStoreObjects", self._list_store_objects)
         r("SetResource", self._set_resource)
         r("RestoreSpilled", self._restore_spilled)
@@ -988,6 +990,114 @@ class NodeAgent:
             "cluster_view": self.cluster_view,
         }
 
+    # ----------------------------------------------------- node reporter
+    def _sample_node_stats(self) -> Dict:
+        """One psutil sample + TPU duty (reference:
+        dashboard/modules/reporter/reporter_agent.py:277 — per-node
+        cpu/mem/disk/net stats; TPU utilization is the SURVEY §5 ask)."""
+        import psutil
+
+        vm = psutil.virtual_memory()
+        try:
+            disk = psutil.disk_usage(self.session_dir)
+            disk_stats = {"total": disk.total, "used": disk.used,
+                          "percent": disk.percent}
+        except Exception:
+            disk_stats = {}
+        try:
+            la1, la5, la15 = os.getloadavg()
+        except OSError:
+            la1 = la5 = la15 = 0.0
+        return {
+            "node_id": self.node_id,
+            "time": time.time(),
+            "cpu_percent": psutil.cpu_percent(interval=None),
+            "cpu_count": psutil.cpu_count(),
+            "load_avg": [la1, la5, la15],
+            "mem_total_bytes": vm.total,
+            "mem_used_bytes": vm.total - vm.available,
+            "mem_percent": vm.percent,
+            "disk": disk_stats,
+            "num_workers": len(self.workers),
+            "num_idle_workers": len(self.idle_workers),
+            "object_store": self.store.stats(),
+            "tpu": self._tpu_stats(),
+        }
+
+    def _tpu_stats(self) -> Dict:
+        """TPU duty: a fake-topology override for tests, else allocation
+        fraction from the resource ledger (chips leased / chips total —
+        scheduling-level utilization; device-trace-level duty comes from
+        the per-worker jax.profiler capture endpoint)."""
+        fake = os.environ.get("RAY_TPU_FAKE_TPU_DUTY")
+        total = self.resources.total.get("TPU")
+        if not total and fake is None:
+            return {}
+        avail = self.resources.available.get("TPU") or 0.0
+        out = {"chips_total": total or 0.0,
+               "chips_in_use": (total or 0.0) - avail,
+               "utilization": ((total - avail) / total) if total else 0.0}
+        if fake is not None:
+            out["duty_cycle_percent"] = float(fake)
+        return out
+
+    async def _node_stats_loop(self) -> None:
+        import json as _json
+
+        period = max(CONFIG.metrics_report_interval_ms, 1000) / 1000
+        self.node_stats: Dict = {}
+        while True:
+            try:
+                self.node_stats = await asyncio.to_thread(
+                    self._sample_node_stats)
+                # publish as Prometheus-schema gauges through the same KV
+                # pipeline user metrics ride (util/metrics.py flush_now)
+                from ray_tpu.util.metrics import make_gauge_snapshot
+
+                st = self.node_stats
+                tags = {"node_id": self.node_id}
+
+                def gauge(name, desc, value):
+                    return make_gauge_snapshot(name, desc, value, tags)
+
+                snaps = [
+                    gauge("ray_tpu_node_cpu_percent",
+                          "Node CPU utilization percent.",
+                          st["cpu_percent"]),
+                    gauge("ray_tpu_node_mem_used_bytes",
+                          "Node memory in use.", st["mem_used_bytes"]),
+                    gauge("ray_tpu_node_mem_total_bytes",
+                          "Node memory total.", st["mem_total_bytes"]),
+                    gauge("ray_tpu_node_workers",
+                          "Worker processes on the node.",
+                          st["num_workers"]),
+                    gauge("ray_tpu_object_store_used_bytes",
+                          "Object store bytes in use.",
+                          st["object_store"].get("used", 0)),
+                ]
+                tpu = st.get("tpu") or {}
+                if tpu:
+                    snaps.append(gauge(
+                        "ray_tpu_tpu_utilization",
+                        "Fraction of the node's TPU chips leased.",
+                        tpu.get("utilization", 0.0)))
+                    if "duty_cycle_percent" in tpu:
+                        snaps.append(gauge(
+                            "ray_tpu_tpu_duty_cycle_percent",
+                            "TPU duty cycle percent.",
+                            tpu["duty_cycle_percent"]))
+                await self.head.call("KvPut", {
+                    "key": f"metrics::{self.node_id}::agent".encode(),
+                    "value": _json.dumps(snaps).encode(),
+                    "ns": "_metrics", "overwrite": True})
+            except Exception:
+                pass
+            await asyncio.sleep(period)
+
+    async def _get_node_stats(self, conn: Connection, p) -> Dict:
+        return getattr(self, "node_stats", {}) or \
+            await asyncio.to_thread(self._sample_node_stats)
+
     async def _list_workers(self, conn: Connection, p) -> List[Dict]:
         """Live worker-table query (reference: the state API pairs GCS data
         with NodeManager::QueryAllWorkerStates, node_manager.h:217)."""
@@ -1002,6 +1112,7 @@ class NodeAgent:
                 "actor_id": w.actor_id,
                 "env_key": w.env_key,
                 "alive": w.alive,
+                "direct_addr": w.direct_addr,
             })
         return out
 

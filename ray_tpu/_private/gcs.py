@@ -353,6 +353,13 @@ class HeadServer:
         if not node.alive:
             return
         node.alive = False
+        # drop the node's published system metrics: a dead node's last
+        # cpu/mem/TPU gauges must not keep exporting as current
+        metrics_ns = self.kv.get("_metrics")
+        if metrics_ns:
+            prefix = f"metrics::{node.node_id}".encode()
+            for key in [k for k in metrics_ns if bytes(k).startswith(prefix)]:
+                metrics_ns.pop(key, None)
         await self._publish_event(
             "node", {"event": "removed", "node_id": node.node_id, "reason": reason}
         )

@@ -103,7 +103,8 @@ class Executor:
             # actor instances carry thread-affine state (sqlite handles,
             # threading.local set in __init__): drain on the same pool the
             # constructor ran on
-            pool = self._actor_pool if self._actor_pool is not None                 else self._task_pool
+            pool = (self._actor_pool if self._actor_pool is not None
+                    else self._task_pool)
             pool.submit(self._drain_exec)
         return fut
 
@@ -444,6 +445,77 @@ def _apply_accelerator_env(assigned: Dict[str, List[int]]) -> None:
         )
 
 
+# ----------------------------------------------------------- profiling
+def _sample_stacks_sync(duration_s: float, interval_s: float) -> Dict:
+    """py-spy-style in-process stack sampler (reference:
+    dashboard/modules/reporter/profile_manager.py:61-97 launches py-spy;
+    this image has none, so the worker samples sys._current_frames itself).
+    Returns {folded_stack: count} — flamegraph.pl / speedscope input."""
+    import collections
+
+    counts: "collections.Counter" = collections.Counter()
+    deadline = time.monotonic() + duration_s
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            # walk f_back directly: traceback.extract_stack would stat()
+            # and read source files via linecache on every sample, skewing
+            # the profile being measured
+            parts = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(f"{code.co_name} "
+                             f"({os.path.basename(code.co_filename)}:"
+                             f"{f.f_lineno})")
+                f = f.f_back
+            if parts:
+                counts[";".join(reversed(parts))] += 1
+        time.sleep(interval_s)
+    return dict(counts)
+
+
+async def _handle_sample_stacks(conn, p) -> Dict:
+    duration = min(float((p or {}).get("duration_s", 2.0)), 60.0)
+    interval = max(float((p or {}).get("interval_s", 0.01)), 0.001)
+    folded = await asyncio.get_running_loop().run_in_executor(
+        None, _sample_stacks_sync, duration, interval)
+    return {"pid": os.getpid(), "duration_s": duration, "folded": folded}
+
+
+async def _handle_capture_jax_trace(conn, p) -> Dict:
+    """Capture an XLA device trace with jax.profiler (SURVEY §5: hook
+    jax.profiler into the reporter surface; loadable in TensorBoard/
+    Perfetto). Blocks for duration_s while the worker keeps executing."""
+    p = p or {}
+    duration = min(float(p.get("duration_s", 2.0)), 120.0)
+    out_dir = p.get("out_dir") or os.path.join(
+        os.environ.get("RAY_TPU_SESSION_DIR", "/tmp"), "jax_traces",
+        f"worker-{os.getpid()}-{int(time.time())}")
+    os.makedirs(out_dir, exist_ok=True)
+
+    def capture():
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+        time.sleep(duration)
+        jax.profiler.stop_trace()
+        files = []
+        for root, _dirs, names in os.walk(out_dir):
+            files += [os.path.relpath(os.path.join(root, n), out_dir)
+                      for n in names]
+        return files
+
+    try:
+        files = await asyncio.get_running_loop().run_in_executor(
+            None, capture)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}", "trace_dir": out_dir}
+    return {"pid": os.getpid(), "trace_dir": out_dir, "files": files}
+
+
 def main() -> None:
     agent_sock = os.environ["RAY_TPU_AGENT_SOCK"]
     from ray_tpu._private.ids import WorkerID
@@ -454,6 +526,9 @@ def main() -> None:
 
     # Executor routes must exist before registration makes us leasable.
     worker.direct_server.add_handler("PushTask", executor.handle_push_task)
+    worker.direct_server.add_handler("SampleStacks", _handle_sample_stacks)
+    worker.direct_server.add_handler("CaptureJaxTrace",
+                                     _handle_capture_jax_trace)
 
     async def on_agent_push(method: str, payload):
         if method == "BecomeActor":

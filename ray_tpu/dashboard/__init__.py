@@ -24,6 +24,10 @@ import ray_tpu
 DASHBOARD_NAME = "RAY_TPU_DASHBOARD"
 
 
+class _BadRequest(Exception):
+    """Client-input error on an API route -> HTTP 400."""
+
+
 class DashboardActor:
     def __init__(self, port: int = 8265, host: str = "127.0.0.1"):
         self.port = port
@@ -77,7 +81,9 @@ class DashboardActor:
 
     async def _dispatch(self, method: str, target: str,
                         body: bytes = b"") -> Tuple[str, bytes, str]:
-        path = urllib.parse.urlsplit(target).path
+        split = urllib.parse.urlsplit(target)
+        path = split.path
+        query = dict(urllib.parse.parse_qsl(split.query))
         try:
             if path == "/healthz":
                 return "200 OK", b"success", "text/plain"
@@ -93,7 +99,12 @@ class DashboardActor:
                 await asyncio.to_thread(self._serve_deploy, config)
                 return "200 OK", b"{}", "application/json"
             if path.startswith("/api/"):
-                data = await asyncio.to_thread(self._api, path)
+                try:
+                    data = await asyncio.to_thread(self._api, path, query)
+                except _BadRequest as e:
+                    return ("400 Bad Request",
+                            json.dumps({"error": str(e)}).encode(),
+                            "application/json")
                 if data is None:
                     return ("404 Not Found", b'{"error": "not found"}',
                             "application/json")
@@ -124,15 +135,33 @@ class DashboardActor:
                   "ingress": ingress}
             for prefix, (app, ingress) in routes.items()}}
 
-    def _api(self, path: str):
+    def _api(self, path: str, query=None):
         from ray_tpu.util import state as state_api
 
+        query = query or {}
         parts = [p for p in path.split("/") if p][1:]  # drop "api"
         if parts[0] == "serve" and len(parts) > 1 \
                 and parts[1] == "applications":
             return self._serve_status()
         if parts[0] == "nodes":
             return state_api.list_nodes()
+        if parts[0] == "node_stats":
+            return state_api.get_node_stats()
+        if parts[0] == "workers":
+            return state_api.list_workers()
+        if parts[0] == "objects":
+            return state_api.list_objects()
+        if parts[0] in ("profile", "jax_trace"):
+            try:
+                worker_id = query["worker_id"]
+                duration = float(query.get("duration_s", 2.0))
+            except (KeyError, ValueError) as e:
+                raise _BadRequest(
+                    "profile endpoints need ?worker_id=<id>"
+                    "[&duration_s=<seconds>]") from e
+            fn = (state_api.profile_worker if parts[0] == "profile"
+                  else state_api.capture_jax_trace)
+            return fn(worker_id, duration)
         if parts[0] == "actors":
             return state_api.list_actors()
         if parts[0] == "tasks":

@@ -111,6 +111,16 @@ class Histogram(Metric):
             }
 
 
+def make_gauge_snapshot(name: str, description: str, value: float,
+                        tags: Optional[Dict[str, str]] = None) -> Dict:
+    """One-off gauge in the exact snapshot schema prometheus_text()
+    merges — for publishers (the node agent) that don't keep Metric
+    registries."""
+    tag_list = [[k, v] for k, v in (tags or {}).items()]
+    return {"name": name, "kind": "gauge", "description": description,
+            "values": [[tag_list, value]]}
+
+
 # ------------------------------------------------------------- aggregation
 def _ensure_flusher() -> None:
     global _flusher_started

@@ -16,6 +16,7 @@ __all__ = [
     "list_actors", "list_nodes", "list_tasks", "list_placement_groups",
     "list_jobs", "list_workers", "list_objects",
     "summarize_tasks", "summarize_actors", "summarize_objects",
+    "get_node_stats", "profile_worker", "capture_jax_trace",
 ]
 
 
@@ -124,6 +125,65 @@ def list_objects(filters=None, limit: int = 1000) -> List[Dict]:
         if len(rows) >= limit:
             break
     return _apply_filters(rows, filters)[:limit]
+
+
+def get_node_stats() -> List[Dict]:
+    """Per-node reporter samples: cpu/mem/disk/workers/object-store/TPU
+    (reference: dashboard reporter_agent.py:277 stats surface)."""
+    rows = []
+    for node in _each_alive_agent():
+        try:
+            stats = _call_agent(node["addr"], "GetNodeStats")
+        except Exception:
+            continue
+        if stats:
+            rows.append(stats)
+    return rows
+
+
+def _worker_direct_addr(worker_id: str) -> Dict:
+    for w in list_workers(limit=100000):
+        if w["worker_id"] == worker_id and w.get("direct_addr") \
+                and w.get("alive"):
+            return w["direct_addr"]
+    raise ValueError(f"no live worker {worker_id!r} with a direct address")
+
+
+def profile_worker(worker_id: str, duration_s: float = 2.0) -> Dict:
+    """Sample a worker's Python stacks (py-spy analog; reference:
+    dashboard/modules/reporter/profile_manager.py:61-97). Returns
+    {"pid", "duration_s", "folded": {stack: count}} — folded-stacks text
+    for flamegraph.pl / speedscope."""
+    addr = _worker_direct_addr(worker_id)
+    w = _worker()
+
+    async def go():
+        client = await w._owner_client(addr)
+        return await client.call("SampleStacks",
+                                 {"duration_s": duration_s},
+                                 timeout=duration_s + 30)
+
+    return w._acall(go(), timeout=duration_s + 35)
+
+
+def capture_jax_trace(worker_id: str, duration_s: float = 2.0,
+                      out_dir: Optional[str] = None) -> Dict:
+    """Capture a jax.profiler device trace inside a worker (SURVEY §5 —
+    device-trace profiling surfaced through the same reporter API).
+    Returns {"trace_dir", "files"} loadable in TensorBoard/Perfetto."""
+    addr = _worker_direct_addr(worker_id)
+    w = _worker()
+
+    async def go():
+        client = await w._owner_client(addr)
+        # generous window: jax.profiler start/stop on a remote-tunnel TPU
+        # can take tens of seconds beyond the capture itself
+        return await client.call(
+            "CaptureJaxTrace",
+            {"duration_s": duration_s, "out_dir": out_dir},
+            timeout=duration_s + 180)
+
+    return w._acall(go(), timeout=duration_s + 185)
 
 
 def summarize_objects() -> Dict[str, Any]:
