@@ -124,6 +124,12 @@ class NodeAgent:
         self._lease_counter = 0
         self._pending_leases: List[Dict] = []  # queued lease requests
 
+        # transient spill ledger: demands redirected to a remote node in
+        # the last ~2s, counted against its advertised availability so a
+        # burst of simultaneous lease requests doesn't all pick the same
+        # least-utilized node off the same stale gossip view
+        self._recent_spills: Dict[str, List[Tuple[float, ResourceSet]]] = {}
+
         # object plane
         self._object_waits: Dict[str, List[asyncio.Future]] = {}
         self._pulls_inflight: Dict[str, asyncio.Task] = {}
@@ -494,6 +500,7 @@ class NodeAgent:
             if node_id == self.node_id or not view.get("alive", True):
                 continue
             nr = NodeResources.from_wire(view["resources"])
+            self._apply_recent_spills(node_id, nr)
             if not request.feasible_on(nr.total):
                 continue
             if not request.fits(nr.available):
@@ -504,11 +511,35 @@ class NodeAgent:
         if best is None:
             return None
         if not local_feasible or not local_fits:
+            self._record_spill(best[0], request)
             return {"node_id": best[0], "addr": best[1]}
         if spread or local_util >= CONFIG.scheduler_spread_threshold:
             if best_util < local_util:
+                self._record_spill(best[0], request)
                 return {"node_id": best[0], "addr": best[1]}
         return None
+
+    SPILL_LEDGER_TTL_S = 2.0
+
+    def _apply_recent_spills(self, node_id: str, nr: NodeResources) -> None:
+        ledger = self._recent_spills.get(node_id)
+        if not ledger:
+            return
+        now = time.monotonic()
+        live = [(t, rs) for t, rs in ledger if t > now]
+        if live:
+            self._recent_spills[node_id] = live
+        else:
+            self._recent_spills.pop(node_id, None)
+        for _t, rs in live:
+            nr.available.subtract(rs, allow_negative=True)
+
+    def _record_spill(self, node_id: str, request: ResourceSet) -> None:
+        if os.environ.get("RAY_TPU_DEBUG"):
+            print(f"SPILL {self.node_id[:8]} -> {node_id[:8]} "
+                  f"{request.to_dict()}", file=sys.stderr, flush=True)
+        self._recent_spills.setdefault(node_id, []).append(
+            (time.monotonic() + self.SPILL_LEDGER_TTL_S, request))
 
     async def _drain_pending_leases(self) -> None:
         made_progress = True

@@ -505,7 +505,30 @@ class HeadServer:
             candidates.append(node)
         if not candidates:
             return False
-        fits = [n for n in candidates if request.fits(n.resources.available)]
+        # count resources already committed to in-flight actor placements
+        # against each candidate: a burst of actor creations scheduled off
+        # the same gossip snapshot must not all pick the same node
+        # (reference: GcsActorScheduler tracks leased resources per node)
+        committed: Dict[str, ResourceSet] = {}
+        for other in self.actors.values():
+            if other is info or other.node_id is None:
+                continue
+            if other.state not in (ACTOR_PENDING, ACTOR_RESTARTING):
+                continue
+            req = ResourceSet.from_wire(
+                other.spec_wire.get("resources", {}))
+            agg = committed.setdefault(other.node_id, ResourceSet({}))
+            agg.add(req)
+
+        def effective_available(n):
+            avail = ResourceSet.from_wire(n.resources.available.to_wire())
+            pending = committed.get(n.node_id)
+            if pending is not None:
+                avail.subtract(pending, allow_negative=True)
+            return avail
+
+        fits = [n for n in candidates
+                if request.fits(effective_available(n))]
         pool = fits or candidates
         if strategy and strategy.get("type") == "node_label":
             soft = strategy.get("soft") or {}

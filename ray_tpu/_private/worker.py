@@ -1449,7 +1449,10 @@ class _LeasePool:
         stay shallow so queued work can spread onto fresh leases."""
         e = self._exec_ms_ema
         if e is None:
-            return self.PIPELINE_DEPTH
+            # duration unknown: committing a second task to a busy worker
+            # can strand it behind an arbitrarily long first task — observe
+            # one completion before pipelining
+            return 1
         if e < 2.0:
             return max(self.PIPELINE_DEPTH, 16)
         if e < 10.0:

@@ -39,6 +39,18 @@ class StandardAutoscaler:
         self.max_workers = config.get("max_workers", 8)
         self.node_types: Dict[str, Dict] = config.get(
             "available_node_types", {})
+        # providers that derive capacity (TPU slice aggregates) expose it
+        # through a hook, so the autoscaler does not depend on sharing the
+        # same mutable config dict object with the provider
+        hook = getattr(provider, "node_type_resources", None)
+        if hook is not None:
+            for name, spec in self.node_types.items():
+                derived = hook(name)
+                if derived:
+                    spec.setdefault("resources", dict(derived.get(
+                        "resources", {})))
+                    spec.setdefault("per_host_resources", dict(derived.get(
+                        "per_host_resources", {})))
         self._idle_since: Dict[str, float] = {}
         self._launch_deadline: Dict[str, float] = {}
         self.num_launches = 0
@@ -75,8 +87,7 @@ class StandardAutoscaler:
         available: List[Dict[str, int]] = []
         runtime_to_provider: Dict[str, str] = {}
         for pid in self.provider.non_terminated_nodes():
-            rid = self.provider.runtime_node_id(pid)
-            if rid:
+            for rid in self.provider.runtime_node_ids(pid):
                 runtime_to_provider[rid] = pid
         totals: List[Dict[str, int]] = []
         for nid, n in view.items():
@@ -92,8 +103,10 @@ class StandardAutoscaler:
         registered = set(view)
         now = time.monotonic()
         for pid in self.provider.non_terminated_nodes():
-            rid = self.provider.runtime_node_id(pid)
-            if rid in registered:
+            rids = [r for r in self.provider.runtime_node_ids(pid)
+                    if r in registered]
+            expected = max(1, self.provider.expected_runtime_nodes(pid))
+            if len(rids) >= expected:
                 self._launch_deadline.pop(pid, None)
                 continue
             deadline = self._launch_deadline.setdefault(
@@ -103,7 +116,10 @@ class StandardAutoscaler:
             ntype = self.provider.node_tags(pid).get("node_type")
             res = self.node_types.get(ntype, {}).get("resources")
             if res:
-                wire = ResourceSet(dict(res)).to_wire()
+                # aggregate spec capacity, minus what already registered
+                frac = 1.0 - len(rids) / expected
+                wire = ResourceSet(
+                    {k: v * frac for k, v in dict(res).items()}).to_wire()
                 available.append(wire)
                 totals.append(wire)
 
@@ -139,17 +155,27 @@ class StandardAutoscaler:
         terminated = []
         pins = self._explicit_requests()
 
-        def _needed_for_pins(candidate_nid: str) -> bool:
-            """Would removing this node break a request_resources pin?"""
+        def _needed_for_pins(removed_nids) -> bool:
+            """Would removing this whole set of nodes (all hosts of a
+            slice at once) break a request_resources pin?"""
             if not pins:
                 return False
             from ray_tpu.autoscaler.resource_demand_scheduler import _fit_on
 
+            removed = set(removed_nids)
             pools = [ResourceSet.from_wire(n2["resources"]["total"])
-                     for nid2, n2 in view.items() if nid2 != candidate_nid]
+                     for nid2, n2 in view.items() if nid2 not in removed]
             return any(not _fit_on(ResourceSet.from_wire(w), pools)
                        for w in pins)
 
+        # group runtime nodes by provider node: a multi-host slice is one
+        # atomic unit — it terminates only when EVERY host is idle past the
+        # timeout (one busy host pins the whole slice)
+        members: Dict[str, List[str]] = {}
+        for nid in view:
+            pid = runtime_to_provider.get(nid)
+            if pid is not None:
+                members.setdefault(pid, []).append(nid)
         for nid, n in view.items():
             pid = runtime_to_provider.get(nid)
             if pid is None:
@@ -158,20 +184,35 @@ class StandardAutoscaler:
             busy = res["available"] != res["total"] or n.get("pending")
             if busy:
                 self._idle_since.pop(nid, None)
+            else:
+                self._idle_since.setdefault(nid, now)
+        for pid, nids in members.items():
+            all_idle = all(
+                nid in self._idle_since
+                and now - self._idle_since[nid] > self.idle_timeout_s
+                for nid in nids)
+            fully_up = len(nids) >= max(
+                1, self.provider.expected_runtime_nodes(pid))
+            # degraded multi-host slice (a host died and will not come
+            # back): reapable once its re-boot deadline expired, else the
+            # survivors would leak forever
+            degraded = now > self._launch_deadline.get(pid, float("inf"))
+            if not (all_idle and (fully_up or degraded)):
                 continue
-            first = self._idle_since.setdefault(nid, now)
             ntype = self.provider.node_tags(pid).get("node_type")
             min_workers = self.node_types.get(ntype, {}).get("min_workers", 0)
-            if (now - first > self.idle_timeout_s
-                    and counts.get(ntype, 0) > min_workers and not to_launch
-                    and not _needed_for_pins(nid)):
-                logger.info("autoscaler: terminating idle node %s", pid)
-                self.gcs_call("DrainNode", {"node_id": nid})
+            if (counts.get(ntype, 0) > min_workers and not to_launch
+                    and not _needed_for_pins(nids)):
+                logger.info("autoscaler: terminating idle node %s "
+                            "(%d runtime nodes)", pid, len(nids))
+                for nid in nids:
+                    self.gcs_call("DrainNode", {"node_id": nid})
                 self.provider.terminate_node(pid)
                 counts[ntype] = counts.get(ntype, 0) - 1
                 self.num_terminations += 1
                 terminated.append(pid)
-                self._idle_since.pop(nid, None)
+                for nid in nids:
+                    self._idle_since.pop(nid, None)
 
         return {"launched": to_launch, "terminated": terminated,
                 "num_nodes": sum(self._type_counts().values())}

@@ -41,6 +41,18 @@ class NodeProvider:
         (None until the node's agent has come up)."""
         return None
 
+    def runtime_node_ids(self, node_id: str) -> List[str]:
+        """All runtime node ids behind one provider node. Multi-host
+        providers (TPU pod slices) override this; the autoscaler then
+        treats the provider node as one atomic scaling unit."""
+        rid = self.runtime_node_id(node_id)
+        return [rid] if rid else []
+
+    def expected_runtime_nodes(self, node_id: str) -> int:
+        """How many runtime nodes this provider node contributes once
+        fully booted (hosts per slice for pod slices)."""
+        return 1
+
 
 class LocalNodeProvider(NodeProvider):
     """Launches worker nodes as local agent processes joining an existing

@@ -90,6 +90,14 @@ def get_nodes_to_launch(
             cap = ResourceSet(dict(spec.get("resources", {})))
             if not demand.feasible_on(cap):
                 continue
+            # multi-host types (TPU slices): "resources" is the slice
+            # aggregate, but one demand must fit on ONE host — launching a
+            # slice no host of which can run the request would churn
+            # useless slices forever
+            per_host = spec.get("per_host_resources")                 or spec.get("_per_host_resources")
+            if per_host is not None and not demand.feasible_on(
+                    ResourceSet(dict(per_host))):
+                continue
             if counts.get(name, 0) >= spec.get("max_workers", max_workers):
                 continue
             chosen = (name, cap)

@@ -104,12 +104,14 @@ class AutoscalingCluster:
                  worker_node_types: Optional[Dict[str, Dict]] = None,
                  idle_timeout_minutes: float = 0.05,
                  max_workers: int = 8,
-                 update_interval_s: float = 0.5):
+                 update_interval_s: float = 0.5,
+                 provider_cls=None):
         self._head_resources = head_resources or {"CPU": 2}
         self._worker_node_types = worker_node_types or {}
         self._idle_timeout_minutes = idle_timeout_minutes
         self._max_workers = max_workers
         self._update_interval_s = update_interval_s
+        self._provider_cls = provider_cls
         self.cluster: Optional[Cluster] = None
         self.monitor = None
         self.provider = None
@@ -126,7 +128,8 @@ class AutoscalingCluster:
             initialize_head=True,
             head_node_args={"resources": self._head_resources})
         head = self.cluster.head_node
-        self.provider = LocalNodeProvider(
+        provider_cls = self._provider_cls or LocalNodeProvider
+        self.provider = provider_cls(
             {"head_host": "127.0.0.1", "head_port": head.head_port,
              "session_dir": head.session_dir,
              "node_types": self._worker_node_types},
