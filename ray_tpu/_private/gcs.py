@@ -510,10 +510,17 @@ class HeadServer:
         # the same gossip snapshot must not all pick the same node
         # (reference: GcsActorScheduler tracks leased resources per node)
         committed: Dict[str, ResourceSet] = {}
+        now = time.monotonic()
         for other in self.actors.values():
             if other is info or other.node_id is None:
                 continue
             if other.state not in (ACTOR_PENDING, ACTOR_RESTARTING):
+                continue
+            # only count RECENT placements: once the target agent's next
+            # resource report lands (~one gossip period), its advertised
+            # availability already reflects the allocation and counting it
+            # again would double-book the node for the whole worker boot
+            if now - getattr(other, "placed_at", 0.0) > 1.5:
                 continue
             req = ResourceSet.from_wire(
                 other.spec_wire.get("resources", {}))
@@ -521,7 +528,7 @@ class HeadServer:
             agg.add(req)
 
         def effective_available(n):
-            avail = ResourceSet.from_wire(n.resources.available.to_wire())
+            avail = n.resources.available.copy()
             pending = committed.get(n.node_id)
             if pending is not None:
                 avail.subtract(pending, allow_negative=True)
@@ -539,6 +546,7 @@ class HeadServer:
             pool.sort(key=lambda n: n.resources.utilization())
         node = pool[0]
         info.node_id = node.node_id
+        info.placed_at = time.monotonic()
         try:
             await node.conn.push("StartActor", {"spec": info.spec_wire,
                                                 "actor_id": info.actor_id})

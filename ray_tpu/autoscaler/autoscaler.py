@@ -86,8 +86,10 @@ class StandardAutoscaler:
         demands: List[Dict[str, int]] = []
         available: List[Dict[str, int]] = []
         runtime_to_provider: Dict[str, str] = {}
+        runtime_ids: Dict[str, List[str]] = {}
         for pid in self.provider.non_terminated_nodes():
-            for rid in self.provider.runtime_node_ids(pid):
+            runtime_ids[pid] = list(self.provider.runtime_node_ids(pid))
+            for rid in runtime_ids[pid]:
                 runtime_to_provider[rid] = pid
         totals: List[Dict[str, int]] = []
         for nid, n in view.items():
@@ -102,9 +104,8 @@ class StandardAutoscaler:
 
         registered = set(view)
         now = time.monotonic()
-        for pid in self.provider.non_terminated_nodes():
-            rids = [r for r in self.provider.runtime_node_ids(pid)
-                    if r in registered]
+        for pid in list(runtime_ids):
+            rids = [r for r in runtime_ids[pid] if r in registered]
             expected = max(1, self.provider.expected_runtime_nodes(pid))
             if len(rids) >= expected:
                 self._launch_deadline.pop(pid, None)
@@ -112,6 +113,15 @@ class StandardAutoscaler:
             deadline = self._launch_deadline.setdefault(
                 pid, now + self.BOOT_TIMEOUT_S)
             if now > deadline:
+                if not rids:
+                    # nothing ever registered: the launch failed outright.
+                    # Reclaim the provider node or it pins the node-type
+                    # count (and cloud spend) forever with zero capacity.
+                    logger.info(
+                        "autoscaler: terminating failed launch %s", pid)
+                    self.provider.terminate_node(pid)
+                    self._launch_deadline.pop(pid, None)
+                    self.num_terminations += 1
                 continue  # boot presumed failed: stop counting its capacity
             ntype = self.provider.node_tags(pid).get("node_type")
             res = self.node_types.get(ntype, {}).get("resources")

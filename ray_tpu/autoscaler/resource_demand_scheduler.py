@@ -65,8 +65,14 @@ def get_nodes_to_launch(
     # FFD ordering decided ONCE here so the native kernel and the Python
     # fallback see identical demand order and make identical decisions
     demands = sorted(demands, key=lambda w: -sum(w.values()))
-    native = _native_pack(node_types, demands, existing_available,
-                          existing_counts, max_workers, total_workers)
+    multi_host = any("per_host_resources" in spec
+                     or "_per_host_resources" in spec
+                     for spec in node_types.values())
+    # the native kernel packs against aggregate capacity only; slice types
+    # need the per-host feasibility guard below, so they take the Python path
+    native = None if multi_host else _native_pack(
+        node_types, demands, existing_available,
+        existing_counts, max_workers, total_workers)
     if native is not None:
         return native
     pools = [ResourceSet.from_wire(w) for w in existing_available]

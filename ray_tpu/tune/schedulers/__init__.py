@@ -6,13 +6,15 @@ from ray_tpu.tune.schedulers.trial_scheduler import (
     FIFOScheduler, TrialScheduler)
 from ray_tpu.tune.schedulers.async_hyperband import (
     ASHAScheduler, AsyncHyperBandScheduler)
-from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
+from ray_tpu.tune.schedulers.hyperband import (
+    HyperBandForBOHB, HyperBandScheduler)
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pb2 import PB2
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
 __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
-    "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "AsyncHyperBandScheduler", "HyperBandScheduler", "HyperBandForBOHB",
+    "MedianStoppingRule",
     "PopulationBasedTraining", "PB2",
 ]

@@ -165,3 +165,12 @@ class HyperBandScheduler(TrialScheduler):
     def debug_string(self) -> str:
         return (f"HyperBand: {len(self._brackets)} brackets, "
                 f"eta={self.eta}, max_t={self.max_t}")
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """BOHB's scheduler half (reference: schedulers/hb_bohb.py): the
+    synchronized HyperBand bracket machinery, paired with the model-based
+    ``TuneBOHB`` searcher that fills each bracket from a TPE fitted on the
+    highest-fidelity observations. The bracket mechanics here already
+    admit searcher-driven trials, so the subclass exists for API parity
+    and as the documented BOHB entry point."""

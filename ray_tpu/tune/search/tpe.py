@@ -1,0 +1,287 @@
+"""Pure-python Bayesian searchers: TPE + the BOHB searcher (reference:
+python/ray/tune/search/hyperopt (TPE via the hyperopt package) and
+tune/search/bohb/bohb_search.py:50 TuneBOHB — both optional-dependency
+adapters upstream; here the model is implemented natively so the searcher
+ABC is proven beyond grid/random with zero extra deps; VERDICT r1 item 9).
+
+TPE (Bergstra et al., NeurIPS 2011): observations are split into a good
+set (top gamma quantile) and a bad set; per-dimension Parzen estimators
+l(x) (good) and g(x) (bad) are built, candidates are drawn from l and the
+one maximizing l(x)/g(x) is suggested. BOHB (Falkner et al., ICML 2018)
+runs the same model on multi-fidelity observations, fitting at the highest
+fidelity that has enough points, and pairs with a HyperBand scheduler.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _flatten_space(space: Dict, prefix: Tuple = ()) -> Dict[Tuple, Domain]:
+    out: Dict[Tuple, Domain] = {}
+    for k, v in (space or {}).items():
+        path = prefix + (k,)
+        if isinstance(v, dict):
+            out.update(_flatten_space(v, path))
+        elif isinstance(v, Domain):
+            out[path] = v
+    return out
+
+
+def _get_path(d: Dict, path: Tuple):
+    for k in path:
+        d = d[k]
+    return d
+
+
+def _set_path(d: Dict, path: Tuple, value) -> None:
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+class _NumericParzen:
+    """1-D mixture-of-normals over observed values (log-space for log
+    domains), blended with the uniform prior over the domain."""
+
+    def __init__(self, domain, values: List[float]):
+        self.domain = domain
+        self.log = bool(getattr(domain, "log", False))
+        self.lo = math.log(domain.lower) if self.log else float(domain.lower)
+        self.hi = math.log(domain.upper) if self.log else float(domain.upper)
+        self.mus = sorted(self._warp(v) for v in values)
+        span = max(self.hi - self.lo, 1e-12)
+        if len(self.mus) >= 2:
+            # adjacent-spacing bandwidth (hyperopt's heuristic, clipped)
+            sigmas = []
+            for i, mu in enumerate(self.mus):
+                left = self.mus[i - 1] if i > 0 else self.lo
+                right = self.mus[i + 1] if i < len(self.mus) - 1 else self.hi
+                sigmas.append(min(max(max(mu - left, right - mu),
+                                      span * 0.03), span))
+            self.sigmas = sigmas
+        else:
+            self.sigmas = [span * 0.5] * len(self.mus)
+
+    def _warp(self, v: float) -> float:
+        return math.log(max(v, 1e-300)) if self.log else float(v)
+
+    def _unwarp(self, x: float):
+        v = math.exp(x) if self.log else x
+        v = min(max(v, self.domain.lower), getattr(
+            self.domain, "upper", v))
+        if isinstance(self.domain, Integer):
+            return int(min(max(int(round(v)), self.domain.lower),
+                           self.domain.upper - 1))
+        q = getattr(self.domain, "q", None)
+        if q:
+            v = round(round(v / q) * q, 10)
+        return float(v)
+
+    def draw(self, rng: random.Random):
+        if not self.mus or rng.random() < 0.2:  # prior exploration
+            x = rng.uniform(self.lo, self.hi)
+        else:
+            i = rng.randrange(len(self.mus))
+            x = rng.gauss(self.mus[i], self.sigmas[i])
+            x = min(max(x, self.lo), self.hi)
+        return self._unwarp(x)
+
+    def logpdf(self, value) -> float:
+        x = self._warp(value if not isinstance(value, bool) else float(value))
+        span = max(self.hi - self.lo, 1e-12)
+        parts = [math.log(0.2 / span)]  # uniform prior component
+        if self.mus:
+            w = math.log(0.8 / len(self.mus))
+            for mu, sig in zip(self.mus, self.sigmas):
+                z = (x - mu) / sig
+                parts.append(w - 0.5 * z * z
+                             - math.log(sig * math.sqrt(2 * math.pi)))
+        m = max(parts)
+        return m + math.log(sum(math.exp(p - m) for p in parts))
+
+
+class _CategoricalParzen:
+    def __init__(self, domain: Categorical, values: List[Any]):
+        self.domain = domain
+        counts = {i: 1.0 for i in range(len(domain.categories))}  # +1 smooth
+        for v in values:
+            try:
+                counts[domain.categories.index(v)] += 1.0
+            except ValueError:
+                pass
+        total = sum(counts.values())
+        self.probs = [counts[i] / total for i in range(len(domain.categories))]
+
+    def draw(self, rng: random.Random):
+        r = rng.random()
+        acc = 0.0
+        for cat, p in zip(self.domain.categories, self.probs):
+            acc += p
+            if r <= acc:
+                return cat
+        return self.domain.categories[-1]
+
+    def logpdf(self, value) -> float:
+        try:
+            return math.log(self.probs[self.domain.categories.index(value)])
+        except ValueError:
+            return -1e9
+
+
+def _make_parzen(domain: Domain, values: List[Any]):
+    if isinstance(domain, Categorical):
+        return _CategoricalParzen(domain, values)
+    return _NumericParzen(domain, values)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator over the param_space's Domain
+    leaves (non-Domain keys pass through untouched)."""
+
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 n_initial_points: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, epsilon: float = 0.1,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = space
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.epsilon = epsilon
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Dict] = {}
+        # observations: (flat_config_values, score)
+        self._obs: List[Tuple[Dict[Tuple, Any], float]] = []
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        if config and self.space is None:
+            self.space = config
+        return True
+
+    # ------------------------------------------------------------- model
+    def _observations(self) -> List[Tuple[Dict[Tuple, Any], float]]:
+        return self._obs
+
+    def _suggest_flat(self, dims: Dict[Tuple, Domain]) -> Dict[Tuple, Any]:
+        obs = self._observations()
+        if len(obs) < self.n_initial or self._rng.random() < self.epsilon:
+            # epsilon exploration: the l/g argmax alone can lock onto a
+            # self-reinforcing cluster (its candidates all come from l);
+            # periodic pure-random suggestions keep feeding the model
+            # evidence from unvisited regions
+            return {p: d.sample(self._rng) for p, d in dims.items()}
+        ranked = sorted(obs, key=lambda o: o[1],
+                        reverse=(self.mode == "max"))
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        good, bad = ranked[:n_good], ranked[n_good:] or ranked[-1:]
+        flat: Dict[Tuple, Any] = {}
+        for path, domain in dims.items():
+            l_est = _make_parzen(domain,
+                                 [o[0][path] for o in good if path in o[0]])
+            g_est = _make_parzen(domain,
+                                 [o[0][path] for o in bad if path in o[0]])
+            best_v, best_score = None, -math.inf
+            for _ in range(self.n_candidates):
+                v = l_est.draw(self._rng)
+                score = l_est.logpdf(v) - g_est.logpdf(v)
+                if score > best_score:
+                    best_v, best_score = v, score
+            flat[path] = best_v
+        return flat
+
+    # ---------------------------------------------------------- interface
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        import copy
+
+        if not self.space:
+            return None
+        dims = _flatten_space(self.space)
+        flat = self._suggest_flat(dims)
+        config = copy.deepcopy(
+            {k: v for k, v in self.space.items()
+             if not isinstance(v, Domain)})
+        # non-domain nested dicts: strip Domain leaves, keep constants
+        for path, value in flat.items():
+            _set_path(config, path, value)
+        self._live[trial_id] = config
+        return config
+
+    def _record(self, trial_id: str, result: Optional[Dict]) -> None:
+        if not result or self.metric not in result:
+            return
+        config = self._live.get(trial_id)
+        if config is None:
+            return
+        dims = _flatten_space(self.space)
+        flat = {}
+        for path in dims:
+            try:
+                flat[path] = _get_path(config, path)
+            except (KeyError, TypeError):
+                pass
+        self._obs.append((flat, float(result[self.metric])))
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        if not error:
+            self._record(trial_id, result)
+        self._live.pop(trial_id, None)
+
+
+class TuneBOHB(TPESearcher):
+    """BOHB's searcher half (reference: bohb_search.py:50): TPE fitted on
+    multi-fidelity observations — the model uses the highest fidelity
+    (training_iteration) that has at least ``min_points_per_fidelity``
+    observations, so early-rung noise doesn't swamp high-fidelity signal.
+    Pair with ``HyperBandForBOHB``."""
+
+    def __init__(self, *args, min_points_per_fidelity: int = 4,
+                 time_attr: str = "training_iteration", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.min_points = min_points_per_fidelity
+        self.time_attr = time_attr
+        # fidelity -> [(flat, score)]
+        self._fidelity_obs: Dict[int, List[Tuple[Dict, float]]] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        self._record_fidelity(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        if not error and result:
+            self._record_fidelity(trial_id, result)
+        self._live.pop(trial_id, None)
+
+    def _record_fidelity(self, trial_id: str, result: Dict) -> None:
+        if self.metric not in result:
+            return
+        config = self._live.get(trial_id)
+        if config is None:
+            return
+        fidelity = int(result.get(self.time_attr, 0))
+        dims = _flatten_space(self.space)
+        flat = {}
+        for path in dims:
+            try:
+                flat[path] = _get_path(config, path)
+            except (KeyError, TypeError):
+                pass
+        self._fidelity_obs.setdefault(fidelity, []).append(
+            (flat, float(result[self.metric])))
+
+    def _observations(self):
+        # highest fidelity with enough points wins: low-budget scores can
+        # actively mislead (that's the BOHB premise), so as soon as even a
+        # few full-fidelity results exist, model on those alone
+        for fidelity in sorted(self._fidelity_obs, reverse=True):
+            obs = self._fidelity_obs[fidelity]
+            if len(obs) >= self.min_points:
+                return obs
+        # pool everything until one fidelity has enough signal
+        return [o for obs in self._fidelity_obs.values() for o in obs]

@@ -1,0 +1,129 @@
+"""BOHB + native TPE searcher (reference: tune/search/bohb/bohb_search.py:50
+TuneBOHB, schedulers/hb_bohb.py; VERDICT r1 item 9 — BOHB reproduces
+ASHA-or-better trial efficiency on a toy surface)."""
+
+import math
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import ASHAScheduler, HyperBandForBOHB
+from ray_tpu.tune.search import TPESearcher, TuneBOHB
+from ray_tpu.tune.search.sample import Categorical, Float
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _surface(x, y):
+    """Smooth toy objective, optimum at (0.7, -0.3), max value 10."""
+    return 10.0 - 12.0 * ((x - 0.7) ** 2 + (y + 0.3) ** 2)
+
+
+def _objective(config):
+    for i in range(1, 10):
+        # fidelity-dependent: low budgets see a noisy shifted surface,
+        # converging toward the true one (the BOHB setting)
+        frac = i / 9.0
+        value = frac * _surface(config["x"], config["y"]) + \
+            (1 - frac) * (5.0 - abs(config["x"]))
+        tune.report({"score": value})
+
+
+SPACE = {"x": tune.uniform(-2.0, 2.0), "y": tune.uniform(-2.0, 2.0)}
+
+
+def test_tpe_exploits_on_pure_model_level():
+    """Model sanity without a cluster: after seeing the toy surface, TPE's
+    suggestions concentrate near the optimum vs uniform random."""
+    searcher = TPESearcher(space=dict(SPACE), metric="score", mode="max",
+                           n_initial_points=12, seed=7)
+    import random
+
+    rng = random.Random(3)
+    for i in range(60):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        searcher.on_trial_complete(
+            tid, {"score": _surface(cfg["x"], cfg["y"])})
+    searcher.epsilon = 0.0  # probe the model greedily
+    tail = []
+    for i in range(10):
+        tid = f"probe{i}"
+        cfg = searcher.suggest(tid)
+        tail.append(math.hypot(cfg["x"] - 0.7, cfg["y"] + 0.3))
+    random_dist = [math.hypot(rng.uniform(-2, 2) - 0.7,
+                              rng.uniform(-2, 2) + 0.3)
+                   for _ in range(1000)]
+    avg_random = sum(random_dist) / len(random_dist)
+    avg_tail = sum(tail) / len(tail)
+    assert avg_tail < avg_random * 0.6, (avg_tail, avg_random)
+
+
+def test_tpe_handles_categorical_and_log():
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "act": tune.choice(["relu", "gelu", "tanh"])}
+    searcher = TPESearcher(space=space, metric="score", mode="max",
+                           n_initial_points=10, seed=11)
+    for i in range(80):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        score = (5.0 if cfg["act"] == "gelu" else 0.0) - \
+            abs(math.log10(cfg["lr"]) + 3.0)  # best: gelu, lr=1e-3
+        searcher.on_trial_complete(tid, {"score": score})
+    searcher.epsilon = 0.0  # probe the model greedily
+    hits = 0
+    for i in range(10):
+        cfg = searcher.suggest(f"p{i}")
+        if cfg["act"] == "gelu" and 1e-4 < cfg["lr"] < 1e-2:
+            hits += 1
+    assert hits >= 5, hits
+
+
+def test_bohb_end_to_end_beats_or_matches_asha(ray4, tmp_path):
+    """Same trial budget: BOHB's model-guided search must find a best
+    score at least as good as ASHA + random within tolerance, and
+    early-stop some trials (trial efficiency)."""
+    n_samples = 32
+
+    def run(name, scheduler, searcher):
+        tuner = Tuner(
+            _objective,
+            param_space=dict(SPACE),
+            tune_config=TuneConfig(
+                metric="score", mode="max", num_samples=n_samples,
+                max_concurrent_trials=4, scheduler=scheduler,
+                search_alg=searcher),
+            run_config=RunConfig(name=name, storage_path=str(tmp_path)),
+        )
+        results = tuner.fit()
+        best = results.get_best_result().metrics["score"]
+        iters = [r.metrics["training_iteration"] for r in results]
+        return best, iters
+
+    bohb_best, bohb_iters = run(
+        "bohb",
+        HyperBandForBOHB(max_t=9, reduction_factor=3),
+        TuneBOHB(metric="score", mode="max", n_initial_points=8, seed=5))
+    asha_best, _ = run(
+        "asha",
+        ASHAScheduler(max_t=9, grace_period=1, reduction_factor=3),
+        None)
+
+    # concurrency makes observation order (and thus the exact model state)
+    # nondeterministic, so quality parity uses a generous tolerance — the
+    # precise exploitation claims live in the deterministic model-level
+    # tests above
+    assert bohb_best >= asha_best - 3.0, (bohb_best, asha_best)
+    assert bohb_best > 6.0, bohb_best          # clearly better than noise
+    assert min(bohb_iters) < 9, bohb_iters     # early stopping happened
+    # trial efficiency: meaningfully below the exhaustive budget
+    assert sum(bohb_iters) <= 0.85 * n_samples * 9, sum(bohb_iters)
