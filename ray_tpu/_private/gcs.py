@@ -520,7 +520,8 @@ class HeadServer:
             # resource report lands (~one gossip period), its advertised
             # availability already reflects the allocation and counting it
             # again would double-book the node for the whole worker boot
-            if now - getattr(other, "placed_at", 0.0) > 1.5:
+            window = max(1.5, 3 * CONFIG.gossip_period_ms / 1000.0)
+            if now - getattr(other, "placed_at", 0.0) > window:
                 continue
             req = ResourceSet.from_wire(
                 other.spec_wire.get("resources", {}))

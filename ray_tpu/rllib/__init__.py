@@ -12,6 +12,9 @@ CPU actors.
 from ray_tpu.rllib.algorithms import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.algorithms.apex import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
@@ -30,7 +33,9 @@ from ray_tpu.rllib.env.multi_agent_env import (
     MultiAgentEnv, MultiAgentEnvRunner)
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DDPPO", "QMIX",
+    "QMIXConfig", "ApexDQN", "ApexDQNConfig",
+    "DDPPOConfig", "DQN", "DQNConfig",
     "BC", "BCConfig", "A2C", "A2CConfig", "APPO", "APPOConfig",
     "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
     "ES", "ESConfig", "MARWIL", "MARWILConfig",

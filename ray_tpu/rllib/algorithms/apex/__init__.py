@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.apex.apex import ApexDQN, ApexDQNConfig
+
+__all__ = ["ApexDQN", "ApexDQNConfig"]

@@ -1,0 +1,33 @@
+"""DD-PPO — decentralized distributed PPO (reference:
+rllib/algorithms/ddppo/ddppo.py:16: rollout workers compute gradients
+locally and allreduce them with no central learner bottleneck).
+
+TPU-native mapping: "decentralized data parallel" is the native execution
+model here, at two scales —
+
+- across PROCESSES: ``num_learners=N`` learner actors each grad their
+  batch shard and allreduce through ``ray_tpu.util.collective`` before
+  applying (params stay bitwise identical; see
+  core/learner_group.py _RemoteLearner);
+- across CHIPS: a single learner jitted over a device mesh ``data`` axis,
+  where GSPMD inserts the gradient psum over ICI — the role the
+  reference's torch.distributed gloo/nccl allreduce plays. The 8-device
+  dryrun exercises this path (__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DDPPO)
+        # decentralized by default: two grad-syncing learner actors
+        self.num_learners = 2
+
+
+class DDPPO(PPO):
+    @classmethod
+    def get_default_config(cls):
+        return DDPPOConfig(algo_class=cls)

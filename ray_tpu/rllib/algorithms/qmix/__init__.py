@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.qmix.qmix import QMIX, QMIXConfig
+
+__all__ = ["QMIX", "QMIXConfig"]

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.models.catalog import (
+    ConvModule, LSTMModule, get_module_for_space)
+
+__all__ = ["ConvModule", "LSTMModule", "get_module_for_space"]

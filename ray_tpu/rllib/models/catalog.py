@@ -1,0 +1,210 @@
+"""Model catalog — CNN and RNN modules beyond the default MLP (reference:
+rllib/models/catalog.py + rllib/models/torch/{visionnet,recurrent_net}.py;
+VERDICT r1 item 4: a minimal catalog so algorithms run beyond MLP envs).
+
+All modules keep the functional RLModule contract (params are a pytree,
+``forward(params, obs)`` is pure), so the same module runs jitted in the
+Learner and on CPU env runners.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.core.rl_module import Categorical, DiagGaussian
+
+# (out_channels, kernel, stride) — the reference's default vision net for
+# 84x84-ish inputs, trimmed for small test images too
+DEFAULT_CONV_FILTERS = ((16, 4, 2), (32, 4, 2), (64, 3, 2))
+
+
+def _mlp_params(key, sizes, final_scale: float = 0.01):
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / a)
+        if i == len(sizes) - 2:
+            scale = scale * final_scale
+        layers.append({"w": jax.random.normal(sub, (a, b)) * scale,
+                       "b": jnp.zeros((b,))})
+    return layers
+
+
+def _mlp_forward(layers, x, act):
+    for layer in layers[:-1]:
+        x = act(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+class ConvModule:
+    """Vision policy/value net: shared conv torso, separate heads
+    (reference: rllib/models/torch/visionnet.py)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.dist = Categorical if spec.discrete else DiagGaussian
+        self._act = jax.nn.relu
+        self._filters = tuple(getattr(spec, "conv_filters", None)
+                              or DEFAULT_CONV_FILTERS)
+        self._out_dim = (spec.action_dim if spec.discrete
+                         else 2 * spec.action_dim)
+        self._obs_shape = tuple(spec.obs_shape)  # (H, W, C)
+
+    def init(self, rng) -> Dict:
+        params: Dict = {"conv": []}
+        in_c = self._obs_shape[-1]
+        for out_c, k, _s in self._filters:
+            rng, sub = jax.random.split(rng)
+            fan_in = k * k * in_c
+            params["conv"].append({
+                "w": jax.random.normal(sub, (k, k, in_c, out_c))
+                * jnp.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((out_c,)),
+            })
+            in_c = out_c
+        flat = self._torso_out_dim()
+        k1, k2 = jax.random.split(jax.random.fold_in(rng, 7))
+        params["pi"] = _mlp_params(k1, (flat, 256, self._out_dim))
+        params["vf"] = _mlp_params(k2, (flat, 256, 1), final_scale=1.0)
+        return params
+
+    def _torso_out_dim(self) -> int:
+        h, w, _ = self._obs_shape
+        for _c, k, s in self._filters:
+            h = max((h - k) // s + 1, 1)
+            w = max((w - k) // s + 1, 1)
+        return h * w * self._filters[-1][0]
+
+    def _torso(self, params, obs):
+        x = obs
+        if x.ndim == len(self._obs_shape):  # add batch dim
+            x = x[None]
+        for layer, (_c, _k, stride) in zip(params["conv"], self._filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(stride, stride),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = self._act(x + layer["b"])
+        return x.reshape(x.shape[0], -1)
+
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        squeeze = obs.ndim == len(self._obs_shape)
+        feats = self._torso(params, obs)
+        logits = _mlp_forward(params["pi"], feats, self._act)
+        vf = _mlp_forward(params["vf"], feats, self._act)[..., 0]
+        if squeeze:
+            logits, vf = logits[0], vf[0]
+        return {"logits": logits, "vf": vf}
+
+    def explore_action(self, params, obs, rng):
+        out = self.forward(params, obs)
+        action = self.dist.sample(rng, out["logits"])
+        logp = self.dist.logp(out["logits"], action)
+        return action, logp, out["vf"]
+
+
+class LSTMModule:
+    """Recurrent policy/value net: MLP encoder -> LSTM cell -> heads
+    (reference: rllib/models/torch/recurrent_net.py LSTMWrapper).
+
+    ``forward_recurrent(params, obs_seq, state)`` scans a [T, B, obs]
+    sequence carrying (h, c); ``initial_state(batch)`` builds zeros.
+    ``forward(params, obs)`` is the stateless facade env runners use —
+    zero state per call — so the module stays drop-in where recurrence
+    isn't plumbed.
+    """
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.dist = Categorical if spec.discrete else DiagGaussian
+        self._act = jnp.tanh
+        self.cell_size = int(getattr(spec, "lstm_cell_size", 64) or 64)
+        self._out_dim = (spec.action_dim if spec.discrete
+                         else 2 * spec.action_dim)
+
+    def init(self, rng) -> Dict:
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        enc_sizes = (self.spec.obs_dim, *self.spec.hiddens)
+        H, E = self.cell_size, enc_sizes[-1]
+        scale = jnp.sqrt(1.0 / (E + H))
+        return {
+            "enc": _mlp_params(k1, enc_sizes, final_scale=1.0),
+            "lstm": {
+                "wx": jax.random.normal(k2, (E, 4 * H)) * scale,
+                "wh": jax.random.normal(k3, (H, 4 * H)) * scale,
+                "b": jnp.zeros((4 * H,)),
+            },
+            "pi": _mlp_params(jax.random.fold_in(k4, 0),
+                              (H, self._out_dim)),
+            "vf": _mlp_params(jax.random.fold_in(k4, 1), (H, 1),
+                              final_scale=1.0),
+        }
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        return (jnp.zeros((batch_size, self.cell_size)),
+                jnp.zeros((batch_size, self.cell_size)))
+
+    def _encode(self, params, obs):
+        x = obs
+        for layer in params["enc"]:
+            x = self._act(x @ layer["w"] + layer["b"])
+        return x
+
+    def _cell(self, params, x, state):
+        h, c = state
+        gates = x @ params["lstm"]["wx"] + h @ params["lstm"]["wh"] \
+            + params["lstm"]["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+    def _heads(self, params, h):
+        logits = _mlp_forward(params["pi"], h, self._act)
+        vf = _mlp_forward(params["vf"], h, self._act)[..., 0]
+        return {"logits": logits, "vf": vf}
+
+    def forward_recurrent(self, params, obs_seq, state):
+        """obs_seq: [T, B, obs_dim]; returns ({logits, vf}: [T, B, ...],
+        final_state)."""
+        enc = self._encode(params, obs_seq)
+
+        def step(carry, x):
+            h, new_carry = self._cell(params, x, carry)
+            return new_carry, h
+
+        final_state, hs = jax.lax.scan(step, state, enc)
+        return self._heads(params, hs), final_state
+
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        squeeze = obs.ndim == 1
+        x = obs[None] if squeeze else obs
+        enc = self._encode(params, x)
+        h, _ = self._cell(params, enc, self.initial_state(x.shape[0]))
+        out = self._heads(params, h)
+        if squeeze:
+            out = {k: v[0] for k, v in out.items()}
+        return out
+
+    def explore_action(self, params, obs, rng):
+        out = self.forward(params, obs)
+        action = self.dist.sample(rng, out["logits"])
+        logp = self.dist.logp(out["logits"], action)
+        return action, logp, out["vf"]
+
+
+def get_module_for_space(spec):
+    """Catalog dispatch (reference: catalog.py get_model_v2): image obs ->
+    ConvModule, use_lstm -> LSTMModule, else the default MLP."""
+    from ray_tpu.rllib.core.rl_module import MLPModule
+
+    if getattr(spec, "conv_filters", None) or \
+            len(getattr(spec, "obs_shape", ()) or ()) == 3:
+        return ConvModule(spec)
+    if getattr(spec, "use_lstm", False):
+        return LSTMModule(spec)
+    return MLPModule(spec)

@@ -18,7 +18,8 @@ import math
 import random
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.sample import (
+    Categorical, Domain, Float, Function, Integer)
 from ray_tpu.tune.search.searcher import Searcher
 
 
@@ -73,15 +74,15 @@ class _NumericParzen:
 
     def _unwarp(self, x: float):
         v = math.exp(x) if self.log else x
-        v = min(max(v, self.domain.lower), getattr(
-            self.domain, "upper", v))
         if isinstance(self.domain, Integer):
             return int(min(max(int(round(v)), self.domain.lower),
                            self.domain.upper - 1))
         q = getattr(self.domain, "q", None)
         if q:
             v = round(round(v / q) * q, 10)
-        return float(v)
+        # clamp AFTER quantization (matching Float.sample) so a rounded
+        # value can't land outside the declared range
+        return float(min(max(v, self.domain.lower), self.domain.upper))
 
     def draw(self, rng: random.Random):
         if not self.mus or rng.random() < 0.2:  # prior exploration
@@ -171,13 +172,18 @@ class TPESearcher(Searcher):
         return self._obs
 
     def _suggest_flat(self, dims: Dict[Tuple, Domain]) -> Dict[Tuple, Any]:
+        # sample_from callables can't be modeled — always sample them fresh
+        fn_dims = {p: d for p, d in dims.items() if isinstance(d, Function)}
+        dims = {p: d for p, d in dims.items() if not isinstance(d, Function)}
+        fn_values = {p: d.sample(self._rng) for p, d in fn_dims.items()}
         obs = self._observations()
         if len(obs) < self.n_initial or self._rng.random() < self.epsilon:
             # epsilon exploration: the l/g argmax alone can lock onto a
             # self-reinforcing cluster (its candidates all come from l);
             # periodic pure-random suggestions keep feeding the model
             # evidence from unvisited regions
-            return {p: d.sample(self._rng) for p, d in dims.items()}
+            return {**fn_values,
+                    **{p: d.sample(self._rng) for p, d in dims.items()}}
         ranked = sorted(obs, key=lambda o: o[1],
                         reverse=(self.mode == "max"))
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
@@ -195,6 +201,7 @@ class TPESearcher(Searcher):
                 if score > best_score:
                     best_v, best_score = v, score
             flat[path] = best_v
+        flat.update(fn_values)
         return flat
 
     # ---------------------------------------------------------- interface
@@ -214,19 +221,24 @@ class TPESearcher(Searcher):
         self._live[trial_id] = config
         return config
 
-    def _record(self, trial_id: str, result: Optional[Dict]) -> None:
-        if not result or self.metric not in result:
-            return
+    def _flat_config(self, trial_id: str) -> Optional[Dict[Tuple, Any]]:
         config = self._live.get(trial_id)
         if config is None:
-            return
-        dims = _flatten_space(self.space)
+            return None
         flat = {}
-        for path in dims:
+        for path in _flatten_space(self.space):
             try:
                 flat[path] = _get_path(config, path)
             except (KeyError, TypeError):
                 pass
+        return flat
+
+    def _record(self, trial_id: str, result: Optional[Dict]) -> None:
+        if not result or self.metric not in result:
+            return
+        flat = self._flat_config(trial_id)
+        if flat is None:
+            return
         self._obs.append((flat, float(result[self.metric])))
 
     def on_trial_complete(self, trial_id, result=None, error=False) -> None:
@@ -249,6 +261,7 @@ class TuneBOHB(TPESearcher):
         self.time_attr = time_attr
         # fidelity -> [(flat, score)]
         self._fidelity_obs: Dict[int, List[Tuple[Dict, float]]] = {}
+        self._seen: set = set()  # (trial_id, fidelity) de-dup
 
     def on_trial_result(self, trial_id: str, result: Dict) -> None:
         self._record_fidelity(trial_id, result)
@@ -261,17 +274,15 @@ class TuneBOHB(TPESearcher):
     def _record_fidelity(self, trial_id: str, result: Dict) -> None:
         if self.metric not in result:
             return
-        config = self._live.get(trial_id)
-        if config is None:
-            return
         fidelity = int(result.get(self.time_attr, 0))
-        dims = _flatten_space(self.space)
-        flat = {}
-        for path in dims:
-            try:
-                flat[path] = _get_path(config, path)
-            except (KeyError, TypeError):
-                pass
+        # on_trial_result and the STOP path's on_trial_complete both carry
+        # the milestone result: record each (trial, fidelity) once
+        if (trial_id, fidelity) in self._seen:
+            return
+        flat = self._flat_config(trial_id)
+        if flat is None:
+            return
+        self._seen.add((trial_id, fidelity))
         self._fidelity_obs.setdefault(fidelity, []).append(
             (flat, float(result[self.metric])))
 

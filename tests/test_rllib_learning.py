@@ -1,0 +1,161 @@
+"""Learning-regression tests with reward thresholds (reference:
+rllib/tuned_examples/ — CI gates algorithms on learning curves, not just
+finite losses; VERDICT r1 item 4). Envs are tiny custom tasks sized to a
+1-CPU box: each algorithm must actually learn, within minutes, or fail."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+try:
+    import gymnasium as gym
+except ImportError:  # pragma: no cover
+    gym = None
+
+pytestmark = pytest.mark.skipif(gym is None, reason="gymnasium required")
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class ChainEnv(gym.Env if gym else object):
+    """Corridor of N cells; +1 for reaching the right end, small step cost.
+    Random walk rarely finishes; a learned right-moving policy scores ~0.9.
+    """
+
+    N = 8
+    MAX_STEPS = 24
+
+    def __init__(self, config=None):
+        self.observation_space = gym.spaces.Box(0.0, 1.0, (self.N,),
+                                                np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._pos = 0
+        self._t = 0
+
+    def _obs(self):
+        obs = np.zeros(self.N, np.float32)
+        obs[self._pos] = 1.0
+        return obs
+
+    def reset(self, *, seed=None, options=None):
+        self._pos, self._t = 0, 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._t += 1
+        self._pos = min(max(self._pos + (1 if action == 1 else -1), 0),
+                        self.N - 1)
+        done = self._pos == self.N - 1
+        trunc = self._t >= self.MAX_STEPS
+        reward = 1.0 if done else -0.01
+        return self._obs(), reward, done, trunc, {}
+
+
+class TargetEnv(gym.Env if gym else object):
+    """1-D continuous control: reward = -(action - g(obs))^2 per step.
+    Optimal return 0; a random policy in [-2, 2] scores about -1.3/step."""
+
+    HORIZON = 16
+
+    def __init__(self, config=None):
+        self.observation_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        self.action_space = gym.spaces.Box(-2.0, 2.0, (1,), np.float32)
+        self._rng = np.random.default_rng(0)
+        self._t = 0
+        self._obs_v = np.zeros(2, np.float32)
+
+    def _target(self):
+        return 0.8 * self._obs_v[0] - 0.5 * self._obs_v[1]
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._obs_v = self._rng.uniform(-1, 1, 2).astype(np.float32)
+        return self._obs_v.copy(), {}
+
+    def step(self, action):
+        self._t += 1
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        reward = -((a - self._target()) ** 2)
+        self._obs_v = self._rng.uniform(-1, 1, 2).astype(np.float32)
+        return self._obs_v.copy(), reward, False, self._t >= self.HORIZON, {}
+
+
+def _run_until(algo, threshold, max_iters, key="episode_return_mean"):
+    best = -np.inf
+    for i in range(max_iters):
+        result = algo.train()
+        value = result.get(key)
+        if value is not None:
+            best = max(best, value)
+        if best >= threshold:
+            return best, i + 1
+    return best, max_iters
+
+
+def test_dqn_learns_chain(ray4):
+    from ray_tpu.rllib import DQNConfig
+
+    cfg = (DQNConfig()
+           .environment(ChainEnv)
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                        rollout_fragment_length=24)
+           .training(lr=1e-3, train_batch_size=64, gamma=0.97)
+           .debugging(seed=0))
+    cfg.epsilon = [(0, 1.0), (10000, 0.05)]
+    cfg.num_steps_sampled_before_learning_starts = 400
+    cfg.target_network_update_freq = 500
+    cfg.training_intensity = 4.0
+    algo = cfg.build()
+    try:
+        # random policy scores ~0.2 and an un-learned greedy policy drifts
+        # negative; 0.5 is only reachable by actually learning to go right
+        best, iters = _run_until(algo, threshold=0.5, max_iters=100)
+        assert best >= 0.5, f"DQN failed to learn ChainEnv: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_sac_learns_target_tracking(ray4):
+    from ray_tpu.rllib import SACConfig
+
+    cfg = (SACConfig()
+           .environment(TargetEnv)
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                        rollout_fragment_length=16)
+           .training(lr=3e-3, train_batch_size=128, gamma=0.9)
+           .debugging(seed=0))
+    cfg.num_steps_sampled_before_learning_starts = 256
+    algo = cfg.build()
+    try:
+        # random return ~ -17..-20 per 16-step episode; learned ~ -5
+        best, iters = _run_until(algo, threshold=-6.0, max_iters=80)
+        assert best >= -6.0, f"SAC failed to learn TargetEnv: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_impala_learns_chain(ray4):
+    from ray_tpu.rllib import IMPALAConfig
+
+    cfg = (IMPALAConfig()
+           .environment(ChainEnv)
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                        rollout_fragment_length=24)
+           .training(lr=3e-3, entropy_coeff=0.005)
+           .debugging(seed=0))
+    cfg.num_fragments_per_step = 4
+    algo = cfg.build()
+    try:
+        best, iters = _run_until(algo, threshold=0.8, max_iters=60)
+        assert best >= 0.8, f"IMPALA failed to learn ChainEnv: best={best}"
+    finally:
+        algo.stop()

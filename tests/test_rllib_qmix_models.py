@@ -1,0 +1,254 @@
+"""QMIX learning + model catalog (reference: the QMIX family and
+rllib/models/ catalog; VERDICT r1 item 4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+
+
+class CoordGame(MultiAgentEnv):
+    """Two agents, 3 actions, one step: both picking action 2 pays +10
+    total; both picking 0 pays +4 (safe); any mismatch pays 0. Greedy
+    independent learners get stuck on the safe action; QMIX's joint value
+    factorization finds the coordinated optimum."""
+
+    possible_agents = ["a0", "a1"]
+
+    def __init__(self, config=None):
+        import gymnasium as gym
+
+        self._obs_space = gym.spaces.Box(0.0, 1.0, (2,), np.float32)
+        self._act_space = gym.spaces.Discrete(3)
+
+    @property
+    def observation_spaces(self):
+        return {a: self._obs_space for a in self.possible_agents}
+
+    @property
+    def action_spaces(self):
+        return {a: self._act_space for a in self.possible_agents}
+
+    def reset(self, *, seed=None):
+        obs = np.asarray([1.0, 0.0], np.float32)
+        return {a: obs.copy() for a in self.possible_agents}, {}
+
+    def step(self, action_dict):
+        a0, a1 = action_dict["a0"], action_dict["a1"]
+        if a0 == 2 and a1 == 2:
+            team = 10.0
+        elif a0 == 0 and a1 == 0:
+            team = 4.0
+        else:
+            team = 0.0
+        obs = {a: np.asarray([0.0, 1.0], np.float32)
+               for a in self.possible_agents}
+        rewards = {a: team / 2 for a in self.possible_agents}
+        dones = {"__all__": True, "a0": True, "a1": True}
+        truncs = {"__all__": False}
+        return obs, rewards, dones, truncs, {}
+
+
+def test_qmix_learns_coordination():
+    from ray_tpu.rllib import QMIXConfig
+
+    cfg = (QMIXConfig()
+           .environment(CoordGame)
+           .training(lr=2e-3, train_batch_size=64,
+                     target_network_update_freq=200,
+                     num_env_steps_per_iter=64)
+           .debugging(seed=3))
+    cfg.epsilon = [(0, 1.0), (2500, 0.05)]
+    cfg.num_steps_sampled_before_learning_starts = 128
+    algo = cfg.build()
+    best = -np.inf
+    for i in range(90):
+        r = algo.train()
+        ret = r.get("episode_return_mean")
+        if ret is not None:
+            best = max(best, ret)
+        if best >= 8.0:
+            break
+    algo.stop()
+    # the safe equilibrium pays 4; >=8 requires coordinated action 2
+    assert best >= 8.0, f"QMIX failed to coordinate: best={best}"
+
+
+def test_qmix_mixer_is_monotonic():
+    from ray_tpu.rllib.algorithms.qmix.qmix import QMixModel
+
+    model = QMixModel(obs_dim=4, state_dim=8, n_agents=2, n_actions=3)
+    params = model.init(jax.random.key(0))
+    state = jnp.ones((1, 8))
+    q = jnp.asarray([[0.3, -0.2]])
+    base = model.mix(params, q, state)[0]
+    # raising any agent's Q must not lower Q_tot (monotonic mixing)
+    for i in range(2):
+        bumped = q.at[0, i].add(0.5)
+        assert model.mix(params, bumped, state)[0] >= base - 1e-5
+
+
+# --------------------------------------------------------- model catalog
+def test_conv_module_shapes_and_grads():
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    spec = RLModuleSpec(obs_dim=0, action_dim=5, obs_shape=(24, 24, 3))
+    mod = spec.build()
+    assert type(mod).__name__ == "ConvModule"
+    params = mod.init(jax.random.key(0))
+    obs = jnp.ones((6, 24, 24, 3))
+    out = mod.forward(params, obs)
+    assert out["logits"].shape == (6, 5) and out["vf"].shape == (6,)
+    grads = jax.grad(lambda p: mod.forward(p, obs)["logits"].sum())(params)
+    assert all(bool(jnp.isfinite(g).all())
+               for g in jax.tree.leaves(grads))
+    action, logp, vf = mod.explore_action(params, obs, jax.random.key(1))
+    assert action.shape == (6,) and logp.shape == (6,)
+    # single-observation (unbatched) path
+    single = mod.forward(params, jnp.ones((24, 24, 3)))
+    assert single["logits"].shape == (5,)
+
+
+def test_conv_module_can_fit_labels():
+    """A tiny supervised fit proves gradients move the conv tower."""
+    import optax
+
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    spec = RLModuleSpec(obs_dim=0, action_dim=2, obs_shape=(10, 10, 1),
+                        conv_filters=((8, 3, 2), (16, 3, 2)))
+    mod = spec.build()
+    params = mod.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 10, 10, 1)).astype(np.float32)
+    y = (X.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, X, y):
+        def loss(p):
+            logits = mod.forward(p, X)["logits"]
+            logps = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(
+                logps, y[:, None], axis=1))
+
+        l, g = jax.value_and_grad(loss)(params)
+        updates, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, updates), opt, l
+
+    first = None
+    for i in range(120):
+        params, opt, l = step(params, opt, X, y)
+        if first is None:
+            first = float(l)
+    assert float(l) < first * 0.5, (first, float(l))
+
+
+def test_lstm_module_recurrence():
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    spec = RLModuleSpec(obs_dim=6, action_dim=3, use_lstm=True,
+                        lstm_cell_size=32)
+    mod = spec.build()
+    assert type(mod).__name__ == "LSTMModule"
+    params = mod.init(jax.random.key(0))
+    seq = jnp.ones((7, 5, 6))
+    out, state = mod.forward_recurrent(params, seq, mod.initial_state(5))
+    assert out["logits"].shape == (7, 5, 3)
+    assert state[0].shape == (5, 32) and state[1].shape == (5, 32)
+    # state carries information: perturbing it changes the output
+    out2, _ = mod.forward_recurrent(params, seq,
+                                    (state[0] + 1.0, state[1]))
+    assert not bool(jnp.allclose(out["logits"][0], out2["logits"][0]))
+    # gradient flows through the scan
+    grads = jax.grad(lambda p: mod.forward_recurrent(
+        p, seq, mod.initial_state(5))[0]["logits"].sum())(params)
+    assert all(bool(jnp.isfinite(g).all())
+               for g in jax.tree.leaves(grads))
+    # stateless facade for the env-runner path
+    single = mod.forward(params, jnp.ones((6,)))
+    assert single["logits"].shape == (3,)
+
+
+def test_lstm_can_remember():
+    """Supervised memory task: the label is the FIRST step's sign, queried
+    at the last step — impossible without recurrent state."""
+    import optax
+
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    spec = RLModuleSpec(obs_dim=2, action_dim=2, use_lstm=True,
+                        lstm_cell_size=16, hiddens=(16,))
+    mod = spec.build()
+    params = mod.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    T, B = 6, 64
+    first = rng.choice([-1.0, 1.0], B).astype(np.float32)
+    X = np.zeros((T, B, 2), np.float32)
+    X[0, :, 0] = first
+    X[1:, :, 1] = 1.0  # uninformative filler
+    y = (first > 0).astype(np.int32)
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss(p):
+            out, _ = mod.forward_recurrent(p, X, mod.initial_state(B))
+            logps = jax.nn.log_softmax(out["logits"][-1])
+            return -jnp.mean(jnp.take_along_axis(logps, y[:, None], axis=1))
+
+        l, g = jax.value_and_grad(loss)(params)
+        updates, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, updates), opt, l
+
+    losses = []
+    for i in range(300):
+        params, opt, l = step(params, opt)
+        losses.append(float(l))
+    assert losses[-1] < 0.1, losses[-1]
+
+
+def test_apex_distributed_replay_learns_chain():
+    """Ape-X: replay lives in a dedicated actor, runners explore on an
+    epsilon ladder — and it still learns (reward-gated)."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    from tests.test_rllib_learning import ChainEnv
+
+    from ray_tpu.rllib import ApexDQNConfig
+
+    cfg = (ApexDQNConfig()
+           .environment(ChainEnv)
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                        rollout_fragment_length=24)
+           .training(lr=1e-3, train_batch_size=64, gamma=0.97)
+           .debugging(seed=0))
+    cfg.epsilon = [(0, 1.0), (10000, 0.05)]
+    cfg.num_steps_sampled_before_learning_starts = 400
+    cfg.target_network_update_freq = 500
+    cfg.training_intensity = 4.0
+    algo = cfg.build()
+    try:
+        eps = algo._runner_epsilons()
+        assert len(eps) == 2 and eps[0] > eps[1]  # exploration ladder
+        best = -np.inf
+        for i in range(100):
+            r = algo.train()
+            ret = r.get("episode_return_mean")
+            if ret is not None:
+                best = max(best, ret)
+            if i == 3:
+                assert r["replay_actor_size"] > 0  # replay is off-driver
+            if best >= 0.5:
+                break
+        assert best >= 0.5, f"ApexDQN failed to learn: best={best}"
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
