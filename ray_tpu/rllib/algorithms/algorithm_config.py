@@ -146,25 +146,32 @@ class AlgorithmConfig:
 
             obs_space = probe.observation_space
             act_space = probe.action_space
-            obs_dim = int(obs_space.shape[0])
-            if self.connector is not None:
+            # catalog routing (reference: models/catalog.py get_model_v2):
+            # rank-3 obs -> ConvModule; model={'use_lstm': True} -> LSTM
+            obs_shape = (tuple(obs_space.shape)
+                         if len(obs_space.shape) == 3 else None)
+            obs_dim = (int(obs_space.shape[0]) if obs_shape is None else 0)
+            if self.connector is not None and obs_shape is None:
                 # FrameStack-style connectors widen the feature dim
                 # (pipelines expose obs_multiplier; bare connectors
                 # obs_dim_multiplier)
                 obs_dim *= getattr(
                     self.connector, "obs_multiplier",
                     getattr(self.connector, "obs_dim_multiplier", 1))
+            common = dict(
+                hiddens=tuple(self.model.get("hiddens", (64, 64))),
+                activation=self.model.get("activation", "tanh"),
+                obs_shape=obs_shape,
+                conv_filters=self.model.get("conv_filters"),
+                use_lstm=bool(self.model.get("use_lstm", False)),
+                lstm_cell_size=int(self.model.get("lstm_cell_size", 64)))
             if isinstance(act_space, gym.spaces.Discrete):
                 return RLModuleSpec(
                     obs_dim=obs_dim, action_dim=int(act_space.n),
-                    discrete=True,
-                    hiddens=tuple(self.model.get("hiddens", (64, 64))),
-                    activation=self.model.get("activation", "tanh"))
+                    discrete=True, **common)
             return RLModuleSpec(
                 obs_dim=obs_dim, action_dim=int(act_space.shape[0]),
-                discrete=False,
-                hiddens=tuple(self.model.get("hiddens", (64, 64))),
-                activation=self.model.get("activation", "tanh"))
+                discrete=False, **common)
         finally:
             probe.close()
 

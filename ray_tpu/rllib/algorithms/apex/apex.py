@@ -26,14 +26,17 @@ class ReplayActor:
 
     def __init__(self, capacity: int, seed: int = 0, alpha: float = 0.6,
                  beta: float = 0.4):
-        self._buffer = PrioritizedReplayBuffer(capacity, seed=seed)
+        self._buffer = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                               beta=beta, seed=seed)
 
     def add_batch(self, batch: Dict[str, np.ndarray]) -> int:
         self._buffer.add_batch(batch)
         return len(self._buffer)
 
     def sample(self, batch_size: int) -> Optional[Dict[str, np.ndarray]]:
-        if len(self._buffer) < batch_size:
+        # sampling with replacement works below batch_size (matching the
+        # local buffer's semantics); only an empty buffer has nothing
+        if len(self._buffer) == 0:
             return None
         return self._buffer.sample(batch_size)
 
@@ -61,7 +64,7 @@ class _RemoteReplayFacade:
         out = ray_tpu.get(self._actor.sample.remote(batch_size),
                           timeout=120)
         if out is None:
-            raise RuntimeError("replay actor below batch size")
+            raise RuntimeError("replay actor is empty")
         return out
 
     def update_priorities(self, indexes, td_errors) -> None:

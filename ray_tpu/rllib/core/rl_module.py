@@ -81,17 +81,9 @@ class RLModuleSpec:
     lstm_cell_size: int = 64
 
     def build(self):
-        is_image = self.conv_filters or (
-            self.obs_shape is not None and len(self.obs_shape) == 3)
-        if is_image and self.use_lstm:
-            raise ValueError(
-                "conv+lstm composition is not supported yet; pick "
-                "conv_filters/obs_shape OR use_lstm")
-        if is_image or self.use_lstm:
-            from ray_tpu.rllib.models.catalog import get_module_for_space
+        from ray_tpu.rllib.models.catalog import get_module_for_space
 
-            return get_module_for_space(self)
-        return MLPModule(self)
+        return get_module_for_space(self)
 
 
 class MLPModule:

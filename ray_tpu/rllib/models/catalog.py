@@ -17,8 +17,17 @@ import jax.numpy as jnp
 from ray_tpu.rllib.core.rl_module import Categorical, DiagGaussian
 
 # (out_channels, kernel, stride) — the reference's default vision net for
-# 84x84-ish inputs, trimmed for small test images too
+# 84x84-ish inputs; smaller images get shallower stacks
 DEFAULT_CONV_FILTERS = ((16, 4, 2), (32, 4, 2), (64, 3, 2))
+
+
+def default_filters_for(obs_shape) -> tuple:
+    side = min(obs_shape[0], obs_shape[1])
+    if side >= 36:
+        return DEFAULT_CONV_FILTERS
+    if side >= 10:
+        return ((16, 4, 2), (32, 3, 2))
+    return ((16, 3, 1),)
 
 
 def _mlp_params(key, sizes, final_scale: float = 0.01):
@@ -48,11 +57,16 @@ class ConvModule:
         self.spec = spec
         self.dist = Categorical if spec.discrete else DiagGaussian
         self._act = jax.nn.relu
+        self._obs_shape = tuple(spec.obs_shape)  # (H, W, C)
         self._filters = tuple(getattr(spec, "conv_filters", None)
-                              or DEFAULT_CONV_FILTERS)
+                              or default_filters_for(self._obs_shape))
         self._out_dim = (spec.action_dim if spec.discrete
                          else 2 * spec.action_dim)
-        self._obs_shape = tuple(spec.obs_shape)  # (H, W, C)
+        if self._torso_out_dim() <= 0:
+            raise ValueError(
+                f"conv_filters {self._filters} collapse obs_shape "
+                f"{self._obs_shape} to zero spatial extent; pass smaller "
+                "kernels/strides via RLModuleSpec.conv_filters")
 
     def init(self, rng) -> Dict:
         params: Dict = {"conv": []}
@@ -75,8 +89,8 @@ class ConvModule:
     def _torso_out_dim(self) -> int:
         h, w, _ = self._obs_shape
         for _c, k, s in self._filters:
-            h = max((h - k) // s + 1, 1)
-            w = max((w - k) // s + 1, 1)
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
         return h * w * self._filters[-1][0]
 
     def _torso(self, params, obs):
@@ -202,9 +216,19 @@ def get_module_for_space(spec):
     ConvModule, use_lstm -> LSTMModule, else the default MLP."""
     from ray_tpu.rllib.core.rl_module import MLPModule
 
-    if getattr(spec, "conv_filters", None) or \
-            len(getattr(spec, "obs_shape", ()) or ()) == 3:
+    is_image = bool(getattr(spec, "conv_filters", None)) or \
+        len(getattr(spec, "obs_shape", ()) or ()) == 3
+    use_lstm = bool(getattr(spec, "use_lstm", False))
+    if is_image and use_lstm:
+        raise ValueError(
+            "conv+lstm composition is not supported yet; pick "
+            "conv_filters/obs_shape OR use_lstm")
+    if is_image:
+        if getattr(spec, "obs_shape", None) is None or \
+                len(spec.obs_shape) != 3:
+            raise ValueError(
+                "conv_filters requires obs_shape=(H, W, C) on the spec")
         return ConvModule(spec)
-    if getattr(spec, "use_lstm", False):
+    if use_lstm:
         return LSTMModule(spec)
     return MLPModule(spec)

@@ -37,7 +37,6 @@ FAST_FILES = {
     "test_state_api.py",
     "test_job_submission.py",
     "test_dashboard.py",
-    "test_observability.py",
 }
 SLOW_TESTS: set = set()
 
