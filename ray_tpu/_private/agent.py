@@ -143,6 +143,12 @@ class NodeAgent:
 
     # ------------------------------------------------------------------ boot
     async def start(self) -> None:
+        from ray_tpu._private.event import init_event_log, report_event
+
+        init_event_log(self.session_dir, f"agent_{self.node_id[:8]}")
+        report_event("INFO", "NODE_STARTED",
+                     f"node agent {self.node_id[:12]} starting",
+                     node_id=self.node_id)
         await self.server.start_unix(self.unix_path)
         self.tcp_port = await self.server.start_tcp("0.0.0.0", 0)
         self.server.set_disconnect_handler(self._on_disconnect)
@@ -179,7 +185,13 @@ class NodeAgent:
                 ]
 
             def kill(victim):
+                from ray_tpu._private.event import report_event
+
                 w = victim["worker"]
+                report_event("WARNING", "OOM_KILL",
+                             f"killing worker {w.worker_id[:12]} under "
+                             "memory pressure",
+                             worker_id=w.worker_id, node_id=self.node_id)
                 try:
                     w.proc.terminate()  # owner sees the failure and retries
                 except Exception:
@@ -207,6 +219,7 @@ class NodeAgent:
         r("GetStoreStats", self._get_store_stats)
         r("GetNodeInfo", self._get_node_info)
         r("ListWorkers", self._list_workers)
+        r("ListEvents", self._list_events)
         r("GetNodeStats", self._get_node_stats)
         r("ListStoreObjects", self._list_store_objects)
         r("SetResource", self._set_resource)
@@ -1128,6 +1141,17 @@ class NodeAgent:
     async def _get_node_stats(self, conn: Connection, p) -> Dict:
         return getattr(self, "node_stats", {}) or \
             await asyncio.to_thread(self._sample_node_stats)
+
+    async def _list_events(self, conn: Connection, p) -> List[Dict]:
+        """This node's structured events (multi-node session dirs are per
+        machine; the state API aggregates across agents)."""
+        from ray_tpu._private.event import read_events
+
+        p = p or {}
+        return await asyncio.to_thread(
+            read_events, self.session_dir,
+            severity=p.get("severity"), label=p.get("label"),
+            limit=int(p.get("limit", 1000)))
 
     async def _list_workers(self, conn: Connection, p) -> List[Dict]:
         """Live worker-table query (reference: the state API pairs GCS data

@@ -1,0 +1,92 @@
+"""Structured cluster event log (reference: src/ray/util/event.h:41 —
+RAY_EVENT macros write severity-tagged JSON event files that the dashboard
+event module aggregates; VERDICT r1 missing #9).
+
+Each process appends JSON lines to its own file under
+``<session>/logs/events/``; readers (state API, dashboard) scan the
+directory. Emission never throws — an observability path must not take
+down the component it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+_writer: Optional["_EventWriter"] = None
+
+
+class _EventWriter:
+    def __init__(self, session_dir: str, component: str):
+        self.dir = os.path.join(session_dir, "logs", "events")
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(
+            self.dir, f"event_{component}_{os.getpid()}.log")
+        self.component = component
+
+    def write(self, record: Dict) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+
+
+def init_event_log(session_dir: str, component: str) -> None:
+    """Called once per process (head/agent/driver) at startup."""
+    global _writer
+    try:
+        _writer = _EventWriter(session_dir, component)
+    except Exception:
+        _writer = None
+
+
+def report_event(severity: str, label: str, message: str,
+                 **fields: Any) -> None:
+    """Append one structured event (reference: RAY_EVENT(severity, label)
+    << message). No-op before init_event_log / on any IO failure."""
+    if _writer is None:
+        return
+    try:
+        _writer.write({
+            "timestamp": time.time(),
+            "severity": severity if severity in SEVERITIES else "INFO",
+            "label": label,
+            "message": message,
+            "component": _writer.component,
+            "pid": os.getpid(),
+            **fields,
+        })
+    except Exception:
+        pass
+
+
+def read_events(session_dir: str, *, severity: Optional[str] = None,
+                label: Optional[str] = None,
+                limit: int = 1000) -> List[Dict]:
+    """All events recorded in a session, newest last."""
+    events_dir = os.path.join(session_dir, "logs", "events")
+    out: List[Dict] = []
+    try:
+        names = sorted(os.listdir(events_dir))
+    except FileNotFoundError:
+        return out
+    for name in names:
+        if not name.startswith("event_"):
+            continue
+        try:
+            with open(os.path.join(events_dir, name)) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
+    if severity:
+        out = [e for e in out if e.get("severity") == severity]
+    if label:
+        out = [e for e in out if e.get("label") == label]
+    out.sort(key=lambda e: e.get("timestamp", 0.0))
+    return out[-limit:]

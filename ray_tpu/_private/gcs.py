@@ -353,6 +353,11 @@ class HeadServer:
         if not node.alive:
             return
         node.alive = False
+        from ray_tpu._private.event import report_event
+
+        report_event("ERROR", "NODE_DEAD",
+                     f"node {node.node_id[:12]} marked dead: {reason}",
+                     node_id=node.node_id, reason=reason)
         # drop the node's published system metrics: a dead node's last
         # cpu/mem/TPU gauges must not keep exporting as current
         metrics_ns = self.kv.get("_metrics")
@@ -584,6 +589,13 @@ class HeadServer:
         await self._handle_actor_failure(info, p.get("reason", "worker died"))
 
     async def _handle_actor_failure(self, info: ActorInfo, reason: str) -> None:
+        from ray_tpu._private.event import report_event
+
+        report_event("WARNING", "ACTOR_FAILURE",
+                     f"actor {info.actor_id[:12]} ({info.class_name}) "
+                     f"failed: {reason}",
+                     actor_id=info.actor_id, reason=reason,
+                     restarts=info.num_restarts)
         if info.num_restarts < info.max_restarts or info.max_restarts == -1:
             info.num_restarts += 1
             info.state = ACTOR_RESTARTING
@@ -850,6 +862,10 @@ def main() -> None:
     async def run():
         import signal
 
+        from ray_tpu._private.event import init_event_log, report_event
+
+        init_event_log(args.session_dir, "head")
+        report_event("INFO", "HEAD_STARTED", "head control plane starting")
         head = HeadServer(args.session_dir, args.port,
                           persist_path=args.persist or None)
         port = await head.start()

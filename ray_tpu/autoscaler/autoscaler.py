@@ -157,6 +157,11 @@ class StandardAutoscaler:
 
         for name, cnt in to_launch.items():
             logger.info("autoscaler: launching %d x %s", cnt, name)
+            from ray_tpu._private.event import report_event
+
+            report_event("INFO", "AUTOSCALER_LAUNCH",
+                         f"launching {cnt} x {name}",
+                         node_type=name, count=cnt)
             self.provider.create_node(name, cnt)
             self.num_launches += cnt
 

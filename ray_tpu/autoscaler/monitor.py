@@ -52,6 +52,13 @@ class Monitor:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
+        from ray_tpu._private.event import _writer, init_event_log
+
+        if _writer is None:
+            session_dir = getattr(self.autoscaler.provider,
+                                  "provider_config", {}).get("session_dir")
+            if session_dir:
+                init_event_log(session_dir, "autoscaler")
         self._thread = threading.Thread(
             target=self._run, name="autoscaler-monitor", daemon=True)
         self._thread.start()

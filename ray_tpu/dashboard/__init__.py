@@ -147,6 +147,9 @@ class DashboardActor:
             return state_api.list_nodes()
         if parts[0] == "node_stats":
             return state_api.get_node_stats()
+        if parts[0] == "events":
+            return state_api.list_cluster_events(
+                severity=query.get("severity"), label=query.get("label"))
         if parts[0] == "workers":
             return state_api.list_workers()
         if parts[0] == "objects":

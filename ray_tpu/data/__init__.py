@@ -24,7 +24,7 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "from_blocks", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "read_datasource",
-    "read_tfrecords", "read_images", "read_webdataset", "from_torch",
+    "read_tfrecords", "read_sql", "read_images", "read_webdataset", "from_torch",
     "DataContext",
 ]
 
@@ -115,6 +115,17 @@ def read_tfrecords(paths, *, parallelism: Optional[int] = None) -> Dataset:
 def read_images(paths, *, size=None, mode: str = "RGB",
                 parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.ImageDatasource(paths, size=size, mode=mode),
+                           parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: Optional[int] = None) -> Dataset:
+    """Read a SQL query through a DBAPI2 connection factory (reference:
+    read_api.py:1902 read_sql). ``connection_factory`` is a zero-arg
+    callable returning a fresh connection (e.g.
+    ``lambda: sqlite3.connect(path)``) so every read task can connect from
+    its own worker process."""
+    return read_datasource(_ds.SQLDatasource(sql, connection_factory),
                            parallelism=parallelism)
 
 

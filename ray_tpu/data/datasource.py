@@ -353,3 +353,64 @@ def write_json_fn(path: str):
                 "num_rows": np.asarray([acc.num_rows()])}
 
     return write
+
+
+class SQLDatasource(Datasource):
+    """DBAPI2 reads (reference: read_api.py:1902 read_sql — connection
+    factory + query; parallelized by wrapping the query in LIMIT/OFFSET
+    windows when a row count is obtainable, else a single task)."""
+
+    name = "SQL"
+
+    def __init__(self, sql: str, connection_factory):
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def _count(self) -> Optional[int]:
+        try:
+            conn = self.connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(f"SELECT COUNT(*) FROM ({self.sql}) AS _q")
+                return int(cur.fetchone()[0])
+            finally:
+                conn.close()
+        except Exception:
+            return None
+
+    def get_read_tasks(self, parallelism: int):
+        import pyarrow as pa
+
+        sql = self.sql
+        factory = self.connection_factory
+
+        def fetch(query: str):
+            conn = factory()
+            try:
+                cur = conn.cursor()  # DBAPI2: execute lives on the cursor
+                cur.execute(query)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            data = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+            return pa.table(data)
+
+        # LIMIT/OFFSET windows are only consistent when the scan order is
+        # stable: without ORDER BY, engines may return rows in a different
+        # order per execution and windows can overlap or drop rows
+        if "order by" not in sql.lower():
+            return [lambda: fetch(sql)]
+        total = self._count()
+        if not total or parallelism <= 1:
+            return [lambda: fetch(sql)]
+        parallelism = min(parallelism, total)
+        chunk = -(-total // parallelism)
+        tasks = []
+        for i in range(parallelism):
+            off = i * chunk
+            if off >= total:
+                break
+            q = f"SELECT * FROM ({sql}) AS _q LIMIT {chunk} OFFSET {off}"
+            tasks.append(lambda q=q: fetch(q))
+        return tasks

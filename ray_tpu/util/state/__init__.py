@@ -17,6 +17,7 @@ __all__ = [
     "list_jobs", "list_workers", "list_objects",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "get_node_stats", "profile_worker", "capture_jax_trace",
+    "list_cluster_events",
 ]
 
 
@@ -125,6 +126,44 @@ def list_objects(filters=None, limit: int = 1000) -> List[Dict]:
         if len(rows) >= limit:
             break
     return _apply_filters(rows, filters)[:limit]
+
+
+def list_cluster_events(severity: Optional[str] = None,
+                        label: Optional[str] = None,
+                        limit: int = 1000) -> List[Dict]:
+    """Structured cluster events — node deaths, actor failures, OOM kills,
+    autoscaler actions (reference: src/ray/util/event.h RAY_EVENT files
+    surfaced by the dashboard event module)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu._private.event import read_events
+
+    node = ray_tpu._global_node
+    session_dir = (node.session_dir if node is not None
+                   else os.environ.get("RAY_TPU_SESSION_DIR"))
+    out: List[Dict] = []
+    if session_dir:
+        out.extend(read_events(session_dir, severity=severity,
+                               label=label, limit=limit))
+    # aggregate remote nodes' events (their session dirs live on their
+    # machines); de-dup against the local read for shared-dir test setups
+    seen = {(e.get("component"), e.get("pid"), e.get("timestamp"))
+            for e in out}
+    for n in _each_alive_agent():
+        try:
+            remote = _call_agent(n["addr"], "ListEvents",
+                                 {"severity": severity, "label": label,
+                                  "limit": limit})
+        except Exception:
+            continue
+        for e in remote:
+            key = (e.get("component"), e.get("pid"), e.get("timestamp"))
+            if key not in seen:
+                seen.add(key)
+                out.append(e)
+    out.sort(key=lambda e: e.get("timestamp", 0.0))
+    return out[-limit:]
 
 
 def get_node_stats() -> List[Dict]:

@@ -37,6 +37,7 @@ FAST_FILES = {
     "test_state_api.py",
     "test_job_submission.py",
     "test_dashboard.py",
+    "test_events_sql.py",
 }
 SLOW_TESTS: set = set()
 

@@ -1,0 +1,94 @@
+"""Structured event log + SQL datasource (reference:
+src/ray/util/event.h:41 RAY_EVENT files + dashboard event module;
+python/ray/data read_sql read_api.py:1902; VERDICT r1 missing #8/#9)."""
+
+import sqlite3
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_startup_events_recorded(ray_cluster):
+    deadline = time.time() + 20
+    events = []
+    while time.time() < deadline:
+        events = state.list_cluster_events()
+        if any(e["label"] == "NODE_STARTED" for e in events):
+            break
+        time.sleep(0.5)
+    labels = {e["label"] for e in events}
+    assert "NODE_STARTED" in labels, labels
+    assert "HEAD_STARTED" in labels, labels
+    started = next(e for e in events if e["label"] == "NODE_STARTED")
+    assert started["severity"] == "INFO"
+    assert started["node_id"]
+    assert started["timestamp"] > 0
+
+
+def test_actor_failure_event_recorded(ray_cluster):
+    import os
+
+    @ray_tpu.remote
+    class Doomed:
+        def boom(self):
+            os._exit(1)
+
+        def ping(self):
+            return 1
+
+    d = Doomed.remote()
+    assert ray_tpu.get(d.ping.remote(), timeout=90) == 1
+    try:
+        ray_tpu.get(d.boom.remote(), timeout=30)
+    except Exception:
+        pass
+    deadline = time.time() + 30
+    failures = []
+    while time.time() < deadline:
+        failures = state.list_cluster_events(label="ACTOR_FAILURE")
+        if failures:
+            break
+        time.sleep(0.5)
+    assert failures, "actor failure never recorded"
+    assert failures[-1]["severity"] == "WARNING"
+
+    # severity filter
+    errors = state.list_cluster_events(severity="ERROR")
+    assert all(e["severity"] == "ERROR" for e in errors)
+
+
+def test_read_sql_roundtrip(ray_cluster, tmp_path):
+    import ray_tpu.data as rdata
+
+    db = str(tmp_path / "demo.sqlite")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE points (id INTEGER, value REAL)")
+    conn.executemany("INSERT INTO points VALUES (?, ?)",
+                     [(i, i * 0.5) for i in range(100)])
+    conn.commit()
+    conn.close()
+
+    ds = rdata.read_sql("SELECT * FROM points",
+                        lambda: sqlite3.connect(db), parallelism=4)
+    rows = ds.take_all()
+    assert len(rows) == 100
+    assert sorted(r["id"] for r in rows) == list(range(100))
+    assert rows[0]["value"] == rows[0]["id"] * 0.5
+
+    # pipeline composition on top of the SQL read
+    total = rdata.read_sql(
+        "SELECT * FROM points WHERE id < 10",
+        lambda: sqlite3.connect(db)).map(
+            lambda r: {"double": r["value"] * 2}).take_all()
+    assert len(total) == 10
