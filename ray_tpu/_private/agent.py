@@ -532,7 +532,9 @@ class NodeAgent:
                 return {"node_id": best[0], "addr": best[1]}
         return None
 
-    SPILL_LEDGER_TTL_S = 2.0
+    @property
+    def SPILL_LEDGER_TTL_S(self) -> float:
+        return CONFIG.spill_ledger_ttl_ms / 1000.0
 
     def _apply_recent_spills(self, node_id: str, nr: NodeResources) -> None:
         ledger = self._recent_spills.get(node_id)
@@ -868,7 +870,9 @@ class NodeAgent:
             # seal notifications are fire-and-forget and can be lost if the
             # sealing worker dies right after store.seal — the object is
             # still on disk, so the poll keeps waiters from hanging forever.
-            poll = 0.2 if wait_timeout is None else min(wait_timeout, 0.2)
+            poll_s = CONFIG.object_wait_poll_ms / 1000.0
+            poll = poll_s if wait_timeout is None \
+                else min(wait_timeout, poll_s)
             done, _ = await asyncio.wait(
                 pending, timeout=poll, return_when=asyncio.FIRST_COMPLETED
             )
@@ -884,7 +888,7 @@ class NodeAgent:
         object directory): ask the owner where the object lives, then fetch
         chunks from that node's agent, or the inline value from the owner."""
         try:
-            deadline = time.monotonic() + 600
+            deadline = time.monotonic() + CONFIG.object_pull_deadline_s
             dead_rounds = 0
             while time.monotonic() < deadline:
                 if self.store.contains(hex_id):
@@ -938,7 +942,7 @@ class NodeAgent:
                     # caller's whole get deadline (reference: pull_manager
                     # hands off to reconstruction on location death).
                     dead_rounds += 1
-                    if dead_rounds >= 5:
+                    if dead_rounds >= CONFIG.pull_dead_holder_rounds:
                         for fut in self._object_waits.pop(hex_id, []):
                             if not fut.done():
                                 fut.set_result(False)

@@ -26,6 +26,12 @@ _flag("scheduler_top_k_fraction", 0.2)
 _flag("max_pending_lease_requests_per_scheduling_category", 10)
 _flag("worker_lease_timeout_ms", 30_000)
 _flag("lease_pipeline_depth", 2)  # tasks in flight per leased worker
+_flag("lease_pipeline_depth_short_task", 16)  # when exec EMA < 2ms
+_flag("lease_pipeline_depth_medium_task", 4)  # when exec EMA < 10ms
+_flag("lease_idle_ttl_ms", 250)  # idle leased workers return after this
+_flag("lease_max_workers_per_pool", 256)
+_flag("lease_spillback_max_hops", 4)
+_flag("spill_ledger_ttl_ms", 2_000)  # in-flight spill accounting window
 _flag("actor_creation_timeout_ms", 120_000)
 
 # --- object store -----------------------------------------------------------
@@ -36,6 +42,9 @@ _flag("object_spilling_dir", "")  # "" = <session dir>/spill
 _flag("min_spilling_size_bytes", 1024 * 1024)
 _flag("object_chunk_size_bytes", 5 * 1024 * 1024)  # cross-node transfer chunking
 _flag("inline_object_max_size_bytes", 100 * 1024)  # small returns ride the RPC reply
+_flag("object_pull_deadline_s", 600)  # per-object pull budget
+_flag("pull_dead_holder_rounds", 5)  # conn-dead rounds before lost verdict
+_flag("object_wait_poll_ms", 200)  # store re-poll while awaiting seal
 
 # --- workers ----------------------------------------------------------------
 _flag("num_workers_soft_limit", 0)  # 0 = num_cpus
@@ -56,6 +65,10 @@ _flag("pubsub_poll_timeout_s", 30)
 _flag("kv_namespace_default", "default")
 _flag("metrics_report_interval_ms", 5_000)
 _flag("task_event_buffer_max", 100_000)
+_flag("task_event_flush_batch", 100)  # buffered transitions before a flush
+_flag("rpc_drain_threshold_bytes", 64 * 1024)  # write-combining flush point
+_flag("head_watchdog_period_s", 2.0)  # driver/worker head-liveness probes
+_flag("autoscaler_boot_timeout_s", 120.0)  # launched-node registration window
 
 # --- TPU --------------------------------------------------------------------
 _flag("tpu_chips_per_host_default", 4)
@@ -80,9 +93,11 @@ class _Config:
             raise AttributeError(name)
         if name not in _DEFS:
             raise AttributeError(f"unknown config flag: {name}")
-        env_key = f"RAY_TPU_{name}"
-        if env_key in os.environ:
-            return _coerce(os.environ[env_key], _DEFS[name])
+        # accept both RAY_TPU_FLAG_NAME (conventional) and the exact
+        # lowercase flag name
+        for env_key in (f"RAY_TPU_{name.upper()}", f"RAY_TPU_{name}"):
+            if env_key in os.environ:
+                return _coerce(os.environ[env_key], _DEFS[name])
         if name in self._overrides:
             return self._overrides[name]
         return _DEFS[name]

@@ -48,7 +48,14 @@ class ConnectionLost(RpcError):
 # Frames above this size await transport drain (flow control); smaller frames
 # ride the write-combining buffer without touching the socket until the next
 # loop tick, so replies/pushes issued in one scheduling burst become one send.
-_DRAIN_THRESHOLD = 64 * 1024
+def _drain_threshold() -> int:
+    # read per-use so head-broadcast cluster config applies
+    try:
+        from ray_tpu._private.config import CONFIG
+
+        return CONFIG.rpc_drain_threshold_bytes
+    except Exception:
+        return 64 * 1024
 
 
 class Connection:
@@ -101,9 +108,9 @@ class Connection:
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_out)
-        if (len(body) >= _DRAIN_THRESHOLD
-                or self._buffered >= 4 * _DRAIN_THRESHOLD
-                or self._transport_backlog(self.writer) >= 4 * _DRAIN_THRESHOLD):
+        if (len(body) >= _drain_threshold()
+                or self._buffered >= 4 * _drain_threshold()
+                or self._transport_backlog(self.writer) >= 4 * _drain_threshold()):
             # flush NOW so drain sees the bytes (a call_soon flush would run
             # after drain returned un-paused), then apply real backpressure.
             # The transport-backlog check catches slow peers accumulating
@@ -321,7 +328,7 @@ class AsyncRpcClient:
         try:
             body = pack({"m": method, "i": req_id, "p": payload})
             self._queue_frame(body)
-            if len(body) >= _DRAIN_THRESHOLD or self._buffered >= 4 * _DRAIN_THRESHOLD:
+            if len(body) >= _drain_threshold() or self._buffered >= 4 * _drain_threshold():
                 self._flush_out()
                 try:
                     await self._writer.drain()
@@ -340,10 +347,10 @@ class AsyncRpcClient:
     async def push(self, method: str, payload: Any) -> None:
         body = pack({"m": method, "i": 0, "p": payload})
         self._queue_frame(body)
-        if (len(body) >= _DRAIN_THRESHOLD
-                or self._buffered >= 4 * _DRAIN_THRESHOLD
+        if (len(body) >= _drain_threshold()
+                or self._buffered >= 4 * _drain_threshold()
                 or Connection._transport_backlog(self._writer)
-                >= 4 * _DRAIN_THRESHOLD):
+                >= 4 * _drain_threshold()):
             self._flush_out()
             try:
                 await self._writer.drain()

@@ -392,7 +392,7 @@ class Worker:
                 break
             await asyncio.sleep(0.1)
         while self.connected:
-            await asyncio.sleep(2.0)
+            await asyncio.sleep(CONFIG.head_watchdog_period_s)
             # periodic task-event flush: observers (state API, dashboard)
             # must see this process's transitions without it having to
             # query (reference: TaskEventBuffer's periodic GCS flush,
@@ -1140,7 +1140,7 @@ class Worker:
                 "node_id": self.node_id,
             }
         )
-        if len(self.task_events) >= 100:
+        if len(self.task_events) >= CONFIG.task_event_flush_batch:
             self.flush_task_events()
 
     def flush_task_events(self) -> None:
@@ -1415,13 +1415,22 @@ class _LeasePool:
     direct_task_transport.h SchedulingKey entry): grab workers from agents,
     pipeline tasks onto idle leased workers, return leases after idle TTL."""
 
-    IDLE_TTL = 0.25
-    MAX_WORKERS = 256
+    # read per-use so head-broadcast cluster config applies (registration
+    # runs after module import)
+    @property
+    def IDLE_TTL(self) -> float:
+        return CONFIG.lease_idle_ttl_ms / 1000.0
+
+    @property
+    def MAX_WORKERS(self) -> int:
+        return CONFIG.lease_max_workers_per_pool
     # Pipelining: tasks committed to a busy worker cannot be stolen back, so
     # depth >1 can strand a short task behind a long one — but it overlaps
     # RPC transport with execution (reference pipelines the same way in
     # direct_task_transport.h). Configurable via lease_pipeline_depth.
-    PIPELINE_DEPTH = CONFIG.lease_pipeline_depth
+    @property
+    def PIPELINE_DEPTH(self) -> int:
+        return CONFIG.lease_pipeline_depth
 
     def __init__(self, worker: Worker, key, spec: TaskSpec):
         self.worker = worker
@@ -1454,9 +1463,11 @@ class _LeasePool:
             # one completion before pipelining
             return 1
         if e < 2.0:
-            return max(self.PIPELINE_DEPTH, 16)
+            return max(self.PIPELINE_DEPTH,
+                       CONFIG.lease_pipeline_depth_short_task)
         if e < 10.0:
-            return max(self.PIPELINE_DEPTH, 4)
+            return max(self.PIPELINE_DEPTH,
+                       CONFIG.lease_pipeline_depth_medium_task)
         return self.PIPELINE_DEPTH
 
     def _conn_depth(self, conn: WorkerConn, now: float, depth: int) -> int:
@@ -1554,7 +1565,8 @@ class _LeasePool:
             else:
                 reply = await w.agent.call("RequestWorkerLease", payload)
             hops = 0
-            while reply and reply.get("spillback") and hops < 4:
+            while reply and reply.get("spillback") and \
+                    hops < CONFIG.lease_spillback_max_hops:
                 hops += 1
                 target = reply["spillback"]
                 agent_addr = target["addr"]

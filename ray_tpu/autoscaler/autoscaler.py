@@ -56,7 +56,11 @@ class StandardAutoscaler:
         self.num_launches = 0
         self.num_terminations = 0
 
-    BOOT_TIMEOUT_S = 120.0
+    @property
+    def BOOT_TIMEOUT_S(self) -> float:
+        from ray_tpu._private.config import CONFIG
+
+        return CONFIG.autoscaler_boot_timeout_s
 
     # ------------------------------------------------------------- helpers
     def _type_counts(self) -> Dict[str, int]:

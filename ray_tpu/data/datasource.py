@@ -400,6 +400,12 @@ class SQLDatasource(Datasource):
         # stable: without ORDER BY, engines may return rows in a different
         # order per execution and windows can overlap or drop rows
         if "order by" not in sql.lower():
+            if parallelism > 1:
+                import logging
+
+                logging.getLogger("ray_tpu.data").info(
+                    "read_sql: query has no ORDER BY; reading as one task "
+                    "(windowed parallelism needs a stable order)")
             return [lambda: fetch(sql)]
         total = self._count()
         if not total or parallelism <= 1:
@@ -411,6 +417,8 @@ class SQLDatasource(Datasource):
             off = i * chunk
             if off >= total:
                 break
-            q = f"SELECT * FROM ({sql}) AS _q LIMIT {chunk} OFFSET {off}"
+            # append directly: a subquery's ORDER BY need not propagate to
+            # the outer SELECT, which would defeat the stable-window premise
+            q = f"{sql} LIMIT {chunk} OFFSET {off}"
             tasks.append(lambda q=q: fetch(q))
         return tasks

@@ -12,11 +12,8 @@ from ray_tpu.util import state
 
 
 @pytest.fixture(scope="module")
-def ray_cluster():
-    if not ray_tpu.is_initialized():
-        ray_tpu.init(num_cpus=4)
+def ray_cluster(ray_start_regular):
     yield
-    ray_tpu.shutdown()
 
 
 def test_startup_events_recorded(ray_cluster):
@@ -79,12 +76,21 @@ def test_read_sql_roundtrip(ray_cluster, tmp_path):
     conn.commit()
     conn.close()
 
-    ds = rdata.read_sql("SELECT * FROM points",
+    # ordered query -> windowed parallel read tasks
+    src = rdata._ds.SQLDatasource("SELECT * FROM points ORDER BY id",
+                                  lambda: sqlite3.connect(db))
+    assert len(src.get_read_tasks(4)) == 4  # windowing actually engaged
+    ds = rdata.read_sql("SELECT * FROM points ORDER BY id",
                         lambda: sqlite3.connect(db), parallelism=4)
     rows = ds.take_all()
     assert len(rows) == 100
     assert sorted(r["id"] for r in rows) == list(range(100))
     assert rows[0]["value"] == rows[0]["id"] * 0.5
+
+    # unordered query: falls back to one task (stability guard)
+    src1 = rdata._ds.SQLDatasource("SELECT * FROM points",
+                                   lambda: sqlite3.connect(db))
+    assert len(src1.get_read_tasks(4)) == 1
 
     # pipeline composition on top of the SQL read
     total = rdata.read_sql(
