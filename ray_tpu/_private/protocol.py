@@ -48,14 +48,22 @@ class ConnectionLost(RpcError):
 # Frames above this size await transport drain (flow control); smaller frames
 # ride the write-combining buffer without touching the socket until the next
 # loop tick, so replies/pushes issued in one scheduling burst become one send.
-def _drain_threshold() -> int:
-    # read per-use so head-broadcast cluster config applies
-    try:
-        from ray_tpu._private.config import CONFIG
+_drain_cache = [0.0, 64 * 1024]  # (last refresh, value)
 
-        return CONFIG.rpc_drain_threshold_bytes
-    except Exception:
-        return 64 * 1024
+
+def _drain_threshold() -> int:
+    # cached with a 1s refresh: cheap on the per-frame hot path, while
+    # head-broadcast config (applied at registration) still lands quickly
+    now = time.monotonic()
+    if now - _drain_cache[0] > 1.0:
+        try:
+            from ray_tpu._private.config import CONFIG
+
+            _drain_cache[1] = CONFIG.rpc_drain_threshold_bytes
+        except Exception:
+            pass
+        _drain_cache[0] = now
+    return _drain_cache[1]
 
 
 class Connection:

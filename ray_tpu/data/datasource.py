@@ -363,7 +363,7 @@ class SQLDatasource(Datasource):
     name = "SQL"
 
     def __init__(self, sql: str, connection_factory):
-        self.sql = sql
+        self.sql = sql.strip().rstrip(";")
         self.connection_factory = connection_factory
 
     def _count(self) -> Optional[int]:
@@ -399,7 +399,8 @@ class SQLDatasource(Datasource):
         # LIMIT/OFFSET windows are only consistent when the scan order is
         # stable: without ORDER BY, engines may return rows in a different
         # order per execution and windows can overlap or drop rows
-        if "order by" not in sql.lower():
+        lowered = sql.lower()
+        if "order by" not in lowered or " limit " in f" {lowered} ":
             if parallelism > 1:
                 import logging
 
