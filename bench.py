@@ -29,15 +29,26 @@ def _tpu_configs():
     from ray_tpu.models.llama import LlamaConfig
 
     ladder = [
+        # Llama-2-7B geometry, frozen-base + LoRA (the north-star workload:
+        # BASELINE.md "Llama-2-7B fine-tune"; reference gates releases on LLM
+        # fine-tunes, release/air_examples/gptj_deepspeed_finetuning). Base
+        # in bf16 (13.5 GiB of 16) — only the adapters carry grads/opt state,
+        # which is what makes 7B fit one v5e chip at all. Chunked lm-head CE
+        # keeps peak logits memory at B*256*V.
+        ("lora", LlamaConfig(
+            vocab_size=32000, hidden=4096, mlp_hidden=11008, num_layers=32,
+            num_heads=32, num_kv_heads=32, head_dim=128, max_seq_len=2048,
+            remat=True, param_dtype=jnp.bfloat16, loss_chunk=256,
+            attn_impl="auto"), 1, 2048, 8),
         # ~1.005B: Llama-2-7B geometry at half width/depth, head_dim 128.
         # Sized to v5e HBM: fp32 params + adafactor factored stats + fp32
         # grads peak at ~15.2 of 15.75 GiB (18 layers exceeds it by 16 MiB).
-        (LlamaConfig(
+        ("full", LlamaConfig(
             vocab_size=32000, hidden=2048, mlp_hidden=5632, num_layers=17,
             num_heads=16, num_kv_heads=16, head_dim=128, max_seq_len=2048,
             remat=True, attn_impl="auto"), 4, 2048, 8),
         # ~271M fallback (round-1 headline config).
-        (LlamaConfig(
+        ("full", LlamaConfig(
             vocab_size=32000, hidden=1024, mlp_hidden=2816, num_layers=16,
             num_heads=8, num_kv_heads=8, head_dim=128, max_seq_len=2048,
             remat=True, attn_impl="auto"), 8, 2048, 10),
@@ -45,40 +56,63 @@ def _tpu_configs():
     return ladder
 
 
-def _run_one(cfg, batch, seq, steps, platform):
+def _time_steps(step, state, b, steps):
+    state, m = step(state, b)          # compile
+    float(m["loss"])  # D2H sync (block_until_ready is a no-op on the
+    t0 = time.perf_counter()  # axon remote platform)
+    for _ in range(steps):
+        state, m = step(state, b)
+    float(m["loss"])
+    return time.perf_counter() - t0
+
+
+def _run_one(kind, cfg, batch, seq, steps, platform):
     import optax
 
     from ray_tpu.models.llama import (
-        init_llama, llama_loss, llama_logical_axes)
+        LoraConfig, init_llama, init_lora, llama_logical_axes, llama_loss,
+        llama_lora_loss, lora_logical_axes)
     from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.parallel.sharding import param_shardings
     from ray_tpu.parallel.train_step import (
         create_train_state, make_train_step)
 
     mesh = create_mesh(MeshConfig(data=-1), devices=jax.devices()[:1])
-    # adafactor (factored second moment, the T5X/PaLM TPU standard): adam's
-    # fp32 mu+nu alone would put the 1B config past the 16 GiB HBM ceiling
-    tx = optax.adafactor(1e-3)
-    with jax.set_mesh(mesh):
-        state, shardings = create_train_state(
-            lambda k: init_llama(cfg, k), tx, mesh, llama_logical_axes(cfg))
-        step = make_train_step(
-            lambda p, b: llama_loss(p, b, cfg), tx, mesh, shardings,
-            batch_logical_axes=("batch", "seq"))
-        rng = np.random.default_rng(0)
-        tok = rng.integers(0, cfg.vocab_size, (batch, seq + 1),
-                           dtype=np.int32)
-        b = {"inputs": tok[:, :-1], "targets": tok[:, 1:]}
-        state, m = step(state, b)          # compile
-        float(m["loss"])  # D2H sync (block_until_ready is a no-op on the
-        t0 = time.perf_counter()  # axon remote platform)
-        for _ in range(steps):
-            state, m = step(state, b)
-        float(m["loss"])
-        dt = time.perf_counter() - t0
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
+    b = {"inputs": tok[:, :-1], "targets": tok[:, 1:]}
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step * steps / dt
-    flops_tok = cfg.flops_per_token(seq)
+    if kind == "lora":
+        lcfg = LoraConfig(rank=16)
+        tx = optax.adamw(1e-4)
+        with jax.set_mesh(mesh):
+            base = jax.jit(
+                lambda k: init_llama(cfg, k),
+                out_shardings=param_shardings(llama_logical_axes(cfg), mesh),
+            )(jax.random.key(0))
+            state, shardings = create_train_state(
+                lambda k: init_lora(cfg, lcfg, k), tx, mesh,
+                lora_logical_axes(cfg, lcfg), seed=1)
+            step = make_train_step(
+                lambda lo, bb: llama_lora_loss(base, lo, bb, cfg, lcfg),
+                tx, mesh, shardings, batch_logical_axes=("batch", "seq"))
+            dt = _time_steps(step, state, b, steps)
+        flops_tok = cfg.flops_per_token_frozen(lcfg.num_params(cfg), seq)
+    else:
+        # adafactor (factored second moment, the T5X/PaLM TPU standard):
+        # adam's fp32 mu+nu alone would put the 1B config past 16 GiB HBM
+        tx = optax.adafactor(1e-3)
+        with jax.set_mesh(mesh):
+            state, shardings = create_train_state(
+                lambda k: init_llama(cfg, k), tx, mesh,
+                llama_logical_axes(cfg))
+            step = make_train_step(
+                lambda p, bb: llama_loss(p, bb, cfg), tx, mesh, shardings,
+                batch_logical_axes=("batch", "seq"))
+            dt = _time_steps(step, state, b, steps)
+        flops_tok = cfg.flops_per_token(seq)
+
+    tok_s = batch * seq * steps / dt
     mfu = tok_s * flops_tok / PEAK_FLOPS.get(platform, 1e12)
     return tok_s, mfu
 
@@ -90,12 +124,12 @@ def main() -> None:
     if platform == "tpu":
         ladder = _tpu_configs()
     else:
-        ladder = [(LlamaConfig.tiny(), 8, 128, 3)]
+        ladder = [("full", LlamaConfig.tiny(), 8, 128, 3)]
 
     last_err = None
-    for cfg, batch, seq, steps in ladder:
+    for kind, cfg, batch, seq, steps in ladder:
         try:
-            tok_s, mfu = _run_one(cfg, batch, seq, steps, platform)
+            tok_s, mfu = _run_one(kind, cfg, batch, seq, steps, platform)
         except Exception as e:  # OOM on smaller chips: walk down the ladder
             oom = any(t in str(e) for t in
                       ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory"))
@@ -112,10 +146,11 @@ def main() -> None:
                 gc.collect()
                 continue
             raise
+        tag = "lora ft, " if kind == "lora" else ""
         print(json.dumps({
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": round(tok_s, 1),
-            "unit": f"tokens/s ({cfg.num_params()/1e6:.0f}M params, "
+            "unit": f"tokens/s ({cfg.num_params()/1e6:.0f}M params, {tag}"
                     f"{platform}, mfu={mfu:.3f})",
             "vs_baseline": round(mfu / 0.40, 3),
         }))

@@ -254,7 +254,15 @@ class NodeAgent:
         """Survive a head restart (reference: GCS fault tolerance —
         NotifyGCSRestart + raylet resubscribe, node_manager.proto:364):
         ping the head; on failure reconnect with backoff and re-register
-        under the same node_id so leases/actors on this node carry over."""
+        under the same node_id so leases/actors on this node carry over.
+
+        If the head stays gone past ``agent_head_gone_exit_s``, the agent
+        shuts itself (and its workers) down: an unreachable head means the
+        cluster is dead, and immortal orphaned agents accumulate into a
+        box-wide CPU leak (observed: a killed test run left 40+ agents
+        idling at ~1%% CPU each — reference parity: raylets exit when the
+        GCS declares them dead, node_manager.cc HandleUnexpectedDisconnect)."""
+        give_up_s = float(CONFIG.agent_head_gone_exit_s)
         while True:
             await asyncio.sleep(2.0)
             try:
@@ -264,6 +272,7 @@ class NodeAgent:
             except Exception:
                 pass
             delay = 0.2
+            down_since = time.monotonic()
             while True:
                 try:
                     self.head.close()
@@ -275,6 +284,13 @@ class NodeAgent:
                     await self._connect_head()
                     break
                 except Exception:
+                    if time.monotonic() - down_since > give_up_s:
+                        for w in list(self.workers.values()):
+                            try:
+                                w.proc.terminate()
+                            except Exception:
+                                pass
+                        os._exit(1)
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 2.0)
 
@@ -333,28 +349,59 @@ class NodeAgent:
                 last_sent = None
 
     # ---------------------------------------------------------- worker pool
-    def _spawn_worker(self, actor_spec: Optional[Dict] = None) -> WorkerHandle:
+    def _spawn_worker(self, actor_spec: Optional[Dict] = None,
+                      container: Optional[Dict] = None,
+                      env_key: Optional[str] = None) -> WorkerHandle:
         worker_id = os.urandom(16).hex()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.out"), "ab")
         err = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.err"), "ab")
-        env = dict(os.environ)
-        env.update(
-            {
-                "RAY_TPU_WORKER_ID": worker_id,
-                "RAY_TPU_AGENT_SOCK": self.unix_path,
-                "RAY_TPU_NODE_ID": self.node_id,
-                "RAY_TPU_SESSION_DIR": self.session_dir,
-                "RAY_TPU_STORE_DIR": self.store_dir,
-                "RAY_TPU_HEAD_ADDR": f"{self.head_host}:{self.head_port}",
-            }
-        )
-        # Workers must not grab the TPU runtime by default; tasks that request
-        # TPU resources get chip visibility through their lease's instance ids.
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        ray_env = {
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_AGENT_SOCK": self.unix_path,
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            "RAY_TPU_STORE_DIR": self.store_dir,
+            "RAY_TPU_HEAD_ADDR": f"{self.head_host}:{self.head_port}",
+        }
+        if container:
+            # container runtime_env: the worker process starts INSIDE
+            # podman/docker with the session dir (unix socket), object
+            # store, and the ray_tpu package bind-mounted (reference:
+            # _private/runtime_env/container.py prepending `podman run`)
+            from ray_tpu.runtime_env.container import (
+                worker_container_command)
+
+            # same guard as the host path below: the axon bootstrap does
+            # not exist inside the image, so an inherited axon platform
+            # would break jax there
+            platforms = os.environ.get("JAX_PLATFORMS", "cpu")
+            ray_env["JAX_PLATFORMS"] = \
+                "cpu" if platforms == "axon" else platforms
+            cmd = worker_container_command(
+                container, self.session_dir, self.store_dir, ray_env)
+            env = dict(os.environ)
+        else:
+            cmd = [sys.executable, "-m", "ray_tpu._private.worker_process"]
+            env = dict(os.environ)
+            env.update(ray_env)
+            # Workers must not grab the TPU runtime by default; tasks that
+            # request TPU resources get chip visibility through their
+            # lease's instance ids.
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            # the axon dev-tunnel bootstrap (sitecustomize) would register
+            # a PJRT client in EVERY worker at interpreter start — seconds
+            # of jax init per process, and the tunnel's single chip belongs
+            # to the driver. Real TPU hosts expose /dev/accel and never set
+            # this; dropping it here costs nothing there. With the axon
+            # backend unregistered, an inherited JAX_PLATFORMS=axon would
+            # break jax in the worker — force cpu alongside.
+            if env.pop("PALLAS_AXON_POOL_IPS", None) is not None \
+                    and env.get("JAX_PLATFORMS") == "axon":
+                env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_process"],
+            cmd,
             env=env,
             stdout=out,
             stderr=err,
@@ -363,6 +410,9 @@ class NodeAgent:
         out.close()
         err.close()
         handle = WorkerHandle(worker_id, proc)
+        # containerized workers are never pristine: pre-tag them so only
+        # leases with the same runtime_env can claim them
+        handle.env_key = env_key
         self.workers[worker_id] = handle
         self._starting_workers += 1
         return handle
@@ -600,12 +650,18 @@ class NodeAgent:
         elif not request.fits(self.resources.available):
             return False
         env_key = req["p"].get("env_key")
-        worker = self._pop_idle_worker(env_key)
+        container = req["p"].get("container")
+        # container envs apply at SPAWN (the process must start inside the
+        # image), so a pristine host worker can never serve them: match only
+        # workers already tagged with this env_key
+        worker = self._pop_idle_worker(env_key, tagged_only=bool(container))
         if worker is None:
             if len(self.workers) + self._starting_workers < self.max_workers + 8:
-                self._spawn_worker()
+                self._spawn_worker(container=container,
+                                   env_key=env_key if container else None)
             elif self._evict_mismatched_idle():
-                self._spawn_worker()
+                self._spawn_worker(container=container,
+                                   env_key=env_key if container else None)
             return False
         # allocate resources
         assigned_instances: Dict[str, list] = {}
@@ -645,13 +701,17 @@ class NodeAgent:
             self.idle_workers.append(worker)
         return True
 
-    def _pop_idle_worker(self, env_key: Optional[str] = None
+    def _pop_idle_worker(self, env_key: Optional[str] = None,
+                         tagged_only: bool = False
                          ) -> Optional[WorkerHandle]:
         # prune dead workers, then prefer an env-matching worker, falling
-        # back to a pristine one (tagged by the caller on grant)
+        # back to a pristine one (tagged by the caller on grant).
+        # tagged_only: spawn-time envs (container) can never ride a
+        # pristine host worker — exact tag match or nothing.
         self.idle_workers = [w for w in self.idle_workers
                              if w.alive and w.registered.is_set()]
-        for tier in (env_key, None):
+        tiers = (env_key,) if tagged_only else (env_key, None)
+        for tier in tiers:
             for i in range(len(self.idle_workers) - 1, -1, -1):
                 if self.idle_workers[i].env_key == tier:
                     return self.idle_workers.pop(i)

@@ -68,6 +68,7 @@ _flag("task_event_buffer_max", 100_000)
 _flag("task_event_flush_batch", 100)  # buffered transitions before a flush
 _flag("rpc_drain_threshold_bytes", 64 * 1024)  # write-combining flush point
 _flag("head_watchdog_period_s", 2.0)  # driver/worker head-liveness probes
+_flag("agent_head_gone_exit_s", 120.0)  # agent suicide after head unreachable
 _flag("autoscaler_boot_timeout_s", 120.0)  # launched-node registration window
 
 # --- TPU --------------------------------------------------------------------

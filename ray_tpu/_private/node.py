@@ -91,6 +91,20 @@ class Node:
             self._start_head()
         self._start_agent()
 
+    @staticmethod
+    def _subprocess_env() -> dict:
+        """Control-plane processes (head/agent) never touch jax: drop the
+        axon dev-tunnel bootstrap so their interpreters skip the
+        per-process PJRT registration the image's sitecustomize runs
+        (seconds of init each; the tunneled chip belongs to the driver)."""
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        # the backend the dropped bootstrap would have registered
+        if env.get("JAX_PLATFORMS") == "axon":
+            env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
     def _start_head(self) -> None:
         log = open(os.path.join(self.session_dir, "logs", "head.log"), "ab")
         self.head_proc = subprocess.Popen(
@@ -99,6 +113,7 @@ class Node:
                 "--session-dir", self.session_dir,
                 "--port", str(self.head_port),
             ],
+            env=self._subprocess_env(),
             stdout=log,
             stderr=log,
             start_new_session=True,
@@ -142,6 +157,7 @@ class Node:
                 "--object-store-memory", str(self.object_store_memory or 0),
                 "--ready-file", ready_file,
             ],
+            env=self._subprocess_env(),
             stdout=log,
             stderr=log,
             start_new_session=True,

@@ -1444,6 +1444,10 @@ class _LeasePool:
         # agents only hand this lease workers whose applied runtime_env
         # matches (or pristine ones) — see agent._pop_idle_worker
         self.env_key = runtime_env_key(spec.runtime_env)
+        # container envs are applied by the AGENT at worker spawn (the
+        # process must start inside the image), so the spec rides the
+        # lease request (runtime_env/container.py ContainerPlugin)
+        self.container = (spec.runtime_env or {}).get("container")
         self.retriable = spec.max_retries > 0
         self.pending: deque = deque()
         self.conns: List[WorkerConn] = []
@@ -1554,6 +1558,7 @@ class _LeasePool:
                 "pg": self.pg,
                 "owner": w.worker_id.hex(),
                 "env_key": self.env_key,
+                "container": self.container,
                 "retriable": self.retriable,
             }
             agent_addr = None

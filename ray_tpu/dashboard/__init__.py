@@ -85,8 +85,21 @@ class DashboardActor:
         path = split.path
         query = dict(urllib.parse.parse_qsl(split.query))
         try:
+            if path in ("/", "/index.html"):
+                from ray_tpu.dashboard.web import INDEX_HTML
+
+                return ("200 OK", INDEX_HTML.encode(),
+                        "text/html; charset=utf-8")
             if path == "/healthz":
                 return "200 OK", b"success", "text/plain"
+            if path == "/grafana/dashboards":
+                from ray_tpu.dashboard.grafana import (
+                    generate_core_dashboard, generate_tpu_dashboard)
+
+                return ("200 OK", json.dumps({
+                    "dashboards": [generate_core_dashboard(),
+                                   generate_tpu_dashboard()]}).encode(),
+                    "application/json")
             if path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
 

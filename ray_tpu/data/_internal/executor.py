@@ -55,19 +55,14 @@ class StreamingExecutor:
     """Drives a Topology on a daemon thread; final bundles land in a bounded
     queue consumed by ``iter_bundles``."""
 
-    OUTPUT_BUFFER = 16
-    # max bundles buffered between an operator and its consumer; bounds
-    # intermediate queues so a slow middle stage throttles upstream reads
-    # (reference: backpressure_policy/ + under_resource_limits)
-    PER_OP_BUFFER = 32
     POLL_INTERVAL = 0.003
 
     def __init__(self, topology: Topology, stats: Optional[ExecutorStats] = None):
         from ray_tpu.data.context import DataContext
+        from ray_tpu.data._internal.backpressure import (
+            DEFAULT_BACKPRESSURE_POLICIES, ResourceManager)
 
         ctx = DataContext.get_current()
-        self.OUTPUT_BUFFER = ctx.output_buffer
-        self.PER_OP_BUFFER = ctx.per_op_buffer
         self.topology = topology
         self.out: "queue.Queue[Optional[RefBundle]]" = queue.Queue()
         self.error: Optional[BaseException] = None
@@ -75,6 +70,12 @@ class StreamingExecutor:
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="raytpu-data-exec")
+        self.resource_manager = ResourceManager(
+            topology, ctx.execution_memory_limit)
+        policy_classes = (ctx.backpressure_policies
+                          if ctx.backpressure_policies is not None
+                          else DEFAULT_BACKPRESSURE_POLICIES)
+        self.policies = [cls(topology, self) for cls in policy_classes]
 
     def start(self) -> "StreamingExecutor":
         self._thread.start()
@@ -137,28 +138,17 @@ class StreamingExecutor:
                             target._left_done = True
                     else:
                         target.inputs_complete = True
-        # 2. backpressure: hold dispatch when the consumer lags.
-        if self.out.qsize() >= self.OUTPUT_BUFFER:
-            return progressed
-        # 3. dispatch — most-downstream runnable op first, so the pipeline
-        #    drains toward the consumer (reference: select_operator_to_run
-        #    prefers ops with less queued output).
+        # 2. dispatch under the backpressure-policy chain — most-downstream
+        #    runnable op first, so the pipeline drains toward the consumer
+        #    (reference: select_operator_to_run prefers ops with less queued
+        #    output; the policy chain replaces the old hardcoded caps).
         for i in reversed(range(len(ops))):
             op = ops[i]
             while op.can_dispatch() and \
-                    self._downstream_backlog(i) < self.PER_OP_BUFFER:
+                    all(p.can_dispatch(i) for p in self.policies):
                 op.dispatch()
                 progressed = True
-                if self.out.qsize() >= self.OUTPUT_BUFFER:
-                    return True
         return progressed
-
-    def _downstream_backlog(self, i: int) -> int:
-        op = self.topology.ops[i]
-        backlog = len(op.output_queue)
-        for dst, _ in self.topology.edges.get(i, []):
-            backlog += len(self.topology.ops[dst].input_queue)
-        return backlog
 
     def _all_done(self) -> bool:
         return all(op.completed() for op in self.topology.ops) and not any(

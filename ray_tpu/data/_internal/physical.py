@@ -197,7 +197,10 @@ class TaskPoolMapOperator(PhysicalOperator):
         return len(self._inflight)
 
     def can_dispatch(self) -> bool:
-        return bool(self.input_queue) and len(self._inflight) < self.max_concurrency
+        # the concurrency cap lives in ConcurrencyCapBackpressurePolicy
+        # (data/_internal/backpressure.py) — ONE source of truth, so
+        # replacing the policy chain actually changes the rule
+        return bool(self.input_queue)
 
     def dispatch(self) -> None:
         item = self.input_queue.popleft()

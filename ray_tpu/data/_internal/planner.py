@@ -76,7 +76,13 @@ def _fuse(ops: List[L.LogicalOperator]) -> List[L.LogicalOperator]:
 
 
 # --------------------------------------------------------------- planner
-def plan(ops: List[L.LogicalOperator], max_concurrency: int = 8) -> Topology:
+def plan(ops: List[L.LogicalOperator],
+         max_concurrency: Optional[int] = None) -> Topology:
+    if max_concurrency is None:
+        from ray_tpu.data.context import DataContext
+
+        max_concurrency = DataContext.get_current() \
+            .max_tasks_in_flight_per_op
     topo = Topology()
     last = _plan_chain(ops, topo, max_concurrency)
     if last is None:

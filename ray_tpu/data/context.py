@@ -24,6 +24,13 @@ class DataContext:
     max_tasks_in_flight_per_op: int = 8
     per_op_buffer: int = 32
     output_buffer: int = 16
+    # bytes of queued block payload the pipeline may hold before dispatch
+    # is restricted to the most-downstream op (0 = unlimited); enforced by
+    # ResourceBudgetBackpressurePolicy via the ResourceManager
+    execution_memory_limit: int = 0
+    # policy classes consulted on every dispatch (None = defaults:
+    # concurrency cap, streaming output buffer, resource budget)
+    backpressure_policies: Optional[list] = None
 
     _lock: ClassVar[threading.Lock] = threading.Lock()
     _current: ClassVar[Optional["DataContext"]] = None

@@ -61,6 +61,9 @@ class LlamaConfig:
     remat: bool = True             # checkpoint each layer (HBM↔FLOPs trade)
     remat_policy: str = "dots"     # dots (save matmuls) | full (recompute all)
     attn_impl: str = "auto"        # auto | flash | reference | ring_seq
+    loss_chunk: int = 0            # >0: lm-head CE in seq chunks of this size
+    #   (peak logits memory B*chunk*V instead of B*S*V; the backward
+    #    recomputes each chunk's logits under jax.checkpoint)
 
     @staticmethod
     def llama2_7b() -> "LlamaConfig":
@@ -87,6 +90,19 @@ class LlamaConfig:
                       * self.head_dim * seq_len)
         return flops
 
+    def flops_per_token_frozen(self, trainable_params: int,
+                               seq_len: Optional[int] = None) -> float:
+        """Frozen-base (LoRA) fwd+bwd FLOPs/token: the backward still
+        propagates activation grads through every frozen layer (2N) but
+        forms weight grads only for the adapters — 4N_base + 6N_adapters.
+        Attention's quadratic term keeps its full factor (dQ/dK/dV are
+        activation grads)."""
+        flops = 4.0 * self.num_params() + 6.0 * trainable_params
+        if seq_len is not None:
+            flops += (12.0 * self.num_layers * self.num_heads
+                      * self.head_dim * seq_len)
+        return flops
+
     def num_params(self) -> int:
         h, m, v = self.hidden, self.mlp_hidden, self.vocab_size
         qkv = h * (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
@@ -94,6 +110,107 @@ class LlamaConfig:
         mlp = 3 * h * m
         per_layer = qkv + o + mlp + 2 * h
         return self.num_layers * per_layer + 2 * v * h + h
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Low-rank adaptation of the projection weights (frozen base).
+
+    The reference fine-tunes LLMs by wrapping HF models with peft
+    (reference: release/air_examples/gptj_deepspeed_finetuning,
+    release/release_tests.yaml LLM fine-tune gates); here LoRA is native:
+    adapters are a separate pytree, the base never enters the optimizer, and
+    the deltas are applied activation-side (two thin matmuls per projection —
+    never materializing the full-rank update, so remat recompute stays cheap).
+    """
+    rank: int = 16
+    alpha: float = 32.0
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo",
+                                "w_gate", "w_up", "w_down")
+    param_dtype: Any = jnp.float32
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    def num_params(self, cfg: LlamaConfig) -> int:
+        h, m, r = cfg.hidden, cfg.mlp_hidden, self.rank
+        nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        per = {"wq": h * r + r * nh * hd, "wk": h * r + r * nkv * hd,
+               "wv": h * r + r * nkv * hd, "wo": nh * hd * r + r * h,
+               "w_gate": h * r + r * m, "w_up": h * r + r * m,
+               "w_down": m * r + r * h}
+        return cfg.num_layers * sum(per[t] for t in self.targets)
+
+
+# (in_axes of A, out_axes of B) per adaptable projection; the A/B shapes are
+# in_axes+(rank,) and (rank,)+out_axes with a leading num_layers dim.
+_LORA_SHAPES = {
+    "wq": (("embed",), ("heads", "head_dim")),
+    "wk": (("embed",), ("kv_heads", "head_dim")),
+    "wv": (("embed",), ("kv_heads", "head_dim")),
+    "wo": (("heads", "head_dim"), ("embed",)),
+    "w_gate": (("embed",), ("mlp",)),
+    "w_up": (("embed",), ("mlp",)),
+    "w_down": (("mlp",), ("embed",)),
+}
+
+
+def _lora_dims(cfg: LlamaConfig):
+    return {"embed": (cfg.hidden,), "mlp": (cfg.mlp_hidden,),
+            "heads": (cfg.num_heads,), "kv_heads": (cfg.num_kv_heads,),
+            "head_dim": (cfg.head_dim,)}
+
+
+def init_lora(cfg: LlamaConfig, lcfg: LoraConfig, key: jax.Array) -> Dict:
+    """A ~ truncated-normal fan-in, B = 0 (the adapted model starts exactly
+    at the base), stacked over layers for the scanned body."""
+    dims = _lora_dims(cfg)
+    L, r = cfg.num_layers, lcfg.rank
+    out = {}
+    keys = jax.random.split(key, len(lcfg.targets))
+    for k, name in zip(keys, lcfg.targets):
+        in_ax, out_ax = _LORA_SHAPES[name]
+        in_shape = sum((dims[a] for a in in_ax), ())
+        out_shape = sum((dims[a] for a in out_ax), ())
+        fan_in = 1
+        for d in in_shape:
+            fan_in *= d
+        a = (jax.random.truncated_normal(
+            k, -2, 2, (L,) + in_shape + (r,), jnp.float32)
+            * fan_in ** -0.5).astype(lcfg.param_dtype)
+        b = jnp.zeros((L, r) + out_shape, lcfg.param_dtype)
+        out[name] = {"a": a, "b": b}
+    return {"layers": out}
+
+
+def lora_logical_axes(cfg: LlamaConfig, lcfg: LoraConfig) -> Dict:
+    """Rank dim stays unsharded (it is tiny); in/out dims shard like the
+    base weight they adapt so the activation-side matmuls need no extra
+    resharding."""
+    out = {}
+    for name in lcfg.targets:
+        in_ax, out_ax = _LORA_SHAPES[name]
+        out[name] = {"a": (None,) + in_ax + (None,),
+                     "b": (None, None) + out_ax}
+    return {"layers": out}
+
+
+def merge_lora(params: Dict, lora: Dict, cfg: LlamaConfig,
+               lcfg: LoraConfig) -> Dict:
+    """Fold adapters into the base weights (for serving/export)."""
+    merged = dict(params)
+    layers = dict(params["layers"])
+    for name, ab in lora["layers"].items():
+        w = layers[name]
+        a2 = ab["a"].reshape(cfg.num_layers, -1, lcfg.rank)
+        b2 = ab["b"].reshape(cfg.num_layers, lcfg.rank, -1)
+        delta = jnp.einsum("lir,lro->lio", a2.astype(jnp.float32),
+                           b2.astype(jnp.float32)) * lcfg.scale
+        layers[name] = (w.astype(jnp.float32)
+                        + delta.reshape(w.shape)).astype(w.dtype)
+    merged["layers"] = layers
+    return merged
 
 
 def llama_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
@@ -173,14 +290,27 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
            positions: jax.Array, kv_cache=None,
-           cache_index: Optional[jax.Array] = None):
+           cache_index: Optional[jax.Array] = None,
+           lora: Optional[Dict[str, Any]] = None, lora_scale: float = 0.0):
     """One transformer block. x: [B, S, H_model]."""
     dt = cfg.dtype
+
+    def _ld(name, t_in, eq_a, eq_b):
+        """Activation-side LoRA delta: (t_in @ A) @ B * scale, or 0."""
+        if lora is None or name not in lora:
+            return 0
+        ab = lora[name]
+        t = jnp.einsum(eq_a, t_in, ab["a"].astype(dt))
+        return jnp.einsum(eq_b, t, ab["b"].astype(dt)) * lora_scale
+
     # --- attention ---
     h = _rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = jnp.einsum("bsh,hnd->bsnd", h, lp["wq"].astype(dt))
-    k = jnp.einsum("bsh,hnd->bsnd", h, lp["wk"].astype(dt))
-    v = jnp.einsum("bsh,hnd->bsnd", h, lp["wv"].astype(dt))
+    q = (jnp.einsum("bsh,hnd->bsnd", h, lp["wq"].astype(dt))
+         + _ld("wq", h, "bsh,hr->bsr", "bsr,rnd->bsnd"))
+    k = (jnp.einsum("bsh,hnd->bsnd", h, lp["wk"].astype(dt))
+         + _ld("wk", h, "bsh,hr->bsr", "bsr,rnd->bsnd"))
+    v = (jnp.einsum("bsh,hnd->bsnd", h, lp["wv"].astype(dt))
+         + _ld("wv", h, "bsh,hr->bsr", "bsr,rnd->bsnd"))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     q = constrain(q, ("batch", "seq", "heads", None))
@@ -200,13 +330,17 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
         else:
             attn_out = attention(q, k, v, impl=cfg.attn_impl, causal=True)
     attn_out = constrain(attn_out, ("batch", "seq", "heads", None))
-    x = x + jnp.einsum("bsnd,ndh->bsh", attn_out, lp["wo"].astype(dt))
+    x = (x + jnp.einsum("bsnd,ndh->bsh", attn_out, lp["wo"].astype(dt))
+         + _ld("wo", attn_out, "bsnd,ndr->bsr", "bsr,rh->bsh"))
     # --- mlp (SwiGLU) ---
     h = _rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-    gate = jnp.einsum("bsh,hm->bsm", h, lp["w_gate"].astype(dt))
-    up = jnp.einsum("bsh,hm->bsm", h, lp["w_up"].astype(dt))
+    gate = (jnp.einsum("bsh,hm->bsm", h, lp["w_gate"].astype(dt))
+            + _ld("w_gate", h, "bsh,hr->bsr", "bsr,rm->bsm"))
+    up = (jnp.einsum("bsh,hm->bsm", h, lp["w_up"].astype(dt))
+          + _ld("w_up", h, "bsh,hr->bsr", "bsr,rm->bsm"))
     act = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "mlp"))
-    x = x + jnp.einsum("bsm,mh->bsh", act, lp["w_down"].astype(dt))
+    x = (x + jnp.einsum("bsm,mh->bsh", act, lp["w_down"].astype(dt))
+         + _ld("w_down", act, "bsm,mr->bsr", "bsr,rh->bsh"))
     x = constrain(x, ("batch", "seq", "embed"))
     return x, new_cache
 
@@ -238,26 +372,29 @@ def llama_decode(
     return logits.astype(jnp.float32), new_caches
 
 
-def llama_forward(
+def llama_hidden(
     params: Dict[str, Any],
     tokens: jax.Array,
     cfg: LlamaConfig,
     *,
     positions: Optional[jax.Array] = None,
+    lora: Optional[Dict[str, Any]] = None,
+    lora_cfg: Optional[LoraConfig] = None,
 ) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, V] (fp32). Layers run under
-    ``lax.scan`` with optional per-layer remat. For kv-cache decoding use
-    ``llama_decode``."""
+    """tokens [B, S] int32 → final hidden states [B, S, H] (activation
+    dtype, post final-norm). Layers run under ``lax.scan`` with optional
+    per-layer remat; LoRA adapters (if given) scan alongside the base."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     x = constrain(x, ("batch", "seq", "embed"))
 
-    body = partial(_layer, cfg)
+    scale = lora_cfg.scale if lora_cfg is not None else 0.0
 
-    def scan_fn(carry, lp):
-        y, _ = body(carry, lp, positions)
+    def scan_fn(carry, xs):
+        lp, lo = xs
+        y, _ = _layer(cfg, carry, lp, positions, lora=lo, lora_scale=scale)
         return y, None
 
     if cfg.remat:
@@ -271,14 +408,62 @@ def llama_forward(
                   if cfg.remat_policy == "full"
                   else jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         scan_fn = jax.checkpoint(scan_fn, policy=policy)
-    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], cfg.rms_eps)
+    lo_layers = lora["layers"] if lora is not None else None
+    # broadcast None through the scan when no adapters: xs must be a pytree
+    # of arrays, so substitute an empty dict
+    x, _ = jax.lax.scan(scan_fn, x, (params["layers"], lo_layers or {}))
+    return _rms_norm(x, params["final_norm"], cfg.rms_eps)
+
+
+def llama_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    lora: Optional[Dict[str, Any]] = None,
+    lora_cfg: Optional[LoraConfig] = None,
+) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, V] (fp32). For kv-cache decoding
+    use ``llama_decode``."""
+    x = llama_hidden(params, tokens, cfg, positions=positions,
+                     lora=lora, lora_cfg=lora_cfg)
     logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"].astype(cfg.dtype))
     return logits.astype(jnp.float32)
 
 
+def _chunked_ce(x, lm_head, targets, mask, chunk, dtype):
+    """Cross-entropy over seq chunks: logits for one chunk at a time, each
+    chunk's logits recomputed in the backward (jax.checkpoint) so peak
+    memory is B*chunk*V instead of B*S*V — the difference between a 7B
+    model fitting one 16-GiB chip or not."""
+    B, S, H = x.shape
+    assert S % chunk == 0, f"seq {S} not divisible by loss_chunk {chunk}"
+    n = S // chunk
+    xc = jnp.moveaxis(x.reshape(B, n, chunk, H), 1, 0)
+    tc = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)
+    mc = (jnp.moveaxis(mask.reshape(B, n, chunk), 1, 0)
+          if mask is not None else jnp.ones_like(tc, jnp.float32))
+
+    @jax.checkpoint
+    def body(carry, inp):
+        xi, ti, mi = inp
+        logits = jnp.einsum("bch,hv->bcv", xi, lm_head.astype(dtype))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, ti[..., None], axis=-1)[..., 0]
+        tot, cnt = carry
+        return (tot + jnp.sum(nll * mi), cnt + jnp.sum(mi)), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, tc, mc))
+    return tot / jnp.maximum(cnt, 1.0)
+
+
 def llama_loss(params: Dict[str, Any], batch: Dict[str, jax.Array],
-               cfg: LlamaConfig) -> jax.Array:
+               cfg: LlamaConfig, *,
+               lora: Optional[Dict[str, Any]] = None,
+               lora_cfg: Optional[LoraConfig] = None) -> jax.Array:
     """Next-token cross-entropy; batch = {tokens [B,S]} or {inputs, targets}."""
     if "targets" in batch:
         inputs, targets = batch["inputs"], batch["targets"]
@@ -286,9 +471,22 @@ def llama_loss(params: Dict[str, Any], batch: Dict[str, jax.Array],
     else:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
         mask = None
-    logits = llama_forward(params, inputs, cfg)
+    x = llama_hidden(params, inputs, cfg, lora=lora, lora_cfg=lora_cfg)
+    if cfg.loss_chunk:
+        return _chunked_ce(x, params["lm_head"], targets, mask,
+                           cfg.loss_chunk, cfg.dtype)
+    logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"].astype(cfg.dtype))
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
+
+
+def llama_lora_loss(base_params: Dict[str, Any], lora: Dict[str, Any],
+                    batch: Dict[str, jax.Array], cfg: LlamaConfig,
+                    lcfg: LoraConfig) -> jax.Array:
+    """Loss as a function of the ADAPTERS only — the signature
+    ``make_train_step`` wants for frozen-base fine-tuning: grads flow
+    through the frozen layers into A/B but no base dW is ever formed."""
+    return llama_loss(base_params, batch, cfg, lora=lora, lora_cfg=lcfg)

@@ -24,6 +24,7 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import (
     MultiAgentPPO, MultiAgentPPOConfig, PPO, PPOConfig)
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner, PPOLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -31,6 +32,8 @@ from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.env.multi_agent_env import (
     MultiAgentEnv, MultiAgentEnvRunner)
+from ray_tpu.rllib.env.policy_client import PolicyClient
+from ray_tpu.rllib.env.policy_server_input import PolicyServerInput
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DDPPO", "QMIX",
@@ -42,5 +45,6 @@ __all__ = [
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "Learner",
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
     "SingleAgentEnvRunner", "MultiAgentEnv", "MultiAgentEnvRunner",
-    "MultiAgentPPO", "MultiAgentPPOConfig",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "R2D2", "R2D2Config",
+    "PolicyClient", "PolicyServerInput",
 ]

@@ -1,0 +1,399 @@
+"""R2D2 — Recurrent Replay Distributed DQN (reference:
+rllib/algorithms/r2d2/r2d2.py R2D2Config + r2d2_torch_policy.py loss;
+Kapturowski et al. 2019).
+
+The three R2D2 mechanics, TPU-first:
+- **Stored recurrent state**: env runners carry the LSTM (h, c) across
+  steps and record each fragment's starting state; replay resumes the net
+  from that state instead of zeros (``SequenceReplayBuffer``).
+- **Burn-in**: the first ``burn_in`` steps of every replayed sequence run
+  forward only to warm the state (``lax.stop_gradient`` on the carry);
+  the loss covers the remaining unroll.
+- **Value rescaling**: targets use h(x) = sign(x)(√(|x|+1)−1) + εx and
+  its inverse, stabilizing bootstrap magnitudes across reward scales.
+
+The whole sequence update — burn-in scan, double-DQN targets along the
+unroll, Huber loss, adam — is ONE jitted function over [B, T] batches;
+the LSTM unroll is a ``lax.scan`` (one compiled cell regardless of T).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.models.catalog import _mlp_forward, _mlp_params
+from ray_tpu.rllib.utils.replay_buffer import SequenceReplayBuffer
+
+
+def h_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def h_inverse(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    # closed-form inverse of h_rescale (Kapturowski 2019 appendix)
+    num = jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0
+    return jnp.sign(x) * (jnp.square(num / (2.0 * eps)) - 1.0)
+
+
+# ------------------------------------------------------------------- module
+@dataclasses.dataclass
+class R2D2ModuleSpec:
+    """Recurrent Q-network spec (reference: r2d2 + recurrent_net.py)."""
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool = True
+    hiddens: Tuple[int, ...] = (64,)
+    lstm_cell_size: int = 64
+    dueling: bool = True
+
+    def build(self) -> "R2D2Module":
+        return R2D2Module(self)
+
+
+class R2D2Module:
+    """Encoder MLP → LSTM → (dueling) Q heads. The recurrent interface
+    (initial_state / explore_action_recurrent) plugs into the env runner's
+    stateful path; q_seq is the learner's scan over stored sequences."""
+
+    def __init__(self, spec: R2D2ModuleSpec):
+        self.spec = spec
+        self._act = jax.nn.relu
+        self.cell_size = spec.lstm_cell_size
+
+    def init(self, rng) -> Dict:
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+        enc_sizes = (self.spec.obs_dim, *self.spec.hiddens)
+        H, E = self.cell_size, enc_sizes[-1]
+        scale = jnp.sqrt(1.0 / (E + H))
+        params = {
+            "enc": _mlp_params(k1, enc_sizes, final_scale=1.0),
+            "lstm": {
+                "wx": jax.random.normal(k2, (E, 4 * H)) * scale,
+                "wh": jax.random.normal(k3, (H, 4 * H)) * scale,
+                "b": jnp.zeros((4 * H,)),
+            },
+            "adv": _mlp_params(k4, (H, self.spec.action_dim)),
+            # exploration epsilon rides in params (no recompilation on
+            # schedule updates — same pattern as DQNModule)
+            "epsilon": jnp.asarray(1.0, jnp.float32),
+        }
+        if self.spec.dueling:
+            params["v"] = _mlp_params(k5, (H, 1))
+        return params
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        return (jnp.zeros((batch_size, self.cell_size)),
+                jnp.zeros((batch_size, self.cell_size)))
+
+    def _encode(self, params, obs):
+        x = obs
+        for layer in params["enc"]:
+            x = self._act(x @ layer["w"] + layer["b"])
+        return x
+
+    def _cell(self, params, x, state):
+        h, c = state
+        gates = x @ params["lstm"]["wx"] + h @ params["lstm"]["wh"] \
+            + params["lstm"]["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+    def _q_from_h(self, params, h):
+        adv = _mlp_forward(params["adv"], h, self._act)
+        if self.spec.dueling:
+            v = _mlp_forward(params["v"], h, self._act)
+            return v + adv - adv.mean(axis=-1, keepdims=True)
+        return adv
+
+    def q_seq(self, params, obs_seq, state, reset_mask=None):
+        """obs_seq [T, B, obs] + (h, c) → (q [T, B, A], final_state).
+
+        reset_mask [T, B] (optional): rows where the carry is zeroed
+        BEFORE consuming step t — replayed sequences spanning episode
+        boundaries must reset the state exactly where the env runner did
+        at collection time, or post-boundary targets train on hidden
+        state inference never sees."""
+        enc = self._encode(params, obs_seq)
+
+        if reset_mask is None:
+            def step(carry, x):
+                h, new_carry = self._cell(params, x, carry)
+                return new_carry, h
+
+            final_state, hs = jax.lax.scan(step, state, enc)
+        else:
+            def step(carry, xs):
+                x, reset = xs
+                keep = (1.0 - reset)[:, None]
+                carry = tuple(c * keep for c in carry)
+                h, new_carry = self._cell(params, x, carry)
+                return new_carry, h
+
+            final_state, hs = jax.lax.scan(step, state, (enc, reset_mask))
+        return self._q_from_h(params, hs), final_state
+
+    # ------------------------------------------- env-runner interfaces
+    def explore_action_recurrent(self, params, obs, state, rng):
+        """One stateful step: eps-greedy over Q(h)."""
+        enc = self._encode(params, obs)
+        h, new_state = self._cell(params, enc, state)
+        q = self._q_from_h(params, h)
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(rng)
+        random_a = jax.random.randint(
+            k1, greedy.shape, 0, self.spec.action_dim)
+        explore = jax.random.uniform(k2, greedy.shape) < params["epsilon"]
+        action = jnp.where(explore, random_a, greedy)
+        zeros = jnp.zeros_like(q[..., 0])
+        return action, zeros, q.max(axis=-1), new_state
+
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        """Stateless facade (zero state) for last-vf bootstraps and
+        non-recurrent callers."""
+        squeeze = obs.ndim == 1
+        x = obs[None] if squeeze else obs
+        enc = self._encode(params, x)
+        h, _ = self._cell(params, enc, self.initial_state(x.shape[0]))
+        q = self._q_from_h(params, h)
+        out = {"logits": q, "vf": q.max(axis=-1)}
+        if squeeze:
+            out = {k: v[0] for k, v in out.items()}
+        return out
+
+    def explore_action(self, params, obs, rng):
+        a, logp, vf, _ = self.explore_action_recurrent(
+            params, obs, self.initial_state(obs.shape[0]), rng)
+        return a, logp, vf
+
+
+# ------------------------------------------------------------------ learner
+class R2D2Learner(Learner):
+    """Burn-in + double-DQN-along-the-unroll sequence loss
+    (reference: r2d2_torch_policy.py r2d2_loss)."""
+
+    def __init__(self, module_spec, config, use_mesh: bool = False):
+        super().__init__(module_spec, config, use_mesh=use_mesh)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def loss(self, params, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        burn_in = cfg.get("burn_in", 0)
+        use_h = cfg.get("use_h_function", True)
+        tp = batch["target_params"]
+
+        # [B, T, ...] -> time-major [T, B, ...]
+        obs = jnp.swapaxes(batch["obs"], 0, 1)
+        actions = jnp.swapaxes(batch["actions"], 0, 1).astype(jnp.int32)
+        rewards = jnp.swapaxes(batch["rewards"], 0, 1)
+        dones = jnp.swapaxes(batch["dones"], 0, 1)
+        valid = jnp.swapaxes(batch["valid"], 0, 1).astype(jnp.float32)
+        state = tuple(batch["state_in"])
+        # mirror collection-time behavior: the runner zeroes (h, c) on the
+        # step after a done, so the replayed unroll must reset the carry at
+        # the same positions (step t resets iff step t-1 terminated)
+        resets = jnp.concatenate(
+            [jnp.zeros_like(dones[:1]), dones[:-1]], axis=0)
+
+        if burn_in > 0:
+            # warm the state; no gradient through the burn-in prefix
+            _, state_on = self.module.q_seq(
+                params, obs[:burn_in], state, resets[:burn_in])
+            state_on = jax.tree.map(jax.lax.stop_gradient, state_on)
+            _, state_tgt = self.module.q_seq(
+                tp, obs[:burn_in], state, resets[:burn_in])
+            obs, actions = obs[burn_in:], actions[burn_in:]
+            rewards = rewards[burn_in:]
+            valid = valid[burn_in:]
+            resets, dones = resets[burn_in:], dones[burn_in:]
+        else:
+            state_on = state_tgt = state
+
+        q_online, _ = self.module.q_seq(params, obs, state_on, resets)
+        q_target, _ = self.module.q_seq(tp, obs, state_tgt, resets)
+
+        q_sa = jnp.take_along_axis(
+            q_online, actions[..., None], axis=-1)[..., 0]       # [T,B]
+        # double DQN along the unroll: online argmax at t+1, target eval
+        a_star = jnp.argmax(q_online[1:], axis=-1)               # [T-1,B]
+        q_next = jnp.take_along_axis(
+            q_target[1:], a_star[..., None], axis=-1)[..., 0]
+        if use_h:
+            q_next = h_inverse(q_next)
+        target = rewards[:-1] + gamma * (1.0 - dones[:-1]) * q_next
+        if use_h:
+            target = h_rescale(target)
+            q_pred = q_sa[:-1]
+        else:
+            q_pred = q_sa[:-1]
+        td = q_pred - jax.lax.stop_gradient(target)
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                          jnp.abs(td) - 0.5)
+        # the last step has no within-sequence successor; autoreset rows
+        # are invalid. Terminal steps keep their loss even though their
+        # successor row is an (invalid) autoreset step — done cuts the
+        # bootstrap, so no successor is needed, and they carry the reward.
+        mask = valid[:-1] * jnp.maximum(dones[:-1], valid[1:])
+        loss = jnp.sum(huber * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, {
+            "td_error_mean": jnp.sum(jnp.abs(td) * mask)
+            / jnp.maximum(jnp.sum(mask), 1.0),
+            "qf_mean": jnp.mean(q_sa),
+        }
+
+    def _build_update(self):
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.loss, has_aux=True)(params, batch)
+            grads["epsilon"] = jnp.zeros_like(params["epsilon"])
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        return jax.jit(update)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self, tau: float = 1.0) -> None:
+        self.target_params = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o,
+            self.target_params, self.params)
+
+    def set_epsilon(self, eps: float) -> None:
+        self.params["epsilon"] = jnp.asarray(eps, jnp.float32)
+
+    def get_state(self) -> Dict:
+        s = super().get_state()
+        s["target_params"] = jax.device_get(self.target_params)
+        return s
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        self.target_params = state["target_params"]
+
+
+# ---------------------------------------------------------------- algorithm
+class R2D2Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or R2D2)
+        self.lr = 5e-4
+        self.train_batch_size = 16          # sequences per update
+        self.replay_buffer_capacity = 4000  # sequences
+        self.num_steps_sampled_before_learning_starts = 200
+        self.target_network_update_freq = 400  # env steps
+        self.training_intensity = 4.0
+        self.epsilon = [(0, 1.0), (5_000, 0.05)]
+        self.burn_in = 4
+        self.model = {"use_lstm": True, "lstm_cell_size": 64,
+                      "hiddens": (64,)}
+        self.rollout_fragment_length = 20   # burn_in + unroll
+        self.num_env_runners = 1
+        self.use_h_function = True
+
+    def _training_keys(self):
+        return {"replay_buffer_capacity", "target_network_update_freq",
+                "num_steps_sampled_before_learning_starts", "epsilon",
+                "burn_in", "training_intensity", "use_h_function"}
+
+    def learner_config_dict(self) -> Dict:
+        d = super().learner_config_dict()
+        d.update({"burn_in": self.burn_in,
+                  "use_h_function": self.use_h_function})
+        return d
+
+    def module_spec(self) -> R2D2ModuleSpec:
+        base = super().module_spec()
+        if not base.discrete:
+            raise ValueError("R2D2 supports discrete action spaces only")
+        return R2D2ModuleSpec(
+            obs_dim=base.obs_dim, action_dim=base.action_dim,
+            hiddens=tuple(self.model.get("hiddens", (64,))),
+            lstm_cell_size=int(self.model.get("lstm_cell_size", 64)),
+            dueling=bool(self.model.get("dueling", True)))
+
+
+class R2D2(Algorithm):
+    learner_cls = R2D2Learner
+
+    @classmethod
+    def get_default_config(cls):
+        return R2D2Config(algo_class=cls)
+
+    def setup(self, _config) -> None:
+        super().setup(_config)
+        cfg = self.config
+        self.replay = SequenceReplayBuffer(cfg.replay_buffer_capacity,
+                                           seed=cfg.seed)
+        self._steps_since_target_sync = 0
+
+    def _make_runner(self, idx: int):
+        cfg = self.config
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        return ray_tpu.remote(SingleAgentEnvRunner).options(
+            resources={"CPU": 1}).remote(
+                cfg.make_env(), cfg.num_envs_per_env_runner,
+                cfg.rollout_fragment_length, self._module_spec,
+                seed=cfg.seed + idx * 1000 + 1, explore=cfg.explore,
+                gamma=cfg.gamma, connector=cfg.connector)
+
+    def _epsilon_at(self, step: int) -> float:
+        (s0, e0), (s1, e1) = self.config.epsilon[0], self.config.epsilon[-1]
+        if step <= s0:
+            return e0
+        if step >= s1:
+            return e1
+        return e0 + (step - s0) / max(s1 - s0, 1) * (e1 - e0)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        learner = self.learner_group.local_learner()
+        learner.set_epsilon(self._epsilon_at(self._total_env_steps))
+        weights_ref = ray_tpu.put(learner.get_weights())
+
+        samples = self._sample_from_runners(weights_ref)
+        new_steps = sum(s["env_steps"] for s in samples)
+        for s in samples:
+            self.replay.add_sequences(
+                {"obs": s["obs"], "actions": s["actions"],
+                 "rewards": s["rewards"], "dones": s["dones"],
+                 "valid": s["valid"].astype(np.float32)},
+                tuple(np.asarray(x) for x in s["state_in"]))
+
+        metrics: Dict = {"env_steps_this_iter": new_steps}
+        seq_len = cfg.rollout_fragment_length
+        if len(self.replay) * seq_len < \
+                cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+
+        num_updates = max(1, int(new_steps * cfg.training_intensity
+                                 / max(cfg.train_batch_size * seq_len, 1)))
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.train_batch_size)
+            # sampled sequences are [B, T, ...] already (buffer layout)
+            metrics.update(learner.update(batch))
+        self._steps_since_target_sync += new_steps
+        if self._steps_since_target_sync >= cfg.target_network_update_freq:
+            learner.sync_target()
+            self._steps_since_target_sync = 0
+        metrics["epsilon"] = self._epsilon_at(self._total_env_steps)
+        return metrics

@@ -37,6 +37,33 @@ class SingleAgentEnvRunner:
         # off-policy algos (DQN/SAC) need (s, a, r, s') tuples
         self._collect_next_obs = collect_next_obs
 
+        # recurrent modules (R2D2's LSTM Q-net) expose explore_action_
+        # recurrent + initial_state: the runner carries (h, c) across
+        # steps, zeroes rows on episode reset, and records each fragment's
+        # STARTING state so replay can resume it (reference:
+        # rllib/algorithms/r2d2 stored-state replay)
+        self._recurrent = hasattr(self.module, "explore_action_recurrent") \
+            and hasattr(self.module, "initial_state")
+        if self._recurrent:
+            self._state = tuple(np.asarray(s) for s in
+                                self.module.initial_state(num_envs))
+            if explore:
+                self._jit_explore_rec = jax.jit(
+                    self.module.explore_action_recurrent)
+            else:
+                # evaluation rollouts: force greedy by zeroing the
+                # module's exploration epsilon (rides in params)
+                def _greedy_rec(weights, obs, state, rng):
+                    import jax.numpy as jnp
+
+                    if "epsilon" in weights:
+                        weights = dict(
+                            weights,
+                            epsilon=jnp.zeros_like(weights["epsilon"]))
+                    return self.module.explore_action_recurrent(
+                        weights, obs, state, rng)
+
+                self._jit_explore_rec = jax.jit(_greedy_rec)
         if explore:
             self._jit_explore = jax.jit(self.module.explore_action)
         else:
@@ -102,9 +129,24 @@ class SingleAgentEnvRunner:
         next_obs_buf = (np.empty_like(obs_buf)
                         if self._collect_next_obs else None)
 
+        # fragment-start recurrent state (rides the sample for replay)
+        start_state = (tuple(s.copy() for s in self._state)
+                       if self._recurrent else None)
+
         for t in range(self.T):
             self._rng, key = jax.random.split(self._rng)
-            action, logp, vf = self._jit_explore(weights, self._obs, key)
+            if self._recurrent:
+                # zero state rows whose episode just reset (autoreset step)
+                if self._prev_done.any():
+                    mask = (~self._prev_done).astype(np.float32)[:, None]
+                    self._state = tuple(s * mask for s in self._state)
+                    if t == 0:
+                        start_state = tuple(s.copy() for s in self._state)
+                action, logp, vf, new_state = self._jit_explore_rec(
+                    weights, self._obs, self._state, key)
+                self._state = tuple(np.asarray(s) for s in new_state)
+            else:
+                action, logp, vf = self._jit_explore(weights, self._obs, key)
             action = np.asarray(action)
             if act_buf is None:
                 act_buf = np.empty((self.T,) + action.shape, action.dtype)
@@ -170,6 +212,8 @@ class SingleAgentEnvRunner:
         }
         if next_obs_buf is not None:
             out["next_obs"] = next_obs_buf
+        if self._recurrent:
+            out["state_in"] = start_state
         return out
 
     def stop(self):

@@ -29,6 +29,7 @@ from ray_tpu.runtime_env.runtime_env import (
 )
 from ray_tpu.runtime_env.context import RuntimeEnvContext, setup_runtime_env
 from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
+import ray_tpu.runtime_env.container  # noqa: F401  (registers the plugin)
 
 __all__ = [
     "RuntimeEnv",

@@ -86,3 +86,8 @@ def validate_runtime_env(env: Dict) -> None:
     if pip is not None and not isinstance(pip, (list, dict, str)):
         raise TypeError("pip must be a list of requirements, a dict, or a "
                         "requirements-file path")
+    # plugin-owned fields validate through their plugin (container, ...)
+    for key, value in env.items():
+        plugin = _PLUGINS.get(key)
+        if plugin is not None and key not in RuntimeEnv.KNOWN_FIELDS:
+            plugin.validate(value)

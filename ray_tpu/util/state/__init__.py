@@ -36,12 +36,36 @@ def _call(method: str, payload: Optional[Dict] = None):
 
 
 def _apply_filters(rows: List[Dict], filters) -> List[Dict]:
-    """filters: [(key, op, value)] with op in ('=', '!=')."""
+    """filters: [(key, op, value)] with op in ('=', '!=', '<', '<=',
+    '>', '>=', 'contains', '!contains') — the reference's predicate set
+    (reference: python/ray/util/state/api.py StateApiClient filters +
+    common.py supported_filters). Ordering ops compare numerically when
+    both sides parse as floats, else lexically."""
+
+    def _cmp(a, b) -> Optional[int]:
+        try:
+            fa, fb = float(a), float(b)
+            return (fa > fb) - (fa < fb)
+        except (TypeError, ValueError):
+            sa, sb = str(a), str(b)
+            return (sa > sb) - (sa < sb)
+
     for key, op, value in filters or []:
         if op == "=":
             rows = [r for r in rows if str(r.get(key)) == str(value)]
         elif op == "!=":
             rows = [r for r in rows if str(r.get(key)) != str(value)]
+        elif op in ("<", "<=", ">", ">="):
+            want = {"<": (-1,), "<=": (-1, 0), ">": (1,), ">=": (0, 1)}[op]
+            rows = [r for r in rows
+                    if r.get(key) is not None
+                    and _cmp(r.get(key), value) in want]
+        elif op == "contains":
+            rows = [r for r in rows if r.get(key) is not None
+                    and str(value) in str(r.get(key))]
+        elif op == "!contains":
+            rows = [r for r in rows if r.get(key) is not None
+                    and str(value) not in str(r.get(key))]
         else:
             raise ValueError(f"unsupported filter op {op!r}")
     return rows

