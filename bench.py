@@ -35,11 +35,15 @@ def _tpu_configs():
         # in bf16 (13.5 GiB of 16) — only the adapters carry grads/opt state,
         # which is what makes 7B fit one v5e chip at all. Chunked lm-head CE
         # keeps peak logits memory at B*256*V.
+        # remat_policy="full": the "dots" policy saves every matmul output
+        # (batch-free dot dims), which at 7B geometry is ~1.3 GiB PER MLP
+        # TENSOR per layer — full recompute keeps activations ~0.6 GiB so
+        # base(13.5) + adapters + workspace fit the 15.75 GiB chip
         ("lora", LlamaConfig(
             vocab_size=32000, hidden=4096, mlp_hidden=11008, num_layers=32,
             num_heads=32, num_kv_heads=32, head_dim=128, max_seq_len=2048,
-            remat=True, param_dtype=jnp.bfloat16, loss_chunk=256,
-            attn_impl="auto"), 1, 2048, 8),
+            remat=True, remat_policy="full", param_dtype=jnp.bfloat16,
+            loss_chunk=256, attn_impl="auto"), 1, 2048, 8),
         # ~1.005B: Llama-2-7B geometry at half width/depth, head_dim 128.
         # Sized to v5e HBM: fp32 params + adafactor factored stats + fp32
         # grads peak at ~15.2 of 15.75 GiB (18 layers exceeds it by 16 MiB).
@@ -94,8 +98,9 @@ def _run_one(kind, cfg, batch, seq, steps, platform):
                 lambda k: init_lora(cfg, lcfg, k), tx, mesh,
                 lora_logical_axes(cfg, lcfg), seed=1)
             step = make_train_step(
-                lambda lo, bb: llama_lora_loss(base, lo, bb, cfg, lcfg),
-                tx, mesh, shardings, batch_logical_axes=("batch", "seq"))
+                lambda lo, bb, fz: llama_lora_loss(fz, lo, bb, cfg, lcfg),
+                tx, mesh, shardings, batch_logical_axes=("batch", "seq"),
+                frozen=base, frozen_logical_axes=llama_logical_axes(cfg))
             dt = _time_steps(step, state, b, steps)
         flops_tok = cfg.flops_per_token_frozen(lcfg.num_params(cfg), seq)
     else:

@@ -373,12 +373,14 @@ class NodeAgent:
             from ray_tpu.runtime_env.container import (
                 worker_container_command)
 
-            # same guard as the host path below: the axon bootstrap does
-            # not exist inside the image, so an inherited axon platform
-            # would break jax there
-            platforms = os.environ.get("JAX_PLATFORMS", "cpu")
-            ray_env["JAX_PLATFORMS"] = \
-                "cpu" if platforms == "axon" else platforms
+            # same scrub as the host path: the axon bootstrap does not
+            # exist inside the image, so an inherited axon platform would
+            # break jax there
+            from ray_tpu._private.config import scrub_axon_bootstrap_env
+
+            container_env = scrub_axon_bootstrap_env(
+                {"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")})
+            ray_env["JAX_PLATFORMS"] = container_env["JAX_PLATFORMS"]
             cmd = worker_container_command(
                 container, self.session_dir, self.store_dir, ray_env)
             env = dict(os.environ)
@@ -386,20 +388,13 @@ class NodeAgent:
             cmd = [sys.executable, "-m", "ray_tpu._private.worker_process"]
             env = dict(os.environ)
             env.update(ray_env)
-            # Workers must not grab the TPU runtime by default; tasks that
+            # Workers must not grab the TPU runtime by default (tasks that
             # request TPU resources get chip visibility through their
-            # lease's instance ids.
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            # the axon dev-tunnel bootstrap (sitecustomize) would register
-            # a PJRT client in EVERY worker at interpreter start — seconds
-            # of jax init per process, and the tunnel's single chip belongs
-            # to the driver. Real TPU hosts expose /dev/accel and never set
-            # this; dropping it here costs nothing there. With the axon
-            # backend unregistered, an inherited JAX_PLATFORMS=axon would
-            # break jax in the worker — force cpu alongside.
-            if env.pop("PALLAS_AXON_POOL_IPS", None) is not None \
-                    and env.get("JAX_PLATFORMS") == "axon":
-                env["JAX_PLATFORMS"] = "cpu"
+            # lease's instance ids), and the axon dev-tunnel bootstrap
+            # must not run in them (config.scrub_axon_bootstrap_env).
+            from ray_tpu._private.config import scrub_axon_bootstrap_env
+
+            scrub_axon_bootstrap_env(env)
         proc = subprocess.Popen(
             cmd,
             env=env,

@@ -127,3 +127,20 @@ def _coerce(raw: str, default: Any) -> Any:
 
 
 CONFIG = _Config()
+
+
+def scrub_axon_bootstrap_env(env: dict) -> dict:
+    """Strip the axon dev-tunnel bootstrap from a child-process env
+    (in place; returned for chaining). The image's sitecustomize would
+    otherwise register a PJRT client in EVERY subprocess — seconds of jax
+    init each, and the tunneled chip belongs to the driver. With the
+    bootstrap gone, an inherited JAX_PLATFORMS=axon would break jax in
+    the child, so it is rewritten to cpu. Real TPU hosts expose
+    /dev/accel and never set these vars — this is a no-op there. ONE
+    implementation for the three spawn sites (node head/agent, agent
+    host-worker, agent container-worker)."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if env.get("JAX_PLATFORMS") == "axon":
+        env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env

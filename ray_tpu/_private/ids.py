@@ -128,16 +128,25 @@ class TaskID(BaseID):
 
     SIZE = 16
 
+    # (actor_id, caller_id) -> hashed prefix: constant per handle, and
+    # for_actor_task sits on the actor-call hot path
+    _prefix_cache: dict = {}
+
     @classmethod
     def for_actor_task(cls, actor_id: ActorID, seq: int,
                        caller_id: bytes = b"") -> "TaskID":
         # Mix caller identity in so two callers' seq counters can't collide
         # on the same task id (and hence the same return ObjectIDs).
-        import hashlib
+        key = (actor_id.binary(), caller_id)
+        prefix = cls._prefix_cache.get(key)
+        if prefix is None:
+            import hashlib
 
-        prefix = hashlib.blake2b(
-            actor_id.binary() + caller_id, digest_size=8
-        ).digest()
+            prefix = hashlib.blake2b(
+                actor_id.binary() + caller_id, digest_size=8).digest()
+            if len(cls._prefix_cache) > 65536:  # unbounded-growth guard
+                cls._prefix_cache.clear()
+            cls._prefix_cache[key] = prefix
         return cls(prefix + struct.pack("<Q", seq))
 
 

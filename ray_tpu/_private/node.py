@@ -94,16 +94,10 @@ class Node:
     @staticmethod
     def _subprocess_env() -> dict:
         """Control-plane processes (head/agent) never touch jax: drop the
-        axon dev-tunnel bootstrap so their interpreters skip the
-        per-process PJRT registration the image's sitecustomize runs
-        (seconds of init each; the tunneled chip belongs to the driver)."""
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        # the backend the dropped bootstrap would have registered
-        if env.get("JAX_PLATFORMS") == "axon":
-            env["JAX_PLATFORMS"] = "cpu"
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        return env
+        axon dev-tunnel bootstrap (config.scrub_axon_bootstrap_env)."""
+        from ray_tpu._private.config import scrub_axon_bootstrap_env
+
+        return scrub_axon_bootstrap_env(dict(os.environ))
 
     def _start_head(self) -> None:
         log = open(os.path.join(self.session_dir, "logs", "head.log"), "ab")

@@ -66,6 +66,23 @@ def main(filter_substr: str = "") -> Dict[str, float]:
 
     arr = np.zeros((5 << 18,), np.float32)  # 5 MiB
 
+    # hardware context for the put number: a put is bounded below by ONE
+    # 5-MiB copy into the shm arena, so report this box's raw single-thread
+    # copy bandwidth alongside (the reference's 19.45 GB/s figure came from
+    # an m4.16xlarge with many memory channels)
+    if not filter_substr or filter_substr in "raw memcpy gigabytes":
+        dst = bytearray(arr.nbytes)
+        src = memoryview(arr).cast("B")
+        dst[:] = src
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 1.0:
+            dst[:] = src
+            reps += 1
+        mgbps = reps * arr.nbytes / (time.perf_counter() - t0) / 1e9
+        print(f"raw memcpy gigabytes: {mgbps:.2f} GB/s")
+        results["raw memcpy gigabytes"] = mgbps
+
     def put_large():
         for _ in range(10):
             ray_tpu.put(arr)

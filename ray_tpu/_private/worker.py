@@ -1282,9 +1282,11 @@ class Worker:
         st = self.actor_state_for(actor_id)
         seq = st.next_seq()
         task_id = TaskID.for_actor_task(actor_id, seq, self.worker_id.binary())
-        wire_args = self._build_args(args)
-        wire_kwargs = {k: v for k, v in zip(kwargs.keys(),
-                                            self._build_args(tuple(kwargs.values())))}
+        wire_args = self._build_args(args) if args else []
+        wire_kwargs = ({k: v for k, v in zip(kwargs.keys(),
+                                             self._build_args(
+                                                 tuple(kwargs.values())))}
+                       if kwargs else {})
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -1734,6 +1736,10 @@ class _ActorState:
     """Caller-side actor call pipeline: sequenced, ordered, reconnecting
     (reference: direct_actor_task_submitter.h CoreWorkerDirectActorTaskSubmitter)."""
 
+    # max specs per PushTaskBatch frame: bounds the receiver's reply delay
+    # for the batch's first task (execution is serial per actor anyway)
+    BATCH_MAX = 64
+
     def __init__(self, actor_id: ActorID):
         self.actor_id = actor_id
         self.state = "PENDING_CREATION"
@@ -1743,6 +1749,7 @@ class _ActorState:
         self.queue: deque = deque()
         self.death_cause = ""
         self._connecting = False
+        self._flush_scheduled = False
 
     def next_seq(self) -> int:
         return self._seq.next()
@@ -1778,6 +1785,18 @@ class _ActorState:
             )
             return
         self.queue.append(record)
+        # defer the flush one loop tick: a burst of enqueues drained from
+        # the submission inbox in one callback then leaves as ONE
+        # PushTaskBatch frame instead of a frame per call (end-to-end
+        # batching; reference: direct_actor_task_submitter.h's
+        # SendPendingTasks draining the whole queue per wakeup)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(
+                self._scheduled_flush, worker)
+
+    def _scheduled_flush(self, worker: Worker) -> None:
+        self._flush_scheduled = False
         self._flush(worker)
 
     def _flush(self, worker: Worker) -> None:
@@ -1788,8 +1807,12 @@ class _ActorState:
             asyncio.get_running_loop().create_task(self._connect_then_flush(worker))
             return
         while self.queue:
-            record = self.queue.popleft()
-            self._push_nowait(worker, record)
+            if len(self.queue) == 1:
+                self._push_nowait(worker, self.queue.popleft())
+            else:
+                n = min(len(self.queue), self.BATCH_MAX)
+                self._push_batch(worker,
+                                 [self.queue.popleft() for _ in range(n)])
 
     async def _connect_then_flush(self, worker: Worker) -> None:
         addr = self.addr
@@ -1818,6 +1841,47 @@ class _ActorState:
             return
         fut.add_done_callback(
             lambda f: self._on_push_reply(worker, record, f))
+
+    def _push_batch(self, worker: Worker, records: List[TaskRecord]) -> None:
+        """Many sequenced calls in ONE frame; the worker executes them in
+        order (its serial per-actor discipline) and replies with a list."""
+        try:
+            fut = self.client.call_future(
+                "PushTaskBatch", [r.spec.to_wire() for r in records])
+        except Exception:
+            for record in records:
+                self._on_push_broken(worker, record)
+            return
+        fut.add_done_callback(
+            lambda f: self._on_batch_reply(worker, records, f))
+
+    def _on_batch_reply(self, worker: Worker, records: List[TaskRecord],
+                        fut: "asyncio.Future") -> None:
+        if fut.cancelled() or fut.exception() is not None:
+            for record in records:
+                self._on_push_broken(worker, record)
+            return
+        replies = fut.result()
+        for record, reply in zip(records, replies):
+            if isinstance(reply, dict) and "batch_item_error" in reply:
+                # one item failed at the handler level; the rest of the
+                # frame is fine (see handle_push_task_batch)
+                worker._on_task_failure(
+                    record,
+                    RuntimeError(
+                        f"actor task failed in worker: "
+                        f"{reply['batch_item_error']}"),
+                    retriable=False)
+                continue
+            try:
+                worker._on_task_reply(record, reply)
+            except Exception as e:
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "error processing actor reply for %s",
+                    record.spec.function_name)
+                worker._on_task_failure(record, e, retriable=False)
 
     def _on_push_reply(self, worker: Worker, record: TaskRecord,
                        fut: "asyncio.Future") -> None:

@@ -90,6 +90,21 @@ class Executor:
             reply["exec_ms"] = (time.monotonic() - start) * 1000.0
         return reply
 
+    async def handle_push_task_batch(self, conn, wires: List[Dict]
+                                     ) -> List[Dict]:
+        """One frame, many sequenced pushes (the submitter's
+        _ActorState._push_batch): fan the specs through the normal
+        per-task paths — creation order keeps the drainer/chain ordering —
+        and reply with the results as one list. Handler-level failures are
+        mapped to PER-ITEM error replies so one bad spec in a 64-task
+        frame keeps the blast radius of a single PushTask (the submitter
+        would otherwise fail the whole frame as an actor death)."""
+        replies = await asyncio.gather(
+            *[self.handle_push_task(conn, w) for w in wires],
+            return_exceptions=True)
+        return [r if not isinstance(r, BaseException)
+                else {"batch_item_error": repr(r)} for r in replies]
+
     # ---------------------------------------------------- batched execution
     def _run_on_drainer(self, spec: TaskSpec, assigned: Dict) -> "asyncio.Future":
         loop = asyncio.get_running_loop()
@@ -526,6 +541,8 @@ def main() -> None:
 
     # Executor routes must exist before registration makes us leasable.
     worker.direct_server.add_handler("PushTask", executor.handle_push_task)
+    worker.direct_server.add_handler("PushTaskBatch",
+                                     executor.handle_push_task_batch)
     worker.direct_server.add_handler("SampleStacks", _handle_sample_stacks)
     worker.direct_server.add_handler("CaptureJaxTrace",
                                      _handle_capture_jax_trace)

@@ -80,7 +80,13 @@ class ActorHandle:
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return ActorMethod(self, item, self._method_num_returns.get(item, 1))
+        method = ActorMethod(self, item,
+                             self._method_num_returns.get(item, 1))
+        # cache on the instance: __getattr__ only fires on misses, so the
+        # next `handle.method` costs a plain attribute lookup instead of a
+        # fresh ActorMethod per call (hot in n:n actor benchmarks)
+        self.__dict__[item] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"

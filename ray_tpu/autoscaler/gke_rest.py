@@ -185,9 +185,12 @@ class GkeRestClient(GkeNodePoolClient):
 
     def pool_runtime_node_ids(self, pool_name: str) -> List[str]:
         """GKE names slice nodes gke-<cluster>-<pool>-<hash>; the agents
-        register those instance names as runtime node ids via the
-        downward API, so the pool's instanceGroupUrls membership is the
-        runtime membership."""
+        register those INSTANCE NAMES as runtime node ids via the
+        downward API. The pool only exposes instanceGroupUrls (one
+        managed group per zone), so membership comes from each group's
+        compute listManagedInstances call — returning the URLs themselves
+        would never match a registered node id and the autoscaler would
+        boot-timeout every healthy slice."""
         try:
             pool = self.request("GET", self._pool_url(pool_name), None)
         except GkeApiError as e:
@@ -196,7 +199,20 @@ class GkeRestClient(GkeNodePoolClient):
             raise
         if pool.get("status") not in ("RUNNING", "RECONCILING"):
             return []
-        return list(pool.get("instanceGroupUrls", []))
+        names: List[str] = []
+        for ig_url in pool.get("instanceGroupUrls", []):
+            # instanceGroupManagers/<name> URL -> listManagedInstances
+            try:
+                reply = self.request(
+                    "POST", f"{ig_url}/listManagedInstances", None)
+            except GkeApiError:
+                continue  # group still materializing
+            for inst in reply.get("managedInstances", []):
+                url = inst.get("instance", "")
+                if url and inst.get("instanceStatus") in (
+                        "RUNNING", None):
+                    names.append(url.rsplit("/", 1)[-1])
+        return names
 
     # ------------------------------------------------------- operations
     def _operation_url(self, op: Dict) -> Optional[str]:

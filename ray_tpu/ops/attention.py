@@ -79,9 +79,9 @@ def attention(
         from ray_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal)
     if impl == "blockwise":
-        # pure-JAX memory-efficient path (scan over KV blocks); used as the
-        # GQA-backward fallback of the Pallas flash kernel and available
-        # explicitly. Decode-time kwargs are not supported here.
+        # pure-JAX memory-efficient path (scan over KV blocks) for
+        # platforms without Pallas; flash handles GQA natively now
+        # (fwd + bwd). Decode-time kwargs are not supported here.
         if q_offset is not None or valid_kv_len is not None:
             raise NotImplementedError(
                 "blockwise attention does not support q_offset/"

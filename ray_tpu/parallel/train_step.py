@@ -102,7 +102,7 @@ def _opt_state_shardings(tx, params, p_shardings, mesh):
 
 
 def make_train_step(
-    loss_fn: Callable[[Any, Dict[str, jax.Array]], jax.Array],
+    loss_fn: Callable[..., jax.Array],
     tx: optax.GradientTransformation,
     mesh: Mesh,
     state_shardings: TrainState,
@@ -111,23 +111,37 @@ def make_train_step(
     batch_logical_axes: Tuple[Optional[str], ...] = ("batch", None),
     grad_accum: int = 1,
     donate: bool = True,
+    frozen: Any = None,
+    frozen_logical_axes: Any = None,
 ):
     """Returns jitted ``step(state, batch) -> (state, metrics)``.
 
     With grad_accum > 1, batch's leading dim is split into microbatches and
     scanned; grads average across the scan then update once.
+
+    ``frozen`` (optional): a pytree of non-trainable parameters (a LoRA
+    run's base model) passed to ``loss_fn(params, batch, frozen)``. It
+    rides the jit as an ARGUMENT, never a closure — closing over it would
+    capture the whole base model as lowered constants (13+ GB of HLO for
+    a 7B base) and stall compilation. ``frozen_logical_axes`` shards it
+    on the mesh (replicated when omitted).
     """
     rules = rules or DEFAULT_RULES
     batch_spec = logical_to_spec(batch_logical_axes, rules)
     batch_sharding = NamedSharding(mesh, batch_spec)
 
-    def single_grad(params, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    def single_grad(params, batch, frozen_arg):
+        if frozen is None:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, frozen_arg)
         return loss, grads
 
-    def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
+    def step(state: TrainState, batch, frozen_arg
+             ) -> Tuple[TrainState, Dict[str, Any]]:
         if grad_accum == 1:
-            loss, grads = single_grad(state.params, batch)
+            loss, grads = single_grad(state.params, batch, frozen_arg)
         else:
             micro = jax.tree.map(
                 lambda x: x.reshape((grad_accum, x.shape[0] // grad_accum)
@@ -135,7 +149,7 @@ def make_train_step(
 
             def body(carry, mb):
                 loss_acc, gacc = carry
-                loss, g = single_grad(state.params, mb)
+                loss, g = single_grad(state.params, mb, frozen_arg)
                 return (loss_acc + loss,
                         jax.tree.map(jnp.add, gacc, g)), None
 
@@ -155,12 +169,28 @@ def make_train_step(
     metric_sharding = {"loss": NamedSharding(mesh, P()),
                        "grad_norm": NamedSharding(mesh, P()),
                        "step": NamedSharding(mesh, P())}
-    return jax.jit(
+    if frozen is None:
+        jitted = jax.jit(
+            lambda state, batch: step(state, batch, None),
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, metric_sharding),
+            donate_argnums=(0,) if donate else (),
+        )
+        return jitted
+    frozen_shardings = (
+        param_shardings(frozen_logical_axes, mesh, rules)
+        if frozen_logical_axes is not None
+        else jax.tree.map(
+            # keep the base wherever its init placed it
+            lambda x: getattr(x, "sharding", NamedSharding(mesh, P())),
+            frozen))
+    jitted = jax.jit(
         step,
-        in_shardings=(state_shardings, batch_sharding),
+        in_shardings=(state_shardings, batch_sharding, frozen_shardings),
         out_shardings=(state_shardings, metric_sharding),
         donate_argnums=(0,) if donate else (),
     )
+    return lambda state, batch: jitted(state, batch, frozen)
 
 
 def make_eval_step(

@@ -250,14 +250,9 @@ class DQN(Algorithm):
                 connector=cfg.connector)
 
     def _epsilon_at(self, step: int) -> float:
-        sched = self.config.epsilon
-        (s0, e0), (s1, e1) = sched[0], sched[-1]
-        if step <= s0:
-            return e0
-        if step >= s1:
-            return e1
-        frac = (step - s0) / max(s1 - s0, 1)
-        return e0 + frac * (e1 - e0)
+        from ray_tpu.rllib.utils.schedules import piecewise_linear
+
+        return piecewise_linear(self.config.epsilon, step)
 
     def training_step(self) -> Dict:
         cfg = self.config

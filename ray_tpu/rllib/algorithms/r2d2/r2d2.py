@@ -357,12 +357,9 @@ class R2D2(Algorithm):
                 gamma=cfg.gamma, connector=cfg.connector)
 
     def _epsilon_at(self, step: int) -> float:
-        (s0, e0), (s1, e1) = self.config.epsilon[0], self.config.epsilon[-1]
-        if step <= s0:
-            return e0
-        if step >= s1:
-            return e1
-        return e0 + (step - s0) / max(s1 - s0, 1) * (e1 - e0)
+        from ray_tpu.rllib.utils.schedules import piecewise_linear
+
+        return piecewise_linear(self.config.epsilon, step)
 
     def training_step(self) -> Dict:
         cfg = self.config

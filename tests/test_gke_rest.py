@@ -129,10 +129,33 @@ class TestLifecycle:
             {"status": "PROVISIONING", "instanceGroupUrls": ["ig-1"]}])
         assert c.pool_runtime_node_ids("pool-a") == []
 
-    def test_runtime_ids_when_running(self):
+    def test_runtime_ids_resolve_instance_names(self):
+        """The autoscaler matches runtime ids against agent-registered
+        node ids (INSTANCE names), so the client must walk each group's
+        listManagedInstances — not echo the group URLs."""
+        ig = ("https://www.googleapis.com/compute/v1/projects/p/zones/z/"
+              "instanceGroupManagers/gke-ray-pool-grp")
+        c, t = make_client(replies=[
+            {"status": "RUNNING", "instanceGroupUrls": [ig]},
+            {"managedInstances": [
+                {"instance": ".../instances/gke-ray-pool-abcd",
+                 "instanceStatus": "RUNNING"},
+                {"instance": ".../instances/gke-ray-pool-efgh",
+                 "instanceStatus": "RUNNING"},
+                {"instance": ".../instances/gke-ray-pool-dead",
+                 "instanceStatus": "STOPPING"},
+            ]},
+        ])
+        assert c.pool_runtime_node_ids("pool-a") == [
+            "gke-ray-pool-abcd", "gke-ray-pool-efgh"]
+        assert t.calls[1][0] == "POST"
+        assert t.calls[1][1] == f"{ig}/listManagedInstances"
+
+    def test_runtime_ids_group_still_materializing(self):
         c, _ = make_client(replies=[
-            {"status": "RUNNING", "instanceGroupUrls": ["ig-1", "ig-2"]}])
-        assert c.pool_runtime_node_ids("pool-a") == ["ig-1", "ig-2"]
+            {"status": "RUNNING", "instanceGroupUrls": ["ig-1"]},
+            GkeApiError(503, "not ready")])
+        assert c.pool_runtime_node_ids("pool-a") == []
 
     def test_runtime_ids_404_is_empty(self):
         c, _ = make_client(replies=[GkeApiError(404, "no pool")])
@@ -148,7 +171,10 @@ class TestProviderIntegration:
         c, t = make_client(replies=[
             {"name": "op-1", "status": "DONE"},           # create
             {"status": "RUNNING",
-             "instanceGroupUrls": ["a", "b", "c", "d"]},  # get pool
+             "instanceGroupUrls": ["ig-url"]},            # get pool
+            {"managedInstances": [                        # listManaged...
+                {"instance": f".../instances/host-{i}",
+                 "instanceStatus": "RUNNING"} for i in range(4)]},
             {"name": "op-2", "status": "DONE"},           # delete
         ])
         provider = GkeTpuPodSliceProvider({
@@ -161,7 +187,7 @@ class TestProviderIntegration:
         assert len(provider.runtime_node_ids(sid)) == 4
         provider.terminate_node(sid)
         methods = [m for m, _, _ in t.calls]
-        assert methods == ["POST", "GET", "DELETE"]
+        assert methods == ["POST", "GET", "POST", "DELETE"]
         # the created pool carries the slice placement policy
         assert t.calls[0][2]["nodePool"]["placementPolicy"][
             "tpuTopology"] == "4x4"

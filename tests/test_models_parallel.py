@@ -272,8 +272,10 @@ class TestLora:
                 lambda k: init_lora(cfg, lcfg, k), tx, mesh,
                 lora_logical_axes(cfg, lcfg), seed=1)
             step = make_train_step(
-                lambda lo, b: llama_lora_loss(base_sh, lo, b, cfg, lcfg),
-                tx, mesh, shardings, batch_logical_axes=("batch", "seq"))
+                lambda lo, b, fz: llama_lora_loss(fz, lo, b, cfg, lcfg),
+                tx, mesh, shardings, batch_logical_axes=("batch", "seq"),
+                frozen=base_sh,
+                frozen_logical_axes=llama_logical_axes(cfg))
             rng = np.random.default_rng(0)
             tok = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
             b = {"inputs": tok[:, :-1], "targets": tok[:, 1:]}

@@ -151,8 +151,10 @@ class TestFlashBackward:
         for a, b, n in zip(gr, gf, "qkv"):
             assert float(jnp.abs(a - b).max()) < 5e-5, n
 
-    def test_gqa_falls_back_without_error(self):
-        """n_rep > 1 routes the backward through blockwise — still exact."""
+    def test_gqa_backward_native(self):
+        """n_rep > 1 runs the native Pallas dk/dv kernel (grid walks each
+        kv head's query group; VERDICT r2 item 6) — gradients must match
+        reference attention."""
         import jax
         import jax.numpy as jnp
 

@@ -227,3 +227,18 @@ class TestPolicyServer:
             assert np.isfinite(out["total_loss"])
         finally:
             server.stop()
+
+
+def test_piecewise_schedule_honors_midpoints():
+    """Shared epsilon schedule (rllib/utils/schedules.py): adjacent-pair
+    interpolation — a 3-point schedule's fast initial decay is honored
+    instead of one flat first-to-last ramp."""
+    from ray_tpu.rllib.utils.schedules import piecewise_linear
+
+    sched = [(0, 1.0), (1000, 0.1), (10000, 0.05)]
+    assert piecewise_linear(sched, 0) == 1.0
+    assert abs(piecewise_linear(sched, 500) - 0.55) < 1e-9   # fast leg
+    assert abs(piecewise_linear(sched, 1000) - 0.1) < 1e-9
+    assert abs(piecewise_linear(sched, 5500) - 0.075) < 1e-9  # slow leg
+    assert piecewise_linear(sched, 99999) == 0.05
+    assert piecewise_linear([(0, 0.3)], 12345) == 0.3
