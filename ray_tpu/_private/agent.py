@@ -264,10 +264,11 @@ class NodeAgent:
         GCS declares them dead, node_manager.cc HandleUnexpectedDisconnect)."""
         give_up_s = float(CONFIG.agent_head_gone_exit_s)
         while True:
-            await asyncio.sleep(2.0)
+            await asyncio.sleep(CONFIG.head_watchdog_period_s)
             try:
-                await asyncio.wait_for(self.head.call("Ping", {}),
-                                       timeout=5.0)
+                await asyncio.wait_for(
+                    self.head.call("Ping", {}),
+                    timeout=CONFIG.head_ping_timeout_s)
                 continue
             except Exception:
                 pass
@@ -467,7 +468,7 @@ class NodeAgent:
 
     async def _worker_reaper_loop(self) -> None:
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(CONFIG.worker_spawn_retry_s)
             for handle in list(self.workers.values()):
                 if not handle.alive:
                     await self._handle_worker_exit(
@@ -505,6 +506,14 @@ class NodeAgent:
         return await fut
 
     def _maybe_spillback(self, request: ResourceSet, p: Dict) -> Optional[Dict]:
+        target = self._maybe_spillback_inner(request, p)
+        if target is not None:
+            # feeds ray_tpu_scheduler_spillbacks_total
+            self._spillback_count = getattr(self, "_spillback_count", 0) + 1
+        return target
+
+    def _maybe_spillback_inner(self, request: ResourceSet,
+                               p: Dict) -> Optional[Dict]:
         strategy = p.get("scheduling_strategy") or {}
         if isinstance(strategy, dict) and strategy.get("type") == "node_label":
             hard = strategy.get("hard") or {}
@@ -951,10 +960,11 @@ class NodeAgent:
                 try:
                     client = await self.pool.get(owner["host"], owner["port"])
                     loc = await client.call(
-                        "LocateObject", {"object_id": hex_id}, timeout=15
+                        "LocateObject", {"object_id": hex_id},
+                        timeout=CONFIG.object_locate_timeout_s
                     )
                 except Exception:
-                    await asyncio.sleep(0.2)
+                    await asyncio.sleep(CONFIG.object_pull_retry_s)
                     continue
                 if loc is None:
                     await asyncio.sleep(0.1)
@@ -1019,7 +1029,9 @@ class NodeAgent:
         'conn' counts toward the pull loop's dead-holder fast-fail."""
         try:
             client = await self.pool.get(addr["host"], addr["port"])
-            meta = await client.call("FetchObjectMeta", {"object_id": hex_id}, timeout=15)
+            meta = await client.call(
+            "FetchObjectMeta", {"object_id": hex_id},
+            timeout=CONFIG.object_locate_timeout_s)
         except Exception:
             self.pool.drop(addr["host"], addr["port"])
             return "conn"
@@ -1039,12 +1051,14 @@ class NodeAgent:
                 data = await client.call(
                     "FetchObjectChunk",
                     {"object_id": hex_id, "offset": off, "length": n},
-                    timeout=60,
+                    timeout=CONFIG.object_chunk_fetch_timeout_s,
                 )
                 if data is None:
                     raise IOError("remote chunk missing")
                 view[off : off + len(data)] = data
                 off += len(data)
+                self._chunks_fetched = getattr(
+                    self, "_chunks_fetched", 0) + 1
             self.store.client.seal(oid, handle)
             self.store.on_sealed(hex_id, size)
             return "ok"
@@ -1064,6 +1078,7 @@ class NodeAgent:
         if view is None:
             return None
         off, length = p["offset"], p["length"]
+        self._chunks_served = getattr(self, "_chunks_served", 0) + 1
         return bytes(view[off : off + length])
 
     async def _free_objects(self, conn: Connection, p: Dict) -> None:
@@ -1077,7 +1092,10 @@ class NodeAgent:
         self.store.unpin(p["object_id"])
 
     async def _restore_spilled(self, conn: Connection, p: Dict) -> bool:
-        return self.store.restore(p["object_id"])
+        ok = self.store.restore(p["object_id"])
+        if ok:
+            self._restored_count = getattr(self, "_restored_count", 0) + 1
+        return ok
 
     async def _get_store_stats(self, conn: Connection, p) -> Dict:
         return self.store.stats()
@@ -1163,21 +1181,90 @@ class NodeAgent:
                 def gauge(name, desc, value):
                     return make_gauge_snapshot(name, desc, value, tags)
 
+                store_stats = st["object_store"]
+                disk = st.get("disk") or {}
                 snaps = [
                     gauge("ray_tpu_node_cpu_percent",
                           "Node CPU utilization percent.",
                           st["cpu_percent"]),
+                    gauge("ray_tpu_node_cpu_count",
+                          "Logical CPUs on the node.",
+                          st.get("cpu_count") or 0),
+                    gauge("ray_tpu_node_load_avg_1m",
+                          "1-minute load average.",
+                          (st.get("load_avg") or [0])[0]),
                     gauge("ray_tpu_node_mem_used_bytes",
                           "Node memory in use.", st["mem_used_bytes"]),
                     gauge("ray_tpu_node_mem_total_bytes",
                           "Node memory total.", st["mem_total_bytes"]),
+                    gauge("ray_tpu_node_disk_used_bytes",
+                          "Session-disk bytes used.",
+                          disk.get("used", 0)),
+                    gauge("ray_tpu_node_disk_total_bytes",
+                          "Session-disk bytes total.",
+                          disk.get("total", 0)),
                     gauge("ray_tpu_node_workers",
                           "Worker processes on the node.",
                           st["num_workers"]),
+                    gauge("ray_tpu_node_idle_workers",
+                          "Idle (leasable) worker processes.",
+                          st["num_idle_workers"]),
+                    # scheduler (reference: metric_defs.cc scheduler_*)
+                    gauge("ray_tpu_scheduler_active_leases",
+                          "Worker leases currently granted on the node.",
+                          len(self.leases)),
+                    gauge("ray_tpu_scheduler_pending_lease_requests",
+                          "Lease requests queued on the node.",
+                          len(self._pending_leases)),
+                    gauge("ray_tpu_scheduler_leases_granted_total",
+                          "Cumulative leases granted (counter semantics).",
+                          self._lease_counter),
+                    gauge("ray_tpu_scheduler_spillbacks_total",
+                          "Lease requests redirected to other nodes.",
+                          getattr(self, "_spillback_count", 0)),
+                    gauge("ray_tpu_pg_bundles_reserved",
+                          "Placement-group bundles reserved on the node.",
+                          len(self._pg_bundles)),
+                    # object plane (reference: metric_defs.cc object_store_*
+                    # + object_manager_*)
                     gauge("ray_tpu_object_store_used_bytes",
                           "Object store bytes in use.",
-                          st["object_store"].get("used", 0)),
+                          store_stats.get("used", 0)),
+                    gauge("ray_tpu_object_store_capacity_bytes",
+                          "Object store arena capacity.",
+                          store_stats.get("capacity", 0)),
+                    gauge("ray_tpu_object_store_num_objects",
+                          "Sealed objects resident in the store.",
+                          store_stats.get("num_objects", 0)),
+                    gauge("ray_tpu_object_store_evictions_total",
+                          "Cumulative LRU evictions.",
+                          store_stats.get("num_evictions", 0)),
+                    gauge("ray_tpu_object_store_created_total",
+                          "Cumulative objects created.",
+                          store_stats.get("num_created", 0)),
+                    gauge("ray_tpu_object_spilled_total",
+                          "Objects spilled to disk.",
+                          getattr(self.store, "num_spills", 0)),
+                    gauge("ray_tpu_object_restored_total",
+                          "Spilled objects restored.",
+                          getattr(self, "_restored_count", 0)),
+                    gauge("ray_tpu_object_chunks_served_total",
+                          "Object chunks served to remote nodes.",
+                          getattr(self, "_chunks_served", 0)),
+                    gauge("ray_tpu_object_chunks_fetched_total",
+                          "Object chunks fetched from remote nodes.",
+                          getattr(self, "_chunks_fetched", 0)),
                 ]
+                # per-resource availability (reference: resources gauge
+                # per resource name)
+                for rname, total_amt in self.resources.total.to_dict() \
+                        .items():
+                    avail = self.resources.available.get(rname) or 0.0
+                    snaps.append(make_gauge_snapshot(
+                        "ray_tpu_resource_in_use",
+                        "Resource units leased out, by resource name.",
+                        float(total_amt) - float(avail),
+                        {"node_id": self.node_id, "resource": str(rname)}))
                 tpu = st.get("tpu") or {}
                 if tpu:
                     snaps.append(gauge(

@@ -71,6 +71,19 @@ _flag("head_watchdog_period_s", 2.0)  # driver/worker head-liveness probes
 _flag("agent_head_gone_exit_s", 120.0)  # agent suicide after head unreachable
 _flag("autoscaler_boot_timeout_s", 120.0)  # launched-node registration window
 
+# --- round-3 sweep: formerly hardcoded timeouts/backoffs ---------------------
+_flag("head_ping_timeout_s", 5.0)  # watchdog ping RPC deadline
+_flag("worker_spawn_retry_s", 0.5)  # backoff when the pool is saturated
+_flag("object_locate_timeout_s", 15.0)  # owner-directory lookups
+_flag("object_chunk_fetch_timeout_s", 60.0)  # one cross-node chunk RPC
+_flag("object_pull_retry_s", 0.2)  # pull-plane retry backoff
+_flag("owned_resolve_timeout_s", 10.0)  # owner metadata resolution
+_flag("borrow_resolve_timeout_s", 15.0)  # borrowed-object owner round trip
+_flag("actor_probe_timeout_s", 5.0)  # liveness probe on a silent actor
+_flag("actor_reconnect_backoff_s", 0.2)  # actor-client reconnect pacing
+_flag("lease_retry_backoff_s", 0.2)  # lease-request retry pacing
+_flag("actor_call_batch_max", 64)  # specs per PushTaskBatch frame
+
 # --- TPU --------------------------------------------------------------------
 _flag("tpu_chips_per_host_default", 4)
 _flag("tpu_premap_device_buffers", True)

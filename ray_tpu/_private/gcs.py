@@ -215,6 +215,8 @@ class HeadServer:
             asyncio.get_running_loop().create_task(self._health_check_loop()))
         self._hold_task(
             asyncio.get_running_loop().create_task(self._broadcast_loop()))
+        self._hold_task(
+            asyncio.get_running_loop().create_task(self._metrics_loop()))
         return self.port
 
     def _register_routes(self) -> None:
@@ -374,6 +376,48 @@ class HeadServer:
                 ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
             ):
                 await self._handle_actor_failure(actor, f"node died: {reason}")
+
+    async def _metrics_loop(self) -> None:
+        """Publish head-level system gauges into the same KV pipeline the
+        agents' node stats ride (reference: src/ray/stats/metric_defs.cc
+        gcs_* series — actor/node/PG/job counts from the control plane)."""
+        import json as _json
+
+        from ray_tpu.util.metrics import make_gauge_snapshot as g
+
+        period = max(CONFIG.metrics_report_interval_ms, 1000) / 1000
+        while True:
+            await asyncio.sleep(period)
+            try:
+                actor_states: Dict[str, int] = {}
+                for a in self.actors.values():
+                    actor_states[a.state] = actor_states.get(a.state, 0) + 1
+                snaps = [
+                    g("ray_tpu_gcs_nodes_alive", "Registered alive nodes.",
+                      sum(1 for n in self.nodes.values() if n.alive)),
+                    g("ray_tpu_gcs_nodes_dead", "Nodes marked dead.",
+                      sum(1 for n in self.nodes.values() if not n.alive)),
+                    g("ray_tpu_gcs_placement_groups",
+                      "Placement groups registered.",
+                      len(self.placement_groups)),
+                    g("ray_tpu_gcs_jobs", "Jobs tracked by the head.",
+                      len(self.jobs)),
+                    g("ray_tpu_gcs_kv_entries",
+                      "Internal-KV entries across namespaces.",
+                      sum(len(ns) for ns in self.kv.values())),
+                    g("ray_tpu_gcs_task_events_buffered",
+                      "Task state-transition events held in the ring.",
+                      len(self.task_events)),
+                ]
+                for state, count in actor_states.items():
+                    snaps.append(g(
+                        "ray_tpu_gcs_actors",
+                        "Actors registered, by lifecycle state.",
+                        count, {"state": state}))
+                ns = self.kv.setdefault("_metrics", {})
+                ns[b"metrics::head::gcs"] = _json.dumps(snaps).encode()
+            except Exception:
+                pass  # metrics must never take the head down
 
     async def _broadcast_loop(self) -> None:
         """Gossip the cluster resource view to all agents (ray_syncer analog)."""

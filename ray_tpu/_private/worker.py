@@ -331,6 +331,49 @@ class Worker:
         global_worker = self
         self._acall(self._async_connect(agent_unix_path))
         self.connected = True
+        self._register_core_metrics()
+
+    def _register_core_metrics(self) -> None:
+        """Core-worker counters as CallbackGauges over plain ints: hot
+        paths (submit/put) pay one integer add, the flusher reads at
+        snapshot time (reference: metric_defs.cc tasks/owned-objects
+        series). Driver-mode only — worker processes are counted by their
+        node's agent."""
+        if self.mode != self.MODE_DRIVER:
+            return
+        self._n_tasks_submitted = 0
+        self._n_actor_calls = 0
+        self._n_task_failures = 0
+        self._n_puts = 0
+        self._n_gets = 0
+        try:
+            from ray_tpu.util.metrics import CallbackGauge
+
+            for name, desc, fn in (
+                ("ray_tpu_tasks_submitted_total",
+                 "Normal tasks submitted by this driver.",
+                 lambda: self._n_tasks_submitted),
+                ("ray_tpu_actor_calls_total",
+                 "Actor method calls submitted by this driver.",
+                 lambda: self._n_actor_calls),
+                ("ray_tpu_task_failures_total",
+                 "Task failures observed by this driver.",
+                 lambda: self._n_task_failures),
+                ("ray_tpu_puts_total", "ray_tpu.put calls.",
+                 lambda: self._n_puts),
+                ("ray_tpu_gets_total", "ray_tpu.get calls.",
+                 lambda: self._n_gets),
+                ("ray_tpu_owned_objects",
+                 "Objects this driver currently owns.",
+                 lambda: len(getattr(self.reference_counter, "_owned",
+                                     ()) or ())),
+                ("ray_tpu_lease_pools",
+                 "Distinct scheduling categories with live lease pools.",
+                 lambda: len(self._lease_pools)),
+            ):
+                CallbackGauge(name, desc, fn)
+        except Exception:
+            pass  # metrics are best-effort
 
     async def _async_connect(self, agent_unix_path: str) -> None:
         self.ready_event = asyncio.Event()
@@ -556,7 +599,8 @@ class Worker:
 
     async def _handle_locate_object(self, conn, p) -> Optional[Dict]:
         binary = bytes.fromhex(p["object_id"])
-        meta = await self._resolve_owned(binary, timeout=10.0)
+        meta = await self._resolve_owned(
+            binary, timeout=CONFIG.owned_resolve_timeout_s)
         if meta is None:
             return None
         if meta.state == "inline":
@@ -570,7 +614,9 @@ class Worker:
     async def _handle_get_owned_value(self, conn, p) -> Optional[Dict]:
         binary = bytes.fromhex(p["object_id"])
         block = p.get("block", True)
-        meta = await self._resolve_owned(binary, timeout=10.0 if block else 0.01)
+        meta = await self._resolve_owned(
+            binary,
+            timeout=CONFIG.owned_resolve_timeout_s if block else 0.01)
         if meta is None:
             return {"status": "unknown"}
         if meta.state == "inline" or meta.state == "error":
@@ -636,6 +682,7 @@ class Worker:
 
     # ------------------------------------------------------------------ put
     def put(self, value: Any) -> ObjectRef:
+        self._n_puts = getattr(self, "_n_puts", 0) + 1
         object_id = ObjectID.from_put(self._put_counter.next(), self.worker_id)
         self.put_object(object_id, value)
         return ObjectRef(object_id, self.direct_addr())
@@ -672,6 +719,7 @@ class Worker:
 
     # ------------------------------------------------------------------ get
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        self._n_gets = getattr(self, "_n_gets", 0) + 1
         deadline = None if timeout is None else time.monotonic() + timeout
         out: List[Any] = [None] * len(refs)
         for i, ref in enumerate(refs):
@@ -738,11 +786,12 @@ class Worker:
                 client = await self._owner_client(owner)
                 return await client.call(
                     "GetOwnedValue", {"object_id": ref.hex(), "block": True},
-                    timeout=15,
+                    timeout=CONFIG.borrow_resolve_timeout_s,
                 )
 
             try:
-                reply = self._acall(ask(), timeout=20)
+                reply = self._acall(
+                    ask(), timeout=CONFIG.borrow_resolve_timeout_s + 5)
             except Exception as e:
                 raise ObjectLostError(ref.hex(), f"owner unreachable ({e})")
             status = reply.get("status") if reply else "unknown"
@@ -859,13 +908,14 @@ class Worker:
                 client = await self._owner_client(owner)
                 return await client.call(
                     "GetOwnedValue", {"object_id": ref.hex(), "block": False},
-                    timeout=5,
+                    timeout=CONFIG.actor_probe_timeout_s,
                 )
             except Exception:
                 return None
 
         try:
-            reply = self._acall(probe(), timeout=6)
+            reply = self._acall(probe(),
+                                timeout=CONFIG.actor_probe_timeout_s + 1)
         except Exception:
             return False
         if not reply:
@@ -930,6 +980,7 @@ class Worker:
     ) -> List[ObjectRef]:
         from ray_tpu._private.function_table import function_descriptor
 
+        self._n_tasks_submitted = getattr(self, "_n_tasks_submitted", 0) + 1
         task_id = TaskID.from_random()
         fid, blob, fname = function_descriptor(function, self)
         from ray_tpu._private.resources import ResourceSet
@@ -1096,10 +1147,14 @@ class Worker:
                 oid.binary(), "plasma", [ret.get("node_addr")]
             )
 
+    def _count_task_failure(self) -> None:
+        self._n_task_failures = getattr(self, "_n_task_failures", 0) + 1
+
     def _on_task_failure(self, record: TaskRecord, error: Exception,
                          retriable: bool = True) -> None:
         if record.completed:
             return
+        self._count_task_failure()
         spec = record.spec
         record.attempts += 1
         if record.streaming_gen is not None:
@@ -1279,6 +1334,7 @@ class Worker:
         kwargs: dict,
         num_returns: int = 1,
     ) -> List[ObjectRef]:
+        self._n_actor_calls = getattr(self, "_n_actor_calls", 0) + 1
         st = self.actor_state_for(actor_id)
         seq = st.next_seq()
         task_id = TaskID.for_actor_task(actor_id, seq, self.worker_id.binary())
@@ -1620,7 +1676,7 @@ class _LeasePool:
                 traceback.print_exc()
             self.inflight_leases -= 1
             if self.pending:
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(CONFIG.lease_retry_backoff_s)
                 self._pump()
 
     def _dispatch(self, conn: WorkerConn, record: TaskRecord) -> None:
@@ -1738,7 +1794,9 @@ class _ActorState:
 
     # max specs per PushTaskBatch frame: bounds the receiver's reply delay
     # for the batch's first task (execution is serial per actor anyway)
-    BATCH_MAX = 64
+    @property
+    def BATCH_MAX(self) -> int:
+        return CONFIG.actor_call_batch_max
 
     def __init__(self, actor_id: ActorID):
         self.actor_id = actor_id
@@ -1750,6 +1808,10 @@ class _ActorState:
         self.death_cause = ""
         self._connecting = False
         self._flush_scheduled = False
+        # observed execution-time EMA (ms), fed by reply exec_ms: batching
+        # is only worth its reply-delay cost for SHORT tasks (a batch's
+        # first result arrives after the whole frame executes serially)
+        self._exec_ms_ema: Optional[float] = None
 
     def next_seq(self) -> int:
         return self._seq.next()
@@ -1807,12 +1869,33 @@ class _ActorState:
             asyncio.get_running_loop().create_task(self._connect_then_flush(worker))
             return
         while self.queue:
-            if len(self.queue) == 1:
+            cap = self._batch_cap()
+            if len(self.queue) == 1 or cap <= 1:
                 self._push_nowait(worker, self.queue.popleft())
             else:
-                n = min(len(self.queue), self.BATCH_MAX)
+                n = min(len(self.queue), cap)
                 self._push_batch(worker,
                                  [self.queue.popleft() for _ in range(n)])
+
+    def _batch_cap(self) -> int:
+        """Frame size by observed task duration: a batch reply lands only
+        after the LAST task in the frame executes, so long tasks ship
+        individually (same duration-adaptive idea as the lease pools'
+        pipelining depth)."""
+        ema = self._exec_ms_ema
+        if ema is None:
+            return 8          # unknown: modest batch until measured
+        if ema < 5.0:
+            return self.BATCH_MAX
+        if ema < 20.0:
+            return 16
+        return 1
+
+    def _note_exec_ms(self, reply) -> None:
+        if isinstance(reply, dict) and "exec_ms" in reply:
+            ms = float(reply["exec_ms"])
+            ema = self._exec_ms_ema
+            self._exec_ms_ema = ms if ema is None else 0.8 * ema + 0.2 * ms
 
     async def _connect_then_flush(self, worker: Worker) -> None:
         addr = self.addr
@@ -1825,7 +1908,7 @@ class _ActorState:
             # The addr may be stale (actor died) or freshly updated while we
             # were connecting; back off and re-drive the flush so queued calls
             # can't wedge.
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(CONFIG.actor_reconnect_backoff_s)
         finally:
             self._connecting = False
         if self.queue:
@@ -1863,6 +1946,7 @@ class _ActorState:
             return
         replies = fut.result()
         for record, reply in zip(records, replies):
+            self._note_exec_ms(reply)
             if isinstance(reply, dict) and "batch_item_error" in reply:
                 # one item failed at the handler level; the rest of the
                 # frame is fine (see handle_push_task_batch)
@@ -1887,6 +1971,7 @@ class _ActorState:
                        fut: "asyncio.Future") -> None:
         if not fut.cancelled() and fut.exception() is None:
             try:
+                self._note_exec_ms(fut.result())
                 worker._on_task_reply(record, fut.result())
             except Exception as e:
                 import logging

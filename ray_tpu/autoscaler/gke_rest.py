@@ -205,8 +205,14 @@ class GkeRestClient(GkeNodePoolClient):
             try:
                 reply = self.request(
                     "POST", f"{ig_url}/listManagedInstances", None)
-            except GkeApiError:
-                continue  # group still materializing
+            except GkeApiError as e:
+                # only a group that does not exist YET is benign; a
+                # persistent failure (403 missing compute permission, …)
+                # must surface, or the autoscaler boot-timeouts healthy
+                # slices forever on an empty membership list
+                if e.status in (404, 409, 503):
+                    continue  # group still materializing
+                raise
             for inst in reply.get("managedInstances", []):
                 url = inst.get("instance", "")
                 if url and inst.get("instanceStatus") in (

@@ -24,7 +24,8 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "from_blocks", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "read_datasource",
-    "read_tfrecords", "read_sql", "read_images", "read_webdataset", "from_torch",
+    "read_tfrecords", "read_sql", "read_images", "read_webdataset",
+    "read_mongo", "read_bigquery", "from_torch",
     "DataContext",
 ]
 
@@ -132,6 +133,25 @@ def read_sql(sql: str, connection_factory, *,
 def read_webdataset(paths, *, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(_ds.WebDatasetDatasource(paths),
                            parallelism=parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, parallelism: Optional[int] = None) -> Dataset:
+    """Read a MongoDB collection (reference: read_api.py read_mongo;
+    gated — requires ``pymongo`` at read time)."""
+    return read_datasource(
+        _ds.MongoDatasource(uri, database, collection, pipeline=pipeline),
+        parallelism=parallelism)
+
+
+def read_bigquery(project_id: str, *, query: Optional[str] = None,
+                  dataset: Optional[str] = None,
+                  parallelism: Optional[int] = None) -> Dataset:
+    """Read a BigQuery query/dataset (reference: read_api.py
+    read_bigquery; gated — requires ``google-cloud-bigquery``)."""
+    return read_datasource(
+        _ds.BigQueryDatasource(project_id, query=query, dataset=dataset),
+        parallelism=parallelism)
 
 
 def from_torch(torch_dataset) -> Dataset:

@@ -30,18 +30,43 @@ class RefBundle:
 
 # --------------------------------------------------------------------- UDFs
 def _apply_specs(specs: List[MapSpec], block: Block) -> Block:
-    """Run a fused chain of transforms over one block inside a task."""
+    """Run a fused chain of transforms over one block inside a task.
+
+    Zero-copy fusion (reference: _internal/logical/rules/
+    zero_copy_map_fusion.py): a RUN of consecutive whole-block "batches"
+    transforms with the same batch_format passes each UDF's output batch
+    STRAIGHT into the next UDF — no block materialization + re-extraction
+    round-trip between fused stages."""
     acc = BlockAccessor(block)
-    for spec in specs:
+    i = 0
+    while i < len(specs):
+        spec = specs[i]
         fn = spec.fn
         kwargs = spec.fn_kwargs or {}
         if spec.kind == "batches":
             bs = spec.batch_size
             n = acc.num_rows()
             if bs is None or n <= bs:
-                out = fn(acc.to_batch(spec.batch_format), *spec.fn_args,
-                         **kwargs)
+                fmt = spec.batch_format
+                out = acc.to_batch(fmt)
+                # drain the whole same-format whole-block run zero-copy;
+                # n is re-derived after every UDF — an expanding UDF must
+                # not smuggle an oversized batch past a downstream
+                # batch_size (fixed-shape jitted fns depend on it)
+                while i < len(specs) and specs[i].kind == "batches" \
+                        and specs[i].batch_format == fmt \
+                        and (specs[i].batch_size is None
+                             or n <= specs[i].batch_size):
+                    s = specs[i]
+                    out = s.fn(out, *s.fn_args, **(s.fn_kwargs or {}))
+                    i += 1
+                    try:
+                        n = len(next(iter(out.values())))
+                    except Exception:
+                        break  # unknown shape: fall back to block path
                 block = BlockAccessor.batch_to_block(out)
+                acc = BlockAccessor(block)
+                continue
             else:
                 # honor batch_size by re-chunking the block — critical for
                 # fixed-shape jitted UDFs (reference: block_batching/)
@@ -72,6 +97,7 @@ def _apply_specs(specs: List[MapSpec], block: Block) -> Block:
         else:
             raise ValueError(f"unknown map kind {spec.kind!r}")
         acc = BlockAccessor(block)
+        i += 1
     return block
 
 

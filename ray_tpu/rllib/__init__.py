@@ -24,6 +24,9 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import (
     MultiAgentPPO, MultiAgentPPOConfig, PPO, PPOConfig)
+from ray_tpu.rllib.algorithms.bandit import (
+    BanditLinTS, BanditLinTSConfig, BanditLinUCB, BanditLinUCBConfig)
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner, PPOLearner
@@ -46,5 +49,7 @@ __all__ = [
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
     "SingleAgentEnvRunner", "MultiAgentEnv", "MultiAgentEnvRunner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "R2D2", "R2D2Config",
+    "MADDPG", "MADDPGConfig", "BanditLinUCB", "BanditLinUCBConfig",
+    "BanditLinTS", "BanditLinTSConfig",
     "PolicyClient", "PolicyServerInput",
 ]

@@ -1,0 +1,283 @@
+"""MADDPG — multi-agent DDPG with centralized critics (reference:
+rllib/algorithms/maddpg (legacy rllib_contrib/maddpg); Lowe et al. 2017
+"Multi-Agent Actor-Critic for Mixed Cooperative-Competitive Environments").
+
+Centralized training, decentralized execution: each agent has a
+deterministic actor μ_i(o_i) over its OWN observation, but its critic
+Q_i(s, a_1..a_n) sees the global state and EVERY agent's action — the
+fix for non-stationarity that independent DDPG learners suffer. Targets
+use target actors+critics (polyak).
+
+TPU-first shape: all agents' actors/critics are stacked into one pytree
+with a leading agent dim and updated in ONE jitted function via vmap over
+agents — n_agents small networks become one batched MXU-friendly update,
+not a Python loop of tiny matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.models.catalog import _mlp_forward, _mlp_params
+from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
+
+
+def _stacked_mlp_params(key, n: int, sizes, final_scale=1.0):
+    keys = jax.random.split(key, n)
+    return jax.vmap(
+        lambda k: _mlp_params(k, sizes, final_scale=final_scale))(keys)
+
+
+class MADDPGModel:
+    """Per-agent actor + centralized critic, agent-stacked (leading dim)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, n_agents: int,
+                 hidden: int = 64, act_low: float = -1.0,
+                 act_high: float = 1.0):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.n_agents = n_agents
+        self.hidden = hidden
+        self.act_low = act_low
+        self.act_high = act_high
+        self.state_dim = obs_dim * n_agents
+        self.joint_act = act_dim * n_agents
+
+    def init(self, rng) -> Dict:
+        k1, k2 = jax.random.split(rng)
+        return {
+            "actor": _stacked_mlp_params(
+                k1, self.n_agents,
+                (self.obs_dim, self.hidden, self.hidden, self.act_dim),
+                final_scale=0.01),
+            "critic": _stacked_mlp_params(
+                k2, self.n_agents,
+                (self.state_dim + self.joint_act, self.hidden,
+                 self.hidden, 1)),
+        }
+
+    def _squash(self, raw):
+        mid = (self.act_high + self.act_low) / 2.0
+        half = (self.act_high - self.act_low) / 2.0
+        return mid + half * jnp.tanh(raw)
+
+    def actions(self, params, obs_all):
+        """obs_all [B, n_agents, obs_dim] -> [B, n_agents, act_dim]."""
+        def one(actor_i, obs_i):   # obs_i [B, obs_dim]
+            return self._squash(_mlp_forward(actor_i, obs_i, jax.nn.relu))
+
+        out = jax.vmap(one, in_axes=(0, 1), out_axes=1)(
+            params["actor"], obs_all)
+        return out
+
+    def q_values(self, params, state, joint_actions):
+        """state [B, state_dim], joint_actions [B, joint_act] ->
+        [B, n_agents]."""
+        x = jnp.concatenate([state, joint_actions], axis=-1)
+
+        def one(critic_i):
+            return _mlp_forward(critic_i, x, jax.nn.relu)[..., 0]
+
+        return jax.vmap(one)(params["critic"]).swapaxes(0, 1)
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MADDPG)
+        self.lr = 1e-3
+        self.critic_lr = 1e-3
+        self.gamma = 0.95
+        self.tau = 0.01                     # polyak
+        self.train_batch_size = 128
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 300
+        self.exploration_noise = 0.3        # gaussian on actions
+        self.hidden_dim = 64
+        self.num_env_steps_per_iter = 128
+
+    def _training_keys(self):
+        return {"critic_lr", "tau", "train_batch_size",
+                "replay_buffer_capacity", "exploration_noise",
+                "hidden_dim", "num_env_steps_per_iter",
+                "num_steps_sampled_before_learning_starts"}
+
+
+class MADDPG(Algorithm):
+    """Self-contained trainer over a MultiAgentEnv with continuous
+    per-agent action spaces (the QMIX in-process collection pattern;
+    distributed rollout rides MultiAgentEnvRunner when envs are costly)."""
+
+    @classmethod
+    def get_default_config(cls):
+        return MADDPGConfig(algo_class=cls)
+
+    def __init__(self, config):
+        # bypass Algorithm.__init__'s env-runner/learner-group setup:
+        # MADDPG owns its own in-process loop (the QMIX pattern)
+        self.config = config
+        self.setup(config)
+
+    def setup(self, _config) -> None:
+        cfg = self.config
+        self._env = cfg.make_env()()
+        self.agents = list(self._env.possible_agents)
+        obs_space = self._env.observation_spaces[self.agents[0]]
+        act_space = self._env.action_spaces[self.agents[0]]
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.act_dim = int(np.prod(act_space.shape))
+        self.model = MADDPGModel(
+            self.obs_dim, self.act_dim, len(self.agents),
+            hidden=cfg.hidden_dim,
+            act_low=float(np.min(act_space.low)),
+            act_high=float(np.max(act_space.high)))
+        self.params = self.model.init(jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx_actor = optax.adam(cfg.lr)
+        self.tx_critic = optax.adam(cfg.critic_lr)
+        self.opt_actor = self.tx_actor.init(self.params["actor"])
+        self.opt_critic = self.tx_critic.init(self.params["critic"])
+        self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                   seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs: Any = None
+        self._ep_return = 0.0
+        self._total_env_steps = 0
+        self._episode_returns: List[float] = []
+        self._iteration = 0
+        self._update_fn = self._build_update()
+
+    # ------------------------------------------------------------ updates
+    def _build_update(self):
+        gamma, tau = self.config.gamma, self.config.tau
+        model = self.model
+        B_agents = len(self.agents)
+
+        def critic_loss(critic, actor_tgt, critic_tgt, batch):
+            state = batch["state"]
+            next_state = batch["next_state"]
+            next_obs = batch["next_obs"]
+            next_act = model.actions({"actor": actor_tgt}, next_obs)
+            next_q = model.q_values(
+                {"critic": critic_tgt}, next_state,
+                next_act.reshape(next_act.shape[0], -1))   # [B, n]
+            y = batch["rewards"] + gamma * \
+                (1.0 - batch["dones"][:, None]) * \
+                jax.lax.stop_gradient(next_q)
+            q = model.q_values({"critic": critic}, state,
+                               batch["joint_actions"])
+            return jnp.mean((q - y) ** 2)
+
+        def actor_loss(actor, critic, batch):
+            obs = batch["obs"]                           # [B, n, obs]
+            acts = model.actions({"actor": actor}, obs)  # [B, n, act]
+            # each agent's critic scores the joint action where ONLY its
+            # own slot comes from its live actor; other slots use the
+            # replayed actions (Lowe 2017 eq. 6)
+            replay_acts = batch["joint_actions"].reshape(acts.shape)
+            losses = []
+            for i in range(B_agents):
+                joint = replay_acts.at[:, i].set(acts[:, i])
+                qi = model.q_values({"critic": critic}, batch["state"],
+                                    joint.reshape(joint.shape[0], -1))
+                losses.append(-jnp.mean(qi[:, i]))
+            return sum(losses) / B_agents
+
+        def update(params, target, opt_a, opt_c, batch):
+            cl, cg = jax.value_and_grad(critic_loss)(
+                params["critic"], target["actor"], target["critic"], batch)
+            cu, opt_c = self.tx_critic.update(cg, opt_c, params["critic"])
+            critic = optax.apply_updates(params["critic"], cu)
+            al, ag = jax.value_and_grad(actor_loss)(
+                params["actor"], critic, batch)
+            au, opt_a = self.tx_actor.update(ag, opt_a, params["actor"])
+            actor = optax.apply_updates(params["actor"], au)
+            new_params = {"actor": actor, "critic": critic}
+            new_target = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, target, new_params)
+            return new_params, new_target, opt_a, opt_c, cl, al
+
+        return jax.jit(update)
+
+    # ---------------------------------------------------------- collection
+    def _obs_matrix(self, obs_dict) -> np.ndarray:
+        return np.stack([np.asarray(obs_dict[a], np.float32).reshape(-1)
+                         for a in self.agents])
+
+    def _collect(self, n_steps: int) -> int:
+        cfg = self.config
+        if self._obs is None:
+            obs_dict, _ = self._env.reset(seed=int(self._rng.integers(1e9)))
+            self._obs = self._obs_matrix(obs_dict)
+            self._ep_return = 0.0
+        for _ in range(n_steps):
+            obs = self._obs
+            acts = np.asarray(self.model.actions(
+                self.params, obs[None]))[0]           # [n, act_dim]
+            acts = acts + self._rng.normal(
+                0.0, cfg.exploration_noise, acts.shape)
+            acts = np.clip(acts, self.model.act_low, self.model.act_high)
+            action_dict = {a: acts[i].astype(np.float32)
+                           for i, a in enumerate(self.agents)}
+            nxt, rewards, terms, truncs, _ = self._env.step(action_dict)
+            done_all = bool(terms.get("__all__"))
+            trunc_all = bool(truncs.get("__all__"))
+            nxt_m = self._obs_matrix(nxt)
+            r_vec = np.asarray([float(rewards.get(a, 0.0))
+                                for a in self.agents], np.float32)
+            self._ep_return += float(r_vec.sum())
+            self.replay.add_batch({
+                "obs": obs[None],
+                "joint_actions": acts.reshape(1, -1).astype(np.float32),
+                "rewards": r_vec[None],
+                "next_obs": nxt_m[None],
+                "state": obs.reshape(1, -1),
+                "next_state": nxt_m.reshape(1, -1),
+                "dones": np.asarray([float(done_all)], np.float32),
+            })
+            self._total_env_steps += 1
+            if done_all or trunc_all:
+                self._episode_returns.append(self._ep_return)
+                obs_dict, _ = self._env.reset(
+                    seed=int(self._rng.integers(1e9)))
+                self._obs = self._obs_matrix(obs_dict)
+                self._ep_return = 0.0
+            else:
+                self._obs = nxt_m
+        return n_steps
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        new = self._collect(cfg.num_env_steps_per_iter)
+        metrics: Dict[str, Any] = {"env_steps_this_iter": new}
+        if len(self.replay) >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(max(1, new // 32)):
+                batch = self.replay.sample(cfg.train_batch_size)
+                (self.params, self.target_params, self.opt_actor,
+                 self.opt_critic, cl, al) = self._update_fn(
+                    self.params, self.target_params, self.opt_actor,
+                    self.opt_critic, batch)
+            metrics["critic_loss"] = float(cl)
+            metrics["actor_loss"] = float(al)
+        if self._episode_returns:
+            metrics["episode_return_mean"] = float(
+                np.mean(self._episode_returns[-100:]))
+        return metrics
+
+    def train(self) -> Dict:
+        self._iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self._iteration
+        return out
+
+    def stop(self) -> None:
+        try:
+            self._env.close()
+        except Exception:
+            pass

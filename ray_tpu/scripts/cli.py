@@ -79,6 +79,30 @@ def cmd_start(args) -> int:
     return 1
 
 
+def cmd_up(args) -> int:
+    """Launch head + workers over SSH (or locally) from a cluster config
+    (reference: autoscaler/_private/commands.py create_or_update_cluster)."""
+    from ray_tpu.autoscaler.launcher import (
+        ClusterLauncher, load_cluster_config)
+
+    config = load_cluster_config(args.config)
+    address = ClusterLauncher(config).up()
+    print(f"cluster '{config.get('cluster_name', 'cluster')}' up; "
+          f"head address: {address}")
+    print(f"connect with: ray_tpu.init(address={address!r})")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler.launcher import (
+        ClusterLauncher, load_cluster_config)
+
+    config = load_cluster_config(args.config)
+    ClusterLauncher(config).down()
+    print(f"cluster '{config.get('cluster_name', 'cluster')}' down")
+    return 0
+
+
 def cmd_stop(args) -> int:
     n = 0
     if os.path.exists(PID_FILE):
@@ -242,6 +266,15 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("stop", help="stop all locally-started nodes")
     s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser(
+        "up", help="launch a cluster from a YAML/JSON config (ray up)")
+    s.add_argument("config")
+    s.set_defaults(fn=cmd_up)
+
+    s = sub.add_parser("down", help="tear down a launched cluster")
+    s.add_argument("config")
+    s.set_defaults(fn=cmd_down)
 
     s = sub.add_parser("status", help="cluster resources + nodes")
     s.set_defaults(fn=cmd_status)

@@ -243,3 +243,25 @@ def prometheus_text() -> str:
             for key, v in snap.get("values", []):
                 lines.append(f"{name}{_fmt_tags(list(key))} {v}")
     return "\n".join(lines) + "\n"
+
+
+class CallbackGauge(Metric):
+    """Gauge whose value is read from a zero-argument callable at snapshot
+    time — for core-runtime counters kept as plain ints on hot paths
+    (reference: metric_defs.cc task/worker counters; a lock per increment
+    would tax the submission path this framework just batched)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str, fn):
+        super().__init__(name, description)
+        self._fn = fn
+
+    def snapshot(self) -> Dict:
+        try:
+            value = float(self._fn())
+        except Exception:
+            value = 0.0
+        return {"name": self.name, "kind": self.kind,
+                "description": self.description,
+                "values": [[list(_tag_key(self._default_tags)), value]]}

@@ -265,3 +265,66 @@ def test_select_drop_rename_block_ops(data_cluster):
         {"b": "bb"}).drop_columns(["a"]).take_all()
     assert list(out[0].keys()) == ["bb"]
     assert [r["bb"] for r in out] == [i * 2 for i in range(8)]
+
+
+def test_zero_copy_batch_fusion(data_cluster):
+    """Consecutive same-format batch transforms pass batches straight
+    through without block round-trips (reference: rules/
+    zero_copy_map_fusion.py). Observable: a mutation-free chain computes
+    correctly AND an identity-checking probe sees the PREVIOUS udf's
+    exact output object."""
+    import numpy as np
+
+    seen = {}
+
+    def first(b):
+        out = {"id": b["id"] * 2}
+        seen["obj"] = out["id"]
+        return out
+
+    def second(b):
+        # same ndarray object arrives — no intermediate block copy
+        seen["same"] = b["id"] is seen.get("obj")
+        return {"id": b["id"] + 1}
+
+    from ray_tpu.data._internal.logical import MapSpec
+    from ray_tpu.data._internal.physical import _apply_specs
+    from ray_tpu.data.block import BlockAccessor
+
+    block = BlockAccessor.batch_to_block(
+        {"id": np.arange(10, dtype=np.int64)})
+    out = _apply_specs(
+        [MapSpec(kind="batches", fn=first),
+         MapSpec(kind="batches", fn=second)], block)
+    rows = BlockAccessor(out).to_batch()
+    np.testing.assert_array_equal(rows["id"], np.arange(10) * 2 + 1)
+    assert seen["same"] is True
+    # and the e2e path still agrees
+    ds = rd.range(20, parallelism=2).map_batches(first).map_batches(second)
+    assert sorted(r["id"] for r in ds.take_all()) == \
+        sorted(2 * i + 1 for i in range(20))
+
+
+def test_gated_db_datasources(data_cluster):
+    """Mongo/BigQuery compose offline and raise clear ImportErrors at
+    read time when their clients are absent (reference:
+    datasource/mongo_datasource.py, bigquery_datasource.py)."""
+    import pytest as _pytest
+
+    def has(mod):
+        try:
+            __import__(mod)
+            return True
+        except ImportError:
+            return False
+
+    ds = rd.read_mongo("mongodb://localhost:27017", "db", "coll")
+    if not has("pymongo"):
+        with _pytest.raises(Exception, match="pymongo"):
+            ds.take_all()
+    bq = rd.read_bigquery("proj", query="SELECT 1 AS x")
+    if not has("google.cloud.bigquery"):
+        with _pytest.raises(Exception, match="bigquery"):
+            bq.take_all()
+    with _pytest.raises(ValueError):
+        rd.read_bigquery("proj")
