@@ -352,6 +352,7 @@ class NodeAgent:
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self, actor_spec: Optional[Dict] = None,
                       container: Optional[Dict] = None,
+                      conda_prefix: Optional[str] = None,
                       env_key: Optional[str] = None) -> WorkerHandle:
         worker_id = os.urandom(16).hex()
         log_dir = os.path.join(self.session_dir, "logs")
@@ -385,6 +386,18 @@ class NodeAgent:
             cmd = worker_container_command(
                 container, self.session_dir, self.store_dir, ray_env)
             env = dict(os.environ)
+        elif conda_prefix:
+            # conda runtime_env: the worker runs under the env's
+            # interpreter (reference conda.py sets the context's
+            # py_executable the same way); ray_tpu rides PYTHONPATH
+            from ray_tpu.runtime_env.conda import worker_conda_command
+
+            cmd, ray_env = worker_conda_command(conda_prefix, ray_env)
+            env = dict(os.environ)
+            env.update(ray_env)
+            from ray_tpu._private.config import scrub_axon_bootstrap_env
+
+            scrub_axon_bootstrap_env(env)
         else:
             cmd = [sys.executable, "-m", "ray_tpu._private.worker_process"]
             env = dict(os.environ)
@@ -412,6 +425,51 @@ class NodeAgent:
         self.workers[worker_id] = handle
         self._starting_workers += 1
         return handle
+
+    def _spawn_conda_worker(self, conda_spec, env_key: Optional[str],
+                            req: Dict) -> None:
+        """Resolve/materialize the conda env off-loop, then spawn a worker
+        under its interpreter. Env creation can take minutes (solver +
+        offline package cache), so it must not block the agent's event
+        loop; failures land on the lease future as a terminal
+        ``runtime_env`` error (retrying would fail identically).
+
+        One in-flight resolution per env_key: every drain pass while the
+        solver runs would otherwise re-trigger a redundant create for the
+        same pending lease."""
+        spawning = getattr(self, "_conda_spawning", None)
+        if spawning is None:
+            spawning = self._conda_spawning = set()
+        if env_key in spawning:
+            return
+        spawning.add(env_key)
+        self._starting_workers += 1
+
+        async def run() -> None:
+            try:
+                from ray_tpu.runtime_env.conda import ensure_conda_env
+
+                cache_root = os.path.join(self.session_dir,
+                                          "runtime_env_cache")
+                os.makedirs(cache_root, exist_ok=True)
+                prefix = await asyncio.get_running_loop().run_in_executor(
+                    None, ensure_conda_env, conda_spec, cache_root)
+            except Exception as e:
+                spawning.discard(env_key)
+                self._starting_workers = max(0, self._starting_workers - 1)
+                fut: asyncio.Future = req["fut"]
+                if not fut.done():
+                    fut.set_result({"error": "runtime_env",
+                                    "message": str(e)})
+                    if req in self._pending_leases:
+                        self._pending_leases.remove(req)
+                return
+            spawning.discard(env_key)
+            self._starting_workers = max(0, self._starting_workers - 1)
+            self._spawn_worker(conda_prefix=prefix, env_key=env_key)
+            await self._drain_pending_leases()
+
+        asyncio.get_running_loop().create_task(run())
 
     async def _register_client(self, conn: Connection, p: Dict) -> Dict:
         role = p.get("role")
@@ -655,17 +713,21 @@ class NodeAgent:
             return False
         env_key = req["p"].get("env_key")
         container = req["p"].get("container")
-        # container envs apply at SPAWN (the process must start inside the
-        # image), so a pristine host worker can never serve them: match only
-        # workers already tagged with this env_key
-        worker = self._pop_idle_worker(env_key, tagged_only=bool(container))
+        conda = req["p"].get("conda")
+        # container/conda envs apply at SPAWN (the process must start
+        # inside the image / under the env's interpreter), so a pristine
+        # host worker can never serve them: match only workers already
+        # tagged with this env_key
+        spawn_env = bool(container or conda)
+        worker = self._pop_idle_worker(env_key, tagged_only=spawn_env)
         if worker is None:
-            if len(self.workers) + self._starting_workers < self.max_workers + 8:
-                self._spawn_worker(container=container,
-                                   env_key=env_key if container else None)
-            elif self._evict_mismatched_idle():
-                self._spawn_worker(container=container,
-                                   env_key=env_key if container else None)
+            if len(self.workers) + self._starting_workers < self.max_workers + 8 \
+                    or self._evict_mismatched_idle():
+                if conda and not container:
+                    self._spawn_conda_worker(conda, env_key, req)
+                else:
+                    self._spawn_worker(container=container,
+                                       env_key=env_key if spawn_env else None)
             return False
         # allocate resources
         assigned_instances: Dict[str, list] = {}

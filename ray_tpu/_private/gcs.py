@@ -98,16 +98,23 @@ class HeadServer:
         self.task_events: List[Dict] = []  # ring buffer of task state transitions
         self.cluster_config = CONFIG.snapshot()
         self._pg_counter = 0
-        # GCS fault tolerance (reference: RedisStoreClient-backed HA,
-        # gcs_server.cc:522-535): durable state snapshots to a file; a
-        # restarted head with the same path resumes KV/jobs/actors/PGs
-        # while agents + drivers re-register through their watchdogs
+        # GCS fault tolerance (reference: storage backend selected at
+        # gcs_server.cc:522-535 — in-memory vs RedisStoreClient HA):
+        # durable state goes through a pluggable StoreClient (a file, or
+        # an external redis:// store that outlives this head); a restarted
+        # head with the same URI resumes KV/jobs/actors/PGs while agents +
+        # drivers re-register through their watchdogs
         # (NodeManagerService.NotifyGCSRestart analog).
         self.persist_path = persist_path
+        self.store = None
+        if persist_path:
+            from ray_tpu._private.store_client import create_store_client
+
+            self.store = create_store_client(persist_path)
         self._save_pending = False
         self._save_lock = asyncio.Lock()
         self._driver_conns: Dict[Optional[str], Connection] = {}
-        if persist_path:
+        if self.store is not None:
             self._load_state()
         # Strong refs to background tasks: the loop only holds weak refs, so
         # an unreferenced retry task can be GC'd mid-flight (asyncio docs).
@@ -118,17 +125,18 @@ class HeadServer:
     def _load_state(self) -> None:
         import pickle
 
-        if not os.path.exists(self.persist_path):
-            return
-        try:
-            with open(self.persist_path, "rb") as f:
-                state = pickle.load(f)
-        except Exception as e:
-            import logging
-
-            logging.getLogger("ray_tpu").error(
-                "head persistence snapshot unreadable (%s); starting "
-                "with empty state", e)
+        # A load failure must be FATAL, not "start empty": the next
+        # debounced save would overwrite the durable store with an empty
+        # snapshot, destroying exactly the state HA exists to protect
+        # (e.g. a transient redis outage during head restart).
+        tables = self.store.load()
+        if tables and all(isinstance(v, bytes) for v in tables.values()):
+            state = {name: pickle.loads(blob)
+                     for name, blob in tables.items()}
+        else:
+            # legacy file snapshot: one pickle of the state dict itself
+            state = tables
+        if not state:
             return
         self.kv = state.get("kv", {})
         self.jobs = state.get("jobs", {})
@@ -148,7 +156,7 @@ class HeadServer:
             self.actors[rec["actor_id"]] = info
 
     def _schedule_save(self) -> None:
-        if not self.persist_path or self._save_pending:
+        if self.store is None or self._save_pending:
             return
         self._save_pending = True
         loop = asyncio.get_running_loop()
@@ -180,26 +188,23 @@ class HeadServer:
 
     async def _save_state_async(self) -> None:
         self._save_pending = False
-        if not self.persist_path:
+        if self.store is None:
             return
         # serialize writers: a second debounced save during a slow write
-        # must not race the same file
+        # must not race the same backend
         async with self._save_lock:
             state = self._snapshot()
             await asyncio.to_thread(self._write_snapshot, state)
 
     def _write_snapshot(self, state: Dict) -> None:
         import pickle
-        import uuid
 
-        tmp = f"{self.persist_path}.{uuid.uuid4().hex[:8]}.tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        os.replace(tmp, self.persist_path)
+        self.store.save({name: pickle.dumps(value)
+                         for name, value in state.items()})
 
     def _save_state(self) -> None:
         """Synchronous save (shutdown/teardown paths)."""
-        if self.persist_path:
+        if self.store is not None:
             self._write_snapshot(self._snapshot())
 
     def _hold_task(self, task: "asyncio.Task") -> "asyncio.Task":

@@ -1,0 +1,215 @@
+"""Pluggable durable storage for the head control plane (GCS HA).
+
+Mirrors the reference's storage-backend split (selected at
+``src/ray/gcs/gcs_server/gcs_server.cc:522-535``): an in-memory/file
+backend for single-head deployments and an external Redis-compatible
+backend (``store_client/redis_store_client.h:33``) so a restarted head —
+possibly on another machine — resumes cluster state from a store that
+outlives it.
+
+The Redis client speaks RESP2 over a plain socket — no third-party
+driver (this image can't pip install one), and the protocol surface the
+head needs is tiny: AUTH/SELECT/PING/HSET/HGETALL/DEL. State is stored
+as one hash per head namespace with a field per GCS table, written
+atomically via MULTI/EXEC.
+
+URI selection (``RAY_TPU_GCS_PERSIST``):
+    /path/to/file.bin          → FileStoreClient (atomic pickle)
+    redis://[:pass@]host:port[/db][?key=name] → RedisStoreClient
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import uuid
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+DEFAULT_HASH_KEY = "ray_tpu:gcs"
+
+
+class StoreClient:
+    """Durable table store: table name -> opaque bytes."""
+
+    def save(self, tables: Dict[str, bytes]) -> None:
+        raise NotImplementedError
+
+    def load(self) -> Dict[str, bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileStoreClient(StoreClient):
+    """Atomic whole-snapshot pickle to a local file (the in-memory
+    store-client analog: durable only as far as the head's disk)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, tables: Dict[str, bytes]) -> None:
+        tmp = f"{self.path}.{uuid.uuid4().hex[:8]}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(tables, f)
+        os.replace(tmp, self.path)
+
+    def load(self) -> Dict[str, bytes]:
+        if not os.path.exists(self.path):
+            return {}
+        with open(self.path, "rb") as f:
+            return pickle.load(f)
+
+
+class RespConnection:
+    """Minimal blocking RESP2 codec over one socket."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # --- encoding ---------------------------------------------------------
+    @staticmethod
+    def encode(*parts) -> bytes:
+        out = [b"*%d\r\n" % len(parts)]
+        for p in parts:
+            if isinstance(p, str):
+                p = p.encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+        return b"".join(out)
+
+    # --- decoding ---------------------------------------------------------
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RuntimeError(f"redis error: {rest.decode()}")
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n < 0 else self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            return None if n < 0 else [self.read_reply() for _ in range(n)]
+        raise RuntimeError(f"unparseable RESP reply {line!r}")
+
+    def command(self, *parts):
+        self.sock.sendall(self.encode(*parts))
+        return self.read_reply()
+
+    def pipeline(self, commands):
+        """Send all commands in one write, then read every reply."""
+        self.sock.sendall(b"".join(self.encode(*c) for c in commands))
+        return [self.read_reply() for _ in commands]
+
+
+class RedisStoreClient(StoreClient):
+    def __init__(self, host: str, port: int, *,
+                 password: Optional[str] = None, db: int = 0,
+                 hash_key: str = DEFAULT_HASH_KEY):
+        self.host, self.port = host, port
+        self.password, self.db = password, db
+        self.hash_key = hash_key
+        self._conn: Optional[RespConnection] = None
+
+    def _connect(self) -> RespConnection:
+        if self._conn is None:
+            conn = RespConnection(self.host, self.port)
+            if self.password:
+                conn.command("AUTH", self.password)
+            if self.db:
+                conn.command("SELECT", str(self.db))
+            conn.command("PING")
+            self._conn = conn
+        return self._conn
+
+    def _retrying(self, fn):
+        """One reconnect on a dropped connection (head outlives transient
+        redis restarts; a second failure raises to the caller). ANY
+        failure invalidates the connection — an error reply mid-pipeline
+        leaves unread replies buffered, and reusing that socket would
+        desynchronize every later command."""
+        try:
+            return fn(self._connect())
+        except (ConnectionError, OSError):
+            self._conn = None
+            return fn(self._connect())
+        except Exception:
+            self.close()
+            raise
+
+    def save(self, tables: Dict[str, bytes]) -> None:
+        def do(conn: RespConnection):
+            # replace the hash atomically: stale tables from a previous
+            # head must not survive a save that dropped them
+            cmds = [("MULTI",), ("DEL", self.hash_key)]
+            if tables:
+                flat = []
+                for name, blob in tables.items():
+                    flat += [name, blob]
+                cmds.append(("HSET", self.hash_key, *flat))
+            cmds.append(("EXEC",))
+            replies = conn.pipeline(cmds)
+            if replies[-1] is None:
+                raise RuntimeError("redis EXEC aborted")
+
+        self._retrying(do)
+
+    def load(self) -> Dict[str, bytes]:
+        def do(conn: RespConnection):
+            flat = conn.command("HGETALL", self.hash_key) or []
+            return {flat[i].decode(): flat[i + 1]
+                    for i in range(0, len(flat), 2)}
+
+        return self._retrying(do)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+def create_store_client(uri: str) -> StoreClient:
+    if uri.startswith(("redis://", "rediss://")):
+        from urllib.parse import unquote
+
+        parsed = urlparse(uri)
+        db = 0
+        if parsed.path and parsed.path.strip("/"):
+            db = int(parsed.path.strip("/"))
+        query = parse_qs(parsed.query)
+        hash_key = query.get("key", [DEFAULT_HASH_KEY])[0]
+        return RedisStoreClient(
+            parsed.hostname or "127.0.0.1", parsed.port or 6379,
+            password=unquote(parsed.password) if parsed.password else None,
+            db=db, hash_key=hash_key)
+    return FileStoreClient(uri)

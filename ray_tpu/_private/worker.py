@@ -1468,6 +1468,11 @@ class _PlacementGroupGone(Exception):
     """The target placement group was removed; queued tasks must fail."""
 
 
+class _RuntimeEnvFailed(Exception):
+    """The agent could not materialize a spawn-time runtime_env (conda /
+    container); retrying the lease would fail identically."""
+
+
 class _LeasePool:
     """Lease cache for one scheduling key (reference:
     direct_task_transport.h SchedulingKey entry): grab workers from agents,
@@ -1502,10 +1507,12 @@ class _LeasePool:
         # agents only hand this lease workers whose applied runtime_env
         # matches (or pristine ones) — see agent._pop_idle_worker
         self.env_key = runtime_env_key(spec.runtime_env)
-        # container envs are applied by the AGENT at worker spawn (the
-        # process must start inside the image), so the spec rides the
-        # lease request (runtime_env/container.py ContainerPlugin)
+        # container/conda envs are applied by the AGENT at worker spawn
+        # (the process must start inside the image / under the env's
+        # interpreter), so the spec rides the lease request
+        # (runtime_env/container.py, runtime_env/conda.py)
         self.container = (spec.runtime_env or {}).get("container")
+        self.conda = (spec.runtime_env or {}).get("conda")
         self.retriable = spec.max_retries > 0
         self.pending: deque = deque()
         self.conns: List[WorkerConn] = []
@@ -1617,6 +1624,7 @@ class _LeasePool:
                 "owner": w.worker_id.hex(),
                 "env_key": self.env_key,
                 "container": self.container,
+                "conda": self.conda,
                 "retriable": self.retriable,
             }
             agent_addr = None
@@ -1640,6 +1648,9 @@ class _LeasePool:
             if reply and reply.get("error") == "pg_removed":
                 raise _PlacementGroupGone(
                     f"placement group {self.pg[0] if self.pg else ''} removed")
+            if reply and reply.get("error") == "runtime_env":
+                raise _RuntimeEnvFailed(
+                    reply.get("message", "runtime_env setup failed"))
             grant = (reply or {}).get("grant")
             if not grant:
                 raise RpcError("lease request failed")
@@ -1662,13 +1673,17 @@ class _LeasePool:
             # lease is returned rather than pinning resources forever.
             self._ensure_reaper()
             self._pump()
-        except _PlacementGroupGone as e:
+        except (_PlacementGroupGone, _RuntimeEnvFailed) as e:
             # Unschedulable forever: fail every queued task, don't retry.
+            from ray_tpu.runtime_env.runtime_env import RuntimeEnvSetupError
+
+            exc = (RuntimeEnvSetupError(str(e))
+                   if isinstance(e, _RuntimeEnvFailed)
+                   else RuntimeError(str(e)))
             self.inflight_leases -= 1
             while self.pending:
                 record = self.pending.popleft()
-                self.worker._on_task_failure(
-                    record, RuntimeError(str(e)), retriable=False)
+                self.worker._on_task_failure(record, exc, retriable=False)
         except Exception:
             if os.environ.get("RAY_TPU_DEBUG"):
                 import traceback

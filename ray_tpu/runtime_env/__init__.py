@@ -11,10 +11,14 @@ exactly once per leased worker):
 - ``working_dir``: a local directory, staged into a content-addressed cache
                    under the session dir and chdir'd into
 - ``py_modules``:  list of local dirs/py files prepended to sys.path
-- ``pip`` / ``conda``: validated only — this deployment forbids network
-                   installs, so packages must already be importable; a
-                   missing import raises RuntimeEnvSetupError at setup time
-                   instead of deep inside user code
+- ``pip``:         per-env virtualenv with content-addressed caching (in
+                   this zero-egress image, requirements must resolve
+                   offline or already be importable system-wide)
+- ``conda``:       env name/prefix or environment.yml dict; SPAWN-TIME —
+                   the agent launches the worker under the env's python
+                   (runtime_env/conda.py; reference conda.py:259)
+- ``container``:   image spec; SPAWN-TIME — the agent wraps the worker
+                   launch in podman/docker (runtime_env/container.py)
 - ``config``:      {"setup_timeout_seconds": ...} accepted for parity
 
 TPU-first deviation: no separate per-node HTTP agent process — env setup is
@@ -30,6 +34,7 @@ from ray_tpu.runtime_env.runtime_env import (
 from ray_tpu.runtime_env.context import RuntimeEnvContext, setup_runtime_env
 from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
 import ray_tpu.runtime_env.container  # noqa: F401  (registers the plugin)
+import ray_tpu.runtime_env.conda  # noqa: F401  (registers the plugin)
 
 __all__ = [
     "RuntimeEnv",

@@ -1,0 +1,250 @@
+"""Conda runtime-env: workers start under a conda environment's python
+(VERDICT r2 missing #3; reference: python/ray/_private/runtime_env/conda.py
+— which materializes the env with ``conda env create`` keyed by a spec
+hash, then rewrites the worker command's interpreter to the env's python).
+
+Like ``container``, conda is a SPAWN-TIME field: a running worker cannot
+swap its interpreter, so the agent launches a fresh worker process with
+``<prefix>/bin/python`` and tags it with the runtime_env hash so pool
+affinity never mixes it with host workers
+(``agent._pop_idle_worker(tagged_only=True)``).
+
+Spec shapes (reference parity):
+    {"conda": "env-name-or-prefix-path"}        # use an existing env
+    {"conda": {"dependencies": ["python=3.11", {"pip": ["x"]}],
+               "channels": ["conda-forge"]}}    # materialize from a spec
+
+Everything that can be checked without a conda install is a pure function
+(command shape, digest, YAML emission, prefix resolution against a fake
+env tree) — the same offline-test pattern as the GKE REST client and the
+container command builder. Env *creation* needs a conda binary and, in
+this zero-egress image, an offline package cache; both are surfaced as
+RuntimeEnvSetupError, not crashes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.runtime_env.plugin import RuntimeEnvPlugin, register_plugin
+from ray_tpu.runtime_env.runtime_env import RuntimeEnvSetupError
+
+
+def conda_binary() -> Optional[str]:
+    """Resolve the fastest available conda-compatible solver binary."""
+    for name in ("mamba", "conda", "micromamba"):
+        path = shutil.which(name)
+        if path:
+            return path
+    return None
+
+
+def validate_conda_spec(spec: Any) -> None:
+    if isinstance(spec, str):
+        if not spec:
+            raise ValueError("conda env name must be non-empty")
+        return
+    if isinstance(spec, dict):
+        deps = spec.get("dependencies")
+        if not isinstance(deps, list) or not deps:
+            raise ValueError(
+                'conda dict spec needs a non-empty "dependencies" list '
+                "(environment.yml schema)")
+        for d in deps:
+            if not isinstance(d, (str, dict)):
+                raise TypeError(
+                    f"conda dependency entries must be str or "
+                    f"{{'pip': [...]}}; got {d!r}")
+        return
+    raise TypeError(
+        f"conda runtime_env must be an env name/prefix or an "
+        f"environment.yml dict; got {type(spec).__name__}")
+
+
+def spec_digest(spec: Dict) -> str:
+    """Content hash of a dict spec — the env cache key (the reference keys
+    on the hash of the serialized conda config the same way)."""
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def emit_environment_yaml(spec: Dict) -> str:
+    """Serialize a dict spec to environment.yml text.
+
+    Hand-rolled because the schema is tiny (name/channels/dependencies
+    with one optional nested ``{"pip": [...]}`` map) and the image may not
+    ship pyyaml; values are JSON-quoted, which is valid YAML.
+    """
+    lines: List[str] = []
+    if spec.get("name"):
+        lines.append(f"name: {json.dumps(str(spec['name']))}")
+    for key in ("channels",):
+        if spec.get(key):
+            lines.append(f"{key}:")
+            lines += [f"  - {json.dumps(str(c))}" for c in spec[key]]
+    lines.append("dependencies:")
+    for dep in spec.get("dependencies", []):
+        if isinstance(dep, str):
+            lines.append(f"  - {json.dumps(dep)}")
+        else:  # {"pip": [...]}
+            for sub_key, sub_list in dep.items():
+                lines.append(f"  - {json.dumps(str(sub_key))}:")
+                lines += [f"    - {json.dumps(str(p))}" for p in sub_list]
+    return "\n".join(lines) + "\n"
+
+
+def create_env_command(binary: str, prefix: str,
+                       yaml_path: str) -> List[str]:
+    """argv that materializes ``yaml_path`` into ``prefix``. micromamba
+    dropped the ``env`` subcommand alias; conda/mamba share it."""
+    base = os.path.basename(binary)
+    if base == "micromamba":
+        return [binary, "create", "--yes", "-p", prefix, "-f", yaml_path]
+    return [binary, "env", "create", "-p", prefix, "-f", yaml_path]
+
+
+def env_python(prefix: str) -> str:
+    return os.path.join(prefix, "bin", "python")
+
+
+def _candidate_roots() -> List[str]:
+    roots = []
+    for env_var in ("CONDA_ENVS_PATH", "CONDA_ENVS_DIRS"):
+        val = os.environ.get(env_var)
+        if val:
+            roots += val.split(os.pathsep)
+    conda_prefix = os.environ.get("CONDA_PREFIX")
+    if conda_prefix:
+        # activated env: envs live next to the base install
+        roots.append(os.path.join(conda_prefix, "envs"))
+        roots.append(os.path.join(os.path.dirname(
+            os.path.dirname(conda_prefix)), "envs"))
+    home = os.path.expanduser("~")
+    for base in ("miniconda3", "anaconda3", "miniforge3", "mambaforge",
+                 ".conda"):
+        roots.append(os.path.join(home, base, "envs"))
+    return roots
+
+
+def resolve_env_prefix(name_or_path: str,
+                       binary: Optional[str] = None) -> str:
+    """Map an env name or prefix path to a concrete prefix containing
+    ``bin/python``. Raises RuntimeEnvSetupError when nothing matches."""
+    if os.sep in name_or_path or name_or_path.startswith("~"):
+        prefix = os.path.expanduser(name_or_path)
+        if os.path.exists(env_python(prefix)):
+            return prefix
+        raise RuntimeEnvSetupError(
+            f"conda prefix {prefix} has no bin/python")
+    for root in _candidate_roots():
+        prefix = os.path.join(root, name_or_path)
+        if os.path.exists(env_python(prefix)):
+            return prefix
+    if binary:
+        try:
+            out = subprocess.run(
+                [binary, "env", "list", "--json"], capture_output=True,
+                text=True, timeout=60)
+            for prefix in json.loads(out.stdout or "{}").get("envs", []):
+                if os.path.basename(prefix) == name_or_path and \
+                        os.path.exists(env_python(prefix)):
+                    return prefix
+        except Exception:
+            pass
+    raise RuntimeEnvSetupError(
+        f"conda env {name_or_path!r} not found (no matching prefix under "
+        f"known env roots{' and conda env list came up empty' if binary else ', and no conda binary is installed to query'})")
+
+
+def ensure_conda_env(spec: Any, cache_root: str,
+                     binary: Optional[str] = None) -> str:
+    """Resolve (and for dict specs, materialize-on-miss) the env prefix.
+
+    Dict specs are content-addressed under ``<cache_root>/conda_envs`` and
+    creation is serialized with an flock, mirroring the pip plugin's
+    venv cache discipline.
+    """
+    binary = binary or conda_binary()
+    if isinstance(spec, str):
+        return resolve_env_prefix(spec, binary)
+    envs_root = os.path.join(cache_root, "conda_envs")
+    os.makedirs(envs_root, exist_ok=True)
+    prefix = os.path.join(envs_root, spec_digest(spec))
+    if os.path.exists(env_python(prefix)):
+        return prefix
+    if binary is None:
+        raise RuntimeEnvSetupError(
+            "conda runtime_env requested but no conda/mamba/micromamba "
+            "binary is installed on this node")
+    import fcntl
+
+    lock_path = prefix + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(env_python(prefix)):
+                return prefix
+            yaml_path = prefix + ".yml"
+            with open(yaml_path, "w") as f:
+                f.write(emit_environment_yaml(spec))
+            r = subprocess.run(
+                create_env_command(binary, prefix, yaml_path),
+                capture_output=True, text=True, timeout=1800)
+            if r.returncode != 0 or not os.path.exists(env_python(prefix)):
+                shutil.rmtree(prefix, ignore_errors=True)
+                raise RuntimeEnvSetupError(
+                    f"conda env create failed:\n{r.stdout}\n{r.stderr}\n"
+                    "(note: this deployment has no network egress — the "
+                    "env must resolve from a local package cache)")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return prefix
+
+
+def worker_conda_command(prefix: str, env: Dict[str, str]
+                         ) -> Tuple[List[str], Dict[str, str]]:
+    """(argv, env-overrides) launching this framework's worker process
+    under the env's interpreter. The ray_tpu package parent rides
+    PYTHONPATH because the env will not have the framework installed —
+    the same trick the container plugin uses with a bind-mount."""
+    import ray_tpu
+
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+    overrides = dict(env)
+    tail = overrides.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
+    # no trailing separator when there is no tail: an empty PYTHONPATH
+    # component means cwd, which would let staged working_dir files
+    # shadow stdlib modules inside the env interpreter
+    overrides["PYTHONPATH"] = (pkg_parent + os.pathsep + tail) if tail \
+        else pkg_parent
+    overrides["PATH"] = os.path.join(prefix, "bin") + os.pathsep + \
+        os.environ.get("PATH", "")
+    overrides["CONDA_PREFIX"] = prefix
+    overrides["CONDA_DEFAULT_ENV"] = os.path.basename(prefix)
+    cmd = [env_python(prefix), "-m", "ray_tpu._private.worker_process"]
+    return cmd, overrides
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Validation + spawn-time marker; by the time the worker runs it is
+    already the conda env's interpreter (agent launched it that way)."""
+
+    name = "conda"
+    priority = 0
+    spawn_time = True
+
+    def validate(self, value) -> None:
+        validate_conda_spec(value)
+
+    def setup(self, value, context) -> None:
+        pass
+
+
+register_plugin(CondaPlugin())

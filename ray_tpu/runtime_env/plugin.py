@@ -285,16 +285,9 @@ class PipPlugin(RuntimeEnvPlugin):
         importlib.invalidate_caches()
 
 
-class CondaGatePlugin(RuntimeEnvPlugin):
-    name = "conda"
-    priority = 3
-
-    def setup(self, value, context) -> None:
-        raise RuntimeEnvSetupError(
-            "conda environments are not supported in the no-install "
-            "deployment")
-
-
 for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-           PipPlugin(), CondaGatePlugin()):
+           PipPlugin()):
     register_plugin(_p)
+
+# conda registers itself from runtime_env/conda.py (spawn-time plugin,
+# imported by runtime_env/__init__.py alongside container)

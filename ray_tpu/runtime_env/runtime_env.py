@@ -86,8 +86,14 @@ def validate_runtime_env(env: Dict) -> None:
     if pip is not None and not isinstance(pip, (list, dict, str)):
         raise TypeError("pip must be a list of requirements, a dict, or a "
                         "requirements-file path")
-    # plugin-owned fields validate through their plugin (container, ...)
+    if "container" in env and "conda" in env:
+        # both are spawn-time interpreter choices; the agent can honor
+        # only one (the reference rejects the combination the same way)
+        raise ValueError(
+            "runtime_env cannot combine 'container' and 'conda'")
+    # every plugin-owned field validates through its plugin (container,
+    # conda, third-party); built-ins default to a no-op validate
     for key, value in env.items():
         plugin = _PLUGINS.get(key)
-        if plugin is not None and key not in RuntimeEnv.KNOWN_FIELDS:
+        if plugin is not None:
             plugin.validate(value)

@@ -40,6 +40,7 @@ FAST_FILES = {
     "test_events_sql.py",
     "test_gke_rest.py",
     "test_runtime_env_container.py",
+    "test_store_client.py",
 }
 SLOW_TESTS: set = set()
 
