@@ -440,6 +440,19 @@ class NodeAgent:
         spawning = getattr(self, "_conda_spawning", None)
         if spawning is None:
             spawning = self._conda_spawning = set()
+        failed = getattr(self, "_conda_failed", None)
+        if failed is None:
+            failed = self._conda_failed = {}
+        if env_key in failed:
+            # terminal: the same spec fails the same way — don't re-run a
+            # minutes-long doomed solver for every queued lease
+            fut: asyncio.Future = req["fut"]
+            if not fut.done():
+                fut.set_result({"error": "runtime_env",
+                                "message": failed[env_key]})
+                if req in self._pending_leases:
+                    self._pending_leases.remove(req)
+            return
         if env_key in spawning:
             return
         spawning.add(env_key)
@@ -456,6 +469,7 @@ class NodeAgent:
                     None, ensure_conda_env, conda_spec, cache_root)
             except Exception as e:
                 spawning.discard(env_key)
+                failed[env_key] = str(e)
                 self._starting_workers = max(0, self._starting_workers - 1)
                 fut: asyncio.Future = req["fut"]
                 if not fut.done():
@@ -463,6 +477,7 @@ class NodeAgent:
                                     "message": str(e)})
                     if req in self._pending_leases:
                         self._pending_leases.remove(req)
+                await self._drain_pending_leases()
                 return
             spawning.discard(env_key)
             self._starting_workers = max(0, self._starting_workers - 1)

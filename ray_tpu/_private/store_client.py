@@ -64,10 +64,16 @@ class FileStoreClient(StoreClient):
 
 
 class RespConnection:
-    """Minimal blocking RESP2 codec over one socket."""
+    """Minimal blocking RESP2 codec over one socket (TLS for rediss://)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 tls: bool = False):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        if tls:
+            import ssl
+
+            self.sock = ssl.create_default_context().wrap_socket(
+                self.sock, server_hostname=host)
         self.buf = b""
 
     def close(self) -> None:
@@ -135,15 +141,16 @@ class RespConnection:
 class RedisStoreClient(StoreClient):
     def __init__(self, host: str, port: int, *,
                  password: Optional[str] = None, db: int = 0,
-                 hash_key: str = DEFAULT_HASH_KEY):
+                 hash_key: str = DEFAULT_HASH_KEY, tls: bool = False):
         self.host, self.port = host, port
         self.password, self.db = password, db
         self.hash_key = hash_key
+        self.tls = tls
         self._conn: Optional[RespConnection] = None
 
     def _connect(self) -> RespConnection:
         if self._conn is None:
-            conn = RespConnection(self.host, self.port)
+            conn = RespConnection(self.host, self.port, tls=self.tls)
             if self.password:
                 conn.command("AUTH", self.password)
             if self.db:
@@ -162,7 +169,11 @@ class RedisStoreClient(StoreClient):
             return fn(self._connect())
         except (ConnectionError, OSError):
             self._conn = None
-            return fn(self._connect())
+            try:
+                return fn(self._connect())
+            except Exception:
+                self.close()
+                raise
         except Exception:
             self.close()
             raise
@@ -211,5 +222,6 @@ def create_store_client(uri: str) -> StoreClient:
         return RedisStoreClient(
             parsed.hostname or "127.0.0.1", parsed.port or 6379,
             password=unquote(parsed.password) if parsed.password else None,
-            db=db, hash_key=hash_key)
+            db=db, hash_key=hash_key,
+            tls=uri.startswith("rediss://"))
     return FileStoreClient(uri)

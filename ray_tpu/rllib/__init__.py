@@ -15,10 +15,15 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.algorithms.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
+from ray_tpu.rllib.algorithms.alpha_zero import (
+    AlphaZero, AlphaZeroConfig)
+from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
@@ -44,7 +49,9 @@ __all__ = [
     "DDPPOConfig", "DQN", "DQNConfig",
     "BC", "BCConfig", "A2C", "A2CConfig", "APPO", "APPOConfig",
     "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
-    "ES", "ESConfig", "MARWIL", "MARWILConfig",
+    "ES", "ESConfig", "ARS", "ARSConfig", "MARWIL", "MARWILConfig",
+    "AlphaZero", "AlphaZeroConfig", "CRR", "CRRConfig",
+    "DreamerV3", "DreamerV3Config",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "Learner",
     "PPOLearner", "LearnerGroup", "MLPModule", "RLModuleSpec",
     "SingleAgentEnvRunner", "MultiAgentEnv", "MultiAgentEnvRunner",

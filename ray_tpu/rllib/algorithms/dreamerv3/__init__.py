@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import (
+    DreamerV3, DreamerV3Config)
+
+__all__ = ["DreamerV3", "DreamerV3Config"]

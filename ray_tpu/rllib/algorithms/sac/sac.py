@@ -93,6 +93,22 @@ class SACModule:
         return (self._tower(params["q1"], x)[..., 0],
                 self._tower(params["q2"], x)[..., 0])
 
+    def logp(self, params, obs, action):
+        """Log-density of a GIVEN squashed action under the current
+        policy (offline learners — CRR/AWR-style — regress onto dataset
+        actions, so they need logp at arbitrary a, not just samples)."""
+        out = self._tower(params["actor"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        raw = jnp.arctanh(jnp.clip(action, -1.0 + 1e-6, 1.0 - 1e-6))
+        std = jnp.exp(log_std)
+        logp_raw = jnp.sum(
+            -0.5 * ((raw - mean) / std) ** 2 - log_std
+            - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+        return logp_raw - jnp.sum(
+            2.0 * (jnp.log(2.0) - raw - jax.nn.softplus(-2.0 * raw)),
+            axis=-1)
+
     # env-runner interface
     def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
         out = self._tower(params["actor"], obs)
