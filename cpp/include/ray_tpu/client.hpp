@@ -1,0 +1,99 @@
+// ray_tpu C++ driver API (reference: cpp/include/ray/api.h — the
+// reference's C++ worker links the full core_worker; this client speaks
+// the framework's own msgpack control plane directly: head RPCs for
+// KV/cluster state, agent RPCs for worker leases, and direct PushTask to
+// leased workers with cross-language specs executed by Python workers).
+//
+// Scope (documented in cpp/README.md): a native DRIVER — connect, KV,
+// cluster view, and SubmitPyTask (lease → push → msgpack result). C++
+// task *execution* (registering C++ functions as workers) is not
+// implemented; tasks target Python functions by "module:qualname".
+
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/msgpack.hpp"
+
+namespace ray_tpu {
+
+// One length-prefixed-frame RPC connection (protocol.py:
+//   <u32 LE length><msgpack {"m", "i", "p"}>  →  {"r": id, "p"|"e": ...}).
+class RpcClient {
+ public:
+  RpcClient() = default;
+  ~RpcClient();
+  RpcClient(const RpcClient&) = delete;
+  RpcClient& operator=(const RpcClient&) = delete;
+
+  void Connect(const std::string& host, int port, double timeout_s = 10.0);
+  bool connected() const { return fd_ >= 0; }
+  void Close();
+
+  // Synchronous call: sends the request and reads frames until the
+  // matching reply arrives (server pushes are skipped). Throws
+  // std::runtime_error on transport failure or an {"e": ...} reply.
+  msgpack::Value Call(const std::string& method,
+                      const msgpack::Value& payload);
+
+ private:
+  int fd_ = -1;
+  uint32_t next_id_ = 1;
+  std::string inbuf_;
+
+  void send_all(const std::string& data);
+  std::string read_frame();
+};
+
+struct TaskOptions {
+  double num_cpus = 1.0;
+  int num_returns = 1;
+  std::string function_name_for_logs;  // defaults to the func ref
+};
+
+class RayClient {
+ public:
+  // Connects to a running cluster's head (host:port printed by
+  // `ray_tpu start --head` / available from python as
+  // ray_tpu._global_node.head_port).
+  void Connect(const std::string& head_host, int head_port);
+
+  // Internal KV (head GcsInternalKVManager analog).
+  bool KvPut(const std::string& key, const std::string& value,
+             bool overwrite = true, const std::string& ns = "default");
+  // Returns nil Value when the key is absent.
+  msgpack::Value KvGet(const std::string& key,
+                       const std::string& ns = "default");
+
+  // {node_id: {addr: {host, port}, alive, ...}, ...}
+  msgpack::Value ClusterView();
+
+  // Submit one task executed by a Python worker: func_ref is
+  // "module:qualname" importable on the worker; args/kwargs are plain
+  // msgpack values (cross-language arg kind "x"). Blocks until the
+  // result; returns the unpacked return value. Throws with the remote
+  // error message on task failure.
+  msgpack::Value SubmitPyTask(const std::string& func_ref,
+                              const std::vector<msgpack::Value>& args,
+                              const TaskOptions& opts = {});
+
+ private:
+  RpcClient head_;
+  std::string job_id_;
+  uint64_t task_counter_ = 0;
+
+  // agent connections are cached per (host, port)
+  struct AgentConn {
+    std::string host;
+    int port;
+    std::unique_ptr<RpcClient> client;
+  };
+  std::vector<AgentConn> agents_;
+
+  RpcClient& AgentAt(const std::string& host, int port);
+};
+
+}  // namespace ray_tpu

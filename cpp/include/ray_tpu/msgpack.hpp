@@ -1,0 +1,291 @@
+// Minimal msgpack codec for the ray_tpu control plane.
+//
+// The wire contract is the framework's own (length-prefixed msgpack maps,
+// ray_tpu/_private/protocol.py) — this implements exactly the subset those
+// frames use: nil, bool, int/uint, float64, str, bin, array, map. No
+// extension types, no streaming. Header-only so the client builds with a
+// bare `g++ -I include` and zero third-party dependencies (the reference's
+// C++ worker pulls in the full msgpack-c via bazel; this deployment builds
+// offline).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ray_tpu {
+namespace msgpack {
+
+class Value {
+ public:
+  enum class Type { Nil, Bool, Int, Double, Str, Bin, Array, Map };
+
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;  // all integers normalize to i64 (the control plane
+                  // never uses the u64 upper half)
+  double d = 0.0;
+  std::string s;  // payload for Str and Bin
+  std::vector<Value> arr;
+  std::vector<std::pair<Value, Value>> map;  // insertion-ordered
+
+  Value() = default;
+  static Value Nil() { return Value(); }
+  static Value Boolean(bool v) {
+    Value x; x.type = Type::Bool; x.b = v; return x;
+  }
+  static Value Int(int64_t v) {
+    Value x; x.type = Type::Int; x.i = v; return x;
+  }
+  static Value Double(double v) {
+    Value x; x.type = Type::Double; x.d = v; return x;
+  }
+  static Value Str(std::string v) {
+    Value x; x.type = Type::Str; x.s = std::move(v); return x;
+  }
+  static Value Bin(std::string v) {
+    Value x; x.type = Type::Bin; x.s = std::move(v); return x;
+  }
+  static Value Array(std::vector<Value> v = {}) {
+    Value x; x.type = Type::Array; x.arr = std::move(v); return x;
+  }
+  static Value Map() {
+    Value x; x.type = Type::Map; return x;
+  }
+
+  Value& Set(const std::string& key, Value v) {
+    map.emplace_back(Str(key), std::move(v));
+    return *this;
+  }
+
+  bool is_nil() const { return type == Type::Nil; }
+
+  const Value* Find(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first.type == Type::Str && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+
+  // Throwing accessors for protocol fields the caller requires.
+  const Value& At(const std::string& key) const {
+    const Value* v = Find(key);
+    if (!v) throw std::runtime_error("msgpack map missing key: " + key);
+    return *v;
+  }
+  int64_t AsInt() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Double) return static_cast<int64_t>(d);
+    throw std::runtime_error("msgpack value is not an int");
+  }
+  const std::string& AsStr() const {
+    if (type != Type::Str && type != Type::Bin)
+      throw std::runtime_error("msgpack value is not a str/bin");
+    return s;
+  }
+};
+
+namespace detail {
+
+inline void put_u8(std::string& out, uint8_t v) {
+  out.push_back(static_cast<char>(v));
+}
+inline void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int k = bytes - 1; k >= 0; --k)
+    out.push_back(static_cast<char>((v >> (8 * k)) & 0xff));
+}
+
+}  // namespace detail
+
+inline void Pack(const Value& v, std::string& out) {
+  using detail::put_be;
+  using detail::put_u8;
+  switch (v.type) {
+    case Value::Type::Nil:
+      put_u8(out, 0xc0);
+      return;
+    case Value::Type::Bool:
+      put_u8(out, v.b ? 0xc3 : 0xc2);
+      return;
+    case Value::Type::Int: {
+      int64_t x = v.i;
+      if (x >= 0) {
+        if (x < 128) put_u8(out, static_cast<uint8_t>(x));
+        else if (x <= 0xff) { put_u8(out, 0xcc); put_be(out, x, 1); }
+        else if (x <= 0xffff) { put_u8(out, 0xcd); put_be(out, x, 2); }
+        else if (x <= 0xffffffffLL) { put_u8(out, 0xce); put_be(out, x, 4); }
+        else { put_u8(out, 0xcf); put_be(out, x, 8); }
+      } else {
+        if (x >= -32) put_u8(out, static_cast<uint8_t>(x));
+        else if (x >= -128) { put_u8(out, 0xd0); put_be(out, x & 0xff, 1); }
+        else if (x >= -32768) { put_u8(out, 0xd1); put_be(out, x & 0xffff, 2); }
+        else if (x >= -2147483648LL) {
+          put_u8(out, 0xd2); put_be(out, x & 0xffffffffULL, 4);
+        } else {
+          put_u8(out, 0xd3); put_be(out, static_cast<uint64_t>(x), 8);
+        }
+      }
+      return;
+    }
+    case Value::Type::Double: {
+      put_u8(out, 0xcb);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.d), "double width");
+      std::memcpy(&bits, &v.d, 8);
+      put_be(out, bits, 8);
+      return;
+    }
+    case Value::Type::Str: {
+      size_t n = v.s.size();
+      if (n < 32) put_u8(out, 0xa0 | static_cast<uint8_t>(n));
+      else if (n <= 0xff) { put_u8(out, 0xd9); put_be(out, n, 1); }
+      else if (n <= 0xffff) { put_u8(out, 0xda); put_be(out, n, 2); }
+      else { put_u8(out, 0xdb); put_be(out, n, 4); }
+      out.append(v.s);
+      return;
+    }
+    case Value::Type::Bin: {
+      size_t n = v.s.size();
+      if (n <= 0xff) { put_u8(out, 0xc4); put_be(out, n, 1); }
+      else if (n <= 0xffff) { put_u8(out, 0xc5); put_be(out, n, 2); }
+      else { put_u8(out, 0xc6); put_be(out, n, 4); }
+      out.append(v.s);
+      return;
+    }
+    case Value::Type::Array: {
+      size_t n = v.arr.size();
+      if (n < 16) put_u8(out, 0x90 | static_cast<uint8_t>(n));
+      else if (n <= 0xffff) { put_u8(out, 0xdc); put_be(out, n, 2); }
+      else { put_u8(out, 0xdd); put_be(out, n, 4); }
+      for (const auto& e : v.arr) Pack(e, out);
+      return;
+    }
+    case Value::Type::Map: {
+      size_t n = v.map.size();
+      if (n < 16) put_u8(out, 0x80 | static_cast<uint8_t>(n));
+      else if (n <= 0xffff) { put_u8(out, 0xde); put_be(out, n, 2); }
+      else { put_u8(out, 0xdf); put_be(out, n, 4); }
+      for (const auto& kv : v.map) {
+        Pack(kv.first, out);
+        Pack(kv.second, out);
+      }
+      return;
+    }
+  }
+  throw std::runtime_error("unreachable msgpack type");
+}
+
+inline std::string Pack(const Value& v) {
+  std::string out;
+  Pack(v, out);
+  return out;
+}
+
+class Unpacker {
+ public:
+  Unpacker(const char* data, size_t size) : p_(data), end_(data + size) {}
+
+  Value Next() {
+    uint8_t tag = u8();
+    if (tag < 0x80) return Value::Int(tag);                    // pos fixint
+    if (tag >= 0xe0) return Value::Int(static_cast<int8_t>(tag));  // neg
+    if ((tag & 0xf0) == 0x80) return map_(tag & 0x0f);         // fixmap
+    if ((tag & 0xf0) == 0x90) return arr_(tag & 0x0f);         // fixarray
+    if ((tag & 0xe0) == 0xa0) return str_(tag & 0x1f);         // fixstr
+    switch (tag) {
+      case 0xc0: return Value::Nil();
+      case 0xc2: return Value::Boolean(false);
+      case 0xc3: return Value::Boolean(true);
+      case 0xc4: return bin_(be(1));
+      case 0xc5: return bin_(be(2));
+      case 0xc6: return bin_(be(4));
+      case 0xca: {  // float32
+        uint32_t bits = static_cast<uint32_t>(be(4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::Double(f);
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = be(8);
+        double d;
+        std::memcpy(&d, &bits, 8);
+        return Value::Double(d);
+      }
+      case 0xcc: return Value::Int(static_cast<int64_t>(be(1)));
+      case 0xcd: return Value::Int(static_cast<int64_t>(be(2)));
+      case 0xce: return Value::Int(static_cast<int64_t>(be(4)));
+      case 0xcf: return Value::Int(static_cast<int64_t>(be(8)));
+      case 0xd0: return Value::Int(static_cast<int8_t>(be(1)));
+      case 0xd1: return Value::Int(static_cast<int16_t>(be(2)));
+      case 0xd2: return Value::Int(static_cast<int32_t>(be(4)));
+      case 0xd3: return Value::Int(static_cast<int64_t>(be(8)));
+      case 0xd9: return str_(be(1));
+      case 0xda: return str_(be(2));
+      case 0xdb: return str_(be(4));
+      case 0xdc: return arr_(be(2));
+      case 0xdd: return arr_(be(4));
+      case 0xde: return map_(be(2));
+      case 0xdf: return map_(be(4));
+      default:
+        throw std::runtime_error("msgpack: unsupported tag " +
+                                 std::to_string(tag));
+    }
+  }
+
+ private:
+  const char* p_;
+  const char* end_;
+
+  void need(size_t n) {
+    if (static_cast<size_t>(end_ - p_) < n)
+      throw std::runtime_error("msgpack: truncated input");
+  }
+  uint8_t u8() {
+    need(1);
+    return static_cast<uint8_t>(*p_++);
+  }
+  uint64_t be(int bytes) {
+    need(bytes);
+    uint64_t v = 0;
+    for (int k = 0; k < bytes; ++k)
+      v = (v << 8) | static_cast<uint8_t>(*p_++);
+    return v;
+  }
+  Value str_(uint64_t n) {
+    need(n);
+    Value v = Value::Str(std::string(p_, p_ + n));
+    p_ += n;
+    return v;
+  }
+  Value bin_(uint64_t n) {
+    need(n);
+    Value v = Value::Bin(std::string(p_, p_ + n));
+    p_ += n;
+    return v;
+  }
+  Value arr_(uint64_t n) {
+    Value v = Value::Array();
+    v.arr.reserve(n);
+    for (uint64_t k = 0; k < n; ++k) v.arr.push_back(Next());
+    return v;
+  }
+  Value map_(uint64_t n) {
+    Value v = Value::Map();
+    v.map.reserve(n);
+    for (uint64_t k = 0; k < n; ++k) {
+      Value key = Next();
+      v.map.emplace_back(std::move(key), Next());
+    }
+    return v;
+  }
+};
+
+inline Value Unpack(const std::string& data) {
+  return Unpacker(data.data(), data.size()).Next();
+}
+
+}  // namespace msgpack
+}  // namespace ray_tpu

@@ -1,0 +1,294 @@
+// ray_tpu C++ driver client — implementation. See client.hpp for scope.
+
+#include "ray_tpu/client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+namespace ray_tpu {
+
+using msgpack::Value;
+
+// ------------------------------------------------------------- RpcClient
+
+RpcClient::~RpcClient() { Close(); }
+
+void RpcClient::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void RpcClient::Connect(const std::string& host, int port,
+                        double timeout_s) {
+  Close();
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_str = std::to_string(port);
+  // the agents bind 0.0.0.0 and advertise it back verbatim for local
+  // clusters; loopback is the reachable address in that case
+  const std::string target =
+      (host == "0.0.0.0" || host.empty()) ? "127.0.0.1" : host;
+  if (::getaddrinfo(target.c_str(), port_str.c_str(), &hints, &res) != 0 ||
+      res == nullptr)
+    throw std::runtime_error("ray_tpu: cannot resolve " + target);
+  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0) {
+    ::freeaddrinfo(res);
+    throw std::runtime_error("ray_tpu: socket() failed");
+  }
+  struct timeval tv;
+  tv.tv_sec = static_cast<long>(timeout_s);
+  tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+  ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  int ok = ::connect(fd_, res->ai_addr, res->ai_addrlen);
+  ::freeaddrinfo(res);
+  if (ok != 0) {
+    Close();
+    throw std::runtime_error("ray_tpu: connect to " + target + ":" +
+                             port_str + " failed");
+  }
+  int nodelay = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &nodelay,
+               sizeof(nodelay));
+}
+
+void RpcClient::send_all(const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = ::send(fd_, data.data() + off, data.size() - off, 0);
+    if (n <= 0) {
+      Close();
+      throw std::runtime_error("ray_tpu: send failed");
+    }
+    off += static_cast<size_t>(n);
+  }
+}
+
+std::string RpcClient::read_frame() {
+  auto read_exact = [&](size_t n) {
+    while (inbuf_.size() < n) {
+      char buf[65536];
+      ssize_t got = ::recv(fd_, buf, sizeof(buf), 0);
+      if (got <= 0) {
+        Close();
+        throw std::runtime_error("ray_tpu: connection closed by peer");
+      }
+      inbuf_.append(buf, static_cast<size_t>(got));
+    }
+  };
+  read_exact(4);
+  uint32_t len;
+  std::memcpy(&len, inbuf_.data(), 4);  // u32 little-endian, like the wire
+  read_exact(4 + len);
+  std::string body = inbuf_.substr(4, len);
+  inbuf_.erase(0, 4 + len);
+  return body;
+}
+
+Value RpcClient::Call(const std::string& method, const Value& payload) {
+  if (fd_ < 0) throw std::runtime_error("ray_tpu: not connected");
+  const uint32_t req_id = next_id_++;
+  Value frame = Value::Map();
+  frame.Set("m", Value::Str(method));
+  frame.Set("i", Value::Int(req_id));
+  frame.Set("p", payload);
+  std::string body = msgpack::Pack(frame);
+  std::string out(4, '\0');
+  uint32_t len = static_cast<uint32_t>(body.size());
+  std::memcpy(&out[0], &len, 4);
+  out += body;
+  send_all(out);
+  for (;;) {
+    Value reply = msgpack::Unpack(read_frame());
+    const Value* r = reply.Find("r");
+    if (!r) continue;  // server push ({"m": ...}); this client ignores them
+    if (r->AsInt() != static_cast<int64_t>(req_id)) continue;  // stale
+    const Value* err = reply.Find("e");
+    if (err && !err->is_nil()) {
+      std::string msg = "remote error";
+      if (err->type == Value::Type::Array && err->arr.size() >= 2)
+        msg = err->arr[0].AsStr() + ": " + err->arr[1].AsStr();
+      throw std::runtime_error("ray_tpu RPC " + method + ": " + msg);
+    }
+    const Value* p = reply.Find("p");
+    return p ? *p : Value::Nil();
+  }
+}
+
+// ------------------------------------------------------------- RayClient
+
+namespace {
+
+constexpr int64_t kFixedPointScale = 10000;  // resources.py granularity
+
+std::string RandomBytes(size_t n) {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  std::string out(n, '\0');
+  for (size_t k = 0; k < n; ++k)
+    out[k] = static_cast<char>(rng() & 0xff);
+  return out;
+}
+
+// the Python-side cross-language sentinel
+// (ray_tpu/_private/function_table.py XLANG_PYREF_FID, 16 bytes)
+const char kXlangFid[] = "xlang-pyref\x00\x00\x00\x00\x00";
+
+}  // namespace
+
+void RayClient::Connect(const std::string& head_host, int head_port) {
+  head_.Connect(head_host, head_port);
+  job_id_ = RandomBytes(4);
+  // announce ourselves like a Python driver would so the job shows up in
+  // the job table / dashboard
+  Value reg = Value::Map();
+  reg.Set("job_id", Value::Str("cpp-" + std::to_string(head_port)));
+  reg.Set("entrypoint", Value::Str("cpp-driver"));
+  try {
+    head_.Call("RegisterDriver", reg);
+  } catch (const std::exception&) {
+    // registration is observability, not a functional dependency
+  }
+}
+
+bool RayClient::KvPut(const std::string& key, const std::string& value,
+                      bool overwrite, const std::string& ns) {
+  Value p = Value::Map();
+  p.Set("ns", Value::Str(ns));
+  p.Set("key", Value::Bin(key));
+  p.Set("value", Value::Bin(value));
+  p.Set("overwrite", Value::Boolean(overwrite));
+  Value r = head_.Call("KvPut", p);
+  return r.type == Value::Type::Bool && r.b;
+}
+
+Value RayClient::KvGet(const std::string& key, const std::string& ns) {
+  Value p = Value::Map();
+  p.Set("ns", Value::Str(ns));
+  p.Set("key", Value::Bin(key));
+  return head_.Call("KvGet", p);
+}
+
+Value RayClient::ClusterView() {
+  return head_.Call("GetClusterView", Value::Map());
+}
+
+RpcClient& RayClient::AgentAt(const std::string& host, int port) {
+  for (auto& a : agents_)
+    if (a.host == host && a.port == port && a.client->connected())
+      return *a.client;
+  AgentConn conn{host, port, std::unique_ptr<RpcClient>(new RpcClient())};
+  conn.client->Connect(host, port, 60.0);
+  agents_.push_back(std::move(conn));
+  return *agents_.back().client;
+}
+
+Value RayClient::SubmitPyTask(const std::string& func_ref,
+                              const std::vector<Value>& args,
+                              const TaskOptions& opts) {
+  // ---- pick a node (first alive) -------------------------------------
+  Value view = ClusterView();
+  const Value* addr = nullptr;
+  for (const auto& kv : view.map) {
+    const Value* alive = kv.second.Find("alive");
+    if (alive && alive->type == Value::Type::Bool && !alive->b) continue;
+    addr = kv.second.Find("addr");
+    if (addr) break;
+  }
+  if (!addr) throw std::runtime_error("ray_tpu: no alive nodes");
+
+  // ---- lease a worker (agent RequestWorkerLease, spillback-following) -
+  Value lease_payload = Value::Map();
+  Value resources = Value::Map();
+  resources.Set("CPU", Value::Int(static_cast<int64_t>(
+      opts.num_cpus * kFixedPointScale)));
+  lease_payload.Set("resources", resources);
+  lease_payload.Set("owner", Value::Str("cpp-driver"));
+  lease_payload.Set("retriable", Value::Boolean(false));
+  std::string host = addr->At("host").AsStr();
+  int port = static_cast<int>(addr->At("port").AsInt());
+  Value reply;
+  for (int hop = 0; hop < 5; ++hop) {
+    RpcClient& agent = AgentAt(host, port);
+    reply = agent.Call("RequestWorkerLease", lease_payload);
+    const Value* spill = reply.Find("spillback");
+    if (!spill || spill->is_nil()) break;
+    host = spill->At("addr").At("host").AsStr();
+    port = static_cast<int>(spill->At("addr").At("port").AsInt());
+    lease_payload.Set("spilled_once", Value::Boolean(true));
+  }
+  const Value* error = reply.Find("error");
+  if (error && !error->is_nil()) {
+    const Value* msg = reply.Find("message");
+    throw std::runtime_error("ray_tpu lease error: " +
+                             (msg ? msg->AsStr() : error->AsStr()));
+  }
+  const Value& grant = reply.At("grant");
+  const Value& waddr = grant.At("addr");
+
+  // ---- push the cross-language spec directly to the leased worker -----
+  RpcClient worker;
+  worker.Connect(waddr.At("host").AsStr(),
+                 static_cast<int>(waddr.At("port").AsInt()), 600.0);
+  ++task_counter_;
+  Value spec = Value::Map();
+  spec.Set("task_id", Value::Bin(RandomBytes(16)));
+  spec.Set("job_id", Value::Bin(job_id_));
+  spec.Set("task_type", Value::Int(0));  // NORMAL_TASK
+  spec.Set("function_id", Value::Bin(std::string(kXlangFid, 16)));
+  spec.Set("function_name", Value::Str(func_ref));
+  Value wire_args = Value::Array();
+  for (const auto& a : args) {
+    Value entry = Value::Array();
+    entry.arr.push_back(Value::Str("x"));
+    entry.arr.push_back(Value::Bin(msgpack::Pack(a)));
+    wire_args.arr.push_back(std::move(entry));
+  }
+  spec.Set("args", std::move(wire_args));
+  spec.Set("kwargs", Value::Map());
+  spec.Set("num_returns", Value::Int(opts.num_returns));
+  spec.Set("resources", Value::Map());
+  Value owner = Value::Map();
+  owner.Set("host", Value::Str(""));
+  owner.Set("port", Value::Int(0));
+  owner.Set("worker_id", Value::Str(std::string(32, '0')));
+  spec.Set("owner_addr", std::move(owner));
+  Value result = worker.Call("PushTask", spec);
+
+  // ---- return the lease, then decode ---------------------------------
+  Value ret_payload = Value::Map();
+  ret_payload.Set("lease_id", grant.At("lease_id"));
+  try {
+    AgentAt(host, port).Call("ReturnWorker", ret_payload);
+  } catch (const std::exception&) {
+    // lease reaping on the agent side covers a lost return
+  }
+  const Value* err = result.Find("error");
+  if (err && !err->is_nil() && !(err->type == Value::Type::Bool && !err->b)) {
+    const Value* msg = result.Find("error_message");
+    throw std::runtime_error(
+        "ray_tpu task failed: " +
+        (msg ? msg->AsStr() : std::string("(no message)")));
+  }
+  const Value& returns = result.At("returns");
+  if (returns.arr.empty()) return Value::Nil();
+  const Value* xl = returns.arr[0].Find("xlang");
+  if (!xl)
+    throw std::runtime_error(
+        "ray_tpu: worker returned a non-cross-language payload");
+  return msgpack::Unpack(xl->AsStr());
+}
+
+}  // namespace ray_tpu

@@ -1,29 +1,42 @@
 """Remaining accelerator families (reference:
 python/ray/_private/accelerators/{amd_gpu,intel_gpu,neuron,hpu,npu}.py) —
-detection + visibility env vars so clusters mixing hardware advertise the
-same custom resources the reference does. None of these devices exist in a
-TPU deployment, so detection returns 0 unless the standard env overrides
-say otherwise; the value is API parity for schedulers and tooling."""
+real device-node/sysfs probing plus the standard visibility env vars, so
+clusters mixing hardware advertise the same custom resources the
+reference does.
+
+Detection per family (all probe-able offline, no vendor SDK needed):
+AMD via kfd topology gpu_ids, Intel via DRM render nodes with the 8086
+vendor id, Neuron via /dev/neuron* (2 cores per device, the reference's
+neuron-ls accounting), Habana via /dev/accel* whose driver symlink says
+habana (shared namespace with TPU accel nodes — the driver name is the
+discriminator), Ascend NPU via /dev/davinci*. An explicit
+``RAY_TPU_NUM_*`` env var always wins (containers without sysfs; tests).
+"""
 
 from __future__ import annotations
 
+import glob
 import os
-from typing import Dict, List, Optional
+import re
+from typing import Dict, List
 
 from ray_tpu._private.accelerators.accelerator import AcceleratorManager
 
 
 def _env_count(var: str) -> int:
     try:
-        return int(os.environ.get(var, "0"))
+        return int(os.environ.get(var, "-1"))
     except ValueError:
-        return 0
+        return -1
 
 
-class _SimpleManager(AcceleratorManager):
+class _ProbingManager(AcceleratorManager):
     RESOURCE = ""
     VISIBLE_ENV = ""
     COUNT_ENV = ""
+    # overridable roots so tests can point at a fake /sys and /dev tree
+    SYS_ROOT = "/sys"
+    DEV_ROOT = "/dev"
 
     @classmethod
     def get_resource_name(cls) -> str:
@@ -34,8 +47,18 @@ class _SimpleManager(AcceleratorManager):
         return cls.VISIBLE_ENV
 
     @classmethod
+    def _detect(cls) -> int:
+        return 0
+
+    @classmethod
     def get_current_node_num_accelerators(cls) -> int:
-        return _env_count(cls.COUNT_ENV)
+        override = _env_count(cls.COUNT_ENV)
+        if override >= 0:
+            return override
+        try:
+            return cls._detect()
+        except OSError:
+            return 0
 
     @classmethod
     def set_visible_accelerator_ids(cls, ids: List[int]) -> None:
@@ -46,41 +69,111 @@ class _SimpleManager(AcceleratorManager):
         return {}
 
 
-class AMDGPUAcceleratorManager(_SimpleManager):
-    """reference: accelerators/amd_gpu.py (HIP_VISIBLE_DEVICES)."""
+class AMDGPUAcceleratorManager(_ProbingManager):
+    """reference: accelerators/amd_gpu.py (HIP_VISIBLE_DEVICES). kfd
+    topology lists CPUs too; only nodes with a nonzero gpu_id are GPUs."""
 
     RESOURCE = "GPU"
     VISIBLE_ENV = "HIP_VISIBLE_DEVICES"
     COUNT_ENV = "RAY_TPU_NUM_AMD_GPUS"
 
+    @classmethod
+    def _detect(cls) -> int:
+        count = 0
+        for path in glob.glob(os.path.join(
+                cls.SYS_ROOT, "class/kfd/kfd/topology/nodes/*/gpu_id")):
+            try:
+                with open(path) as f:
+                    if f.read().strip() not in ("", "0"):
+                        count += 1
+            except OSError:
+                pass
+        return count
 
-class IntelGPUAcceleratorManager(_SimpleManager):
-    """reference: accelerators/intel_gpu.py (ONEAPI_DEVICE_SELECTOR)."""
+
+class IntelGPUAcceleratorManager(_ProbingManager):
+    """reference: accelerators/intel_gpu.py (ONEAPI_DEVICE_SELECTOR).
+    DRM render nodes whose PCI vendor is 0x8086."""
 
     RESOURCE = "GPU"
     VISIBLE_ENV = "ONEAPI_DEVICE_SELECTOR"
     COUNT_ENV = "RAY_TPU_NUM_INTEL_GPUS"
 
+    @classmethod
+    def _detect(cls) -> int:
+        count = 0
+        for node in glob.glob(os.path.join(
+                cls.SYS_ROOT, "class/drm/renderD*")):
+            try:
+                with open(os.path.join(node, "device/vendor")) as f:
+                    if f.read().strip().lower() != "0x8086":
+                        continue
+                # skip the boot display (integrated graphics): an iGPU on
+                # a CPU node must not advertise a schedulable GPU
+                try:
+                    with open(os.path.join(node,
+                                           "device/boot_vga")) as f:
+                        if f.read().strip() == "1":
+                            continue
+                except OSError:
+                    pass  # discrete/headless parts often omit the file
+                count += 1
+            except OSError:
+                pass
+        return count
 
-class NeuronAcceleratorManager(_SimpleManager):
-    """reference: accelerators/neuron.py (NEURON_RT_VISIBLE_CORES)."""
+
+class NeuronAcceleratorManager(_ProbingManager):
+    """reference: accelerators/neuron.py (NEURON_RT_VISIBLE_CORES);
+    inf/trn devices appear as /dev/neuron<N>, two NeuronCores each."""
 
     RESOURCE = "neuron_cores"
     VISIBLE_ENV = "NEURON_RT_VISIBLE_CORES"
     COUNT_ENV = "RAY_TPU_NUM_NEURON_CORES"
+    CORES_PER_DEVICE = 2
+
+    @classmethod
+    def _detect(cls) -> int:
+        devices = [p for p in glob.glob(os.path.join(cls.DEV_ROOT,
+                                                     "neuron*"))
+                   if re.fullmatch(r"neuron\d+", os.path.basename(p))]
+        return len(devices) * cls.CORES_PER_DEVICE
 
 
-class HPUAcceleratorManager(_SimpleManager):
-    """reference: accelerators/hpu.py (HABANA_VISIBLE_MODULES)."""
+class HPUAcceleratorManager(_ProbingManager):
+    """reference: accelerators/hpu.py (HABANA_VISIBLE_MODULES). Gaudi
+    shares the /dev/accel* namespace with TPUs; the sysfs driver symlink
+    (habanalabs) is the discriminator."""
 
     RESOURCE = "HPU"
     VISIBLE_ENV = "HABANA_VISIBLE_MODULES"
     COUNT_ENV = "RAY_TPU_NUM_HPUS"
 
+    @classmethod
+    def _detect(cls) -> int:
+        count = 0
+        for node in glob.glob(os.path.join(cls.SYS_ROOT,
+                                           "class/accel/accel*")):
+            driver = os.path.join(node, "device/driver")
+            try:
+                if "habana" in os.path.basename(
+                        os.readlink(driver)).lower():
+                    count += 1
+            except OSError:
+                pass
+        return count
 
-class NPUAcceleratorManager(_SimpleManager):
-    """reference: accelerators/npu.py (ASCEND_RT_VISIBLE_DEVICES)."""
+
+class NPUAcceleratorManager(_ProbingManager):
+    """reference: accelerators/npu.py (ASCEND_RT_VISIBLE_DEVICES);
+    Ascend devices appear as /dev/davinci<N>."""
 
     RESOURCE = "NPU"
     VISIBLE_ENV = "ASCEND_RT_VISIBLE_DEVICES"
     COUNT_ENV = "RAY_TPU_NUM_NPUS"
+
+    @classmethod
+    def _detect(cls) -> int:
+        return len([p for p in glob.glob(os.path.join(cls.DEV_ROOT,
+                                                      "davinci*"))
+                    if re.fullmatch(r"davinci\d+", os.path.basename(p))])

@@ -443,13 +443,17 @@ class NodeAgent:
         failed = getattr(self, "_conda_failed", None)
         if failed is None:
             failed = self._conda_failed = {}
-        if env_key in failed:
-            # terminal: the same spec fails the same way — don't re-run a
-            # minutes-long doomed solver for every queued lease
+        cached = failed.get(env_key)
+        if cached is not None and \
+                time.monotonic() - cached[0] < CONFIG.conda_failure_cache_s:
+            # recently failed: the same spec very likely fails the same
+            # way — don't re-run a minutes-long doomed solver for every
+            # queued lease. The cache expires (transient solver/disk
+            # failures must not poison the env for the agent's lifetime).
             fut: asyncio.Future = req["fut"]
             if not fut.done():
                 fut.set_result({"error": "runtime_env",
-                                "message": failed[env_key]})
+                                "message": cached[1]})
                 if req in self._pending_leases:
                     self._pending_leases.remove(req)
             return
@@ -469,7 +473,7 @@ class NodeAgent:
                     None, ensure_conda_env, conda_spec, cache_root)
             except Exception as e:
                 spawning.discard(env_key)
-                failed[env_key] = str(e)
+                failed[env_key] = (time.monotonic(), str(e))
                 self._starting_workers = max(0, self._starting_workers - 1)
                 fut: asyncio.Future = req["fut"]
                 if not fut.done():
@@ -858,7 +862,7 @@ class NodeAgent:
                          "reason": "pg bundle unavailable"},
                     )
                     return
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(CONFIG.actor_resource_wait_poll_s)
             pg = list(key)
             self._pg_available[key].subtract(request)
             assigned = {}
@@ -872,7 +876,7 @@ class NodeAgent:
                          "reason": "timed out waiting for actor resources"},
                     )
                     return
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(CONFIG.actor_resource_wait_poll_s)
             assigned = self.resources.allocate(request, owner=p["actor_id"]) or {}
             self._resources_dirty = True
         handle = self._spawn_worker()
@@ -901,7 +905,7 @@ class NodeAgent:
         # Hold the resources until the actor dies.
         async def watch_release():
             while handle.alive:
-                await asyncio.sleep(0.5)
+                await asyncio.sleep(CONFIG.actor_liveness_poll_s)
             if pg:
                 pool = self._pg_available.get((pg[0], pg[1]))
                 if pool is not None:
@@ -1044,7 +1048,7 @@ class NodeAgent:
                     await asyncio.sleep(CONFIG.object_pull_retry_s)
                     continue
                 if loc is None:
-                    await asyncio.sleep(0.1)
+                    await asyncio.sleep(CONFIG.object_unlocated_retry_s)
                     continue
                 if loc.get("inline") is not None:
                     data = loc["inline"]
@@ -1091,7 +1095,7 @@ class NodeAgent:
                         return
                 else:
                     dead_rounds = 0
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(CONFIG.object_pull_round_s)
         finally:
             self._pulls_inflight.pop(hex_id, None)
 

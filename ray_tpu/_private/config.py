@@ -84,6 +84,20 @@ _flag("actor_reconnect_backoff_s", 0.2)  # actor-client reconnect pacing
 _flag("lease_retry_backoff_s", 0.2)  # lease-request retry pacing
 _flag("actor_call_batch_max", 64)  # specs per PushTaskBatch frame
 
+# --- round-3 sweep 2: poll cadences + 2PC/bootstrap deadlines ----------------
+_flag("actor_resource_wait_poll_s", 0.1)  # actor waiting on node/PG capacity
+_flag("actor_liveness_poll_s", 0.5)  # agent's hold-resources-until-death poll
+_flag("object_unlocated_retry_s", 0.1)  # owner knows no location yet
+_flag("object_pull_round_s", 0.2)  # pull-plane round pacing
+_flag("head_save_debounce_s", 0.05)  # snapshot write coalescing window
+_flag("pg_prepare_timeout_s", 10.0)  # 2PC bundle-prepare RPC deadline
+_flag("pg_retry_place_period_s", 0.5)  # pending-PG placement retry cadence
+_flag("pg_resolve_poll_s", 0.1)  # lease pool waiting for PG placement
+_flag("wait_poll_interval_s", 0.002)  # ray.wait readiness re-check
+_flag("node_boot_poll_s", 0.02)  # head/agent subprocess startup polling
+_flag("worker_park_poll_s", 0.5)  # worker main-thread liveness park
+_flag("conda_failure_cache_s", 60.0)  # failed-env fast-fail window
+
 # --- TPU --------------------------------------------------------------------
 _flag("tpu_chips_per_host_default", 4)
 _flag("tpu_premap_device_buffers", True)

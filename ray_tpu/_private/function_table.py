@@ -18,6 +18,35 @@ from ray_tpu._private import serialization as ser
 INLINE_FUNCTION_MAX = 16 * 1024
 _KV_NS = "funcs"
 
+# Cross-language function id: a task spec carrying this sentinel names its
+# target by module path in ``function_name`` ("pkg.mod:qualname") instead
+# of shipping a pickled blob — non-Python drivers (the C++ client) cannot
+# cloudpickle (reference: python/ray/cross_language.py function
+# descriptors address Python targets by module/name the same way).
+XLANG_PYREF_FID = b"xlang-pyref\x00\x00\x00\x00\x00"
+assert len(XLANG_PYREF_FID) == 16
+
+
+def load_pyref(name: str) -> Callable:
+    """Resolve "pkg.mod:qualname" (or dotted fallback) to a callable."""
+    import importlib
+
+    if ":" in name:
+        module_name, qual = name.split(":", 1)
+    else:
+        module_name, _, qual = name.rpartition(".")
+        if not module_name:
+            raise RuntimeError(
+                f"cross-language function name {name!r} must be "
+                "'module:qualname'")
+    module = importlib.import_module(module_name)
+    target = module
+    for part in qual.split("."):
+        target = getattr(target, part)
+    if not callable(target):
+        raise RuntimeError(f"{name!r} resolved to a non-callable")
+    return target
+
 import weakref
 
 _export_lock = threading.Lock()
@@ -51,7 +80,14 @@ def function_descriptor(function: Callable, worker) -> Tuple[bytes, Optional[byt
     return result
 
 
-def load_function(fid: bytes, blob: Optional[bytes], worker) -> Callable:
+def load_function(fid: bytes, blob: Optional[bytes], worker,
+                  name: str = "") -> Callable:
+    if fid == XLANG_PYREF_FID:
+        fn = _function_cache.get(b"pyref:" + name.encode())
+        if fn is None:
+            fn = load_pyref(name)
+            _function_cache[b"pyref:" + name.encode()] = fn
+        return fn
     fn = _function_cache.get(fid)
     if fn is not None:
         return fn

@@ -161,7 +161,8 @@ class HeadServer:
         self._save_pending = True
         loop = asyncio.get_running_loop()
         loop.call_later(
-            0.05, lambda: self._hold_task(loop.create_task(
+            CONFIG.head_save_debounce_s,
+            lambda: self._hold_task(loop.create_task(
                 self._save_state_async())))
 
     def _snapshot(self) -> Dict:
@@ -753,7 +754,7 @@ class HeadServer:
                     self._agent_call(node, "PreparePGBundle",
                                      {"pg_id": pg_id, "bundle_index": idx,
                                       "resources": bundle.to_wire()}),
-                    timeout=10,
+                    timeout=CONFIG.pg_prepare_timeout_s,
                 )
                 if resp and resp.get("ok"):
                     prepared.append((node, idx, bundle))
@@ -783,7 +784,7 @@ class HeadServer:
 
     async def _retry_place_pg(self, pg_id: str) -> None:
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(CONFIG.pg_retry_place_period_s)
             pg = self.placement_groups.get(pg_id)
             if pg is None or pg["state"] != "PENDING":
                 return

@@ -21,6 +21,7 @@ import time
 import uuid
 from typing import Dict, Optional
 
+from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID
 
 
@@ -127,7 +128,7 @@ class Node:
                     "head process exited during startup; see "
                     f"{self.session_dir}/logs/head.log"
                 )
-            time.sleep(0.02)
+            time.sleep(CONFIG.node_boot_poll_s)
         raise TimeoutError("head process did not report its port")
 
     def _start_agent(self) -> None:
@@ -173,7 +174,7 @@ class Node:
                     "agent process exited during startup; see "
                     f"{self.session_dir}/logs/agent-{self.node_id[:12]}.log"
                 )
-            time.sleep(0.02)
+            time.sleep(CONFIG.node_boot_poll_s)
         raise TimeoutError("agent did not become ready")
 
     # ---------------------------------------------------------------- down
@@ -192,7 +193,7 @@ class Node:
             if proc is None:
                 continue
             while proc.poll() is None and time.monotonic() < deadline:
-                time.sleep(0.02)
+                time.sleep(CONFIG.node_boot_poll_s)
             if proc.poll() is None:
                 try:
                     os.killpg(os.getpgid(proc.pid), signal.SIGKILL)

@@ -446,7 +446,7 @@ class Worker:
                 pass
             try:
                 await asyncio.wait_for(self.head.call("Ping", {}),
-                                       timeout=5.0)
+                                       timeout=CONFIG.head_ping_timeout_s)
                 continue
             except Exception:
                 if not self.connected:
@@ -881,7 +881,7 @@ class Worker:
                 chosen = ready[:num_returns]
                 rest = [r for r in refs if r not in set(chosen)]
                 return chosen, rest
-            time.sleep(0.002)
+            time.sleep(CONFIG.wait_poll_interval_s)
 
     def _is_ready(self, ref: ObjectRef,
                   last_probe: Optional[Dict[bytes, float]] = None) -> bool:
@@ -1612,7 +1612,7 @@ class _LeasePool:
                 if node is None:
                     raise RpcError(f"bundle node {node_id} lost")
                 return node["addr"]
-            await asyncio.sleep(0.1)
+            await asyncio.sleep(CONFIG.pg_resolve_poll_s)
 
     async def _request_lease(self) -> None:
         w = self.worker

@@ -219,6 +219,12 @@ class Executor:
         if kind == "r":
             ref = _rebuild_ref(bytes(entry[1]), entry[2])
             return self.worker._get_one(ref, timeout=None)
+        if kind == "x":
+            # cross-language by-value arg: plain msgpack, no pickle
+            # (reference: cross_language.py msgpack arg encoding)
+            import msgpack
+
+            return msgpack.unpackb(entry[1], raw=False)
         raise ValueError(f"bad arg entry kind {kind}")
 
     def _execute_sync(self, spec: TaskSpec, assigned: Dict) -> Dict:
@@ -245,7 +251,8 @@ class Executor:
                 fn = getattr(self.worker.actor_instance, spec.actor_method)
                 result = fn(*args, **kwargs)
             else:
-                fn = load_function(spec.function_id, spec.function_blob, self.worker)
+                fn = load_function(spec.function_id, spec.function_blob,
+                                   self.worker, name=spec.function_name)
                 result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 # async callable that evaded static detection (e.g. attached
@@ -259,6 +266,7 @@ class Executor:
             data = self.worker._serialize_value(err).to_bytes()
             return {
                 "error": True,
+                "error_message": f"{type(e).__name__}: {e}",  # xlang-readable
                 "error_inline": data,  # streaming tasks have no return slots
                 "returns": [
                     {"inline": data, "is_exception": True}
@@ -330,6 +338,32 @@ class Executor:
                 "node_addr": self.worker.agent_tcp_addr}
 
     def _package_returns(self, spec: TaskSpec, result: Any) -> Dict:
+        from ray_tpu._private.function_table import XLANG_PYREF_FID
+
+        if spec.function_id == XLANG_PYREF_FID:
+            # cross-language caller: returns must be readable without
+            # pickle — plain msgpack, one entry per return slot
+            import msgpack
+
+            if spec.num_returns == -1:
+                raise ValueError(
+                    "cross-language tasks do not support streaming "
+                    "returns (num_returns=-1)")
+            if spec.num_returns == 0:
+                return {"returns": []}
+            values = [result] if spec.num_returns == 1 else list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task declared num_returns={spec.num_returns} but "
+                    f"returned {len(values)} values")
+            try:
+                return {"returns": [
+                    {"xlang": msgpack.packb(v, use_bin_type=True)}
+                    for v in values]}
+            except (TypeError, ValueError) as e:
+                raise TypeError(
+                    f"cross-language task {spec.function_name!r} returned "
+                    f"a value msgpack cannot encode: {e}") from e
         if spec.num_returns == -1:
             return self._package_streaming(spec, result)
         if spec.num_returns == 0:
@@ -558,7 +592,7 @@ def main() -> None:
     # Park the main thread; all work happens on the IO loop + executors.
     try:
         while worker.connected and worker.agent.connected:
-            time.sleep(0.5)
+            time.sleep(CONFIG.worker_park_poll_s)
     except KeyboardInterrupt:
         pass
     os._exit(0)

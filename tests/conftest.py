@@ -41,6 +41,8 @@ FAST_FILES = {
     "test_gke_rest.py",
     "test_runtime_env_container.py",
     "test_store_client.py",
+    "test_accelerators.py",
+    "test_cpp_client.py",
 }
 SLOW_TESTS: set = set()
 

@@ -1,0 +1,95 @@
+"""Accelerator detection against fake sysfs/dev trees (reference:
+python/ray/tests/test_accelerators/* probe their managers the same way —
+no real hardware, just the filesystem contract each driver exposes)."""
+
+import os
+
+import pytest
+
+from ray_tpu._private.accelerators.other import (
+    AMDGPUAcceleratorManager, HPUAcceleratorManager,
+    IntelGPUAcceleratorManager, NeuronAcceleratorManager,
+    NPUAcceleratorManager)
+
+
+@pytest.fixture(autouse=True)
+def clear_overrides(monkeypatch):
+    for var in ("RAY_TPU_NUM_AMD_GPUS", "RAY_TPU_NUM_INTEL_GPUS",
+                "RAY_TPU_NUM_NEURON_CORES", "RAY_TPU_NUM_HPUS",
+                "RAY_TPU_NUM_NPUS"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_amd_counts_only_gpu_nodes(tmp_path, monkeypatch):
+    nodes = tmp_path / "class/kfd/kfd/topology/nodes"
+    for i, gpu_id in enumerate(["0", "1234", "777"]):  # node 0 is the CPU
+        d = nodes / str(i)
+        d.mkdir(parents=True)
+        (d / "gpu_id").write_text(gpu_id + "\n")
+    monkeypatch.setattr(AMDGPUAcceleratorManager, "SYS_ROOT",
+                        str(tmp_path))
+    assert AMDGPUAcceleratorManager.get_current_node_num_accelerators() == 2
+
+
+def test_intel_matches_vendor(tmp_path, monkeypatch):
+    for name, vendor in [("renderD128", "0x8086"), ("renderD129", "0x10de"),
+                         ("renderD130", "0x8086")]:
+        d = tmp_path / "class/drm" / name / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text(vendor + "\n")
+    monkeypatch.setattr(IntelGPUAcceleratorManager, "SYS_ROOT",
+                        str(tmp_path))
+    assert IntelGPUAcceleratorManager.\
+        get_current_node_num_accelerators() == 2
+
+
+def test_neuron_two_cores_per_device(tmp_path, monkeypatch):
+    for name in ("neuron0", "neuron1", "neuron_monitor"):  # last not a dev
+        (tmp_path / name).touch()
+    monkeypatch.setattr(NeuronAcceleratorManager, "DEV_ROOT", str(tmp_path))
+    assert NeuronAcceleratorManager.get_current_node_num_accelerators() == 4
+
+
+def test_hpu_discriminates_from_tpu_accel_nodes(tmp_path, monkeypatch):
+    drivers = tmp_path / "drivers"
+    drivers.mkdir(parents=True)
+    for name, drv in [("accel0", "habanalabs"), ("accel1", "tpu_common")]:
+        d = tmp_path / "class/accel" / name / "device"
+        d.mkdir(parents=True)
+        (drivers / drv).mkdir(exist_ok=True)
+        os.symlink(drivers / drv, d / "driver")
+    monkeypatch.setattr(HPUAcceleratorManager, "SYS_ROOT", str(tmp_path))
+    assert HPUAcceleratorManager.get_current_node_num_accelerators() == 1
+
+
+def test_npu_davinci_nodes(tmp_path, monkeypatch):
+    for name in ("davinci0", "davinci1", "davinci_manager"):
+        (tmp_path / name).touch()
+    monkeypatch.setattr(NPUAcceleratorManager, "DEV_ROOT", str(tmp_path))
+    assert NPUAcceleratorManager.get_current_node_num_accelerators() == 2
+
+
+def test_env_override_wins(tmp_path, monkeypatch):
+    monkeypatch.setattr(NPUAcceleratorManager, "DEV_ROOT", str(tmp_path))
+    (tmp_path / "davinci0").touch()
+    monkeypatch.setenv("RAY_TPU_NUM_NPUS", "8")
+    assert NPUAcceleratorManager.get_current_node_num_accelerators() == 8
+    monkeypatch.setenv("RAY_TPU_NUM_NPUS", "0")
+    assert NPUAcceleratorManager.get_current_node_num_accelerators() == 0
+
+
+def test_visible_ids_env(monkeypatch):
+    monkeypatch.setenv("HIP_VISIBLE_DEVICES", "")  # register for teardown
+    AMDGPUAcceleratorManager.set_visible_accelerator_ids([0, 2])
+    assert os.environ["HIP_VISIBLE_DEVICES"] == "0,2"
+
+
+def test_intel_skips_boot_vga_igpu(tmp_path, monkeypatch):
+    d = tmp_path / "class/drm/renderD128/device"
+    d.mkdir(parents=True)
+    (d / "vendor").write_text("0x8086\n")
+    (d / "boot_vga").write_text("1\n")
+    monkeypatch.setattr(IntelGPUAcceleratorManager, "SYS_ROOT",
+                        str(tmp_path))
+    assert IntelGPUAcceleratorManager.\
+        get_current_node_num_accelerators() == 0
