@@ -58,6 +58,12 @@ class Value {
   }
 
   Value& Set(const std::string& key, Value v) {
+    for (auto& kv : map) {
+      if (kv.first.type == Type::Str && kv.first.s == key) {
+        kv.second = std::move(v);  // replace: duplicate map keys are
+        return *this;              // malformed msgpack
+      }
+    }
     map.emplace_back(Str(key), std::move(v));
     return *this;
   }

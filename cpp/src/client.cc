@@ -8,6 +8,7 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstring>
 #include <random>
 #include <stdexcept>
@@ -189,6 +190,13 @@ RpcClient& RayClient::AgentAt(const std::string& host, int port) {
   for (auto& a : agents_)
     if (a.host == host && a.port == port && a.client->connected())
       return *a.client;
+  // drop dead entries so reconnect churn doesn't grow the cache forever
+  agents_.erase(
+      std::remove_if(agents_.begin(), agents_.end(),
+                     [](const AgentConn& a) {
+                       return !a.client->connected();
+                     }),
+      agents_.end());
   AgentConn conn{host, port, std::unique_ptr<RpcClient>(new RpcClient())};
   conn.client->Connect(host, port, 60.0);
   agents_.push_back(std::move(conn));
@@ -228,6 +236,13 @@ Value RayClient::SubmitPyTask(const std::string& func_ref,
     host = spill->At("addr").At("host").AsStr();
     port = static_cast<int>(spill->At("addr").At("port").AsInt());
     lease_payload.Set("spilled_once", Value::Boolean(true));
+  }
+  {
+    const Value* spill = reply.Find("spillback");
+    if (spill && !spill->is_nil())
+      throw std::runtime_error(
+          "ray_tpu: no worker lease granted after max spillback hops "
+          "(cluster busy)");
   }
   const Value* error = reply.Find("error");
   if (error && !error->is_nil()) {

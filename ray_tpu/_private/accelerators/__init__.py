@@ -10,10 +10,49 @@ from ray_tpu._private.accelerators.other import (
 )
 
 
+class _GPUChain:
+    """One node runs one GPU family (reference assumption): Nvidia is
+    probed first, then AMD (kfd), then Intel (DRM) — the first family
+    reporting devices owns the node's GPU resource + visibility env."""
+
+    CHAIN = (NvidiaGPUAcceleratorManager, AMDGPUAcceleratorManager,
+             IntelGPUAcceleratorManager)
+
+    @classmethod
+    def _active(cls):
+        for manager in cls.CHAIN:
+            try:
+                if manager.get_current_node_num_accelerators():
+                    return manager
+            except Exception:
+                continue
+        return cls.CHAIN[0]
+
+    @classmethod
+    def get_resource_name(cls):
+        return "GPU"
+
+    @classmethod
+    def get_current_node_num_accelerators(cls):
+        return cls._active().get_current_node_num_accelerators()
+
+    @classmethod
+    def get_current_node_additional_resources(cls):
+        return cls._active().get_current_node_additional_resources()
+
+    @classmethod
+    def get_visible_accelerator_ids_env_var(cls):
+        return cls._active().get_visible_accelerator_ids_env_var()
+
+    @classmethod
+    def set_visible_accelerator_ids(cls, ids):
+        return cls._active().set_visible_accelerator_ids(ids)
+
+
 def get_all_accelerator_managers():
     return {
         "TPU": TPUAcceleratorManager,
-        "GPU": NvidiaGPUAcceleratorManager,
+        "GPU": _GPUChain,
         "neuron_cores": NeuronAcceleratorManager,
         "HPU": HPUAcceleratorManager,
         "NPU": NPUAcceleratorManager,

@@ -32,13 +32,22 @@ def _detect_resources() -> Dict[str, float]:
         "CPU": float(os.cpu_count() or 1),
         "memory": float(psutil.virtual_memory().total),
     }
-    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+    from ray_tpu._private.accelerators import get_all_accelerator_managers
 
-    num_tpu = TPUAcceleratorManager.get_current_node_num_accelerators()
-    if num_tpu:
-        resources["TPU"] = float(num_tpu)
-        for name, qty in TPUAcceleratorManager.get_current_node_additional_resources().items():
-            resources[name] = qty
+    # every registered family probes; nonzero counts become schedulable
+    # resources (reference: NodeManagerConfig.resource_config fed by the
+    # AcceleratorManager ABC — TPU first-class, others detected the
+    # same way so mixed-hardware clusters advertise what they have)
+    for resource_name, manager in get_all_accelerator_managers().items():
+        try:
+            count = manager.get_current_node_num_accelerators()
+        except Exception:
+            count = 0
+        if count:
+            resources[resource_name] = float(count)
+            for name, qty in \
+                    manager.get_current_node_additional_resources().items():
+                resources[name] = qty
     return resources
 
 

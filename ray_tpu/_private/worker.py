@@ -1333,11 +1333,15 @@ class Worker:
         args: tuple,
         kwargs: dict,
         num_returns: int = 1,
+        max_retries: int = 0,
     ) -> List[ObjectRef]:
         self._n_actor_calls = getattr(self, "_n_actor_calls", 0) + 1
         st = self.actor_state_for(actor_id)
         seq = st.next_seq()
         task_id = TaskID.for_actor_task(actor_id, seq, self.worker_id.binary())
+        if max_retries < 0:
+            # reference semantics: -1 = retry indefinitely
+            max_retries = 2 ** 31
         wire_args = self._build_args(args) if args else []
         wire_kwargs = ({k: v for k, v in zip(kwargs.keys(),
                                              self._build_args(
@@ -1357,6 +1361,7 @@ class Worker:
             actor_id=actor_id.binary(),
             actor_method=method_name,
             seq=seq,
+            max_retries=max_retries,
         )
         if num_returns == -1:  # streaming actor method
             record = TaskRecord(spec, [])
@@ -1823,6 +1828,11 @@ class _ActorState:
         self.death_cause = ""
         self._connecting = False
         self._flush_scheduled = False
+        # in-flight records awaiting retry after a broken push; flushed
+        # onto the FRONT of the queue once per tick so a broken batch
+        # re-lands in original submission order
+        self._retry_buf: List[TaskRecord] = []
+        self._retry_flush_scheduled = False
         # observed execution-time EMA (ms), fed by reply exec_ms: batching
         # is only worth its reply-delay cost for SHORT tasks (a batch's
         # first result arrives after the whole frame executes serially)
@@ -1999,12 +2009,24 @@ class _ActorState:
             self._on_push_broken(worker, record)
 
     def _on_push_broken(self, worker: Worker, record: TaskRecord) -> None:
-        # Connection broke with the task in flight. It may have executed:
-        # do NOT resend (reference semantics: actor tasks are not retried
-        # by default; max_task_retries opts in). Queued-but-unsent tasks
-        # stay queued for the restarted actor.
+        # Connection broke with the task in flight. It MAY have executed:
+        # the default is fail-don't-resend; max_task_retries opts in to
+        # at-least-once resubmission after the actor restarts (reference
+        # actor.py max_task_retries semantics). Queued-but-unsent tasks
+        # stay queued for the restarted actor either way.
         if self.state == "ALIVE":
             self.state = "RESTARTING"
+        spec = record.spec
+        if spec.max_retries > record.attempts and not record.cancelled \
+                and record.streaming_gen is None and self.state != "DEAD":
+            record.attempts += 1
+            self._retry_buf.append(record)
+            worker._record_task_event(spec, "RETRYING")
+            if not self._retry_flush_scheduled:
+                self._retry_flush_scheduled = True
+                asyncio.get_running_loop().call_soon(
+                    self._flush_retries, worker)
+            return
         worker._on_task_failure(
             record,
             ActorDiedError(
@@ -2014,7 +2036,28 @@ class _ActorState:
             retriable=False,
         )
 
+    def _flush_retries(self, worker: Worker) -> None:
+        """Splice buffered retries onto the queue front in their original
+        submission order (per-record appendleft would reverse a broken
+        batch). A death that landed while buffering fails them instead —
+        a DEAD actor's queue is never drained again."""
+        self._retry_flush_scheduled = False
+        buf, self._retry_buf = self._retry_buf, []
+        if self.state == "DEAD":
+            for record in buf:
+                worker._on_task_failure(
+                    record,
+                    ActorDiedError(self.actor_id.hex(),
+                                   self.death_cause or "actor died"),
+                    retriable=False,
+                )
+            return
+        self.queue.extendleft(reversed(buf))
+
     def _fail_all(self, worker: Worker) -> None:
+        # late retries must die with the actor, not linger in the buffer
+        self.queue.extendleft(reversed(self._retry_buf))
+        self._retry_buf = []
         while self.queue:
             record = self.queue.popleft()
             worker._on_task_failure(

@@ -62,6 +62,9 @@ class ActorMethod:
             args,
             kwargs,
             num_returns=num_returns,
+            max_retries=opts.get(
+                "max_task_retries",
+                getattr(self._handle, "_max_task_retries", 0)),
         )
         if num_returns == -1:
             return refs  # ObjectRefGenerator
@@ -72,10 +75,14 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str = "Actor",
-                 method_num_returns: Optional[Dict[str, int]] = None):
+                 method_num_returns: Optional[Dict[str, int]] = None,
+                 max_task_retries: int = 0):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_num_returns = method_num_returns or {}
+        # creation-time opt-in: in-flight calls resubmit after a restart
+        # (at-least-once; reference actor.py max_task_retries semantics)
+        self._max_task_retries = max_task_retries
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -94,7 +101,8 @@ class ActorHandle:
     def __reduce__(self):
         return (
             ActorHandle,
-            (self._actor_id, self._class_name, self._method_num_returns),
+            (self._actor_id, self._class_name, self._method_num_returns,
+             self._max_task_retries),
         )
 
     def __hash__(self):
@@ -156,7 +164,9 @@ class ActorClass:
             attr = getattr(self._cls, name, None)
             if callable(attr) and hasattr(attr, "_num_returns"):
                 method_num_returns[name] = attr._num_returns
-        return ActorHandle(actor_id, self._cls.__name__, method_num_returns)
+        return ActorHandle(actor_id, self._cls.__name__, method_num_returns,
+                           max_task_retries=int(
+                               opts.get("max_task_retries", 0)))
 
 
 def method(num_returns: int = 1):

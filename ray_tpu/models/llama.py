@@ -397,18 +397,42 @@ def llama_hidden(
         y, _ = _layer(cfg, carry, lp, positions, lora=lo, lora_scale=scale)
         return y, None
 
+    lo_layers = lora["layers"] if lora is not None else None
     if cfg.remat:
         # "dots": keep matmul outputs, recompute elementwise — near-zero
         # extra MXU work for most of full remat's memory win. "full":
-        # recompute everything (longest-context fallback).
+        # recompute everything (longest-context fallback). "mixed:K":
+        # first K layers keep their matmul outputs, the rest recompute —
+        # spends whatever HBM headroom full remat leaves on skipping
+        # recompute FLOPs (each dots layer trades ~160 MB at 7B/B=1/S=2k
+        # for one layer-forward less recompute per step).
+        if cfg.remat_policy.startswith("mixed:"):
+            k = int(cfg.remat_policy.split(":", 1)[1])
+            n = cfg.num_layers
+            k = max(0, min(k, n))
+            dots_fn = jax.checkpoint(
+                scan_fn,
+                policy=jax.checkpoint_policies.
+                dots_with_no_batch_dims_saveable)
+            full_fn = jax.checkpoint(
+                scan_fn, policy=jax.checkpoint_policies.nothing_saveable)
+            head = jax.tree.map(lambda a: a[:k], params["layers"])
+            tail = jax.tree.map(lambda a: a[k:], params["layers"])
+            lo_head = (jax.tree.map(lambda a: a[:k], lo_layers)
+                       if lo_layers is not None else {})
+            lo_tail = (jax.tree.map(lambda a: a[k:], lo_layers)
+                       if lo_layers is not None else {})
+            x, _ = jax.lax.scan(dots_fn, x, (head, lo_head))
+            x, _ = jax.lax.scan(full_fn, x, (tail, lo_tail))
+            return _rms_norm(x, params["final_norm"], cfg.rms_eps)
         if cfg.remat_policy not in ("dots", "full"):
             raise ValueError(
-                f"remat_policy {cfg.remat_policy!r}: expected 'dots'|'full'")
+                f"remat_policy {cfg.remat_policy!r}: expected "
+                "'dots'|'full'|'mixed:K'")
         policy = (jax.checkpoint_policies.nothing_saveable
                   if cfg.remat_policy == "full"
                   else jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         scan_fn = jax.checkpoint(scan_fn, policy=policy)
-    lo_layers = lora["layers"] if lora is not None else None
     # broadcast None through the scan when no adapters: xs must be a pytree
     # of arrays, so substitute an empty dict
     x, _ = jax.lax.scan(scan_fn, x, (params["layers"], lo_layers or {}))

@@ -16,6 +16,8 @@ from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
 from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.jax.config import JaxConfig
 from ray_tpu.train.jax.jax_trainer import JaxTrainer
+from ray_tpu.train.predictor import (
+    BatchPredictor, JaxPredictor, Predictor, TorchPredictor)
 
 
 def report(metrics: Dict, *, checkpoint: Optional[Checkpoint] = None) -> None:
@@ -44,4 +46,5 @@ __all__ = [
     "load_pytree", "save_pytree_orbax", "load_pytree_orbax",
     "XGBoostTrainer", "LightGBMTrainer", "AccelerateTrainer",
     "LightningTrainer",
+    "Predictor", "JaxPredictor", "TorchPredictor", "BatchPredictor",
 ]

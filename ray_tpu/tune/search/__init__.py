@@ -1,8 +1,10 @@
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.bayesopt import BayesOptSearcher
 from ray_tpu.tune.search.hyperopt import HyperOptSearch
 from ray_tpu.tune.search.optuna import OptunaSearch
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
 from ray_tpu.tune.search.tpe import TPESearcher, TuneBOHB
 
 __all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator",
-           "OptunaSearch", "HyperOptSearch", "TPESearcher", "TuneBOHB"]
+           "OptunaSearch", "HyperOptSearch", "TPESearcher", "TuneBOHB",
+           "BayesOptSearcher"]

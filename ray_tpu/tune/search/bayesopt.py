@@ -1,0 +1,176 @@
+"""Native Gaussian-process Bayesian optimization searcher (reference:
+python/ray/tune/search/bayesopt/bayesopt_search.py wraps the external
+`bayesian-optimization` package; this is a dependency-free equivalent so
+the zero-egress deployment gets a model-based searcher beyond TPE).
+
+Model: a GP with an RBF kernel over unit-cube-normalized numeric
+dimensions (log-scaled where the domain is log-uniform), fit by Cholesky
+with a small jitter; acquisition is Expected Improvement maximized over
+random candidates. Categorical and sample_from dimensions are sampled
+randomly and passed through (the reference's BayesOpt has the same
+numeric-only restriction).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Function, Integer
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.search.tpe import _flatten_space, _get_path, _set_path
+
+
+def _is_log(domain: Domain) -> bool:
+    return bool(getattr(domain, "log", False))
+
+
+def _to_unit(domain: Domain, value: float) -> float:
+    lo, hi = float(domain.lower), float(domain.upper)
+    if _is_log(domain):
+        lo, hi, value = math.log(lo), math.log(hi), math.log(max(value, 1e-300))
+    if hi <= lo:
+        return 0.5
+    return min(max((value - lo) / (hi - lo), 0.0), 1.0)
+
+
+def _from_unit(domain: Domain, u: float) -> Any:
+    lo, hi = float(domain.lower), float(domain.upper)
+    if _is_log(domain):
+        value = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+    else:
+        value = lo + u * (hi - lo)
+    if isinstance(domain, Integer):
+        return int(min(max(round(value), domain.lower), domain.upper - 1))
+    return float(min(max(value, domain.lower), domain.upper))
+
+
+class _GP:
+    """RBF-kernel GP posterior on the unit cube."""
+
+    def __init__(self, X: np.ndarray, y: np.ndarray,
+                 length_scale: float = 0.25, noise: float = 1e-4):
+        self.X = X
+        self.ls = length_scale
+        self.y_mean = float(y.mean())
+        self.y_std = float(y.std()) or 1.0
+        yn = (y - self.y_mean) / self.y_std
+        K = self._kernel(X, X) + noise * np.eye(len(X))
+        jitter = 1e-8
+        while True:
+            try:
+                self.L = np.linalg.cholesky(K + jitter * np.eye(len(X)))
+                break
+            except np.linalg.LinAlgError:
+                jitter *= 10
+                if jitter > 1.0:
+                    raise
+        self.alpha = np.linalg.solve(
+            self.L.T, np.linalg.solve(self.L, yn))
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+    def posterior(self, Xs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        Ks = self._kernel(Xs, self.X)
+        mu = Ks @ self.alpha
+        v = np.linalg.solve(self.L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return (mu * self.y_std + self.y_mean,
+                np.sqrt(var) * self.y_std)
+
+
+def _expected_improvement(mu: np.ndarray, sigma: np.ndarray,
+                          best: float, xi: float = 0.01) -> np.ndarray:
+    z = (mu - best - xi) / sigma
+    # standard-normal pdf/cdf without scipy
+    pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+    cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+    return (mu - best - xi) * cdf + sigma * pdf
+
+
+class BayesOptSearcher(Searcher):
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 n_initial_points: int = 8, n_candidates: int = 256,
+                 length_scale: float = 0.25, xi: float = 0.01,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = space
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.xi = xi
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._live: Dict[str, Dict] = {}
+        self._obs: List[Tuple[Dict[Tuple, Any], float]] = []
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        if config and self.space is None:
+            self.space = config
+        return True
+
+    def _numeric_dims(self, dims: Dict[Tuple, Domain]) -> Dict[Tuple, Domain]:
+        return {p: d for p, d in dims.items()
+                if isinstance(d, (Float, Integer))}
+
+    def _suggest_flat(self, dims: Dict[Tuple, Domain]) -> Dict[Tuple, Any]:
+        flat = {p: d.sample(self._rng) for p, d in dims.items()
+                if isinstance(d, (Categorical, Function))}
+        numeric = self._numeric_dims(dims)
+        if not numeric:
+            return flat
+        obs = [(o, s) for o, s in self._obs
+               if all(p in o for p in numeric)]
+        if len(obs) < self.n_initial:
+            flat.update({p: d.sample(self._rng)
+                         for p, d in numeric.items()})
+            return flat
+        paths = sorted(numeric)
+        X = np.array([[_to_unit(numeric[p], float(o[p])) for p in paths]
+                      for o, _ in obs])
+        sign = 1.0 if self.mode == "max" else -1.0
+        y = sign * np.array([s for _, s in obs])
+        gp = _GP(X, y, length_scale=self.length_scale)
+        cand = self._np_rng.random((self.n_candidates, len(paths)))
+        mu, sigma = gp.posterior(cand)
+        ei = _expected_improvement(mu, sigma, float(y.max()), xi=self.xi)
+        best = cand[int(ei.argmax())]
+        for k, p in enumerate(paths):
+            flat[p] = _from_unit(numeric[p], float(best[k]))
+        return flat
+
+    # ---------------------------------------------------------- interface
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        import copy
+
+        if not self.space:
+            return None
+        dims = _flatten_space(self.space)
+        flat = self._suggest_flat(dims)
+        config = copy.deepcopy(
+            {k: v for k, v in self.space.items()
+             if not isinstance(v, Domain)})
+        for path, value in flat.items():
+            _set_path(config, path, value)
+        self._live[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        config = self._live.pop(trial_id, None)
+        if error or not result or self.metric not in result or \
+                config is None:
+            return
+        flat = {}
+        for path in _flatten_space(self.space):
+            try:
+                flat[path] = _get_path(config, path)
+            except (KeyError, TypeError):
+                pass
+        self._obs.append((flat, float(result[self.metric])))

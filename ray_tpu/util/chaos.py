@@ -1,0 +1,137 @@
+"""Chaos / fault-injection utilities (reference:
+python/ray/_private/test_utils.py:1431 ResourceKillerActor hierarchy and
+python/ray/tests/chaos/ — periodic killers that chaos tests aim at the
+cluster while a workload runs; recovery machinery, not the workload, is
+what's under test).
+
+Killers run in the DRIVER process on a background thread (they must
+survive the very failures they inject — an actor-based killer can be
+scheduled onto the node it kills). Targets come from the live cluster
+state, so the same killer works against ``cluster_utils.Cluster``
+fixtures and real deployments.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Callable, List, Optional
+
+import ray_tpu
+
+
+class ResourceKiller:
+    """Base: periodically pick a target and kill it until stopped."""
+
+    def __init__(self, interval_s: float = 1.0,
+                 max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.rng = random.Random(seed)
+        self.kills: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- subclass hooks ----------------------------------------------------
+    def find_target(self):
+        raise NotImplementedError
+
+    def kill_target(self, target) -> Optional[str]:
+        """Kill; return a human-readable record or None if it got away."""
+        raise NotImplementedError
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> "ResourceKiller":
+        def loop():
+            while not self._stop.is_set():
+                if self.max_kills is not None and \
+                        len(self.kills) >= self.max_kills:
+                    return
+                try:
+                    target = self.find_target()
+                    if target is not None:
+                        record = self.kill_target(target)
+                        if record:
+                            self.kills.append(record)
+                except Exception:
+                    pass  # the cluster may be mid-recovery; try again
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=type(self).__name__)
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[str]:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        return list(self.kills)
+
+
+class WorkerKiller(ResourceKiller):
+    """SIGKILL random task/actor worker processes on the local node
+    (reference: WorkerKillerActor). Workers are discovered through the
+    agent's ListWorkers RPC; the driver's own pid is never a target."""
+
+    def __init__(self, interval_s: float = 1.0,
+                 max_kills: Optional[int] = None,
+                 seed: Optional[int] = None,
+                 filter_fn: Optional[Callable[[dict], bool]] = None):
+        super().__init__(interval_s, max_kills, seed)
+        self.filter_fn = filter_fn
+
+    def find_target(self):
+        worker = ray_tpu._private.worker.global_worker
+        reply = worker._acall(
+            worker.agent.call("ListWorkers", {}), timeout=10)
+        candidates = [
+            w for w in (reply or [])
+            if w.get("pid") and w["pid"] != os.getpid()
+            # busy workers only: killing idle pool processes is no chaos
+            and w.get("state") in ("LEASED", "ACTOR")
+            and (self.filter_fn is None or self.filter_fn(w))
+        ]
+        return self.rng.choice(candidates) if candidates else None
+
+    def kill_target(self, target) -> Optional[str]:
+        try:
+            os.kill(target["pid"], signal.SIGKILL)
+            return f"worker pid={target['pid']}"
+        except ProcessLookupError:
+            return None
+
+
+class NodeKiller(ResourceKiller):
+    """Kill a random non-head node's agent process (reference:
+    RayletKiller / EC2InstanceTerminator). Operates on a
+    ``cluster_utils.Cluster`` so the process handles are killable."""
+
+    def __init__(self, cluster, interval_s: float = 2.0,
+                 max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
+        super().__init__(interval_s, max_kills, seed)
+        self.cluster = cluster
+
+    def find_target(self):
+        nodes = [n for n in self.cluster.worker_nodes
+                 if n.agent_proc and n.agent_proc.poll() is None]
+        return self.rng.choice(nodes) if nodes else None
+
+    def kill_target(self, target) -> Optional[str]:
+        node_id = target.node_id
+        self.cluster.remove_node(target, allow_graceful=False)
+        return f"node {node_id[:12]}"
+
+
+def kill_random_node(cluster, exclude_head: bool = True) -> Optional[str]:
+    """One-shot helper (the `ray kill-random-node` CLI analog)."""
+    killer = NodeKiller(cluster, max_kills=1)
+    target = killer.find_target()
+    if target is None:
+        return None
+    return killer.kill_target(target)

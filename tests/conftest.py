@@ -43,6 +43,7 @@ FAST_FILES = {
     "test_store_client.py",
     "test_accelerators.py",
     "test_cpp_client.py",
+    "test_tune_bayesopt.py",
 }
 SLOW_TESTS: set = set()
 

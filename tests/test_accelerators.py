@@ -78,6 +78,32 @@ def test_env_override_wins(tmp_path, monkeypatch):
     assert NPUAcceleratorManager.get_current_node_num_accelerators() == 0
 
 
+def test_node_detection_advertises_probed_families(monkeypatch):
+    """The probe results must reach the node's resource advertisement
+    (review finding: detection that never feeds scheduling is dead
+    code). Uses env overrides as the probe stand-in."""
+    from ray_tpu._private.node import _detect_resources
+
+    monkeypatch.setenv("RAY_TPU_NUM_NEURON_CORES", "4")
+    monkeypatch.setenv("RAY_TPU_NUM_NPUS", "2")
+    resources = _detect_resources()
+    assert resources["neuron_cores"] == 4.0
+    assert resources["NPU"] == 2.0
+
+
+def test_gpu_chain_falls_through_to_amd(tmp_path, monkeypatch):
+    from ray_tpu._private.accelerators import _GPUChain
+
+    nodes = tmp_path / "class/kfd/kfd/topology/nodes/1"
+    nodes.mkdir(parents=True)
+    (nodes / "gpu_id").write_text("777\n")
+    monkeypatch.setattr(AMDGPUAcceleratorManager, "SYS_ROOT",
+                        str(tmp_path))
+    assert _GPUChain.get_current_node_num_accelerators() == 1
+    assert _GPUChain.get_visible_accelerator_ids_env_var() == \
+        "HIP_VISIBLE_DEVICES"
+
+
 def test_visible_ids_env(monkeypatch):
     monkeypatch.setenv("HIP_VISIBLE_DEVICES", "")  # register for teardown
     AMDGPUAcceleratorManager.set_visible_accelerator_ids([0, 2])

@@ -46,6 +46,33 @@ class TestShardingRules:
 
 
 class TestLlama:
+    def test_mixed_remat_matches_full(self):
+        """remat_policy='mixed:K' (first K layers keep matmul outputs,
+        rest recompute) must produce the same loss and gradients as
+        'full' — the policy only changes what is stored, never the math."""
+        import dataclasses
+
+        from ray_tpu.models.llama import llama_loss
+
+        cfg = dataclasses.replace(LlamaConfig.debug_1l(), num_layers=2,
+                                  max_seq_len=32)
+        params = init_llama(dataclasses.replace(cfg, remat=False),
+                            jax.random.key(0))
+        tok = jax.random.randint(jax.random.key(1), (2, 17), 0,
+                                 cfg.vocab_size)
+        batch = {"inputs": tok[:, :-1], "targets": tok[:, 1:]}
+        results = {}
+        for pol in ("full", "mixed:1"):
+            c = dataclasses.replace(cfg, remat=True, remat_policy=pol)
+            results[pol] = jax.value_and_grad(
+                lambda p, c=c: llama_loss(p, batch, c))(params)
+        (ref_loss, ref_grads), (loss, grads) = \
+            results["full"], results["mixed:1"]
+        assert abs(float(loss) - float(ref_loss)) < 1e-5
+        for a, b in zip(jax.tree.leaves(grads),
+                        jax.tree.leaves(ref_grads)):
+            assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
     def test_forward_shape(self):
         cfg = LlamaConfig.debug_1l()
         params = init_llama(cfg, jax.random.key(0))

@@ -18,12 +18,21 @@ N_EXTRA_NODES = 49
 @pytest.fixture(scope="module")
 def big_cluster():
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
-    ray_tpu.init(_node=cluster.head_node)
-    for i in range(N_EXTRA_NODES):
-        # num_cpus=0: no prestarted worker processes — 50 agents alone is
-        # the point, not 50 worker pools
-        cluster.add_node(num_cpus=0, resources={f"n{i}": 1})
-    cluster.wait_for_nodes(timeout=600)
+    try:
+        ray_tpu.init(_node=cluster.head_node)
+        for i in range(N_EXTRA_NODES):
+            # num_cpus=0: no prestarted worker processes — 50 agents alone
+            # is the point, not 50 worker pools
+            cluster.add_node(num_cpus=0, resources={f"n{i}": 1})
+        cluster.wait_for_nodes(timeout=600)
+    except BaseException:
+        # a setup failure fires BEFORE yield — without this, the teardown
+        # below never runs and ~50 agent processes leak onto the box,
+        # poisoning every later test (observed: the full-suite run's
+        # wait_for_nodes timeout left 50+ agents running)
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        raise
     yield cluster
     ray_tpu.shutdown()
     cluster.shutdown()

@@ -1,0 +1,101 @@
+"""Native GP Bayesian-optimization searcher (reference:
+tune/search/bayesopt/bayesopt_search.py wraps an external package; this
+dependency-free GP must concentrate suggestions near the optimum of a
+smooth surface far better than random search)."""
+
+import math
+import random
+
+import numpy as np
+
+from ray_tpu import tune
+from ray_tpu.tune.search.bayesopt import (
+    BayesOptSearcher, _expected_improvement, _GP)
+
+
+def _surface(x, y):
+    return -((x - 0.7) ** 2) - ((y + 0.3) ** 2)
+
+
+def test_gp_posterior_interpolates():
+    X = np.array([[0.1], [0.5], [0.9]])
+    y = np.array([1.0, 3.0, 2.0])
+    gp = _GP(X, y, length_scale=0.3, noise=1e-6)
+    mu, sigma = gp.posterior(X)
+    np.testing.assert_allclose(mu, y, atol=0.05)
+    assert (sigma < 0.1).all()
+    # far from data the posterior reverts toward the mean with wide bands
+    mu_far, sigma_far = gp.posterior(np.array([[5.0]]))
+    assert abs(mu_far[0] - y.mean()) < 0.5
+    assert sigma_far[0] > sigma.max()
+
+
+def test_ei_prefers_high_mean_and_high_uncertainty():
+    mu = np.array([1.0, 2.0, 1.0])
+    sigma = np.array([0.1, 0.1, 2.0])
+    ei = _expected_improvement(mu, sigma, best=1.5)
+    assert ei[1] > ei[0]           # better mean wins at equal sigma
+    assert ei[2] > ei[0]           # uncertainty adds exploration value
+
+
+def test_bayesopt_concentrates_near_optimum():
+    space = {"x": tune.uniform(-2.0, 2.0), "y": tune.uniform(-2.0, 2.0)}
+    searcher = BayesOptSearcher(space=space, metric="score", mode="max",
+                                n_initial_points=10, seed=11)
+    for i in range(45):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        searcher.on_trial_complete(
+            tid, {"score": _surface(cfg["x"], cfg["y"])})
+    tail = []
+    for i in range(8):
+        tid = f"probe{i}"
+        cfg = searcher.suggest(tid)
+        tail.append(math.hypot(cfg["x"] - 0.7, cfg["y"] + 0.3))
+        searcher.on_trial_complete(
+            tid, {"score": _surface(cfg["x"], cfg["y"])})
+    rng = random.Random(3)
+    random_dist = [math.hypot(rng.uniform(-2, 2) - 0.7,
+                              rng.uniform(-2, 2) + 0.3)
+                   for _ in range(1000)]
+    avg_random = sum(random_dist) / len(random_dist)
+    avg_tail = sum(tail) / len(tail)
+    assert avg_tail < avg_random * 0.5, (avg_tail, avg_random)
+
+
+def test_bayesopt_minimize_mode_and_mixed_dims():
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "layers": tune.randint(1, 8),
+             "act": tune.choice(["relu", "gelu"])}
+    searcher = BayesOptSearcher(space=space, metric="loss", mode="min",
+                                n_initial_points=6, seed=0)
+    # loss minimized at lr = 1e-3, more layers help slightly
+    for i in range(30):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert cfg["act"] in ("relu", "gelu")
+        assert 1 <= cfg["layers"] < 8
+        loss = (math.log10(cfg["lr"]) + 3.0) ** 2 - 0.05 * cfg["layers"]
+        searcher.on_trial_complete(tid, {"loss": loss})
+    probes = []
+    for i in range(6):
+        cfg = searcher.suggest(f"p{i}")
+        probes.append(abs(math.log10(cfg["lr"]) + 3.0))
+        searcher.on_trial_complete(
+            f"p{i}", {"loss": (math.log10(cfg["lr"]) + 3.0) ** 2})
+    # suggestions should hover within one decade of the optimum
+    assert sum(probes) / len(probes) < 1.0, probes
+
+
+def test_bayesopt_state_roundtrip():
+    space = {"x": tune.uniform(0.0, 1.0)}
+    searcher = BayesOptSearcher(space=space, metric="score", mode="max",
+                                seed=1)
+    for i in range(12):
+        cfg = searcher.suggest(f"t{i}")
+        searcher.on_trial_complete(f"t{i}", {"score": -abs(cfg["x"] - 0.4)})
+    blob = searcher.save_state()
+    fresh = BayesOptSearcher(space=space, metric="score", mode="max")
+    fresh.restore_state(blob)
+    assert len(fresh._obs) == len(searcher._obs)
+    assert fresh.suggest("next") is not None
