@@ -416,6 +416,7 @@ class NodeAgent:
             stderr=err,
             start_new_session=True,
         )
+        self._workers_spawned = getattr(self, "_workers_spawned", 0) + 1
         out.close()
         err.close()
         handle = WorkerHandle(worker_id, proc)
@@ -810,6 +811,7 @@ class NodeAgent:
                 self.idle_workers.pop(i)
                 w.proc.terminate()
                 self.workers.pop(w.worker_id, None)
+                self._env_evictions = getattr(self, "_env_evictions", 0) + 1
                 return True
         return False
 
@@ -1246,6 +1248,8 @@ class NodeAgent:
     async def _node_stats_loop(self) -> None:
         import json as _json
 
+        from ray_tpu._private.protocol import STATS as _rpc_stats
+
         period = max(CONFIG.metrics_report_interval_ms, 1000) / 1000
         self.node_stats: Dict = {}
         while True:
@@ -1335,6 +1339,36 @@ class NodeAgent:
                     gauge("ray_tpu_object_chunks_fetched_total",
                           "Object chunks fetched from remote nodes.",
                           getattr(self, "_chunks_fetched", 0)),
+                    gauge("ray_tpu_object_pulls_inflight",
+                          "Cross-node object pulls in progress.",
+                          len(self._pulls_inflight)),
+                    gauge("ray_tpu_object_waits_pending",
+                          "Local seal-wait futures outstanding.",
+                          sum(len(v) for v in self._object_waits.values())),
+                    # worker pool lifecycle (reference: metric_defs.cc
+                    # worker_register/worker_process series)
+                    gauge("ray_tpu_worker_processes_started_total",
+                          "Cumulative worker processes spawned.",
+                          getattr(self, "_workers_spawned", 0)),
+                    gauge("ray_tpu_worker_env_evictions_total",
+                          "Idle workers killed for runtime-env mismatch.",
+                          getattr(self, "_env_evictions", 0)),
+                    gauge("ray_tpu_worker_starting",
+                          "Worker processes spawning (pre-registration).",
+                          self._starting_workers),
+                    # RPC fabric (reference: grpc_server_* / grpc_client_*)
+                    gauge("ray_tpu_rpc_frames_in_total",
+                          "Control-plane frames received by this process.",
+                          _rpc_stats["frames_in"]),
+                    gauge("ray_tpu_rpc_frames_out_total",
+                          "Control-plane frames sent by this process.",
+                          _rpc_stats["frames_out"]),
+                    gauge("ray_tpu_rpc_bytes_in_total",
+                          "Control-plane bytes received by this process.",
+                          _rpc_stats["bytes_in"]),
+                    gauge("ray_tpu_rpc_bytes_out_total",
+                          "Control-plane bytes sent by this process.",
+                          _rpc_stats["bytes_out"]),
                 ]
                 # per-resource availability (reference: resources gauge
                 # per resource name)

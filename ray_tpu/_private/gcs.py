@@ -389,6 +389,7 @@ class HeadServer:
         gcs_* series — actor/node/PG/job counts from the control plane)."""
         import json as _json
 
+        from ray_tpu._private.protocol import STATS as _rpc_stats
         from ray_tpu.util.metrics import make_gauge_snapshot as g
 
         period = max(CONFIG.metrics_report_interval_ms, 1000) / 1000
@@ -414,6 +415,29 @@ class HeadServer:
                     g("ray_tpu_gcs_task_events_buffered",
                       "Task state-transition events held in the ring.",
                       len(self.task_events)),
+                    g("ray_tpu_gcs_named_actors",
+                      "Named actors registered.", len(self.named_actors)),
+                    g("ray_tpu_gcs_driver_connections",
+                      "Driver connections attached to the head.",
+                      len(self._driver_conns)),
+                    g("ray_tpu_gcs_pubsub_channels",
+                      "Pubsub channels with at least one subscriber.",
+                      sum(1 for s in self.subscribers.values() if s)),
+                    g("ray_tpu_gcs_pubsub_subscriptions",
+                      "Total (channel, subscriber) pairs.",
+                      sum(len(s) for s in self.subscribers.values())),
+                    g("ray_tpu_rpc_frames_in_total",
+                      "Control-plane frames received by the head.",
+                      _rpc_stats["frames_in"]),
+                    g("ray_tpu_rpc_frames_out_total",
+                      "Control-plane frames sent by the head.",
+                      _rpc_stats["frames_out"]),
+                    g("ray_tpu_rpc_bytes_in_total",
+                      "Control-plane bytes received by the head.",
+                      _rpc_stats["bytes_in"]),
+                    g("ray_tpu_rpc_bytes_out_total",
+                      "Control-plane bytes sent by the head.",
+                      _rpc_stats["bytes_out"]),
                 ]
                 for state, count in actor_states.items():
                     snaps.append(g(

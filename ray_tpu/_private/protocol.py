@@ -26,6 +26,11 @@ import msgpack
 _HDR = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
+# Per-process RPC fabric counters (reference: src/ray/stats grpc_server_*
+# / grpc_client_* series). Plain ints bumped on the hot path; the node
+# agent and head read them into callback gauges each metrics period.
+STATS = {"frames_in": 0, "bytes_in": 0, "frames_out": 0, "bytes_out": 0}
+
 
 def pack(msg: Any) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
@@ -101,7 +106,9 @@ class Connection:
             self._outbuf.clear()
             return
         data = self._outbuf[0] if len(self._outbuf) == 1 else b"".join(self._outbuf)
+        STATS["frames_out"] += len(self._outbuf)  # frames, not flushes
         self._outbuf.clear()
+        STATS["bytes_out"] += len(data)
         try:
             self.writer.write(data)
         except (ConnectionError, RuntimeError):
@@ -194,6 +201,8 @@ class RpcServer:
             while True:
                 hdr = await reader.readexactly(4)
                 (length,) = _HDR.unpack(hdr)
+                STATS["frames_in"] += 1
+                STATS["bytes_in"] += 4 + length
                 body = await reader.readexactly(length)
                 msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
                 asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
@@ -272,7 +281,9 @@ class AsyncRpcClient:
             self._outbuf.clear()
             return
         data = self._outbuf[0] if len(self._outbuf) == 1 else b"".join(self._outbuf)
+        STATS["frames_out"] += len(self._outbuf)  # frames, not flushes
         self._outbuf.clear()
+        STATS["bytes_out"] += len(data)
         try:
             self._writer.write(data)
         except (ConnectionError, RuntimeError):
@@ -286,6 +297,8 @@ class AsyncRpcClient:
             while True:
                 hdr = await self._reader.readexactly(4)
                 (length,) = _HDR.unpack(hdr)
+                STATS["frames_in"] += 1
+                STATS["bytes_in"] += 4 + length
                 body = await self._reader.readexactly(length)
                 msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
                 if "r" in msg:

@@ -331,6 +331,91 @@ class Dataset:
         return [MaterializedDataset(L.InputData(g), self._max_concurrency)
                 for g in groups]
 
+    def split_at_indices(self, indices: List[int]
+                         ) -> List["MaterializedDataset"]:
+        """Split at global row offsets (reference: dataset.py
+        split_at_indices): [3, 8] → rows [0,3), [3,8), [8,end)."""
+        if any(i < 0 for i in indices) or list(indices) != sorted(indices):
+            raise ValueError(
+                f"indices must be non-negative and sorted; got {indices}")
+        mat = self.materialize()
+        blocks = list(mat._iter_blocks())
+        merged = BlockAccessor.concat(blocks)
+        acc = BlockAccessor(merged)
+        total = acc.num_rows()
+        out = []
+        bounds = [0] + [min(i, total) for i in indices] + [total]
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            out.append(from_blocks([acc.slice(lo, hi)]))
+        return out
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["MaterializedDataset"]:
+        """Split by fractions; the remainder forms the final split
+        (reference: dataset.py split_proportionately)."""
+        if not proportions or any(p <= 0 for p in proportions) or \
+                sum(proportions) >= 1.0:
+            raise ValueError(
+                "proportions must be positive and sum to < 1 "
+                f"(the remainder is the last split); got {proportions}")
+        mat = self.materialize()  # one execution feeds count AND split
+        total = mat.count()
+        indices = []
+        acc = 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(total * acc))
+        return mat.split_at_indices(indices)
+
+    def train_test_split(self, test_size: Union[float, int], *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["MaterializedDataset",
+                                    "MaterializedDataset"]:
+        """(train, test) split (reference: dataset.py train_test_split)."""
+        ds: Dataset = self
+        if shuffle:
+            ds = ds.random_shuffle(seed=seed)
+        mat = ds.materialize()  # one execution feeds count AND split
+        total = mat.count()
+        if isinstance(test_size, float):
+            if not 0.0 < test_size < 1.0:
+                raise ValueError("float test_size must be in (0, 1)")
+            n_test = int(total * test_size)
+        else:
+            if not 0 < test_size < total:
+                raise ValueError(
+                    f"int test_size must be in (0, {total})")
+            n_test = int(test_size)
+        train, test = mat.split_at_indices([total - n_test])
+        return train, test
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: dataset.py unique)."""
+        seen = set()
+        out = []
+        for batch in self.iter_batches(batch_format="numpy"):
+            for v in batch[column]:
+                key = v.item() if hasattr(v, "item") else v
+                if key not in seen:
+                    seen.add(key)
+                    out.append(key)
+        return out
+
+    def to_torch(self, **iter_kwargs):
+        """A torch IterableDataset over this Dataset's batches
+        (reference: dataset.py to_torch; batches come through
+        iter_torch_batches so dtype/device handling stays in one place)."""
+        import torch
+
+        outer = self
+
+        class _TorchIterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                return outer.iter_torch_batches(**iter_kwargs)
+
+        return _TorchIterable()
+
     def streaming_split(self, n: int, *, equality: bool = False,
                         locality_hints=None) -> List[DataIterator]:
         """N coordinated iterators backed by one execution (reference:

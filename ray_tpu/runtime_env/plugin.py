@@ -109,13 +109,48 @@ class WorkingDirPlugin(RuntimeEnvPlugin):
     name = "working_dir"
     priority = 1
 
+    @staticmethod
+    def _stage_zip(path: str, cache_root: str) -> str:
+        """Extract a .zip working dir into a content-addressed cache dir
+        (reference: runtime_env packaging accepts zip archives keyed by
+        content URI)."""
+        import hashlib
+        import zipfile
+
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        dest = os.path.join(cache_root, f"working_zip_{h.hexdigest()[:16]}")
+        if not os.path.isdir(dest):
+            tmp = dest + f".tmp{os.getpid()}"
+            with zipfile.ZipFile(path) as zf:
+                for info in zf.infolist():
+                    target = os.path.realpath(os.path.join(tmp,
+                                                           info.filename))
+                    if not (target + os.sep).startswith(
+                            os.path.realpath(tmp) + os.sep) and \
+                            target != os.path.realpath(tmp):
+                        raise RuntimeEnvSetupError(
+                            f"zip entry escapes the archive root: "
+                            f"{info.filename!r}")
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, dest)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)  # lost a race
+        return dest
+
     def setup(self, value: str, context) -> None:
         if value.startswith(("http://", "https://", "gs://", "s3://")):
             raise RuntimeEnvSetupError(
                 "remote working_dir URIs need network access, which this "
                 "deployment forbids; use a local path")
-        staged = _stage_dir(value, context.cache_root,
-                            context.spec.get("excludes"))
+        if value.endswith(".zip") and os.path.isfile(value):
+            staged = self._stage_zip(value, context.cache_root)
+        else:
+            staged = _stage_dir(value, context.cache_root,
+                                context.spec.get("excludes"))
         os.chdir(staged)
         if staged not in sys.path:
             sys.path.insert(0, staged)

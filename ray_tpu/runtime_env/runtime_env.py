@@ -73,8 +73,10 @@ def validate_runtime_env(env: Dict) -> None:
         if not isinstance(wd, str):
             raise TypeError("working_dir must be a path string")
         if not (wd.startswith(("http://", "https://", "gs://", "s3://"))
-                or os.path.isdir(wd)):
-            raise ValueError(f"working_dir {wd!r} is not a directory")
+                or os.path.isdir(wd)
+                or (wd.endswith(".zip") and os.path.isfile(wd))):
+            raise ValueError(
+                f"working_dir {wd!r} is not a directory or .zip archive")
     pm = env.get("py_modules")
     if pm is not None:
         if not isinstance(pm, (list, tuple)):

@@ -177,6 +177,50 @@ def test_split(data_cluster):
     assert [p.count() for p in parts] == [10, 10, 10]
 
 
+def test_split_at_indices_and_proportionately(data_cluster):
+    parts = rd.range(20, parallelism=4).split_at_indices([5, 12])
+    assert [p.count() for p in parts] == [5, 7, 8]
+    rows = [r["id"] for r in parts[1].take_all()]
+    assert rows == list(range(5, 12))
+    with pytest.raises(ValueError):
+        rd.range(10).split_at_indices([7, 3])
+
+    parts = rd.range(100, parallelism=4).split_proportionately([0.2, 0.3])
+    assert [p.count() for p in parts] == [20, 30, 50]
+    with pytest.raises(ValueError):
+        rd.range(10).split_proportionately([0.9, 0.2])
+
+
+def test_train_test_split(data_cluster):
+    train, test = rd.range(50, parallelism=4).train_test_split(0.2)
+    assert train.count() == 40 and test.count() == 10
+    # unshuffled: test is the tail
+    assert [r["id"] for r in test.take_all()] == list(range(40, 50))
+    train, test = rd.range(50, parallelism=4).train_test_split(
+        10, shuffle=True, seed=7)
+    assert train.count() == 40 and test.count() == 10
+    ids = sorted(r["id"] for r in train.take_all()) + \
+        sorted(r["id"] for r in test.take_all())
+    assert sorted(ids) == list(range(50))
+    assert [r["id"] for r in test.take_all()] != list(range(40, 50))
+
+
+def test_unique(data_cluster):
+    ds = rd.from_items([{"tag": t} for t in
+                        ["a", "b", "a", "c", "b", "a"]])
+    assert sorted(ds.unique("tag")) == ["a", "b", "c"]
+
+
+def test_to_torch(data_cluster):
+    torch = pytest.importorskip("torch")
+    ds = rd.range(16, parallelism=2)
+    it = ds.to_torch(batch_size=4)
+    batches = list(iter(it))
+    assert len(batches) == 4
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert int(sum(b["id"].sum() for b in batches)) == sum(range(16))
+
+
 def test_streaming_split_epochs(data_cluster):
     its = rd.range(24, parallelism=4).streaming_split(2)
     assert sum(len(list(it.iter_rows())) for it in its) == 24

@@ -60,6 +60,41 @@ class TestTaskRuntimeEnv:
         assert content == "payload-123"
         assert "runtime_env_cache" in cwd  # staged copy, not the original
 
+    def test_working_dir_zip_archive(self, ray_start_regular, tmp_path):
+        """A .zip working_dir extracts into the content-addressed cache
+        (reference: runtime_env packaging zip URIs)."""
+        import zipfile
+
+        zip_path = tmp_path / "proj.zip"
+        with zipfile.ZipFile(zip_path, "w") as zf:
+            zf.writestr("data.txt", "zipped-payload")
+            zf.writestr("pkg/helper.py", "X = 7\n")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(zip_path)})
+        def read_zip():
+            import pkg.helper
+
+            return open("data.txt").read(), pkg.helper.X, os.getcwd()
+
+        content, x, cwd = ray_tpu.get(read_zip.remote(), timeout=60)
+        assert content == "zipped-payload"
+        assert x == 7
+        assert "working_zip_" in cwd
+
+    def test_zip_slip_rejected(self, tmp_path):
+        """Entries escaping the archive root must be refused."""
+        import zipfile
+
+        from ray_tpu.runtime_env.plugin import WorkingDirPlugin
+
+        evil = tmp_path / "evil.zip"
+        with zipfile.ZipFile(evil, "w") as zf:
+            zf.writestr("../outside.txt", "nope")
+        from ray_tpu.runtime_env.runtime_env import RuntimeEnvSetupError
+
+        with pytest.raises(RuntimeEnvSetupError, match="escapes"):
+            WorkingDirPlugin._stage_zip(str(evil), str(tmp_path / "cache"))
+
     def test_py_modules_importable(self, ray_start_regular, tmp_path):
         mod_dir = tmp_path / "mods"
         mod_dir.mkdir()
