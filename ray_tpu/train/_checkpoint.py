@@ -15,26 +15,51 @@ from typing import Any, Dict, Iterator, Optional
 
 
 class Checkpoint:
-    """A reference to a directory holding checkpoint data."""
+    """A reference to a directory of checkpoint data — local path OR remote
+    URI (reference: ray.train.Checkpoint wraps (path, pyarrow filesystem),
+    train/_internal/storage.py:99-111; here the scheme resolves a
+    StorageBackend). Remote checkpoints download on ``as_directory()`` /
+    ``to_directory()``; ``.path`` stays the URI."""
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        from ray_tpu._private.storage import is_remote_uri, local_path
+
+        self.path = path if is_remote_uri(path) \
+            else os.path.abspath(local_path(path))
+
+    @property
+    def is_remote(self) -> bool:
+        from ray_tpu._private.storage import is_remote_uri
+
+        return is_remote_uri(self.path)
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
 
     def to_directory(self, path: Optional[str] = None) -> str:
-        """Copy the checkpoint data into ``path`` (or a fresh temp dir)."""
+        """Materialize the checkpoint data into ``path`` (or a fresh temp
+        dir) — downloads when remote."""
         dest = path or os.path.join(
             tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
-        if os.path.abspath(dest) != self.path:
+        if self.is_remote:
+            from ray_tpu._private.storage import get_storage_backend
+
+            get_storage_backend(self.path).download_dir(self.path, dest)
+        elif os.path.abspath(dest) != self.path:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return dest
 
     @contextlib.contextmanager
     def as_directory(self) -> Iterator[str]:
-        yield self.path
+        if self.is_remote:
+            d = self.to_directory()
+            try:
+                yield d
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+        else:
+            yield self.path
 
     # -- dict convenience (reference keeps these on legacy Checkpoint) -----
     @classmethod
@@ -45,8 +70,9 @@ class Checkpoint:
         return cls(d)
 
     def to_dict(self) -> Dict[str, Any]:
-        with open(os.path.join(self.path, "_dict.pkl"), "rb") as f:
-            return pickle.load(f)
+        with self.as_directory() as d:
+            with open(os.path.join(d, "_dict.pkl"), "rb") as f:
+                return pickle.load(f)
 
     def __repr__(self):
         return f"Checkpoint(path={self.path})"

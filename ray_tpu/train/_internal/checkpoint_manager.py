@@ -39,7 +39,24 @@ class CheckpointManager:
                             reverse=True)
         for dropped in ranked[keep:]:
             self._checkpoints.remove(dropped)
-            shutil.rmtree(dropped.checkpoint.path, ignore_errors=True)
+            # scheme-aware: remote checkpoints are deleted through their
+            # storage backend, local ones from disk; a failed remote delete
+            # must be loud (a silently-leaked bucket prefix grows forever)
+            from ray_tpu._private.storage import (
+                get_storage_backend, is_remote_uri)
+
+            if is_remote_uri(dropped.checkpoint.path):
+                try:
+                    get_storage_backend(dropped.checkpoint.path).delete(
+                        dropped.checkpoint.path)
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "failed to prune remote checkpoint %s: %s",
+                        dropped.checkpoint.path, e)
+            else:
+                shutil.rmtree(dropped.checkpoint.path, ignore_errors=True)
 
     def _score(self, t: _TrackedCheckpoint) -> Tuple:
         """Rank key, higher = better. A checkpoint missing the score

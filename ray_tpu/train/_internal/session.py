@@ -64,20 +64,34 @@ class _TrainSession:
     def report(self, metrics: Dict, checkpoint: Optional[Checkpoint] = None):
         ckpt_dir = None
         if checkpoint is not None:
-            # Persist into the trial dir (StorageContext analog:
-            # reference train/_internal/storage.py:99-111). Only rank 0
-            # uploads in the common fully-replicated case; other ranks may
-            # still pass shard checkpoints which land in per-rank subdirs.
+            # Persist into the trial dir (StorageContext analog: reference
+            # train/_internal/storage.py:99-111). Only rank 0 uploads in
+            # the common fully-replicated case; other ranks may still pass
+            # shard checkpoints which land in per-rank subdirs. When the
+            # trial dir is a remote URI, THIS worker process uploads its
+            # own shards directly (upload-from-worker: on a pod each host
+            # pushes to the bucket; nothing round-trips the driver).
+            from ray_tpu._private.storage import (
+                get_storage_backend, is_remote_uri, join_uri)
+
             name = f"checkpoint_{self.iteration:06d}"
-            if self.world_rank == 0:
-                dest = os.path.join(self.trial_dir, name)
+            if is_remote_uri(self.trial_dir):
+                sub = [] if self.world_rank == 0 \
+                    else [f"rank_{self.world_rank}"]
+                dest = join_uri(self.trial_dir, name, *sub)
+                get_storage_backend(dest).upload_dir(checkpoint.path, dest)
+                ckpt_dir = join_uri(self.trial_dir, name)
             else:
-                dest = os.path.join(self.trial_dir, name,
-                                    f"rank_{self.world_rank}")
-            os.makedirs(os.path.dirname(dest), exist_ok=True)
-            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
-            ckpt_dir = os.path.join(self.trial_dir, name)
+                if self.world_rank == 0:
+                    dest = os.path.join(self.trial_dir, name)
+                else:
+                    dest = os.path.join(self.trial_dir, name,
+                                        f"rank_{self.world_rank}")
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                    shutil.copytree(checkpoint.path, dest,
+                                    dirs_exist_ok=True)
+                ckpt_dir = os.path.join(self.trial_dir, name)
         self.iteration += 1
         self.result_queue.put(
             TrainingResult(TrainingResult.REPORT, metrics, ckpt_dir))

@@ -55,10 +55,17 @@ class BaseTrainer:
         raise NotImplementedError
 
     def fit(self) -> Result:
+        from ray_tpu._private.storage import (
+            get_storage_backend, is_remote_uri, join_uri, local_path)
+
         name = self.run_config.name or f"train_{int(time.time())}"
         storage = self.run_config.resolved_storage_path()
-        trial_dir = os.path.join(storage, name)
-        os.makedirs(trial_dir, exist_ok=True)
+        if is_remote_uri(storage):
+            trial_dir = join_uri(storage, name)
+            get_storage_backend(trial_dir).makedirs(trial_dir)
+        else:
+            trial_dir = os.path.join(local_path(storage), name)
+            os.makedirs(trial_dir, exist_ok=True)
         self._experiment_name = name
         self._storage_path = storage
         self._trial_dir = trial_dir

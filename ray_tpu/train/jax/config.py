@@ -30,6 +30,15 @@ class JaxConfig:
     use_jax_distributed: bool = False
     collective_backend: str = "cpu"  # host-fallback group backend
     group_name: str = "train_default"
+    # Platform overrides for the worker processes. On a real pod slice all
+    # three stay None (the TPU runtime discovers its own topology); tests
+    # form a genuine multi-process global mesh out of CPU devices the way
+    # jax's own multiprocess CPU tests do: pin the platform, give each
+    # process `num_local_devices` devices, and let gloo carry the
+    # cross-process collectives.
+    jax_platform: Optional[str] = None          # e.g. "cpu" in tests
+    num_local_devices: Optional[int] = None     # devices per worker process
+    cpu_collectives: Optional[str] = None       # e.g. "gloo"
 
     @property
     def backend_cls(self):
@@ -46,11 +55,33 @@ def _setup_worker(rank: int, world_size: int, coordinator: str,
     if cfg_wire["use_jax_distributed"]:
         import jax
 
+        # Order matters: platform/device-count/collectives config must land
+        # before the first backend touch, and a worker process recycled from
+        # a previous group incarnation must drop its old coordination-service
+        # connection before re-forming the mesh.
+        if cfg_wire.get("jax_platform"):
+            jax.config.update("jax_platforms", cfg_wire["jax_platform"])
+        if cfg_wire.get("num_local_devices"):
+            jax.config.update("jax_num_cpu_devices",
+                              cfg_wire["num_local_devices"])
+        if cfg_wire.get("cpu_collectives"):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              cfg_wire["cpu_collectives"])
+        from jax._src import distributed as _jax_dist
+
+        if _jax_dist.global_state.client is not None:
+            jax.distributed.shutdown()
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=world_size,
             process_id=rank,
         )
+        expected = cfg_wire.get("num_local_devices")
+        if expected and jax.local_device_count() != expected:
+            raise RuntimeError(
+                f"worker {rank}: wanted {expected} local devices, got "
+                f"{jax.local_device_count()} — platform config landed too "
+                "late (backend already initialized in this process)")
     if world_size > 1:
         from ray_tpu.util import collective as col
 
@@ -76,6 +107,9 @@ class JaxBackend(Backend):
             "use_jax_distributed": backend_config.use_jax_distributed,
             "collective_backend": backend_config.collective_backend,
             "group_name": backend_config.group_name,
+            "jax_platform": backend_config.jax_platform,
+            "num_local_devices": backend_config.num_local_devices,
+            "cpu_collectives": backend_config.cpu_collectives,
             # per-incarnation store: a restarted group must not inherit a
             # dead predecessor's staged contributions
             "store_key": f"{backend_config.group_name}:{uuid.uuid4().hex[:8]}",
@@ -95,6 +129,15 @@ class JaxBackend(Backend):
                 from ray_tpu.util import collective as col
 
                 col.destroy_collective_group(group_name)
+            except Exception:
+                pass
+            try:
+                from jax._src import distributed as _jax_dist
+
+                if _jax_dist.global_state.client is not None:
+                    import jax
+
+                    jax.distributed.shutdown()
             except Exception:
                 pass
 

@@ -63,9 +63,15 @@ class TuneController:
         time_budget_s: Optional[float] = None,
         run_config: Optional[RunConfig] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
+        sync_uri: Optional[str] = None,
     ):
         self._trainable_cls = trainable_cls
         self.experiment_dir = experiment_dir
+        # remote persistence: experiment state + trial checkpoints run in a
+        # local working dir and mirror to this URI on every state save
+        # (reference: pyarrow-fs experiment sync,
+        # train/_internal/storage.py:99-111)
+        self._sync_uri = sync_uri
         os.makedirs(experiment_dir, exist_ok=True)
         self.search_alg = search_alg
         self.scheduler = scheduler or FIFOScheduler()
@@ -359,6 +365,17 @@ class TuneController:
                 f.write(self.search_alg.save_state())
         except Exception:
             pass
+        if self._sync_uri:
+            from ray_tpu._private.storage import get_storage_backend
+
+            try:
+                get_storage_backend(self._sync_uri).upload_dir(
+                    self.experiment_dir, self._sync_uri)
+            except Exception as e:  # keep training; surface in the log
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "experiment sync to %s failed: %s", self._sync_uri, e)
 
     @staticmethod
     def load_state(experiment_dir: str) -> Optional[Dict]:

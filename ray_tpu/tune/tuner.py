@@ -107,12 +107,35 @@ class Tuner:
             trainable = wrap_function(trainable)
         self._trainable_cls = trainable
 
+    @staticmethod
+    def _local_cache_dir() -> str:
+        return os.environ.get(
+            "RAY_TPU_EXPERIMENT_CACHE",
+            os.path.expanduser("~/.cache/ray_tpu/experiments"))
+
     # ----------------------------------------------------------------- fit
     def fit(self) -> ResultGrid:
+        from ray_tpu._private.storage import is_remote_uri, join_uri
+
         cfg = self._tune_config
         name = self._run_config.name or f"tune_{int(time.time())}"
-        experiment_dir = os.path.join(
-            self._run_config.resolved_storage_path(), name)
+        storage = self._run_config.resolved_storage_path()
+        sync_uri = None
+        if is_remote_uri(storage):
+            # remote persistence: run against a local working dir, mirror
+            # to the URI on every state save (upload), restore by download
+            sync_uri = join_uri(storage, name)
+            experiment_dir = os.path.join(self._local_cache_dir(), name)
+            if self._restore_dir is None and os.path.exists(experiment_dir):
+                # a fresh run must not inherit (and then sync up) trial
+                # state a previous same-named experiment left in the cache
+                import shutil
+
+                shutil.rmtree(experiment_dir, ignore_errors=True)
+        else:
+            from ray_tpu._private.storage import local_path
+
+            experiment_dir = os.path.join(local_path(storage), name)
 
         search_alg = cfg.search_alg
         num_samples_cap = None
@@ -137,10 +160,25 @@ class Tuner:
             time_budget_s=cfg.time_budget_s,
             run_config=self._run_config,
             resources_per_trial=self._resources_per_trial,
+            sync_uri=sync_uri,
         )
         if self._restore_dir:
             state = TuneController.load_state(self._restore_dir)
             if state:
+                # a restored experiment keeps its recorded metric/mode when
+                # the caller didn't re-specify them
+                if cfg.metric is None and state.get("metric"):
+                    cfg.metric = state["metric"]
+                    cfg.mode = state.get("mode") or cfg.mode
+                    controller.metric = cfg.metric
+                    controller.mode = cfg.mode
+                    # scheduler/searcher were constructed before the saved
+                    # metric was known; re-propagate or an ASHA-style
+                    # scheduler scores on the wrong metric/mode
+                    controller.scheduler.set_search_properties(
+                        cfg.metric, cfg.mode)
+                    controller.search_alg.set_search_properties(
+                        cfg.metric, cfg.mode, None)
                 controller.experiment_dir = self._restore_dir
                 controller.trials = [
                     Trial.from_state(s, self._restore_dir)
@@ -166,8 +204,36 @@ class Tuner:
                 *, param_space: Optional[Dict] = None,
                 tune_config: Optional[TuneConfig] = None,
                 run_config: Optional[RunConfig] = None) -> "Tuner":
-        """Resume an interrupted experiment from its directory
-        (reference: Tuner.restore, tuner.py:54 docstring)."""
+        """Resume an interrupted experiment from its directory — a local
+        path or a remote URI, which is downloaded into the local working
+        dir and re-synced as the resumed run progresses (reference:
+        Tuner.restore, tuner.py:54; remote restore via pyarrow fs,
+        train/_internal/storage.py:99-111)."""
+        from ray_tpu._private.storage import (
+            get_storage_backend, is_remote_uri, parse_uri)
+
+        if is_remote_uri(path):
+            from ray_tpu._private.storage import join_uri
+
+            backend = get_storage_backend(path)
+            # require the state file itself, not just any prefix — a typo'd
+            # parent URI would otherwise "restore" into a fresh experiment
+            # and overwrite the remote record on the next state sync
+            if not backend.exists(join_uri(path, "experiment_state.json")):
+                raise FileNotFoundError(f"no experiment state under {path}")
+            rest = parse_uri(path)[1].rstrip("/")
+            name = rest.rsplit("/", 1)[-1]
+            local = os.path.join(cls._local_cache_dir(), name)
+            os.makedirs(local, exist_ok=True)
+            backend.download_dir(path, local)
+            scheme = parse_uri(path)[0]
+            parent = f"{scheme}://{rest.rsplit('/', 1)[0]}" \
+                if "/" in rest else f"{scheme}://"
+            run_config = run_config or RunConfig(name=name,
+                                                 storage_path=parent)
+            return cls(trainable, param_space=param_space,
+                       tune_config=tune_config, run_config=run_config,
+                       _restore_dir=local)
         if not os.path.exists(os.path.join(path, "experiment_state.json")):
             raise FileNotFoundError(f"no experiment state under {path}")
         run_config = run_config or RunConfig(
@@ -179,4 +245,13 @@ class Tuner:
 
     @staticmethod
     def can_restore(path: str) -> bool:
+        from ray_tpu._private.storage import (
+            get_storage_backend, is_remote_uri, join_uri)
+
+        if is_remote_uri(path):
+            try:
+                return get_storage_backend(path).exists(
+                    join_uri(path, "experiment_state.json"))
+            except Exception:
+                return False
         return os.path.exists(os.path.join(path, "experiment_state.json"))
