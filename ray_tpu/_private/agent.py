@@ -21,6 +21,7 @@ import os
 import subprocess
 import sys
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import CONFIG
@@ -32,9 +33,13 @@ from ray_tpu._private.resources import (
 
 
 class WorkerHandle:
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    def __init__(self, worker_id: str, proc: Optional[subprocess.Popen]):
         self.worker_id = worker_id
+        # None while the spawn sits in the admission queue (the agent caps
+        # concurrent process startups like the reference raylet's
+        # maximum_startup_concurrency, worker_pool.h)
         self.proc = proc
+        self.launched_at: Optional[float] = None
         self.conn: Optional[Connection] = None  # registration connection
         self.direct_addr: Optional[Dict] = None  # {"host","port","unix"} for PushTask
         self.registered = asyncio.Event()
@@ -51,7 +56,15 @@ class WorkerHandle:
 
     @property
     def alive(self) -> bool:
-        return self.proc.poll() is None
+        return self.proc is None or self.proc.poll() is None
+
+    def terminate(self) -> None:
+        """None-safe terminate (proc is None while spawn-queued)."""
+        if self.proc is not None:
+            try:
+                self.proc.terminate()
+            except Exception:
+                pass
 
 
 class ConnectionPool:
@@ -121,6 +134,18 @@ class NodeAgent:
         if CONFIG.num_workers_soft_limit:
             self.max_workers = CONFIG.num_workers_soft_limit
         self._starting_workers = 0
+        # spawn admission (reference: maximum_startup_concurrency):
+        # requests queue here; at most STARTUP_CONCURRENCY are between
+        # fork and registration at once
+        self._spawn_queue: deque = deque()
+        self._launching_workers = 0
+        # warm-template forkserver (worker_forkserver.py): plain workers
+        # fork from a pre-imported template (~20ms) instead of a cold
+        # interpreter launch (~350ms); container/conda workers still use
+        # Popen (they need a different command line)
+        self._forkserver_proc: Optional[subprocess.Popen] = None
+        self._forkserver_sock = os.path.join(
+            session_dir, "sockets", f"fs-{node_id[:12]}.sock")
         self._lease_counter = 0
         self._pending_leases: List[Dict] = []  # queued lease requests
 
@@ -193,7 +218,7 @@ class NodeAgent:
                              "memory pressure",
                              worker_id=w.worker_id, node_id=self.node_id)
                 try:
-                    w.proc.terminate()  # owner sees the failure and retries
+                    w.terminate()  # owner sees the failure and retries
                 except Exception:
                     pass
 
@@ -288,7 +313,7 @@ class NodeAgent:
                     if time.monotonic() - down_since > give_up_s:
                         for w in list(self.workers.values()):
                             try:
-                                w.proc.terminate()
+                                w.terminate()
                             except Exception:
                                 pass
                         os._exit(1)
@@ -350,11 +375,159 @@ class NodeAgent:
                 last_sent = None
 
     # ---------------------------------------------------------- worker pool
+    @property
+    def STARTUP_CONCURRENCY(self) -> int:
+        cap = CONFIG.worker_startup_concurrency
+        if cap > 0:
+            return cap
+        return max(2, int(self.resources.total.get("CPU") or 1))
+
     def _spawn_worker(self, actor_spec: Optional[Dict] = None,
                       container: Optional[Dict] = None,
                       conda_prefix: Optional[str] = None,
                       env_key: Optional[str] = None) -> WorkerHandle:
+        """Admission-queued spawn: a burst of requests (1000 actors at
+        once) must not fork 1000 interpreters simultaneously — that starves
+        the node's cores until the head's health checks declare it dead.
+        At most STARTUP_CONCURRENCY processes are between fork and
+        registration at any moment (reference: worker_pool.h
+        maximum_startup_concurrency = num_cpus)."""
         worker_id = os.urandom(16).hex()
+        handle = WorkerHandle(worker_id, proc=None)
+        handle.env_key = env_key
+        self.workers[worker_id] = handle
+        self._starting_workers += 1
+        self._spawn_queue.append(
+            (handle, actor_spec, container, conda_prefix, env_key))
+        self._workers_spawned = getattr(self, "_workers_spawned", 0) + 1
+        self._kick_spawner()
+        return handle
+
+    def _kick_spawner(self) -> None:
+        while (self._spawn_queue
+               and self._launching_workers < self.STARTUP_CONCURRENCY):
+            (handle, actor_spec, container, conda_prefix,
+             env_key) = self._spawn_queue.popleft()
+            if handle.worker_id not in self.workers:  # cancelled meanwhile
+                self._starting_workers = max(0, self._starting_workers - 1)
+                continue
+            self._launching_workers += 1
+            handle.launching = True
+            if container or conda_prefix or not CONFIG.worker_forkserver:
+                try:
+                    self._launch_worker(handle, container, conda_prefix,
+                                        env_key)
+                except Exception:
+                    self._launching_workers -= 1
+                    handle.launching = False
+                    self._starting_workers = max(0,
+                                                 self._starting_workers - 1)
+                    self.workers.pop(handle.worker_id, None)
+            else:
+                asyncio.get_running_loop().create_task(
+                    self._launch_via_forkserver(handle, env_key))
+
+    async def _launch_via_forkserver(self, handle: WorkerHandle,
+                                     env_key: Optional[str]) -> None:
+        try:
+            pid = await self._forkserver_spawn(handle)
+        except Exception:
+            pid = None
+        if pid:
+            handle.proc = _ForeignProc(pid)
+            handle.launched_at = time.monotonic()
+            handle.spawn_time = time.monotonic()
+            return
+        # template unavailable/broken: cold-launch fallback
+        try:
+            self._launch_worker(handle, None, None, env_key)
+        except Exception:
+            self._launching_workers = max(0, self._launching_workers - 1)
+            handle.launching = False
+            self._starting_workers = max(0, self._starting_workers - 1)
+            self.workers.pop(handle.worker_id, None)
+            # the freed slot must pull the next queued spawn or a burst
+            # whose launches all fail would strand the queue forever
+            self._kick_spawner()
+
+    def _worker_env(self, worker_id: str) -> Dict[str, str]:
+        ray_env = {
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_AGENT_SOCK": self.unix_path,
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            "RAY_TPU_STORE_DIR": self.store_dir,
+            "RAY_TPU_HEAD_ADDR": f"{self.head_host}:{self.head_port}",
+        }
+        from ray_tpu._private.config import scrub_axon_bootstrap_env
+
+        env = dict(os.environ)
+        env.update(ray_env)
+        scrub_axon_bootstrap_env(env)
+        return env
+
+    async def _forkserver_spawn(self, handle: WorkerHandle) -> Optional[int]:
+        """Ask the warm template to fork a worker; returns the child pid
+        or None when the template can't serve (caller cold-launches)."""
+        import json as _json
+
+        if self._forkserver_proc is None or \
+                self._forkserver_proc.poll() is not None:
+            from ray_tpu._private.config import scrub_axon_bootstrap_env
+
+            env = dict(os.environ)
+            scrub_axon_bootstrap_env(env)
+            try:
+                os.unlink(self._forkserver_sock + ".ready")
+            except FileNotFoundError:
+                pass
+            log_dir = os.path.join(self.session_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            with open(os.path.join(log_dir, "forkserver.log"), "ab") as lg:
+                self._forkserver_proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "ray_tpu._private.worker_forkserver",
+                     self._forkserver_sock],
+                    env=env, stdout=lg, stderr=lg, start_new_session=True)
+        for _ in range(200):  # template warms up once (~0.5s)
+            if os.path.exists(self._forkserver_sock + ".ready"):
+                break
+            if self._forkserver_proc.poll() is not None:
+                return None
+            await asyncio.sleep(0.05)
+        else:
+            return None
+        log_dir = os.path.join(self.session_dir, "logs")
+        wid = handle.worker_id
+        req = {
+            "env": self._worker_env(wid),
+            "log_out": os.path.join(log_dir, f"worker-{wid[:12]}.out"),
+            "log_err": os.path.join(log_dir, f"worker-{wid[:12]}.err"),
+        }
+        try:
+            reader, writer = await asyncio.open_unix_connection(
+                self._forkserver_sock)
+            writer.write((_json.dumps(req) + "\n").encode())
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), 30)
+            writer.close()
+            rep = _json.loads(line)
+            return rep.get("pid")
+        except Exception:
+            return None
+
+    def _spawn_slot_freed(self, handle: WorkerHandle) -> None:
+        """A launching worker registered or died: free its startup slot."""
+        if getattr(handle, "launching", False):
+            handle.launching = False
+            self._launching_workers = max(0, self._launching_workers - 1)
+            self._kick_spawner()
+
+    def _launch_worker(self, handle: WorkerHandle,
+                       container: Optional[Dict] = None,
+                       conda_prefix: Optional[str] = None,
+                       env_key: Optional[str] = None) -> None:
+        worker_id = handle.worker_id
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.out"), "ab")
@@ -416,16 +589,11 @@ class NodeAgent:
             stderr=err,
             start_new_session=True,
         )
-        self._workers_spawned = getattr(self, "_workers_spawned", 0) + 1
         out.close()
         err.close()
-        handle = WorkerHandle(worker_id, proc)
-        # containerized workers are never pristine: pre-tag them so only
-        # leases with the same runtime_env can claim them
-        handle.env_key = env_key
-        self.workers[worker_id] = handle
-        self._starting_workers += 1
-        return handle
+        handle.proc = proc
+        handle.launched_at = time.monotonic()
+        handle.spawn_time = time.monotonic()
 
     def _spawn_conda_worker(self, conda_spec, env_key: Optional[str],
                             req: Dict) -> None:
@@ -503,6 +671,7 @@ class NodeAgent:
                 self.workers[worker_id] = handle
             else:
                 self._starting_workers = max(0, self._starting_workers - 1)
+                self._spawn_slot_freed(handle)
             handle.conn = conn
             handle.direct_addr = p["direct_addr"]
             handle.registered.set()
@@ -527,6 +696,7 @@ class NodeAgent:
 
     async def _handle_worker_exit(self, handle: WorkerHandle, reason: str) -> None:
         self.workers.pop(handle.worker_id, None)
+        self._spawn_slot_freed(handle)
         if handle in self.idle_workers:
             self.idle_workers.remove(handle)
         if handle.leased_to:
@@ -540,7 +710,7 @@ class NodeAgent:
                 pass
         if handle.alive:
             try:
-                handle.proc.terminate()
+                handle.terminate()
             except Exception:
                 pass
 
@@ -558,7 +728,7 @@ class NodeAgent:
                 victim = self.idle_workers[0]
                 if victim.idle_since < cutoff:
                     self.idle_workers.pop(0)
-                    victim.proc.terminate()
+                    victim.terminate()
                 else:
                     break
 
@@ -809,7 +979,7 @@ class NodeAgent:
         for i, w in enumerate(self.idle_workers):
             if w.env_key is not None:
                 self.idle_workers.pop(i)
-                w.proc.terminate()
+                w.terminate()
                 self.workers.pop(w.worker_id, None)
                 self._env_evictions = getattr(self, "_env_evictions", 0) + 1
                 return True
@@ -887,15 +1057,24 @@ class NodeAgent:
         handle.assigned_resources = None  # released via actor-death path below
 
         async def finish():
-            try:
-                await asyncio.wait_for(handle.registered.wait(),
-                                       CONFIG.worker_register_timeout_s)
-            except asyncio.TimeoutError:
-                await self.head.call(
-                    "ActorDied",
-                    {"actor_id": p["actor_id"], "reason": "worker failed to start"},
-                )
-                return
+            # the register timeout counts from the actual LAUNCH (fork),
+            # not from enqueue: under spawn admission a 1000-actor burst
+            # legitimately queues for minutes
+            while True:
+                try:
+                    await asyncio.wait_for(handle.registered.wait(), 5.0)
+                    break
+                except asyncio.TimeoutError:
+                    if handle.worker_id not in self.workers or (
+                            handle.launched_at is not None
+                            and time.monotonic() - handle.launched_at
+                            > CONFIG.worker_register_timeout_s):
+                        await self.head.call(
+                            "ActorDied",
+                            {"actor_id": p["actor_id"],
+                             "reason": "worker failed to start"},
+                        )
+                        return
             await handle.conn.push(
                 "BecomeActor",
                 {"spec": spec, "actor_id": p["actor_id"],
@@ -922,7 +1101,7 @@ class NodeAgent:
         for handle in self.workers.values():
             if handle.actor_id == actor_id:
                 try:
-                    handle.proc.terminate()
+                    handle.terminate()
                 except Exception:
                     pass
 

@@ -14,10 +14,22 @@ import os
 from typing import Any, Dict
 
 _DEFS: Dict[str, Any] = {}
+# per-flag precomputed env-override keys: building f-strings + .upper() on
+# every CONFIG access showed up at ~7 accesses/task in the submit hot loop
+_ENV_KEYS: Dict[str, tuple] = {}
+# CPython/posix fast path: os.environ._data is a plain dict keyed by
+# encodekey()'d names; both fall back cleanly when absent
+_ENV_DATA = getattr(os.environ, "_data", None)
+_ENCODE = getattr(os.environ, "encodekey", None)
+if not isinstance(_ENV_DATA, dict) or _ENCODE is None:
+    _ENV_DATA = _ENCODE = None
 
 
 def _flag(name: str, default: Any) -> None:
     _DEFS[name] = default
+    up, ex = f"RAY_TPU_{name.upper()}", f"RAY_TPU_{name}"
+    _ENV_KEYS[name] = ((_ENCODE(up), _ENCODE(ex)) if _ENCODE is not None
+                       else (up, ex))
 
 
 # --- scheduling -------------------------------------------------------------
@@ -26,7 +38,7 @@ _flag("scheduler_top_k_fraction", 0.2)
 _flag("max_pending_lease_requests_per_scheduling_category", 10)
 _flag("worker_lease_timeout_ms", 30_000)
 _flag("lease_pipeline_depth", 2)  # tasks in flight per leased worker
-_flag("lease_pipeline_depth_short_task", 16)  # when exec EMA < 2ms
+_flag("lease_pipeline_depth_short_task", 48)  # when exec EMA < 2ms
 _flag("lease_pipeline_depth_medium_task", 4)  # when exec EMA < 10ms
 _flag("lease_idle_ttl_ms", 250)  # idle leased workers return after this
 _flag("lease_max_workers_per_pool", 256)
@@ -48,6 +60,10 @@ _flag("object_wait_poll_ms", 200)  # store re-poll while awaiting seal
 
 # --- workers ----------------------------------------------------------------
 _flag("num_workers_soft_limit", 0)  # 0 = num_cpus
+_flag("worker_forkserver", True)  # fork plain workers from a warm template
+_flag("worker_startup_concurrency", 0)  # 0 = max(2, num_cpus); processes
+# between fork and registration at once (reference:
+# maximum_startup_concurrency, worker_pool.h)
 _flag("worker_register_timeout_s", 60)
 _flag("idle_worker_killing_time_ms", 600_000)
 _flag("prestart_workers", True)
@@ -65,7 +81,10 @@ _flag("pubsub_poll_timeout_s", 30)
 _flag("kv_namespace_default", "default")
 _flag("metrics_report_interval_ms", 5_000)
 _flag("task_event_buffer_max", 100_000)
-_flag("task_event_flush_batch", 100)  # buffered transitions before a flush
+_flag("task_event_flush_batch", 5000)  # size backstop between periodic
+# flushes (the watchdog's periodic flush is the normal path — reference
+# flushes on a 1s timer, task_events_report_interval_ms; a small size
+# trigger made every 50th task in a burst pay a head round-trip)
 _flag("rpc_drain_threshold_bytes", 64 * 1024)  # write-combining flush point
 _flag("head_watchdog_period_s", 2.0)  # driver/worker head-liveness probes
 _flag("agent_head_gone_exit_s", 120.0)  # agent suicide after head unreachable
@@ -122,10 +141,21 @@ class _Config:
         if name not in _DEFS:
             raise AttributeError(f"unknown config flag: {name}")
         # accept both RAY_TPU_FLAG_NAME (conventional) and the exact
-        # lowercase flag name
-        for env_key in (f"RAY_TPU_{name.upper()}", f"RAY_TPU_{name}"):
-            if env_key in os.environ:
-                return _coerce(os.environ[env_key], _DEFS[name])
+        # lowercase flag name; env stays authoritative on EVERY read (tests
+        # flip flags mid-process) — the raw environ dict makes that a plain
+        # dict lookup instead of two MutableMapping round-trips per access
+        upper_key, exact_key = _ENV_KEYS[name]
+        data = _ENV_DATA
+        if data is not None:
+            raw = data.get(upper_key)
+            if raw is None:
+                raw = data.get(exact_key)
+            if raw is not None:
+                return _coerce(os.fsdecode(raw), _DEFS[name])
+        else:  # non-CPython/exotic platform fallback
+            for env_key in (upper_key, exact_key):
+                if env_key in os.environ:
+                    return _coerce(os.environ[env_key], _DEFS[name])
         if name in self._overrides:
             return self._overrides[name]
         return _DEFS[name]

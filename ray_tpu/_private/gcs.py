@@ -902,17 +902,51 @@ class HeadServer:
 
     # ----------------------------------------------------------- task events
     async def _report_task_events(self, conn, p) -> None:
-        self.task_events.extend(p["events"])
+        # v2: columnar tuples (task_id, job_id, name, state, type, time)
+        # with node_id once per frame — dicts are built only on query
+        node_id = p.get("node_id", "")
+        for ev in p.get("events_v2", ()):
+            self.task_events.append((node_id, ev))
+        for ev in p.get("events", ()):  # legacy dict form
+            self.task_events.append((ev.get("node_id", node_id), ev))
         cap = CONFIG.task_event_buffer_max
         if len(self.task_events) > cap:
             self.task_events = self.task_events[-cap:]
 
+    @staticmethod
+    def _event_to_dict(node_id: str, ev) -> Dict:
+        if isinstance(ev, dict):
+            return ev
+        task_id, job_id, name, state, task_type, t = ev
+        return {
+            "task_id": task_id.hex() if isinstance(task_id, bytes) else task_id,
+            "job_id": job_id.hex() if isinstance(job_id, bytes) else job_id,
+            "name": name, "state": state, "type": task_type, "time": t,
+            "node_id": node_id,
+        }
+
     async def _list_task_events(self, conn, p) -> List[Dict]:
+        # filter + slice on the stored tuples, dict-render only the tail —
+        # a full buffer is 100k entries and this runs on every poll
         limit = p.get("limit", 1000)
-        events = self.task_events
-        if p.get("job_id"):
-            events = [e for e in events if e.get("job_id") == p["job_id"]]
-        return events[-limit:]
+        job = p.get("job_id")
+        if job:
+            def match(ev):
+                if isinstance(ev, dict):
+                    return ev.get("job_id") == job
+                jid = ev[1]
+                return (jid.hex() if isinstance(jid, bytes) else jid) == job
+
+            picked: List = []
+            for nid, ev in reversed(self.task_events):
+                if match(ev):
+                    picked.append((nid, ev))
+                    if len(picked) >= limit:
+                        break
+            picked.reverse()
+        else:
+            picked = self.task_events[-limit:]
+        return [self._event_to_dict(nid, ev) for nid, ev in picked]
 
     # ----------------------------------------------------------------- jobs
     async def _register_job(self, conn, p) -> None:

@@ -146,6 +146,10 @@ class Connection:
     async def push(self, method: str, payload: Any) -> None:
         await self.send({"m": method, "i": 0, "p": payload})
 
+    def push_nowait(self, method: str, payload: Any) -> None:
+        """Fire-and-forget push; loop-thread only, write-combined."""
+        self.send_nowait({"m": method, "i": 0, "p": payload})
+
     def close(self) -> None:
         self.closed = True
         try:
@@ -309,9 +313,20 @@ class AsyncRpcClient:
                         else:
                             fut.set_result(msg.get("p"))
                 elif self._push_handler:
-                    asyncio.get_running_loop().create_task(
-                        self._push_handler(msg.get("m"), msg.get("p"))
-                    )
+                    # sync handlers run inline (the streamed batch-item
+                    # path is a hot loop — a task per item would drown the
+                    # loop); async handlers still get their own task. A
+                    # handler bug must not kill the read loop — every
+                    # pending future on this connection would hang.
+                    try:
+                        res = self._push_handler(msg.get("m"), msg.get("p"))
+                        if asyncio.iscoroutine(res):
+                            asyncio.get_running_loop().create_task(res)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger("ray_tpu").exception(
+                            "push handler failed for %s", msg.get("m"))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             self.connected = False
             for fut in self._pending.values():

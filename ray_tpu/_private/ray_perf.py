@@ -55,7 +55,11 @@ def main(filter_substr: str = "") -> Dict[str, float]:
     bench("single client tasks sync",
           lambda: ray_tpu.get(noop.remote()))
 
-    N_ASYNC = 100
+    # round sizes mirror the reference suite (reference ray_perf.py:204
+    # submits 1000 per async round; :222-232 runs the n:n pattern through
+    # m concurrent CLIENT worker processes) so the numbers are comparable
+    # with BASELINE.md's
+    N_ASYNC = 1000
     bench("single client tasks async",
           lambda: ray_tpu.get([noop.remote() for _ in range(N_ASYNC)]),
           multiplier=N_ASYNC)
@@ -117,10 +121,20 @@ def main(filter_substr: str = "") -> Dict[str, float]:
     actors = [Actor.remote() for _ in range(4)]
     for act in actors:
         ray_tpu.get(act.noop.remote(), timeout=60)
+
+    # n:n = n CLIENTS x n actors: m concurrent driver-side `work` tasks
+    # each fan N_NN calls over the actor pool from their own worker
+    # process (reference: ray_perf.py:222-232 — `work.remote(actors)` x m)
+    N_NN, M_NN = 1000, 4
+
+    @ray_tpu.remote
+    def work(actor_handles):
+        ray_tpu.get([actor_handles[i % len(actor_handles)].noop.remote()
+                     for i in range(N_NN)])
+
     bench("n:n actor calls async",
-          lambda: ray_tpu.get(
-              [act.noop.remote() for act in actors for _ in range(25)]),
-          multiplier=100)
+          lambda: ray_tpu.get([work.remote(actors) for _ in range(M_NN)]),
+          multiplier=N_NN * M_NN)
     for act in actors + [a]:
         ray_tpu.kill(act)
 

@@ -30,15 +30,26 @@ ACTOR_TASK = 2
 #   ("r", object_id_bytes, owner_addr) — pass-by-reference, fetch before run
 
 
+_WIRE_FIELDS = (
+    "task_id", "job_id", "task_type", "function_id", "function_blob",
+    "function_name", "args", "kwargs", "num_returns", "resources",
+    "max_retries", "retry_exceptions", "owner_addr", "actor_id",
+    "actor_method", "seq", "scheduling_strategy", "placement_group_id",
+    "placement_group_bundle_index", "max_concurrency", "namespace",
+    "actor_name", "max_restarts", "runtime_env", "label_selector",
+)
+
+
+# non-None __init__ defaults, used when a wire dict omits a field
+_WIRE_DEFAULTS = {
+    "max_retries": 0, "retry_exceptions": False, "actor_method": "",
+    "seq": 0, "placement_group_bundle_index": -1, "max_concurrency": 1,
+    "namespace": "", "actor_name": "", "max_restarts": 0,
+}
+
+
 class TaskSpec:
-    __slots__ = (
-        "task_id", "job_id", "task_type", "function_id", "function_blob",
-        "function_name", "args", "kwargs", "num_returns", "resources",
-        "max_retries", "retry_exceptions", "owner_addr", "actor_id",
-        "actor_method", "seq", "scheduling_strategy", "placement_group_id",
-        "placement_group_bundle_index", "max_concurrency", "namespace",
-        "actor_name", "max_restarts", "runtime_env", "label_selector",
-    )
+    __slots__ = _WIRE_FIELDS + ("_wire",)
 
     def __init__(
         self,
@@ -93,13 +104,28 @@ class TaskSpec:
         self.max_restarts = max_restarts
         self.runtime_env = runtime_env
         self.label_selector = label_selector
+        self._wire = None
 
     def to_wire(self) -> Dict[str, Any]:
-        return {s: getattr(self, s) for s in self.__slots__}
+        # specs are immutable after construction; dispatch sits on the
+        # task/actor-call hot path, so the wire dict is built once. Callers
+        # that add per-dispatch keys (assigned_instances) copy first.
+        w = self._wire
+        if w is None:
+            self._wire = w = {s: getattr(self, s) for s in _WIRE_FIELDS}
+        return w
 
     @classmethod
     def from_wire(cls, wire: Dict[str, Any]) -> "TaskSpec":
-        return cls(**wire)
+        # executor-side hot path: fill slots directly, tolerating extra
+        # keys (assigned_instances rides the same frame) and missing ones
+        # (older senders) without a 26-kwarg call
+        self = cls.__new__(cls)
+        get = wire.get
+        for s in _WIRE_FIELDS:
+            setattr(self, s, get(s, _WIRE_DEFAULTS.get(s)))
+        self._wire = None
+        return self
 
     def scheduling_key(self) -> Tuple:
         """Tasks with the same key can reuse the same leased worker

@@ -1184,17 +1184,13 @@ class Worker:
         self._record_task_event(spec, "FAILED")
 
     def _record_task_event(self, spec: TaskSpec, state: str) -> None:
+        # hot path: one tuple append, no dicts/hex (the wire + head store
+        # stay columnar; the state API renders dicts only on query —
+        # reference analog: TaskEventBuffer batches binary protos,
+        # task_event_buffer.h:206)
         self.task_events.append(
-            {
-                "task_id": spec.task_id.hex(),
-                "job_id": spec.job_id.hex(),
-                "name": spec.function_name,
-                "state": state,
-                "type": spec.task_type,
-                "time": time.time(),
-                "node_id": self.node_id,
-            }
-        )
+            (spec.task_id, spec.job_id, spec.function_name, state,
+             spec.task_type, time.time()))
         if len(self.task_events) >= CONFIG.task_event_flush_batch:
             self.flush_task_events()
 
@@ -1205,7 +1201,9 @@ class Worker:
 
         async def send():
             try:
-                await self.head.call("ReportTaskEvents", {"events": events})
+                await self.head.call(
+                    "ReportTaskEvents",
+                    {"events_v2": events, "node_id": self.node_id})
             except Exception:
                 pass
 
@@ -1469,6 +1467,24 @@ class KvClient:
 # ---------------------------------------------------------------------------
 
 
+def _attach_batch_router(client) -> Dict[int, Callable]:
+    """Route streamed BatchItem pushes on this client to their batch's
+    per-item callback. One sync push handler per connection; batches
+    register/unregister by id."""
+    batches: Dict[int, Callable] = {}
+
+    def on_push(method, payload):
+        if method == "BatchItems":
+            cb = batches.get(payload.get("b"))
+            if cb is not None:
+                for i, reply in payload.get("xs", ()):
+                    cb(i, reply)
+
+    client.set_push_handler(on_push)
+    client._stream_batches = batches
+    return batches
+
+
 class _PlacementGroupGone(Exception):
     """The target placement group was removed; queued tasks must fail."""
 
@@ -1525,6 +1541,7 @@ class _LeasePool:
         self.inflight_leases = 0
         self._exec_ms_ema: Optional[float] = None
         self._reaper: Optional[asyncio.Task] = None
+        self._pump_scheduled = False
 
     def _depth(self) -> int:
         """Adaptive pipelining: short tasks go deep so one worker wakeup
@@ -1557,6 +1574,16 @@ class _LeasePool:
 
     def submit(self, record: TaskRecord) -> None:
         self.pending.append(record)
+        # defer one loop tick so a burst of submits drained from the inbox
+        # in the same tick lands in pending TOGETHER and rides batched
+        # PushTaskBatch frames (the actor path defers its flush the same
+        # way); a lone submit still pumps within the same loop iteration
+        if not self._pump_scheduled:
+            self._pump_scheduled = True
+            asyncio.get_running_loop().call_soon(self._scheduled_pump)
+
+    def _scheduled_pump(self) -> None:
+        self._pump_scheduled = False
         self._pump()
 
     def _pump(self) -> None:
@@ -1572,13 +1599,21 @@ class _LeasePool:
                  if not c.dead and c.inflight < self._conn_depth(c, now, depth)),
                 key=lambda c: c.inflight)
             for conn in ready:
+                batch: List[TaskRecord] = []
                 while self.pending and conn.inflight < self._conn_depth(
                         conn, now, depth):
                     if conn in self.idle:
                         self.idle.remove(conn)
                     conn.inflight += 1
-                    record = self.pending.popleft()
-                    self._dispatch(conn, record)
+                    batch.append(self.pending.popleft())
+                # a burst headed for one worker rides ONE submission frame
+                # instead of a frame per task; results stream back per
+                # item, so neither latency nor in-frame dependencies
+                # couple to the slowest sibling
+                if len(batch) == 1:
+                    self._dispatch(conn, batch[0])
+                elif batch:
+                    self._dispatch_batch(conn, batch)
                 if not self.pending:
                     break
         want = len(self.pending)
@@ -1707,7 +1742,7 @@ class _LeasePool:
             self._after_task(conn)
             return
         try:
-            wire = record.spec.to_wire()
+            wire = dict(record.spec.to_wire())  # copy: cached base
             wire["assigned_instances"] = getattr(conn, "assigned_instances", {})
             fut = conn.client.call_future("PushTask", wire)
         except Exception:
@@ -1740,6 +1775,114 @@ class _LeasePool:
                 record.spec.function_name)
             self.worker._on_task_failure(record, e, retriable=False)
         self._after_task(conn)
+
+    def _dispatch_batch(self, conn: WorkerConn,
+                        records: List[TaskRecord]) -> None:
+        """One submission frame, streamed per-item replies: each BatchItem
+        push resolves its record the moment the worker finishes it, so a
+        frame can safely mix producers with their dependents and a fast
+        task never waits out a slow frame-mate."""
+        wires = []
+        live = []
+        for record in records:
+            if record.cancelled:
+                self._after_task(conn)
+                continue
+            wire = dict(record.spec.to_wire())  # copy: cached base
+            wire["assigned_instances"] = getattr(
+                conn, "assigned_instances", {})
+            wires.append(wire)
+            live.append(record)
+        if not live:
+            return
+        client = conn.client
+        batches = getattr(client, "_stream_batches", None)
+        if batches is None:
+            batches = _attach_batch_router(client)
+        self._batch_seq = getattr(self, "_batch_seq", 0) + 1
+        bid = self._batch_seq
+        resolved = [False] * len(live)
+
+        def on_item(i, reply):
+            if i is None or not (0 <= i < len(live)) or resolved[i]:
+                return
+            resolved[i] = True
+            if conn.dispatch_times:
+                conn.dispatch_times.popleft()
+            record = live[i]
+            ms = reply.get("exec_ms") if isinstance(reply, dict) else None
+            if ms is not None:
+                prev = self._exec_ms_ema
+                self._exec_ms_ema = ms if prev is None \
+                    else 0.8 * prev + 0.2 * ms
+            try:
+                if isinstance(reply, dict) and "batch_item_error" in reply:
+                    self.worker._on_task_failure(
+                        record,
+                        RuntimeError("task failed in worker: "
+                                     f"{reply['batch_item_error']}"),
+                        retriable=False)
+                else:
+                    self.worker._on_task_reply(record, reply)
+            except Exception as e:
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "error processing task reply for %s",
+                    record.spec.function_name)
+                self.worker._on_task_failure(record, e, retriable=False)
+            self._after_stream_item(conn)
+
+        batches[bid] = on_item
+        try:
+            fut = client.call_future("PushTaskBatchStream",
+                                     {"b": bid, "specs": wires})
+        except Exception:
+            batches.pop(bid, None)
+            self._on_batch_failed(conn, live)
+            return
+        now = time.monotonic()
+        conn.dispatch_times.extend([now] * len(live))
+
+        def on_final(f):
+            batches.pop(bid, None)
+            stragglers = [r for r, done in zip(live, resolved) if not done]
+            if not stragglers:
+                return
+            for _ in stragglers:
+                if conn.dispatch_times:
+                    conn.dispatch_times.popleft()
+            self._on_batch_failed(conn, stragglers)
+
+        fut.add_done_callback(on_final)
+
+    def _after_stream_item(self, conn: WorkerConn) -> None:
+        """Per-item completion: free the pipeline slot; refills coalesce
+        into one deferred pump (items from one network frame decrement
+        together, then a single pump re-batches)."""
+        conn.inflight -= 1
+        if self.pending and not conn.dead:
+            if not self._pump_scheduled:
+                self._pump_scheduled = True
+                asyncio.get_running_loop().call_soon(self._scheduled_pump)
+        elif conn.inflight == 0 and not conn.dead and conn not in self.idle:
+            conn.idle_since = time.monotonic()
+            self.idle.append(conn)
+            self._ensure_reaper()
+
+    def _on_batch_failed(self, conn: WorkerConn,
+                         records: List[TaskRecord]) -> None:
+        conn.dead = True
+        asyncio.get_running_loop().create_task(
+            self._drop_conn(conn, worker_exited=True))
+        for record in records:
+            self.worker._on_task_failure(
+                record, WorkerCrashedError(
+                    f"worker died while running {record.spec.function_name}"
+                ),
+                retriable=True,
+            )
+        self._pump()
 
     def _on_push_failed(self, conn: WorkerConn, record: TaskRecord) -> None:
         conn.dead = True
@@ -1952,36 +2095,34 @@ class _ActorState:
 
     def _push_batch(self, worker: Worker, records: List[TaskRecord]) -> None:
         """Many sequenced calls in ONE frame; the worker executes them in
-        order (its serial per-actor discipline) and replies with a list."""
-        try:
-            fut = self.client.call_future(
-                "PushTaskBatch", [r.spec.to_wire() for r in records])
-        except Exception:
-            for record in records:
-                self._on_push_broken(worker, record)
-            return
-        fut.add_done_callback(
-            lambda f: self._on_batch_reply(worker, records, f))
+        order (its serial per-actor discipline) and STREAMS each result
+        back as it lands — a slow method doesn't gate its frame-mates'
+        callers, and a call whose arg is a frame-mate's return resolves
+        instead of deadlocking on the frame reply."""
+        client = self.client
+        batches = getattr(client, "_stream_batches", None)
+        if batches is None:
+            batches = _attach_batch_router(client)
+        self._batch_seq = getattr(self, "_batch_seq", 0) + 1
+        bid = self._batch_seq
+        resolved = [False] * len(records)
 
-    def _on_batch_reply(self, worker: Worker, records: List[TaskRecord],
-                        fut: "asyncio.Future") -> None:
-        if fut.cancelled() or fut.exception() is not None:
-            for record in records:
-                self._on_push_broken(worker, record)
-            return
-        replies = fut.result()
-        for record, reply in zip(records, replies):
+        def on_item(i, reply):
+            if i is None or not (0 <= i < len(records)) or resolved[i]:
+                return
+            resolved[i] = True
+            record = records[i]
             self._note_exec_ms(reply)
             if isinstance(reply, dict) and "batch_item_error" in reply:
                 # one item failed at the handler level; the rest of the
-                # frame is fine (see handle_push_task_batch)
+                # frame is fine (see handle_push_task_batch_stream)
                 worker._on_task_failure(
                     record,
                     RuntimeError(
                         f"actor task failed in worker: "
                         f"{reply['batch_item_error']}"),
                     retriable=False)
-                continue
+                return
             try:
                 worker._on_task_reply(record, reply)
             except Exception as e:
@@ -1991,6 +2132,25 @@ class _ActorState:
                     "error processing actor reply for %s",
                     record.spec.function_name)
                 worker._on_task_failure(record, e, retriable=False)
+
+        batches[bid] = on_item
+        try:
+            fut = client.call_future(
+                "PushTaskBatchStream",
+                {"b": bid, "specs": [r.spec.to_wire() for r in records]})
+        except Exception:
+            batches.pop(bid, None)
+            for record in records:
+                self._on_push_broken(worker, record)
+            return
+
+        def on_final(f):
+            batches.pop(bid, None)
+            for record, done in zip(records, resolved):
+                if not done:
+                    self._on_push_broken(worker, record)
+
+        fut.add_done_callback(on_final)
 
     def _on_push_reply(self, worker: Worker, record: TaskRecord,
                        fut: "asyncio.Future") -> None:

@@ -1,0 +1,129 @@
+"""Warm-template forkserver: workers start by fork() from a pre-imported
+template instead of a cold ``python -m`` launch.
+
+The reference hides interpreter-startup latency behind prestarted idle
+workers (reference: worker_pool.h StartWorkerProcess + prestart pools);
+on a 1-core host a burst of 1000 actor creations still pays ~350ms of
+imports per process. Forking from this template costs ~20-30ms: the
+interpreter, ray_tpu._private.worker_process, msgpack and the protocol
+stack are already imported; the child just fixes its env and enters
+worker main.
+
+Protocol (newline-delimited JSON over a unix stream socket):
+  request:  {"env": {...}, "log_out": path, "log_err": path}
+  response: {"pid": <child pid>}    (or {"error": "..."})
+
+Fork safety: this process is SINGLE-THREADED by construction (blocking
+socket loop, no asyncio); children reset inherited state — they setsid,
+close the server fds, redirect stdio, and worker main builds every
+socket/loop fresh. jax is deliberately NOT pre-imported (workers default
+to JAX_PLATFORMS=cpu and import lazily). Zombies are reaped via SIGCHLD.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+
+# Pre-import the worker stack while still single-threaded (this is the
+# whole point of the template). Must not start loops or sockets.
+import ray_tpu._private.worker_process  # noqa: F401  (warm import)
+
+
+def _reap(_sig, _frm):
+    try:
+        while True:
+            pid, _ = os.waitpid(-1, os.WNOHANG)
+            if pid == 0:
+                break
+    except ChildProcessError:
+        pass
+
+
+def _spawn(req: dict, server: socket.socket, conn: socket.socket) -> int:
+    pid = os.fork()
+    if pid != 0:
+        return pid
+    # ---- child ----
+    try:
+        os.setsid()
+        server.close()
+        conn.close()
+        signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+        out = os.open(req["log_out"], os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+        err = os.open(req["log_err"], os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+        os.dup2(out, 1)
+        os.dup2(err, 2)
+        os.close(out)
+        os.close(err)
+        env = req["env"]
+        os.environ.clear()
+        os.environ.update(env)
+        from ray_tpu._private import worker_process
+
+        worker_process.main()
+        os._exit(0)
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()
+        os._exit(1)
+
+
+def main() -> None:
+    sock_path = sys.argv[1]
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    signal.signal(signal.SIGCHLD, _reap)
+    parent = os.getppid()
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    server.bind(sock_path)
+    server.listen(16)
+    server.settimeout(5.0)
+    # tell the agent we're ready (it waits for this file)
+    with open(sock_path + ".ready", "w") as f:
+        f.write(str(os.getpid()))
+    while True:
+        # the template must not outlive its agent (it is setsid-detached,
+        # so nothing else reaps it on session shutdown)
+        if os.getppid() != parent:
+            break
+        try:
+            conn, _ = server.accept()
+        except socket.timeout:
+            continue
+        except InterruptedError:  # SIGCHLD during accept
+            continue
+        except OSError:
+            break
+        try:
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    buf = b""
+                    break
+                buf += chunk
+            if not buf:
+                continue
+            req = json.loads(buf)
+            try:
+                pid = _spawn(req, server, conn)
+                conn.sendall((json.dumps({"pid": pid}) + "\n").encode())
+            except BaseException as e:  # noqa: BLE001
+                conn.sendall(
+                    (json.dumps({"error": repr(e)}) + "\n").encode())
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,7 @@ class Executor:
     async def handle_push_task(self, conn, wire: Dict) -> Dict:
         if not self.worker.ready_event.is_set():
             await self.worker.ready_event.wait()
-        spec = TaskSpec.from_wire({k: wire[k] for k in TaskSpec.__slots__ if k in wire})
+        spec = TaskSpec.from_wire(wire)  # tolerates extra frame keys
         assigned = wire.get("assigned_instances") or {}
         start = time.monotonic()
         if spec.task_type == ACTOR_TASK and self._max_concurrency == 1:
@@ -89,6 +89,45 @@ class Executor:
         if isinstance(reply, dict) and "exec_ms" not in reply:
             reply["exec_ms"] = (time.monotonic() - start) * 1000.0
         return reply
+
+    async def handle_push_task_batch_stream(self, conn, p: Dict) -> Dict:
+        """One frame, many pushes — but each item's result STREAMS back as
+        a BatchItem push the moment it completes (write-combined), so a
+        fast item's caller isn't gated on a slow sibling and a dependent
+        task batched behind its producer sees the producer's result
+        immediately. The frame's reply just closes the batch (reference:
+        the per-task PushTask replies of direct_actor_task_submitter.h,
+        amortized onto one submission frame)."""
+        bid = p["b"]
+        wires = p["specs"]
+        # items completing in the same loop tick coalesce into ONE frame
+        # (a serial run of sub-ms tasks streams as a few chunky pushes; a
+        # slow task's result still leaves the moment it lands)
+        out: List = []
+        armed = [False]
+
+        def flush() -> None:
+            armed[0] = False
+            if out:
+                items, out[:] = list(out), []
+                try:
+                    conn.push_nowait("BatchItems", {"b": bid, "xs": items})
+                except Exception:
+                    pass  # owner gone; the final reply will fail too
+
+        async def run_one(i: int, wire: Dict) -> None:
+            try:
+                reply = await self.handle_push_task(conn, wire)
+            except BaseException as e:  # noqa: BLE001 — per-item blast radius
+                reply = {"batch_item_error": repr(e)}
+            out.append((i, reply))
+            if not armed[0]:
+                armed[0] = True
+                asyncio.get_running_loop().call_soon(flush)
+
+        await asyncio.gather(*[run_one(i, w) for i, w in enumerate(wires)])
+        flush()
+        return {"n": len(wires)}
 
     async def handle_push_task_batch(self, conn, wires: List[Dict]
                                      ) -> List[Dict]:
@@ -575,6 +614,8 @@ def main() -> None:
 
     # Executor routes must exist before registration makes us leasable.
     worker.direct_server.add_handler("PushTask", executor.handle_push_task)
+    worker.direct_server.add_handler("PushTaskBatchStream",
+                                     executor.handle_push_task_batch_stream)
     worker.direct_server.add_handler("PushTaskBatch",
                                      executor.handle_push_task_batch)
     worker.direct_server.add_handler("SampleStacks", _handle_sample_stacks)

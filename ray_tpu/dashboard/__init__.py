@@ -156,6 +156,22 @@ class DashboardActor:
         if parts[0] == "serve" and len(parts) > 1 \
                 and parts[1] == "applications":
             return self._serve_status()
+        if parts[0] == "data_stats":
+            # per-dataset per-operator execution stats published by
+            # drivers (Dataset._publish_stats; reference: the dashboard's
+            # Ray Data tab fed by _internal/stats.py)
+            import json as _json
+
+            from ray_tpu.experimental.internal_kv import (
+                _internal_kv_get, _internal_kv_list)
+
+            out = []
+            for key in sorted(_internal_kv_list(b"__data_stats__:"))[-50:]:
+                val = _internal_kv_get(key)
+                if val:
+                    out.append({"dataset": key.decode().split(":", 1)[1],
+                                **_json.loads(val)})
+            return out
         if parts[0] == "nodes":
             return state_api.list_nodes()
         if parts[0] == "node_stats":

@@ -37,18 +37,45 @@ class Topology:
 
 
 class ExecutorStats:
+    """Per-operator execution accounting, rendered like the reference's
+    ``ds.stats()`` report (reference: python/ray/data/_internal/stats.py —
+    DatasetStats.to_summary / OpRuntimeMetrics, wired through
+    streaming_executor.py)."""
+
     def __init__(self):
         self.start_time = time.perf_counter()
         self.wall_s = 0.0
         self.per_op: List[Dict] = []
 
+    @staticmethod
+    def _fmt_bytes(n: int) -> str:
+        for unit in ("B", "KB", "MB", "GB"):
+            if n < 1024 or unit == "GB":
+                return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+            n /= 1024
+        return f"{n}B"
+
     def summary(self) -> str:
-        lines = [f"Dataset execution: {self.wall_s:.3f}s wall"]
-        for rec in self.per_op:
+        lines = []
+        for i, rec in enumerate(self.per_op):
             lines.append(
-                f"  {rec['name']}: {rec['tasks']} tasks, "
-                f"{rec['rows']} rows, {rec['exec_s']:.3f}s task time")
+                f"Operator {i} {rec['name']}: {rec['tasks']} tasks "
+                f"executed, {rec['blocks_out']} blocks produced in "
+                f"{rec['wall_s']:.2f}s")
+            lines.append(
+                f"* Rows: {rec['rows_in']} in / {rec['rows_out']} out, "
+                f"bytes: {self._fmt_bytes(rec['bytes_in'])} in / "
+                f"{self._fmt_bytes(rec['bytes_out'])} out")
+            lines.append(
+                f"* Task time: {rec['exec_s']:.3f}s total"
+                + (f", {rec['exec_s'] / rec['tasks']:.3f}s mean"
+                   if rec['tasks'] else ""))
+        lines.append(f"Dataset: {self.wall_s:.2f}s wall, "
+                     f"{sum(r['tasks'] for r in self.per_op)} tasks")
         return "\n".join(lines)
+
+    def to_dict(self) -> Dict:
+        return {"wall_s": round(self.wall_s, 4), "ops": self.per_op}
 
 
 class StreamingExecutor:
@@ -116,6 +143,7 @@ class StreamingExecutor:
                     self.out.put(bundle)
                 for dst, port in dsts:
                     target = ops[dst]
+                    target._note_input(bundle)
                     if isinstance(target, ZipOperator) and port == "right":
                         target.add_right(bundle)
                     elif isinstance(target, ZipOperator):
@@ -158,7 +186,12 @@ class StreamingExecutor:
         self.stats.wall_s = time.perf_counter() - self.stats.start_time
         self.stats.per_op = [
             {"name": op.name, "tasks": op.tasks_launched,
-             "rows": op.rows_out, "exec_s": op.exec_time_s}
+             "rows": op.rows_out, "rows_in": op.rows_in,
+             "rows_out": op.rows_out, "bytes_in": op.bytes_in,
+             "bytes_out": op.bytes_out, "blocks_out": op.blocks_out,
+             "exec_s": round(op.exec_time_s, 4),
+             "wall_s": round(max(0.0, op.last_activity_t
+                                 - op.first_activity_t), 4)}
             for op in self.topology.ops]
 
     # ------------------------------------------------------------- consume

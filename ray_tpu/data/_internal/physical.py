@@ -153,9 +153,17 @@ class PhysicalOperator:
         self.input_queue: collections.deque = collections.deque()
         self.output_queue: collections.deque = collections.deque()
         self.inputs_complete = False
+        # per-operator accounting surfaced by Dataset.stats() (reference:
+        # python/ray/data/_internal/stats.py OpRuntimeMetrics)
+        self.rows_in = 0
+        self.bytes_in = 0
         self.rows_out = 0
+        self.bytes_out = 0
+        self.blocks_out = 0
         self.exec_time_s = 0.0
         self.tasks_launched = 0
+        self.first_activity_t: float = 0.0
+        self.last_activity_t: float = 0.0
 
     # --- scheduling interface
     def num_active_tasks(self) -> int:
@@ -178,9 +186,25 @@ class PhysicalOperator:
                 and self.num_active_tasks() == 0)
 
     def _emit(self, bundle: RefBundle) -> None:
+        import time as _t
+
+        now = _t.perf_counter()
+        if not self.first_activity_t:
+            self.first_activity_t = now
+        self.last_activity_t = now
         self.rows_out += bundle.meta.num_rows
+        self.bytes_out += bundle.meta.size_bytes or 0
+        self.blocks_out += 1
         self.exec_time_s += bundle.meta.exec_time_s
         self.output_queue.append(bundle)
+
+    def _note_input(self, bundle: RefBundle) -> None:
+        import time as _t
+
+        if not self.first_activity_t:
+            self.first_activity_t = _t.perf_counter()
+        self.rows_in += bundle.meta.num_rows
+        self.bytes_in += bundle.meta.size_bytes or 0
 
 
 class InputDataBuffer(PhysicalOperator):

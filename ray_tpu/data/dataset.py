@@ -213,6 +213,30 @@ class Dataset:
             yield from executor.iter_bundles()
         finally:
             executor.shutdown()
+            self._publish_stats(stats)
+
+    def _publish_stats(self, stats: ExecutorStats) -> None:
+        """Best-effort: per-operator stats land in the head KV so the
+        dashboard's /api/data_stats can render them cluster-wide
+        (reference: data stats surface in the dashboard's Ray Data tab)."""
+        try:
+            import json as _json
+            import time as _time
+
+            from ray_tpu.experimental.internal_kv import (
+                _internal_kv_del, _internal_kv_list, _internal_kv_put)
+
+            # zero-padded ms timestamp first => lexicographic == recency
+            key = (f"__data_stats__:{int(_time.time() * 1000):015d}"
+                   f":{id(self):x}")
+            _internal_kv_put(key.encode(), _json.dumps(
+                stats.to_dict()).encode())
+            # bound head-KV growth: keep only the most recent entries
+            stale = sorted(_internal_kv_list(b"__data_stats__:"))[:-100]
+            for k in stale:
+                _internal_kv_del(k)
+        except Exception:
+            pass
 
     def iter_internal_ref_bundles(self) -> Iterator[RefBundle]:
         return self._execute_bundles()

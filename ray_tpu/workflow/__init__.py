@@ -1,17 +1,27 @@
 """Durable workflows (reference: python/ray/workflow/ — workflow.run
 api.py:123, run_async :177, WorkflowExecutor + step checkpointing
-workflow_storage.py).
+workflow_storage.py, continuations workflow_executor.py, event system
+http_event_provider.py).
 
 Executes a ``ray_tpu.dag`` graph with every step's result checkpointed to
 storage; ``resume`` re-runs the graph, skipping steps whose checkpoints
 exist — lineage-on-disk rather than lineage-in-memory.
+
+Dynamic workflows: a step may return ``workflow.continuation(sub_dag)``;
+the engine executes the sub-DAG as that step's continuation, each sub-step
+durably checkpointed under the parent step's key prefix, so a crash inside
+a continuation resumes mid-continuation (reference:
+workflow_executor.py's continuation handling).
+
+Storage is scheme-pluggable: ``init("mock://bucket/workflows")`` (or any
+registered backend, _private/storage.py) persists checkpoints remotely —
+the reference's equivalent of workflow storage on S3/GCS.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import pickle
 import time
 from typing import Any, Dict, List, Optional
 
@@ -25,14 +35,94 @@ def init(storage: Optional[str] = None) -> None:
     global _storage_root
     if storage:
         _storage_root = storage
-    os.makedirs(_storage_root, exist_ok=True)
+    _Store(_storage_root).makedirs("")
 
 
-def _wf_dir(workflow_id: str) -> str:
-    return os.path.join(_storage_root, workflow_id)
+# --------------------------------------------------------------- storage
+class _Store:
+    """Workflow storage over a local dir OR a remote URI (scheme resolves
+    a StorageBackend — reference: workflow_storage.py over pyarrow fs)."""
+
+    def __init__(self, root: str):
+        from ray_tpu._private.storage import is_remote_uri
+
+        self.root = root
+        self.remote = is_remote_uri(root)
+
+    def _backend(self):
+        from ray_tpu._private.storage import get_storage_backend
+
+        return get_storage_backend(self.root)
+
+    def _join(self, *parts: str) -> str:
+        from ray_tpu._private.storage import join_uri
+
+        if self.remote:
+            return join_uri(self.root, *parts)
+        return os.path.join(self.root, *parts)
+
+    def makedirs(self, rel: str) -> None:
+        if self.remote:
+            return
+        os.makedirs(self._join(rel) if rel else self.root, exist_ok=True)
+
+    def write_bytes(self, rel: str, data: bytes) -> None:
+        if self.remote:
+            self._backend().write_bytes(self._join(rel), data)
+            return
+        path = self._join(rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def read_bytes(self, rel: str) -> Optional[bytes]:
+        try:
+            if self.remote:
+                return self._backend().read_bytes(self._join(rel))
+            with open(self._join(rel), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+
+    def exists(self, rel: str) -> bool:
+        if self.remote:
+            return self._backend().exists(self._join(rel))
+        return os.path.exists(self._join(rel))
+
+    def listdir(self, rel: str = "") -> List[str]:
+        if self.remote:
+            return self._backend().listdir(
+                self._join(rel) if rel else self.root)
+        p = self._join(rel) if rel else self.root
+        return sorted(os.listdir(p)) if os.path.isdir(p) else []
+
+    def delete(self, rel: str) -> None:
+        if self.remote:
+            self._backend().delete(self._join(rel))
+            return
+        import shutil
+
+        shutil.rmtree(self._join(rel), ignore_errors=True)
 
 
-def _node_keys(root: DAGNode) -> Dict[int, str]:
+# ---------------------------------------------------------- continuations
+class Continuation:
+    """Returned by a step to hand execution to a dynamically-built
+    sub-DAG (reference: workflow.continuation — api.py)."""
+
+    def __init__(self, dag: DAGNode):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a bound DAG node")
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    return Continuation(dag)
+
+
+def _node_keys(root: DAGNode, prefix: str = "") -> Dict[int, str]:
     """Deterministic step keys: postorder index + function name."""
     keys: Dict[int, str] = {}
     counter = [0]
@@ -46,7 +136,7 @@ def _node_keys(root: DAGNode) -> Dict[int, str]:
         name = type(node).__name__
         if isinstance(node, FunctionNode):
             name = getattr(node._remote_fn, "__name__", "fn")
-        keys[id(node)] = f"step_{counter[0]:04d}_{name}"
+        keys[id(node)] = f"{prefix}step_{counter[0]:04d}_{name}"
         counter[0] += 1
 
     visit(root)
@@ -54,34 +144,39 @@ def _node_keys(root: DAGNode) -> Dict[int, str]:
 
 
 class _DurableExecutor:
-    def __init__(self, workflow_id: str, root: DAGNode):
+    def __init__(self, workflow_id: str, root: DAGNode, prefix: str = ""):
         self.workflow_id = workflow_id
-        self.dir = _wf_dir(workflow_id)
-        os.makedirs(self.dir, exist_ok=True)
-        self.keys = _node_keys(root)
+        self.store = _Store(_storage_root)
+        self.store.makedirs(workflow_id)
+        self.keys = _node_keys(root, prefix)
         self.root = root
 
-    def _ckpt_path(self, node) -> str:
-        return os.path.join(self.dir, self.keys[id(node)] + ".pkl")
+    def _ckpt_rel(self, node) -> str:
+        return f"{self.workflow_id}/{self.keys[id(node)]}.pkl"
 
     def _set_status(self, status: str) -> None:
-        with open(os.path.join(self.dir, "status.json"), "w") as f:
-            json.dump({"status": status, "time": time.time()}, f)
+        self.store.write_bytes(
+            f"{self.workflow_id}/status.json",
+            json.dumps({"status": status, "time": time.time()}).encode())
 
     def run(self, *input_args, **input_kwargs) -> Any:
         self._set_status("RUNNING")
         try:
-            result = self._exec(self.root, input_args, input_kwargs)
-            if isinstance(result, ray_tpu.ObjectRef):
-                result = ray_tpu.get(result)
-            elif isinstance(result, list):
-                result = [ray_tpu.get(r) if isinstance(r, ray_tpu.ObjectRef)
-                          else r for r in result]
+            result = self.run_inner(input_args, input_kwargs)
             self._set_status("SUCCESSFUL")
             return result
         except Exception:
             self._set_status("FAILED")
             raise
+
+    def run_inner(self, input_args, input_kwargs) -> Any:
+        result = self._exec(self.root, input_args, input_kwargs)
+        if isinstance(result, ray_tpu.ObjectRef):
+            result = ray_tpu.get(result)
+        elif isinstance(result, list):
+            result = [ray_tpu.get(r) if isinstance(r, ray_tpu.ObjectRef)
+                      else r for r in result]
+        return result
 
     def _exec(self, node: DAGNode, input_args, input_kwargs):
         if isinstance(node, InputNode):
@@ -89,28 +184,42 @@ class _DurableExecutor:
         if isinstance(node, MultiOutputNode):
             return [self._exec(a, input_args, input_kwargs)
                     for a in node._bound_args]
-        path = self._ckpt_path(node)
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                return pickle.load(f)
+        from ray_tpu._private import serialization as ser
 
-        def resolve(a):
-            if isinstance(a, DAGNode):
-                return self._exec(a, input_args, input_kwargs)
-            return a
-
-        args = [resolve(a) for a in node._bound_args]
-        kwargs = {k: resolve(v) for k, v in node._bound_kwargs.items()}
-        if isinstance(node, FunctionNode):
-            ref = node._remote_fn.remote(*args, **kwargs)
+        rel = self._ckpt_rel(node)
+        data = self.store.read_bytes(rel)
+        if data is not None:
+            value = ser.loads(data)
         else:
-            method = getattr(node._actor, node._method_name)
-            ref = method.remote(*args, **kwargs)
-        value = ray_tpu.get(ref)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(value, f)
-        os.replace(tmp, path)
+            def resolve(a):
+                if isinstance(a, DAGNode):
+                    return self._exec(a, input_args, input_kwargs)
+                return a
+
+            args = [resolve(a) for a in node._bound_args]
+            kwargs = {k: resolve(v) for k, v in node._bound_kwargs.items()}
+            if isinstance(node, FunctionNode):
+                ref = node._remote_fn.remote(*args, **kwargs)
+            else:
+                method = getattr(node._actor, node._method_name)
+                ref = method.remote(*args, **kwargs)
+            value = ray_tpu.get(ref)
+            # COMMIT the raw step result now — even (especially) when it
+            # is a Continuation: the dynamic sub-DAG it names is then
+            # durable, and a crash inside the continuation resumes from
+            # the sub-steps' own checkpoints instead of re-running this
+            # step (reference: workflow_executor.py persists the
+            # continuation DAG before descending)
+            self.store.write_bytes(rel, ser.dumps(value))
+        # dynamic workflow: run the continuation chain, each level's steps
+        # checkpointing under this step's key prefix
+        depth = 0
+        while isinstance(value, Continuation):
+            sub = _DurableExecutor(
+                self.workflow_id, value.dag,
+                prefix=f"{self.keys[id(node)]}.c{depth}.")
+            value = sub.run_inner(input_args, input_kwargs)
+            depth += 1
         return value
 
 
@@ -144,9 +253,8 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
 # ------------------------------------------------------------------ events
 class EventListener:
     """Event source ABC (reference: workflow/event_system —
-    EventListener.poll_for_event; the HTTPEventProvider is an
-    implementation detail of its hosted variant). ``poll_for_event``
-    blocks until the event arrives and returns its payload."""
+    EventListener.poll_for_event). ``poll_for_event`` blocks until the
+    event arrives and returns its payload."""
 
     def poll_for_event(self) -> Any:
         raise NotImplementedError
@@ -178,6 +286,74 @@ class FileEventListener(EventListener):
             return f.read()
 
 
+class HTTPEventProvider(EventListener):
+    """Durable HTTP event delivery (reference:
+    python/ray/workflow/http_event_provider.py — an HTTP endpoint
+    receives ``POST /event/<key>`` and the payload is COMMITTED to
+    workflow storage before the sender gets 200, so a delivered event
+    survives a crash before the workflow consumes it).
+
+    ``poll_for_event`` first checks the durable spool (resume path), then
+    serves one HTTP request. The bound port is written to
+    ``<storage>/_events/<key>.port`` so external senders can discover it.
+    """
+
+    def __init__(self, event_key: str, port: int = 0,
+                 timeout_s: float = 300.0):
+        self.event_key = event_key
+        self.port = port
+        self.timeout_s = timeout_s
+
+    def _spool_rel(self) -> str:
+        return f"_events/{self.event_key}.payload"
+
+    def poll_for_event(self) -> bytes:
+        init()
+        store = _Store(_storage_root)
+        spooled = store.read_bytes(self._spool_rel())
+        if spooled is not None:  # durably delivered earlier (resume path)
+            return spooled
+
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        received: List[bytes] = []
+        key = self.event_key
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/").split("/")[-1] != key:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(n)
+                # COMMIT before acking: that is the durability contract
+                store.write_bytes(f"_events/{key}.payload", payload)
+                received.append(payload)
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        server = HTTPServer(("127.0.0.1", self.port), Handler)
+        server.timeout = 1.0
+        store.write_bytes(f"_events/{key}.port",
+                          str(server.server_address[1]).encode())
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            while not received and time.monotonic() < deadline:
+                server.handle_request()
+        finally:
+            server.server_close()
+        if not received:
+            raise TimeoutError(
+                f"no event delivered for key {key!r} "
+                f"within {self.timeout_s}s")
+        return received[0]
+
+
 def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
     """A DAG step that completes when the listener's event arrives
     (reference: workflow.wait_for_event). Like any step, the received
@@ -199,23 +375,26 @@ def resume(workflow_id: str, dag: DAGNode, *, args: tuple = (),
     here the caller re-supplies the graph and storage supplies the state.)
     """
     init()
-    if not os.path.isdir(_wf_dir(workflow_id)):
+    store = _Store(_storage_root)
+    if not (store.exists(f"{workflow_id}/status.json")
+            or store.listdir(workflow_id)):
         raise ValueError(f"no workflow {workflow_id!r}")
     return _DurableExecutor(workflow_id, dag).run(*args, **(kwargs or {}))
 
 
 def get_status(workflow_id: str) -> Optional[str]:
-    path = os.path.join(_wf_dir(workflow_id), "status.json")
-    if not os.path.exists(path):
+    data = _Store(_storage_root).read_bytes(f"{workflow_id}/status.json")
+    if data is None:
         return None
-    with open(path) as f:
-        return json.load(f)["status"]
+    return json.loads(data)["status"]
 
 
 def list_all() -> List[Dict]:
     init()
     out = []
-    for wid in sorted(os.listdir(_storage_root)):
+    for wid in _Store(_storage_root).listdir():
+        if wid.startswith("_"):
+            continue
         status = get_status(wid)
         if status:
             out.append({"workflow_id": wid, "status": status})
@@ -223,6 +402,4 @@ def list_all() -> List[Dict]:
 
 
 def delete(workflow_id: str) -> None:
-    import shutil
-
-    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+    _Store(_storage_root).delete(workflow_id)

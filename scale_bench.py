@@ -1,0 +1,161 @@
+"""Control-plane scale benchmarks (VERDICT r3 next #4).
+
+Mirrors the reference's scalability-envelope suite
+(reference: release/benchmarks/README.md:5-31 — many_tasks 10k,
+many_actors 10k, many_pgs 1k, 1M queued tasks) scaled to one box: the
+numbers prove the asyncio control plane schedules/queues at envelope
+depth without wedging; absolute rates are bounded by this box's single
+core (the baseline's came from a 64-core head + cluster).
+
+Run: ``python scale_bench.py [--quick]`` — prints one JSON dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_many_tasks(ray, n: int) -> dict:
+    """n short tasks submitted at once: end-to-end completion rate
+    (reference: many_tasks — 10k tasks across the cluster)."""
+
+    @ray.remote
+    def noop():
+        return None
+
+    ray.get(noop.remote(), timeout=120)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    submitted = time.perf_counter() - t0
+    ray.get(refs, timeout=600)
+    total = time.perf_counter() - t0
+    return {"n": n, "submit_s": round(submitted, 3),
+            "total_s": round(total, 3),
+            "tasks_per_s": round(n / total, 1)}
+
+
+def bench_many_actors(ray, n: int) -> dict:
+    """n actors created + first call answered, then killed
+    (reference: many_actors — launch rate)."""
+
+    @ray.remote
+    class A:
+        def ping(self):
+            return 1
+
+    # alive actors hold ~no CPU (reference actors hold 0 CPU while
+    # idle); the envelope measures control-plane depth, not core count
+    t0 = time.perf_counter()
+    actors = [A.options(num_cpus=0.001).remote() for _ in range(n)]
+    ray.get([a.ping.remote() for a in actors], timeout=600)
+    ready = time.perf_counter() - t0
+    for a in actors:
+        ray.kill(a)
+    return {"n": n, "ready_s": round(ready, 3),
+            "actors_per_s": round(n / ready, 1)}
+
+
+def bench_pg_churn(ray, n: int) -> dict:
+    """create -> ready -> remove cycles (reference: placement group
+    create/removal 899/s on m4.16xlarge)."""
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = placement_group([{"CPU": 1}])
+        assert pg.wait(timeout_seconds=60)
+        remove_placement_group(pg)
+    took = time.perf_counter() - t0
+    return {"n": n, "total_s": round(took, 3),
+            "pg_cycles_per_s": round(n / took, 1)}
+
+
+def bench_many_pgs(ray, n: int) -> dict:
+    """n placement groups simultaneously alive (reference envelope: 1,000
+    simultaneous PGs). Zero-CPU bundles: the envelope tests control-plane
+    bookkeeping depth, not this box's 4 CPUs."""
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(n)]
+    for pg in pgs:
+        assert pg.wait(timeout_seconds=120)
+    created = time.perf_counter() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    return {"n": n, "create_all_s": round(created, 3),
+            "pgs_per_s": round(n / created, 1)}
+
+
+def bench_queued_tasks(ray, n: int) -> dict:
+    """Queue-depth envelope: n tasks pending behind a blocked worker pool
+    (reference envelope: 1M queued). Proves submission + queueing stays
+    O(1) per task and the runtime drains the backlog without wedging."""
+    import ray_tpu
+
+    @ray.remote
+    class Gate:
+        def __init__(self):
+            self._open = False
+
+        def open(self):
+            self._open = True
+
+        def is_open(self):
+            return self._open
+
+    gate = Gate.remote()
+
+    @ray.remote
+    def blocked(gate):
+        import time as _t
+        while not ray_tpu.get(gate.is_open.remote()):
+            _t.sleep(0.2)
+        return 1
+
+    @ray.remote
+    def noop():
+        return None
+
+    # fill every worker slot with blockers, then queue n tasks behind them
+    blockers = [blocked.remote(gate) for _ in range(4)]
+    time.sleep(1.0)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    submit_s = time.perf_counter() - t0
+    # backlog is fully queued; release the gate and drain everything
+    gate.open.remote()
+    t1 = time.perf_counter()
+    ray.get(refs, timeout=1200)
+    drain_s = time.perf_counter() - t1
+    ray.get(blockers, timeout=60)
+    return {"n": n, "submit_s": round(submit_s, 3),
+            "enqueue_per_s": round(n / submit_s, 1),
+            "drain_s": round(drain_s, 3),
+            "drain_per_s": round(n / drain_s, 1)}
+
+
+def main(quick: bool = False) -> dict:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    results = {}
+    results["many_tasks"] = bench_many_tasks(ray_tpu, 2000 if quick else 10_000)
+    results["many_actors"] = bench_many_actors(ray_tpu, 200 if quick else 1000)
+    results["pg_churn"] = bench_pg_churn(ray_tpu, 50 if quick else 200)
+    results["many_pgs"] = bench_many_pgs(ray_tpu, 200 if quick else 1000)
+    results["queued_tasks"] = bench_queued_tasks(
+        ray_tpu, 20_000 if quick else 100_000)
+    print(json.dumps(results))
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -1,0 +1,71 @@
+"""Dataset.stats(): per-operator rows/bytes/wall/task-count collected by
+the streaming executor (VERDICT r3 missing #5 / next #6; reference:
+python/ray/data/_internal/stats.py rendered via ds.stats())."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_stats_read_map_shuffle(cluster):
+    ds = (rdata.range(1000, parallelism=8)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .random_shuffle(seed=7))
+    rows = sum(len(b["id"]) for b in ds.iter_batches(batch_size=None))
+    assert rows == 1000
+
+    report = ds.stats()
+    d = ds._last_stats.to_dict()
+    assert d["wall_s"] > 0
+    ops = d["ops"]
+    assert len(ops) >= 2  # read+map fused, shuffle stage(s)
+
+    # the fused read->map operator produced all 1000 rows with real bytes
+    first = ops[0]
+    assert first["rows_out"] == 1000
+    assert first["bytes_out"] > 0
+    assert first["tasks"] == 8  # one task per block
+    assert first["blocks_out"] == 8
+    assert first["wall_s"] >= 0
+
+    # the terminal operator emitted all rows, consumed what upstream made
+    last = ops[-1]
+    assert last["rows_out"] == 1000
+    assert last["rows_in"] == 1000
+    assert last["bytes_in"] > 0
+
+    # the rendered report carries the reference-style lines
+    assert "Operator 0" in report
+    assert "tasks executed" in report
+    assert "Rows: " in report
+    assert "Dataset: " in report
+
+
+def test_stats_published_to_kv_for_dashboard(cluster):
+    ds = rdata.range(100, parallelism=2).map_batches(
+        lambda b: {"id": b["id"] + 1})
+    ds.materialize()
+    from ray_tpu.experimental.internal_kv import _internal_kv_list
+
+    keys = _internal_kv_list(b"__data_stats__:")
+    assert keys, "driver did not publish dataset stats"
+    # dashboard route consumes the same keys
+    from ray_tpu.dashboard import DashboardActor
+
+    api = DashboardActor.__new__(DashboardActor)
+    out = api._api("/api/data_stats")
+    assert out and out[-1]["ops"]
+
+
+def test_stats_empty_before_execution(cluster):
+    ds = rdata.range(10)
+    assert ds.stats() == ""
