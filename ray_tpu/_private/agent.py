@@ -32,6 +32,18 @@ from ray_tpu._private.resources import (
     NodeResources, ResourceSet, label_constraints_match)
 
 
+class _NeverLaunched:
+    """Sentinel proc for spawns that failed before producing a process."""
+
+    pid = None
+
+    def poll(self):
+        return 1
+
+    def terminate(self):
+        pass
+
+
 class WorkerHandle:
     def __init__(self, worker_id: str, proc: Optional[subprocess.Popen]):
         self.worker_id = worker_id
@@ -65,6 +77,12 @@ class WorkerHandle:
                 self.proc.terminate()
             except Exception:
                 pass
+
+    def mark_failed(self) -> None:
+        """A launch that will never produce a process: flips `alive` to
+        False so liveness watchers (actor resource release) resolve."""
+        if self.proc is None:
+            self.proc = _NeverLaunched()
 
 
 class ConnectionPool:
@@ -422,6 +440,7 @@ class NodeAgent:
                     handle.launching = False
                     self._starting_workers = max(0,
                                                  self._starting_workers - 1)
+                    handle.mark_failed()
                     self.workers.pop(handle.worker_id, None)
             else:
                 asyncio.get_running_loop().create_task(
@@ -445,13 +464,17 @@ class NodeAgent:
             self._launching_workers = max(0, self._launching_workers - 1)
             handle.launching = False
             self._starting_workers = max(0, self._starting_workers - 1)
+            handle.mark_failed()
             self.workers.pop(handle.worker_id, None)
             # the freed slot must pull the next queued spawn or a burst
             # whose launches all fail would strand the queue forever
             self._kick_spawner()
 
-    def _worker_env(self, worker_id: str) -> Dict[str, str]:
-        ray_env = {
+    def _worker_ray_env(self, worker_id: str) -> Dict[str, str]:
+        """The one authoritative worker-bootstrap variable set (every
+        launch path — forkserver, Popen, container, conda — builds on
+        this; divergence here means divergent worker environments)."""
+        return {
             "RAY_TPU_WORKER_ID": worker_id,
             "RAY_TPU_AGENT_SOCK": self.unix_path,
             "RAY_TPU_NODE_ID": self.node_id,
@@ -459,10 +482,12 @@ class NodeAgent:
             "RAY_TPU_STORE_DIR": self.store_dir,
             "RAY_TPU_HEAD_ADDR": f"{self.head_host}:{self.head_port}",
         }
+
+    def _worker_env(self, worker_id: str) -> Dict[str, str]:
         from ray_tpu._private.config import scrub_axon_bootstrap_env
 
         env = dict(os.environ)
-        env.update(ray_env)
+        env.update(self._worker_ray_env(worker_id))
         scrub_axon_bootstrap_env(env)
         return env
 
@@ -532,14 +557,7 @@ class NodeAgent:
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.out"), "ab")
         err = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.err"), "ab")
-        ray_env = {
-            "RAY_TPU_WORKER_ID": worker_id,
-            "RAY_TPU_AGENT_SOCK": self.unix_path,
-            "RAY_TPU_NODE_ID": self.node_id,
-            "RAY_TPU_SESSION_DIR": self.session_dir,
-            "RAY_TPU_STORE_DIR": self.store_dir,
-            "RAY_TPU_HEAD_ADDR": f"{self.head_host}:{self.head_port}",
-        }
+        ray_env = self._worker_ray_env(worker_id)
         if container:
             # container runtime_env: the worker process starts INSIDE
             # podman/docker with the session dir (unix socket), object
@@ -1069,6 +1087,14 @@ class NodeAgent:
                             handle.launched_at is not None
                             and time.monotonic() - handle.launched_at
                             > CONFIG.worker_register_timeout_s):
+                        # a hung launch must not pin its startup slot or
+                        # linger in the pool — terminate + evict, or the
+                        # admission queue wedges node-wide after
+                        # STARTUP_CONCURRENCY such hangs
+                        handle.terminate()
+                        handle.mark_failed()
+                        self.workers.pop(handle.worker_id, None)
+                        self._spawn_slot_freed(handle)
                         await self.head.call(
                             "ActorDied",
                             {"actor_id": p["actor_id"],
@@ -1083,9 +1109,12 @@ class NodeAgent:
 
         asyncio.get_running_loop().create_task(finish())
 
-        # Hold the resources until the actor dies.
+        # Hold the resources until the actor dies. An evicted/never-
+        # launched handle (no longer in the pool) counts as dead — its
+        # resources must flow back (the spawn may have failed with
+        # proc=None, which `alive` alone reads as still-starting).
         async def watch_release():
-            while handle.alive:
+            while handle.alive and handle.worker_id in self.workers:
                 await asyncio.sleep(CONFIG.actor_liveness_poll_s)
             if pg:
                 pool = self._pg_available.get((pg[0], pg[1]))

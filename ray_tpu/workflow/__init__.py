@@ -360,8 +360,17 @@ def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
     payload is checkpointed — a resumed workflow does NOT wait again."""
     import ray_tpu
 
+    # the step executes in a WORKER process whose workflow module starts
+    # at the default storage root; carry the driver's configured root so
+    # storage-backed listeners (HTTPEventProvider spool/port files) land
+    # where the driver and external senders look
+    configured_root = _storage_root
+
     @ray_tpu.remote
     def __wait_for_event__():
+        from ray_tpu import workflow as _wf
+
+        _wf.init(configured_root)
         return listener_cls(*args, **kwargs).poll_for_event()
 
     return __wait_for_event__.bind()
