@@ -31,6 +31,7 @@ class RpcClient {
 
   void Connect(const std::string& host, int port, double timeout_s = 10.0);
   bool connected() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
   void Close();
 
   // Synchronous call: sends the request and reads frames until the

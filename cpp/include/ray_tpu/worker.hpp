@@ -1,0 +1,294 @@
+// ray_tpu C++ task worker: registers native functions and lease-executes
+// tasks pushed by any driver — the execution half of the C++ worker API
+// (reference: cpp/src/ray/runtime/task/task_executor.cc executes
+// registered C++ functions inside a worker process; here the worker
+// speaks the msgpack control plane directly).
+//
+// Protocol (mirrors ray_tpu/_private/worker_process.py):
+//   - RegisterClient on the agent (TCP) with role=worker and an env_key
+//     tagging the process as language:cpp, so only leases asking for
+//     {"language": "cpp"} land here (agent-side affinity —
+//     agent._pop_idle_worker).
+//   - a direct server accepts PushTask / PushTaskBatchStream frames;
+//     args arrive as ("x", msgpack) entries, results return as
+//     {"returns": [{"xlang": msgpack}]} like the Python executor's
+//     cross-language packaging (worker_process.py _package_returns).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ray_tpu/client.hpp"
+#include "ray_tpu/msgpack.hpp"
+
+namespace ray_tpu {
+
+class TaskWorker {
+ public:
+  using Fn = std::function<msgpack::Value(
+      const std::vector<msgpack::Value>& args)>;
+
+  void Register(const std::string& name, Fn fn) { fns_[name] = fn; }
+
+  // Registers with the agent and serves tasks until the agent connection
+  // drops (agent death / lease return semantics match Python workers:
+  // the registration connection IS the liveness signal).
+  void Serve(const std::string& agent_host, int agent_port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = 0;
+    if (::bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 16) != 0)
+      throw std::runtime_error("bind/listen failed");
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+                  &alen);
+    const int port = ntohs(addr.sin_port);
+
+    worker_id_ = RandomHex(16);
+    agent_.Connect(agent_host, agent_port, 30.0);
+    using msgpack::Value;
+    Value reg = Value::Map();
+    reg.Set("role", Value::Str("worker"));
+    reg.Set("worker_id", Value::Str(worker_id_));
+    reg.Set("pid", Value::Int(static_cast<int64_t>(::getpid())));
+    // agent._pop_idle_worker only hands this worker to leases whose
+    // runtime_env canonicalizes to the same key (task_spec.py
+    // runtime_env_key: json with sorted keys)
+    reg.Set("env_key", Value::Str("{\"language\": \"cpp\"}"));
+    Value daddr = Value::Map();
+    daddr.Set("host", Value::Str("127.0.0.1"));
+    daddr.Set("port", Value::Int(port));
+    daddr.Set("worker_id", Value::Str(worker_id_));
+    reg.Set("direct_addr", daddr);
+    agent_.Call("RegisterClient", reg);
+
+    std::thread accept_thread([this] { AcceptLoop(); });
+    // park on the agent connection like worker_process.main(): read until
+    // EOF (the agent never sends unsolicited frames we must answer)
+    ParkOnAgent();
+    running_ = false;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    accept_thread.join();
+  }
+
+  const std::string& worker_id() const { return worker_id_; }
+
+ private:
+  static std::string RandomHex(size_t nbytes) {
+    static const char* hexd = "0123456789abcdef";
+    std::random_device rd;
+    std::string out;
+    out.reserve(nbytes * 2);
+    for (size_t i = 0; i < nbytes; ++i) {
+      unsigned char c = static_cast<unsigned char>(rd());
+      out.push_back(hexd[c >> 4]);
+      out.push_back(hexd[c & 15]);
+    }
+    return out;
+  }
+
+  void ParkOnAgent() {
+    // blocking read on the registration socket; EOF = agent gone
+    char buf[4096];
+    for (;;) {
+      ssize_t n = ::recv(agent_.fd(), buf, sizeof(buf), 0);
+      if (n <= 0) return;
+    }
+  }
+
+  void AcceptLoop() {
+    while (running_) {
+      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) {
+        if (!running_) return;
+        continue;
+      }
+      int nodelay = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &nodelay,
+                   sizeof(nodelay));
+      std::thread(&TaskWorker::ConnLoop, this, cfd).detach();
+    }
+  }
+
+  // ---- framing (little-endian u32 length prefix, protocol.py _HDR) ----
+  static bool ReadExact(int fd, char* dst, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::recv(fd, dst + off, n - off, 0);
+      if (r <= 0) return false;
+      off += static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool SendAll(int fd, const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t r = ::send(fd, data.data() + off, data.size() - off, 0);
+      if (r <= 0) return false;
+      off += static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool SendFrame(int fd, const msgpack::Value& msg) {
+    const std::string body = msgpack::Pack(msg);
+    uint32_t len = static_cast<uint32_t>(body.size());
+    char hdr[4];
+    std::memcpy(hdr, &len, 4);  // little-endian hosts only (x86/arm)
+    return SendAll(fd, std::string(hdr, 4) + body);
+  }
+
+  void ConnLoop(int fd) {
+    using msgpack::Value;
+    for (;;) {
+      char hdr[4];
+      if (!ReadExact(fd, hdr, 4)) break;
+      uint32_t len;
+      std::memcpy(&len, hdr, 4);
+      std::string body(len, '\0');
+      if (!ReadExact(fd, &body[0], len)) break;
+      Value msg;
+      try {
+        msg = msgpack::Unpack(body);
+      } catch (const std::exception&) {
+        break;
+      }
+      const Value* mid = msg.Find("i");
+      const Value* method = msg.Find("m");
+      const Value* payload = msg.Find("p");
+      const int64_t req_id = (mid && mid->type == Value::Type::Int)
+                                 ? mid->i : 0;
+      const std::string m =
+          method ? method->s : std::string();
+      Value reply = Value::Map();
+      if (m == "PushTask") {
+        reply = ExecuteOne(payload);
+      } else if (m == "PushTaskBatchStream") {
+        const Value* bid = payload ? payload->Find("b") : nullptr;
+        const Value* specs = payload ? payload->Find("specs") : nullptr;
+        int n = 0;
+        if (specs && specs->type == Value::Type::Array) {
+          for (size_t i = 0; i < specs->arr.size(); ++i) {
+            Value item = ExecuteOne(&specs->arr[i]);
+            // stream the result back like worker_process.py's coalesced
+            // BatchItems pushes (one item per frame is fine here)
+            Value xs = Value::Array();
+            Value pair = Value::Array();
+            pair.arr.push_back(Value::Int(static_cast<int64_t>(i)));
+            pair.arr.push_back(item);
+            xs.arr.push_back(pair);
+            Value pp = Value::Map();
+            pp.Set("b", bid ? *bid : Value::Int(0));
+            pp.Set("xs", xs);
+            Value push = Value::Map();
+            push.Set("m", Value::Str("BatchItems"));
+            push.Set("i", Value::Int(0));
+            push.Set("p", pp);
+            SendFrame(fd, push);
+            ++n;
+          }
+        }
+        reply.Set("n", Value::Int(n));
+      } else {
+        // Ping / profiling probes: answer emptily rather than wedging
+        reply.Set("ok", Value::Boolean(true));
+      }
+      Value out = Value::Map();
+      out.Set("r", Value::Int(req_id));
+      out.Set("p", reply);
+      if (!SendFrame(fd, out)) break;
+    }
+    ::close(fd);
+  }
+
+  msgpack::Value ExecuteOne(const msgpack::Value* spec) {
+    using msgpack::Value;
+    auto t0 = std::chrono::steady_clock::now();
+    Value reply = Value::Map();
+    std::string err;
+    Value result;
+    const Value* name = spec ? spec->Find("function_name") : nullptr;
+    if (!name) {
+      err = "malformed spec: no function_name";
+    } else {
+      auto it = fns_.find(name->s);
+      if (it == fns_.end()) {
+        err = "no such C++ function: " + name->s;
+      } else {
+        std::vector<Value> args;
+        const Value* wire_args = spec->Find("args");
+        if (wire_args && wire_args->type == Value::Type::Array) {
+          for (const Value& entry : wire_args->arr) {
+            if (entry.type == Value::Type::Array && !entry.arr.empty() &&
+                entry.arr[0].s == "x") {
+              args.push_back(msgpack::Unpack(entry.arr[1].s));
+            } else {
+              err = "C++ worker takes cross-language ('x') args only";
+              break;
+            }
+          }
+        }
+        if (err.empty()) {
+          try {
+            result = it->second(args);
+          } catch (const std::exception& e) {
+            err = std::string("C++ task raised: ") + e.what();
+          }
+        }
+      }
+    }
+    const double ms =
+        std::chrono::duration<double, std::milli>(
+            std::chrono::steady_clock::now() - t0).count();
+    reply.Set("exec_ms", Value::Double(ms));
+    if (!err.empty()) {
+      reply.Set("error", Value::Boolean(true));
+      reply.Set("error_message", Value::Str(err));
+      Value rets = Value::Array();
+      Value r0 = Value::Map();
+      r0.Set("xlang_error", Value::Str(err));
+      rets.arr.push_back(r0);
+      reply.Set("returns", rets);
+      return reply;
+    }
+    Value rets = Value::Array();
+    Value r0 = Value::Map();
+    r0.Set("xlang", Value::Bin(msgpack::Pack(result)));
+    rets.arr.push_back(r0);
+    reply.Set("returns", rets);
+    return reply;
+  }
+
+  std::map<std::string, Fn> fns_;
+  RpcClient agent_;
+  std::string worker_id_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{true};
+};
+
+}  // namespace ray_tpu

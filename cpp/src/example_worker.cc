@@ -1,0 +1,54 @@
+// Example C++ task worker: registers native functions and serves leases
+// (reference: cpp/src/ray/runtime/task/task_executor.cc + the
+// RAY_REMOTE-registered function table). Used by tests/test_cpp_client.py.
+// Usage: example_worker <agent_host> <agent_tcp_port>
+
+#include <cstdlib>
+#include <iostream>
+
+#include "ray_tpu/worker.hpp"
+
+using ray_tpu::TaskWorker;
+using ray_tpu::msgpack::Value;
+
+namespace {
+
+int64_t AsInt(const Value& v) {
+  if (v.type == Value::Type::Int) return v.i;
+  if (v.type == Value::Type::Double) return static_cast<int64_t>(v.d);
+  throw std::runtime_error("expected an integer argument");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: example_worker <agent_host> <agent_tcp_port>\n";
+    return 2;
+  }
+  TaskWorker w;
+  w.Register("cpp.add", [](const std::vector<Value>& a) {
+    int64_t s = 0;
+    for (const Value& v : a) s += AsInt(v);
+    return Value::Int(s);
+  });
+  w.Register("cpp.fib", [](const std::vector<Value>& a) {
+    int64_t n = a.empty() ? 0 : AsInt(a[0]);
+    int64_t x = 0, y = 1;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t t = x + y;
+      x = y;
+      y = t;
+    }
+    return Value::Int(x);
+  });
+  w.Register("cpp.echo", [](const std::vector<Value>& a) {
+    return a.empty() ? Value::Nil() : a[0];
+  });
+  w.Register("cpp.fail", [](const std::vector<Value>&) -> Value {
+    throw std::runtime_error("deliberate C++ failure");
+  });
+  std::cout << "cpp-worker starting\n" << std::flush;
+  w.Serve(argv[1], std::atoi(argv[2]));
+  return 0;
+}

@@ -108,6 +108,9 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
         lib.tpu_store_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        # test-only robust-mutex hook (store.cc tpu_store_test_lock_and_leak)
+        lib.tpu_store_test_lock_and_leak.restype = ctypes.c_int
+        lib.tpu_store_test_lock_and_leak.argtypes = [ctypes.c_void_p]
         lib.tpu_store_lru_candidates.restype = ctypes.c_int
         lib.tpu_store_lru_candidates.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int]

@@ -26,13 +26,14 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
 
-constexpr uint64_t kMagic = 0x7261795f74707531ULL;  // "ray_tpu1"
+constexpr uint64_t kMagic = 0x7261795f74707532ULL;  // "ray_tpu2" (v2: Slot.creator_pid)
 constexpr uint32_t kIdSize = 20;                    // ObjectID width (ids.py: task id 16B + return index 4B)
 constexpr uint64_t kAlign = 64;                     // cache-line alignment
 
@@ -53,6 +54,8 @@ struct Slot {
   uint64_t lru;     // last-touch clock tick
   uint32_t state;
   int32_t pincount;
+  int32_t creator_pid;  // writer filling a CREATED slot (robust-recovery
+                        // sweep reclaims slots of dead creators)
 };
 
 // Free-list block header, lives in the heap itself (boundary-tag allocator).
@@ -277,13 +280,56 @@ bool evict_for(Store* s, uint64_t need) {
   }
 }
 
-struct MutexGuard {
-  pthread_mutex_t* m;
-  explicit MutexGuard(pthread_mutex_t* mu) : m(mu) {
-    int rc = pthread_mutex_lock(m);
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(m);  // robust recovery
+// Repair shared state after a writer died holding the lock. Must run with
+// the (now-consistent) mutex held. Two hazards are repairable from the
+// block/slot metadata: (a) CREATED slots whose writer is gone — their heap
+// space would leak forever; (b) a free list left mid-splice — the links
+// are rebuilt from the per-block `free_` boundary tags, which every path
+// updates before touching links. (A death INSIDE the two-word link write
+// itself can still lose a block to the list until the next rebuild —
+// bounded leak, never corruption of sealed payloads.)
+void repair_after_owner_death(Store* s) {
+  // (a) rebuild the free list from boundary tags FIRST: the dead writer
+  // may have left the link words mid-splice, and the sweep below walks
+  // delete_slot -> heap_free -> coalesce -> freelist_remove THROUGH them
+  s->hdr->free_head = kNoBlock;
+  uint64_t off = 0;
+  uint64_t prev_free = kNoBlock;
+  for (;;) {
+    Block* b = block_at(s, off);
+    if (b->free_) {
+      *free_prev(s, off) = prev_free;
+      *free_next(s, off) = kNoBlock;
+      if (prev_free == kNoBlock)
+        s->hdr->free_head = off;
+      else
+        *free_next(s, prev_free) = off;
+      prev_free = off;
+    }
+    if (b->last) break;
+    off += sizeof(Block) + align_up(b->size, kAlign);
   }
-  ~MutexGuard() { pthread_mutex_unlock(m); }
+  // (b) sweep CREATED slots of dead writers (their heap space would
+  // otherwise leak forever); the free list is now consistent
+  for (uint64_t i = 0; i < s->hdr->table_slots; i++) {
+    Slot* slot = &s->table[i];
+    if (slot->state == SLOT_CREATED && slot->creator_pid > 0 &&
+        kill(slot->creator_pid, 0) != 0 && errno == ESRCH) {
+      delete_slot(s, slot);
+    }
+  }
+}
+
+struct MutexGuard {
+  Store* s;
+  explicit MutexGuard(Store* st) : s(st) {
+    int rc = pthread_mutex_lock(&s->hdr->mutex);
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&s->hdr->mutex);  // robust recovery
+      repair_after_owner_death(s);
+    }
+  }
+  ~MutexGuard() { pthread_mutex_unlock(&s->hdr->mutex); }
 };
 
 }  // namespace
@@ -399,7 +445,7 @@ uint8_t* tpu_store_base(void* handle) {
 // the segment base, or 0 on failure (0 is never a valid payload offset).
 uint64_t tpu_store_create_object(void* handle, const uint8_t* id, uint64_t size) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   if (size > s->hdr->capacity) return 0;
   Slot* slot = table_insert(s, id);
   if (!slot) return 0;  // duplicate or table full
@@ -412,6 +458,7 @@ uint64_t tpu_store_create_object(void* handle, const uint8_t* id, uint64_t size)
   slot->lru = ++s->hdr->lru_clock;
   slot->state = SLOT_CREATED;
   slot->pincount = 0;
+  slot->creator_pid = static_cast<int32_t>(getpid());
   s->hdr->used += size;
   s->hdr->num_objects++;
   s->hdr->num_created++;
@@ -420,7 +467,7 @@ uint64_t tpu_store_create_object(void* handle, const uint8_t* id, uint64_t size)
 
 int tpu_store_seal(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   Slot* slot = table_find(s, id);
   if (!slot || slot->state != SLOT_CREATED) return -1;
   std::atomic_thread_fence(std::memory_order_release);
@@ -430,7 +477,7 @@ int tpu_store_seal(void* handle, const uint8_t* id) {
 
 int tpu_store_abort(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   Slot* slot = table_find(s, id);
   if (!slot || slot->state != SLOT_CREATED) return -1;
   delete_slot(s, slot);
@@ -442,7 +489,7 @@ int tpu_store_abort(void* handle, const uint8_t* id) {
 int tpu_store_get(void* handle, const uint8_t* id, uint64_t* offset_out,
                   uint64_t* size_out) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   Slot* slot = table_find(s, id);
   if (!slot || slot->state != SLOT_SEALED) return -1;
   slot->lru = ++s->hdr->lru_clock;
@@ -454,14 +501,14 @@ int tpu_store_get(void* handle, const uint8_t* id, uint64_t* offset_out,
 
 int tpu_store_contains(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   Slot* slot = table_find(s, id);
   return (slot && slot->state == SLOT_SEALED) ? 1 : 0;
 }
 
 int tpu_store_release(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   Slot* slot = table_find(s, id);
   if (!slot) return -1;
   if (slot->pincount > 0) slot->pincount--;
@@ -470,7 +517,7 @@ int tpu_store_release(void* handle, const uint8_t* id) {
 
 int tpu_store_delete(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   Slot* slot = table_find(s, id);
   if (!slot || slot->state == SLOT_TOMBSTONE) return -1;
   if (slot->pincount > 0) return -2;  // pinned: caller defers
@@ -480,7 +527,7 @@ int tpu_store_delete(void* handle, const uint8_t* id) {
 
 void tpu_store_stats(void* handle, uint64_t* out /* [6] */) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   out[0] = s->hdr->used;
   out[1] = s->hdr->capacity;
   out[2] = s->hdr->num_objects;
@@ -493,7 +540,7 @@ void tpu_store_stats(void* handle, uint64_t* out /* [6] */) {
 // Fills up to max ids into out (contiguous 16-byte records); returns count.
 int tpu_store_lru_candidates(void* handle, uint8_t* out, int max) {
   Store* s = static_cast<Store*>(handle);
-  MutexGuard g(&s->hdr->mutex);
+  MutexGuard g(s);
   // selection sort over at most `max` winners (table scan is the cost anyway)
   int n = 0;
   uint64_t last_lru = 0;
@@ -511,6 +558,15 @@ int tpu_store_lru_candidates(void* handle, uint8_t* out, int max) {
     n++;
   }
   return n;
+}
+
+// TEST-ONLY: acquire the segment mutex and return WITHOUT releasing, so a
+// test child can _exit() while holding it — the only way to exercise the
+// EOWNERDEAD robust-recovery path (repair_after_owner_death) for real
+// (reference analog: plasma's unit-test fault hooks).
+int tpu_store_test_lock_and_leak(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  return pthread_mutex_lock(&s->hdr->mutex);
 }
 
 }  // extern "C"

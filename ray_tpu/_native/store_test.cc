@@ -1,0 +1,172 @@
+// Native unit test for the shm store kernel — the ASAN/UBSAN build target
+// (reference test culture: plasma's co-located unit tests,
+// src/ray/object_manager/plasma/). Build + run:
+//
+//   g++ -std=c++17 -g -fsanitize=address,undefined -Iray_tpu/_native \
+//       ray_tpu/_native/store_test.cc -o /tmp/store_test -lpthread
+//   /tmp/store_test /dev/shm/store_test_seg
+//
+// Exercises: lifecycle, eviction, fork-based multi-writer stress, and the
+// EOWNERDEAD robust-mutex recovery (a forked child dies holding the lock;
+// the parent's next op must recover and repair).
+
+#include <sys/wait.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+
+#include "store.cc"  // single-TU: the kernel is header-free by design
+
+
+namespace {
+
+void make_id(uint8_t* id, uint32_t n) {
+  for (int i = 0; i < 20; i++) id[i] = static_cast<uint8_t>(n >> (i % 4));
+  id[0] = static_cast<uint8_t>(n);
+  id[1] = static_cast<uint8_t>(n >> 8);
+  id[2] = static_cast<uint8_t>(n >> 16);
+  id[3] = static_cast<uint8_t>(n >> 24);
+}
+
+int failures = 0;
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                              \
+      failures++;                                                       \
+    }                                                                   \
+  } while (0)
+
+void test_lifecycle(const char* path) {
+  void* h = tpu_store_create(path, 1 << 20);
+  CHECK(h != nullptr);
+  uint8_t id[20];
+  make_id(id, 1);
+  uint64_t off = tpu_store_create_object(h, id, 1000);
+  CHECK(off != 0);
+  uint8_t* base = tpu_store_base(h);
+  for (int i = 0; i < 1000; i++) base[off + i] = static_cast<uint8_t>(i);
+  CHECK(tpu_store_seal(h, id) == 0);
+  uint64_t goff = 0, size = 0;
+  CHECK(tpu_store_get(h, id, &goff, &size) == 0 && goff == off &&
+        size == 1000);
+  CHECK(tpu_store_release(h, id) == 0);
+  CHECK(tpu_store_contains(h, id) == 1);
+  CHECK(tpu_store_delete(h, id) == 0);
+  CHECK(tpu_store_contains(h, id) == 0);
+  tpu_store_detach(h);
+}
+
+void test_eviction_fill(const char* path) {
+  void* h = tpu_store_create(path, 1 << 20);
+  // overfill 4x: LRU eviction must keep making room
+  for (uint32_t n = 0; n < 64; n++) {
+    uint8_t id[20];
+    make_id(id, 1000 + n);
+    uint64_t off = tpu_store_create_object(h, id, 60 * 1024);
+    CHECK(off != 0);
+    CHECK(tpu_store_seal(h, id) == 0);
+  }
+  tpu_store_detach(h);
+}
+
+void test_multiprocess_stress(const char* path) {
+  void* h = tpu_store_create(path, 4 << 20);
+  tpu_store_detach(h);
+  const int kProcs = 4, kOps = 4000;
+  for (int p = 0; p < kProcs; p++) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      void* ch = tpu_store_attach(path);
+      if (!ch) _exit(2);
+      unsigned seed = 1234u + p;
+      for (int op = 0; op < kOps; op++) {
+        uint8_t id[20];
+        make_id(id, (rand_r(&seed) % 512) | (p << 20));
+        int what = rand_r(&seed) % 3;
+        if (what == 0) {
+          uint64_t off =
+              tpu_store_create_object(ch, id, 1 + rand_r(&seed) % 8192);
+          if (off) tpu_store_seal(ch, id);
+        } else if (what == 1) {
+          uint64_t goff, size;
+          if (tpu_store_get(ch, id, &goff, &size) == 0)
+            tpu_store_release(ch, id);
+        } else {
+          tpu_store_delete(ch, id);
+        }
+      }
+      tpu_store_detach(ch);
+      _exit(0);
+    }
+  }
+  for (int p = 0; p < kProcs; p++) {
+    int st = 0;
+    ::wait(&st);
+    CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  }
+  // the arena must still be fully usable
+  void* h2 = tpu_store_attach(path);
+  uint8_t id[20];
+  make_id(id, 999999);
+  uint64_t off = tpu_store_create_object(h2, id, 4096);
+  CHECK(off != 0);
+  CHECK(tpu_store_seal(h2, id) == 0);
+  tpu_store_detach(h2);
+}
+
+void test_eownerdead_recovery(const char* path) {
+  void* h = tpu_store_create(path, 1 << 20);
+  pid_t pid = fork();
+  if (pid == 0) {
+    void* ch = tpu_store_attach(path);
+    if (!ch) _exit(2);
+    uint8_t id[20];
+    make_id(id, 777);
+    // die with a half-written (CREATED) object AND the mutex held
+    tpu_store_create_object(ch, id, 2048);
+    tpu_store_test_lock_and_leak(ch);
+    _exit(0);  // mutex owner dies => EOWNERDEAD for the next locker
+  }
+  int st = 0;
+  ::waitpid(pid, &st, 0);
+  CHECK(WIFEXITED(st));
+  // next op sees EOWNERDEAD, repairs, and proceeds
+  uint8_t id2[20];
+  make_id(id2, 778);
+  uint64_t off = tpu_store_create_object(h, id2, 1024);
+  CHECK(off != 0);
+  CHECK(tpu_store_seal(h, id2) == 0);
+  // the dead writer's CREATED slot was swept by the repair
+  uint8_t id[20];
+  make_id(id, 777);
+  CHECK(tpu_store_contains(h, id) == 0);
+  tpu_store_detach(h);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* base = argc > 1 ? argv[1] : "/dev/shm/ray_tpu_store_test";
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s.l", base);
+  ::unlink(path);
+  test_lifecycle(path);
+  std::snprintf(path, sizeof(path), "%s.e", base);
+  ::unlink(path);
+  test_eviction_fill(path);
+  std::snprintf(path, sizeof(path), "%s.s", base);
+  ::unlink(path);
+  test_multiprocess_stress(path);
+  std::snprintf(path, sizeof(path), "%s.d", base);
+  ::unlink(path);
+  test_eownerdead_recovery(path);
+  if (failures) {
+    std::fprintf(stderr, "%d failures\n", failures);
+    return 1;
+  }
+  std::printf("store_test OK\n");
+  return 0;
+}

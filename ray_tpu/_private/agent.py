@@ -32,6 +32,23 @@ from ray_tpu._private.resources import (
     NodeResources, ResourceSet, label_constraints_match)
 
 
+def _env_key_language(env_key):
+    """Top-level "language" of a canonical runtime_env key, or None — a
+    nested env_vars value spelled 'language' must not be mistaken for a
+    cross-language lease (env keys are json with sorted keys,
+    task_spec.runtime_env_key)."""
+    if not env_key:
+        return None
+    try:
+        import json as _json
+
+        env = _json.loads(env_key)
+    except Exception:
+        return None
+    lang = env.get("language") if isinstance(env, dict) else None
+    return lang if isinstance(lang, str) else None
+
+
 class _NeverLaunched:
     """Sentinel proc for spawns that failed before producing a process."""
 
@@ -684,12 +701,17 @@ class NodeAgent:
             worker_id = p["worker_id"]
             handle = self.workers.get(worker_id)
             if handle is None:
-                # Worker we didn't spawn (e.g. driver-embedded); track anyway.
+                # Worker we didn't spawn (e.g. driver-embedded, or an
+                # externally-started C++ worker); track anyway.
                 handle = WorkerHandle(worker_id, proc=_ForeignProc(p.get("pid", 0)))
                 self.workers[worker_id] = handle
             else:
                 self._starting_workers = max(0, self._starting_workers - 1)
                 self._spawn_slot_freed(handle)
+            if p.get("env_key"):
+                # self-tagged env affinity (C++ workers tag themselves
+                # language:cpp so only matching leases land on them)
+                handle.env_key = p["env_key"]
             handle.conn = conn
             handle.direct_addr = p["direct_addr"]
             handle.registered.set()
@@ -927,8 +949,16 @@ class NodeAgent:
         # host worker can never serve them: match only workers already
         # tagged with this env_key
         spawn_env = bool(container or conda)
-        worker = self._pop_idle_worker(env_key, tagged_only=spawn_env)
+        # language-tagged leases ({"language": "cpp"}) can only run on a
+        # worker of that language; those register EXTERNALLY (reference:
+        # C++ worker processes joining the cluster) — never spawn a
+        # Python worker for them, just wait for one to appear
+        lang_env = _env_key_language(env_key) is not None
+        worker = self._pop_idle_worker(
+            env_key, tagged_only=spawn_env or lang_env)
         if worker is None:
+            if lang_env:
+                return False
             if len(self.workers) + self._starting_workers < self.max_workers + 8 \
                     or self._evict_mismatched_idle():
                 if conda and not container:
@@ -995,7 +1025,10 @@ class NodeAgent:
         """Kill one idle worker with a foreign runtime_env to make room for
         a fresh process (its env cannot be un-applied)."""
         for i, w in enumerate(self.idle_workers):
-            if w.env_key is not None:
+            # externally-managed language workers (C++) are not ours to
+            # recycle for Python leases
+            if w.env_key is not None and \
+                    _env_key_language(w.env_key) is None:
                 self.idle_workers.pop(i)
                 w.terminate()
                 self.workers.pop(w.worker_id, None)

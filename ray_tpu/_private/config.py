@@ -38,7 +38,12 @@ _flag("scheduler_top_k_fraction", 0.2)
 _flag("max_pending_lease_requests_per_scheduling_category", 10)
 _flag("worker_lease_timeout_ms", 30_000)
 _flag("lease_pipeline_depth", 2)  # tasks in flight per leased worker
-_flag("lease_pipeline_depth_short_task", 48)  # when exec EMA < 2ms
+_flag("lease_pipeline_depth_short_task", 48)  # when exec EMA < short ms
+_flag("pipeline_short_task_ms", 2.0)   # exec EMA below => deep pipeline
+_flag("pipeline_medium_task_ms", 10.0)  # exec EMA below => medium pipeline
+_flag("actor_batch_short_ms", 5.0)   # exec EMA below => BATCH_MAX frames
+_flag("actor_batch_medium_ms", 20.0)  # exec EMA below => 16-call frames
+_flag("straggler_limit_multiplier", 4.0)  # head-of-line age vs EMA
 _flag("lease_pipeline_depth_medium_task", 4)  # when exec EMA < 10ms
 _flag("lease_idle_ttl_ms", 250)  # idle leased workers return after this
 _flag("lease_max_workers_per_pool", 256)

@@ -1035,6 +1035,56 @@ class Worker:
         self._post(self._submit_to_pool_sync, record)
         return refs
 
+    def submit_xlang_task(
+        self,
+        function_name: str,
+        args: tuple,
+        *,
+        language: str = "cpp",
+        resources: Optional[Dict[str, float]] = None,
+        num_returns: int = 1,
+    ) -> List[ObjectRef]:
+        """Submit a task to a worker of another LANGUAGE (reference:
+        python/ray/cross_language.py cpp_function/java_function). Args are
+        plain msgpack ("x" entries); the lease carries
+        runtime_env={"language": ...} so the agent routes it to a
+        matching self-registered worker (agent._try_grant lang_env)."""
+        import msgpack as _mp
+
+        from ray_tpu._private.function_table import XLANG_PYREF_FID
+        from ray_tpu._private.resources import ResourceSet
+
+        if num_returns != 1:
+            raise ValueError(
+                "cross-language tasks support num_returns=1 only (the "
+                "foreign worker packages a single msgpack payload)")
+        task_id = TaskID.from_random()
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            task_type=NORMAL_TASK,
+            function_id=XLANG_PYREF_FID,
+            function_name=function_name,
+            args=[("x", _mp.packb(a, use_bin_type=True)) for a in args],
+            kwargs={},
+            num_returns=num_returns,
+            resources=ResourceSet(dict(resources or {"CPU": 1.0})).to_wire(),
+            owner_addr=self.direct_addr(),
+            max_retries=0,
+            runtime_env={"language": language},
+        )
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        refs = []
+        for oid in return_ids:
+            self.reference_counter.register_owned(oid)
+            refs.append(ObjectRef(oid, self.direct_addr()))
+        record = TaskRecord(spec, return_ids)
+        self._tasks[task_id.binary()] = record
+        self._record_task_event(spec, "PENDING")
+        self._post(self._submit_to_pool_sync, record)
+        return refs
+
     def _build_args(self, args: tuple) -> List:
         """Top-level refs pass by reference (inlining small resolved values);
         plain values serialize, collecting nested refs for pinning."""
@@ -1135,6 +1185,24 @@ class Worker:
             self._tasks.pop(record.spec.task_id, None)
 
     def _resolve_return(self, oid: ObjectID, ret: Dict) -> None:
+        if ret.get("xlang") is not None:
+            # cross-language return (a C++ worker's msgpack payload):
+            # re-encode with the local context so ray_tpu.get is uniform
+            # (reference: cross_language.py msgpack deserialization)
+            import msgpack as _mp
+
+            value = _mp.unpackb(ret["xlang"], raw=False)
+            data = self._serialize_value(value).to_bytes()
+            self.memory_store.put(oid.binary(), data, VAL)
+            self.reference_counter.set_resolved(oid.binary(), "inline")
+            return
+        if ret.get("xlang_error") is not None:
+            err = RayTaskError("cross-language task",
+                               str(ret["xlang_error"]))
+            data = self._serialize_value(err).to_bytes()
+            self.memory_store.put(oid.binary(), data, EXC)
+            self.reference_counter.set_resolved(oid.binary(), "error")
+            return
         if ret.get("inline") is not None:
             flags = EXC if ret.get("is_exception") else VAL
             self.memory_store.put(oid.binary(), ret["inline"], flags)
@@ -1553,10 +1621,10 @@ class _LeasePool:
             # can strand it behind an arbitrarily long first task — observe
             # one completion before pipelining
             return 1
-        if e < 2.0:
+        if e < CONFIG.pipeline_short_task_ms:
             return max(self.PIPELINE_DEPTH,
                        CONFIG.lease_pipeline_depth_short_task)
-        if e < 10.0:
+        if e < CONFIG.pipeline_medium_task_ms:
             return max(self.PIPELINE_DEPTH,
                        CONFIG.lease_pipeline_depth_medium_task)
         return self.PIPELINE_DEPTH
@@ -1567,7 +1635,8 @@ class _LeasePool:
         duration (a surprise straggler — e.g. an abandoned get-timeout task),
         stop stacking work behind it and let _pump lease fresh workers."""
         if conn.dispatch_times:
-            limit = max(0.05, ((self._exec_ms_ema or 0.0) * 4.0) / 1000.0)
+            limit = max(0.05, ((self._exec_ms_ema or 0.0)
+                              * CONFIG.straggler_limit_multiplier) / 1000.0)
             if now - conn.dispatch_times[0] > limit:
                 return 0 if conn.inflight else 1
         return depth
@@ -2053,9 +2122,9 @@ class _ActorState:
         ema = self._exec_ms_ema
         if ema is None:
             return 8          # unknown: modest batch until measured
-        if ema < 5.0:
+        if ema < CONFIG.actor_batch_short_ms:
             return self.BATCH_MAX
-        if ema < 20.0:
+        if ema < CONFIG.actor_batch_medium_ms:
             return 16
         return 1
 

@@ -425,24 +425,60 @@ class SQLDatasource(Datasource):
         return tasks
 
 
+def _docs_to_block(docs: List[Dict]) -> Dict[str, List]:
+    """Column-union a list of documents (a field first appearing
+    mid-collection must not silently vanish)."""
+    for d in docs:
+        d.pop("_id", None)
+    if not docs:
+        return {"_empty": []}
+    keys: List[str] = []
+    for d in docs:
+        for k in d:
+            if k not in keys:
+                keys.append(k)
+    return {k: [d.get(k) for d in docs] for k in keys}
+
+
+def _mongo_range_filters(split_points: List, lo, hi) -> List[Dict]:
+    """[lo, p1), [p1, p2), ..., [pN, hi] range filters over _id
+    (reference: mongo_datasource.py splits the collection by _id
+    boundaries so each read task scans a disjoint slice)."""
+    bounds = [lo] + list(split_points) + [hi]
+    filters = []
+    for i in range(len(bounds) - 1):
+        f: Dict = {"_id": {"$gte": bounds[i]}}
+        if i < len(bounds) - 2:
+            f["_id"]["$lt"] = bounds[i + 1]
+        else:
+            f["_id"]["$lte"] = bounds[i + 1]
+        filters.append(f)
+    return filters
+
+
 class MongoDatasource(Datasource):
-    """MongoDB reads (reference: python/ray/data/datasource/
-    mongo_datasource.py — pymongoarrow-backed collection scans split by
-    _id ranges). Gated: ``pymongo`` is not in this deployment's package
-    set; construction succeeds (so pipelines can be composed/validated)
-    and the read tasks raise a clear ImportError at execution if the
-    client is still missing on the worker."""
+    """MongoDB reads partitioned by _id ranges (reference:
+    python/ray/data/datasource/mongo_datasource.py — each read task
+    scans a disjoint _id slice so ``parallelism`` is honored).
+    ``pymongo`` is not in this deployment's package set: construction
+    composes offline and read tasks raise a clear ImportError at
+    execution; ``_collection_factory`` injects a client for tests (the
+    partitioned path executes against a fake collection)."""
 
     name = "Mongo"
 
     def __init__(self, uri: str, database: str, collection: str,
-                 pipeline: Optional[List[Dict]] = None):
+                 pipeline: Optional[List[Dict]] = None,
+                 _collection_factory=None):
         self.uri = uri
         self.database = database
         self.collection = collection
         self.pipeline = pipeline or []
+        self._collection_factory = _collection_factory
 
     def _collection(self):
+        if self._collection_factory is not None:
+            return self._collection_factory()
         try:
             import pymongo
         except ImportError as e:
@@ -452,53 +488,91 @@ class MongoDatasource(Datasource):
         client = pymongo.MongoClient(self.uri)
         return client[self.database][self.collection]
 
+    def _split_bounds(self, parallelism: int):
+        """(lo, hi, interior split points) via $bucketAuto server-side
+        sampling; (None, None, []) => unsplittable (empty/tiny/gated)."""
+        try:
+            coll = self._collection()
+            buckets = list(coll.aggregate([
+                {"$bucketAuto": {"groupBy": "$_id",
+                                 "buckets": max(1, parallelism)}}]))
+        except ImportError:
+            raise
+        except Exception:
+            return None, None, []
+        if not buckets:
+            return None, None, []
+        lo = buckets[0]["_id"]["min"]
+        hi = buckets[-1]["_id"]["max"]
+        points = [b["_id"]["min"] for b in buckets[1:]]
+        return lo, hi, points
+
     def get_read_tasks(self, parallelism: int):
-        uri, db, coll = self.uri, self.database, self.collection
-        pipeline = self.pipeline
         src = self
+        pipeline = self.pipeline
 
-        def read_all():
-            collection = src._collection()
-            docs = list(collection.aggregate(pipeline) if pipeline
-                        else collection.find())
-            for d in docs:
-                d.pop("_id", None)
-            if not docs:
-                return {"_empty": []}
-            # schema union across ALL documents: a field first appearing
-            # mid-collection must not silently vanish
-            keys: List[str] = []
-            for d in docs:
-                for k in d:
-                    if k not in keys:
-                        keys.append(k)
-            return {k: [d.get(k) for d in docs] for k in keys}
+        def read_range(flt: Optional[Dict]):
+            coll = src._collection()
+            if pipeline:
+                docs = list(coll.aggregate(pipeline))
+            else:
+                docs = list(coll.find(flt or {}))
+            return _docs_to_block(docs)
 
-        # real partitioning needs server-side _id split points; one task
-        # keeps semantics correct for the gated path
-        return [read_all]
+        if parallelism <= 1 or pipeline:
+            # a user aggregation pipeline ($group/$sort/$limit) computes a
+            # GLOBAL answer: sharding it by _id slices would return per-
+            # partition partials — run it as one whole-collection task
+            return [lambda: read_range(None)]
+        try:
+            lo, hi, points = self._split_bounds(parallelism)
+        except ImportError:
+            # gated: keep the task-shape contract (N tasks) so pipelines
+            # compose; each raises the clear error at execution
+            return [lambda: read_range(None)
+                    for _ in range(parallelism)][:1]
+        if lo is None or not points:
+            return [lambda: read_range(None)]
+        filters = _mongo_range_filters(points, lo, hi)
+        return [lambda f=f: read_range(f) for f in filters]
 
 
 class BigQueryDatasource(Datasource):
-    """BigQuery reads (reference: python/ray/data/datasource/
-    bigquery_datasource.py — BQ Storage read sessions with stream
-    splits). Gated like Mongo: composes offline, raises a clear
-    ImportError at read time without ``google-cloud-bigquery``."""
+    """BigQuery reads partitioned by Storage-API read streams
+    (reference: python/ray/data/datasource/bigquery_datasource.py —
+    create_read_session(max_stream_count=parallelism), one task per
+    stream). Gated like Mongo: composes offline, raises a clear
+    ImportError at read time; ``_client_factory`` injects a fake
+    storage client so the stream-split path executes in tests."""
 
     name = "BigQuery"
 
     def __init__(self, project_id: str, query: Optional[str] = None,
-                 dataset: Optional[str] = None):
+                 dataset: Optional[str] = None, _client_factory=None):
         if not (query or dataset):
             raise ValueError("BigQueryDatasource needs query= or dataset=")
         self.project_id = project_id
         self.query = query
         self.dataset = dataset
+        self._client_factory = _client_factory
+
+    def _storage_client(self):
+        if self._client_factory is not None:
+            return self._client_factory()
+        try:
+            from google.cloud import bigquery_storage
+        except ImportError as e:
+            raise ImportError(
+                "read_bigquery requires `google-cloud-bigquery[-storage]`"
+                ", which is not installed in this environment") from e
+        return bigquery_storage.BigQueryReadClient()
 
     def get_read_tasks(self, parallelism: int):
         src = self
 
-        def read_all():
+        def read_query():
+            # query path: BQ materializes the result; stream-splitting
+            # applies to table reads below
             try:
                 from google.cloud import bigquery
             except ImportError as e:
@@ -506,7 +580,42 @@ class BigQueryDatasource(Datasource):
                     "read_bigquery requires `google-cloud-bigquery`, "
                     "which is not installed in this environment") from e
             client = bigquery.Client(project=src.project_id)
-            query = src.query or f"SELECT * FROM `{src.dataset}`"
-            return client.query(query).to_arrow()
+            return client.query(src.query).to_arrow()
 
-        return [read_all]
+        if self.query:
+            return [read_query]
+
+        def read_stream(stream_name: str):
+            # client built INSIDE the task: read tasks ship to workers by
+            # pickle and a live gRPC client cannot ride the closure
+            client = src._storage_client()
+            rows = client.read_rows(stream_name)
+            if hasattr(rows, "pages"):
+                import pyarrow as pa  # bigquery ships arrow batches
+
+                return pa.Table.from_batches(
+                    [page.to_arrow() for page in rows.pages])
+            return rows.to_arrow()
+
+        # table path: one read task per storage stream
+        parts = self.dataset.split(".")
+        if len(parts) != 2:
+            raise ValueError(
+                f"dataset must be '<dataset>.<table>', got {self.dataset!r}")
+        table = (f"projects/{self.project_id}/datasets/{parts[0]}"
+                 f"/tables/{parts[1]}")
+        try:
+            client = self._storage_client()
+            session = client.create_read_session(
+                parent=f"projects/{self.project_id}",
+                read_session={"table": table, "data_format": "ARROW"},
+                max_stream_count=max(1, parallelism))
+            streams = [s.name for s in session.streams]
+        except ImportError:
+            def gated():
+                src._storage_client()  # raises the clear ImportError
+
+            return [gated]
+        if not streams:
+            return [lambda: {"_empty": []}]
+        return [lambda s=s: read_stream(s) for s in streams]

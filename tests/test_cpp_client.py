@@ -112,3 +112,72 @@ class TestCppDriver:
             assert internal_kv._internal_kv_get(b"cpp_key") == b"from-cpp"
         finally:
             ray_tpu.shutdown()
+
+
+def _agent_tcp_port():
+    w = ray_tpu._private.worker.global_worker
+    view = w._acall(w.head.call("GetClusterView", {}))
+    return list(view.values())[0]["addr"]["port"]
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no g++ on this box")
+class TestCppWorker:
+    """C++ task EXECUTION (VERDICT r3 next #7; reference:
+    cpp/src/ray/runtime/task/task_executor.cc): an external C++ worker
+    registers native functions, the agent routes language:cpp leases to
+    it, and Python drivers call the functions by name."""
+
+    @pytest.fixture()
+    def cpp_worker(self):
+        import subprocess as sp
+        import time
+
+        sp.run(["make", "-s"], cwd=CPP_DIR, check=True, timeout=300)
+        binary = os.path.join(CPP_DIR, "build", "example_worker")
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(num_cpus=2)
+        proc = sp.Popen([binary, "127.0.0.1", str(_agent_tcp_port())],
+                        stdout=sp.PIPE, stderr=sp.STDOUT, text=True)
+        time.sleep(1.0)
+        yield proc
+        proc.terminate()
+        ray_tpu.shutdown()
+
+    def test_python_calls_cpp_function(self, cpp_worker):
+        from ray_tpu.cross_language import cpp_function
+
+        assert ray_tpu.get(cpp_function("cpp.add").remote(2, 3, 5),
+                           timeout=60) == 10
+        assert ray_tpu.get(cpp_function("cpp.fib").remote(20),
+                           timeout=60) == 6765
+        # structured values survive the msgpack round trip
+        assert ray_tpu.get(
+            cpp_function("cpp.echo").remote({"k": [1, 2, "three"]}),
+            timeout=60) == {"k": [1, 2, "three"]}
+
+    def test_cpp_error_propagates(self, cpp_worker):
+        from ray_tpu.cross_language import cpp_function
+
+        with pytest.raises(Exception, match="deliberate C\\+\\+ failure"):
+            ray_tpu.get(cpp_function("cpp.fail").remote(), timeout=60)
+        with pytest.raises(Exception, match="no such C"):
+            ray_tpu.get(cpp_function("cpp.nope").remote(), timeout=60)
+
+    def test_burst_rides_stream_batches(self, cpp_worker):
+        from ray_tpu.cross_language import cpp_function
+
+        refs = [cpp_function("cpp.add").remote(i, i) for i in range(60)]
+        assert sum(ray_tpu.get(refs, timeout=120)) == sum(
+            2 * i for i in range(60))
+
+    def test_roundtrip_python_task_calls_cpp(self, cpp_worker):
+        """Py driver -> Py worker task -> C++ worker -> back: the full
+        cross-language chain in one object graph."""
+
+        @ray_tpu.remote
+        def via_python(x):
+            from ray_tpu.cross_language import cpp_function
+
+            return ray_tpu.get(cpp_function("cpp.fib").remote(x)) + 1
+
+        assert ray_tpu.get(via_python.remote(10), timeout=120) == 56

@@ -4,6 +4,7 @@ src/ray/object_manager/plasma/ and python/ray/tests/test_object_store*.py).
 """
 
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -245,3 +246,95 @@ def test_ids_differing_only_in_last_4_bytes_do_not_collide(tmp_path):
         r = s.get(oid)
         assert bytes(r[:4]) == bytes([i]) * 4
         s.release(oid)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+class TestHardening:
+    """VERDICT r3 next #8: EOWNERDEAD robust-mutex recovery, multi-writer
+    stress, and the ASAN build target — run as a native unit binary (the
+    reference's plasma test culture, src/ray/object_manager/plasma/)."""
+
+    def test_asan_unit_binary(self, tmp_path):
+        import ray_tpu._native as native
+
+        src = os.path.join(os.path.dirname(native.__file__),
+                           "store_test.cc")
+        binary = str(tmp_path / "store_test")
+        subprocess.run(
+            ["g++", "-std=c++17", "-g", "-fsanitize=address,undefined",
+             "-o", binary, src, "-lpthread"],
+            check=True, capture_output=True, timeout=300)
+        out = subprocess.run(
+            [binary, str(tmp_path / "seg")], capture_output=True,
+            text=True, timeout=300)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "store_test OK" in out.stdout
+
+    def test_eownerdead_recovery_from_python(self, tmp_path):
+        """A ctypes client killed while HOLDING the segment mutex (with a
+        half-written object) must not wedge other clients: the next op
+        recovers the robust mutex and sweeps the orphaned slot."""
+        import ray_tpu._native as native
+
+        if native.get_native_lib() is None:
+            pytest.skip("native lib unavailable")
+        seg = str(tmp_path / "seg")
+        store = NativeStore(seg, capacity=1 << 20, create=True)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "from ray_tpu._native import NativeStore, get_native_lib\n"
+            f"h = NativeStore({seg!r})\n"
+            "h.create(b'7' * 20, 2048)\n"  # CREATED, never sealed
+            "get_native_lib().tpu_store_test_lock_and_leak(h._h)\n"
+            "import os; os._exit(0)\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        buf = store.create(b"8" * 20, 1024)
+        assert buf is not None
+        assert store.seal(b"8" * 20)
+        assert not store.contains(b"7" * 20)
+
+    def test_multiprocess_writer_stress_python(self, tmp_path):
+        """4 concurrent ctypes writers hammering one segment; the arena
+        stays consistent and usable."""
+        import ray_tpu._native as native
+
+        if native.get_native_lib() is None:
+            pytest.skip("native lib unavailable")
+        seg = str(tmp_path / "seg")
+        store = NativeStore(seg, capacity=4 << 20, create=True)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "import os, random, sys\n"
+            "from ray_tpu._native import NativeStore\n"
+            f"h = NativeStore({seg!r})\n"
+            "rng = random.Random(int(sys.argv[1]))\n"
+            "for _ in range(2000):\n"
+            "    oid = bytes([rng.randrange(64)]) * 20\n"
+            "    op = rng.randrange(3)\n"
+            "    if op == 0:\n"
+            "        if h.create(oid, 1 + rng.randrange(8192)) is not None:\n"
+            "            h.seal(oid)\n"
+            "    elif op == 1:\n"
+            "        if h.get(oid) is not None:\n"
+            "            h.release(oid)\n"
+            "    else:\n"
+            "        h.delete(oid)\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [subprocess.Popen([sys.executable, "-c", code, str(i)],
+                                  env=env, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+                 for i in range(4)]
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, out
+        buf = store.create(b"z" * 20, 4096)
+        assert buf is not None and store.seal(b"z" * 20)
+        stats = store.stats()
+        assert stats["num_objects"] >= 1
