@@ -105,6 +105,29 @@ def main(filter_substr: str = "") -> Dict[str, float]:
     bench("single client get large",
           lambda: ray_tpu.get(ref))
 
+    # multi client tasks async: m actor-clients each submit a batch of
+    # noop TASKS from inside their own process (reference:
+    # ray_perf.py:181-189 small_value_batch x4)
+    N_MULTI, M_MULTI = 2500, 4
+
+    @ray_tpu.remote
+    class TaskClient:
+        def submit_batch(self, n):
+            ray_tpu.get([noop.remote() for _ in range(n)])
+
+    # near-zero CPU: the clients must leave the pool's cores to the
+    # tasks they submit (reference actors hold 0 CPU while alive)
+    clients = [TaskClient.options(num_cpus=0.001).remote()
+               for _ in range(M_MULTI)]
+    for c in clients:
+        ray_tpu.get(c.submit_batch.remote(2), timeout=120)
+    bench("multi client tasks async",
+          lambda: ray_tpu.get([c.submit_batch.remote(N_MULTI)
+                               for c in clients], timeout=600),
+          multiplier=N_MULTI * M_MULTI)
+    for c in clients:
+        ray_tpu.kill(c)
+
     # ---------------------------------------------------------------- actors
     @ray_tpu.remote
     class Actor:

@@ -166,8 +166,19 @@ class StandardAutoscaler:
             report_event("INFO", "AUTOSCALER_LAUNCH",
                          f"launching {cnt} x {name}",
                          node_type=name, count=cnt)
-            self.provider.create_node(name, cnt)
-            self.num_launches += cnt
+            try:
+                launched = self.provider.create_node(name, cnt)
+            except Exception as e:
+                # a provider failure (quota, stockout, bad config) must
+                # not kill the whole reconcile cycle — the provider has
+                # already recorded its own backoff/rollback
+                logger.warning("autoscaler: create_node(%s) failed: %s",
+                               name, e)
+                report_event("WARNING", "AUTOSCALER_LAUNCH_FAILED",
+                             f"create_node {name}: {e}", node_type=name)
+                continue
+            self.num_launches += len(launched) \
+                if isinstance(launched, list) else cnt
 
         # scale down: runtime-registered nodes idle past the timeout
         now = time.monotonic()

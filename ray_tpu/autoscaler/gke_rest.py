@@ -80,6 +80,21 @@ def default_request_fn(token_provider: Callable[[], str]):
 
 
 class GkeApiError(RuntimeError):
+    # quota/stockout markers GKE/Compute surface in error bodies
+    _RETRYABLE_MARKERS = ("QUOTA", "RESOURCE_EXHAUSTED", "STOCKOUT",
+                          "RESOURCE_AVAILABILITY", "rateLimitExceeded",
+                          "GCE_STOCKOUT", "ZONE_RESOURCE_POOL_EXHAUSTED")
+
+    @property
+    def retryable(self) -> bool:
+        """True for capacity/rate failures that a LATER retry can fix
+        (429, 5xx, quota/stockout bodies); False for permanent request
+        errors (400 bad topology, 403 missing permission) where hot
+        retries would just spam the API."""
+        if self.status == 429 or self.status >= 500:
+            return True
+        return any(m in self.message for m in self._RETRYABLE_MARKERS)
+
     def __init__(self, status: int, message: str):
         super().__init__(f"GKE API {status}: {message}")
         self.status = status

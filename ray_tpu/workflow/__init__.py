@@ -412,3 +412,94 @@ def list_all() -> List[Dict]:
 
 def delete(workflow_id: str) -> None:
     _Store(_storage_root).delete(workflow_id)
+
+
+# ---------------------------------------------------------- virtual actors
+class VirtualActorHandle:
+    """Durable actor: state lives in workflow storage, every method call
+    runs as a checkpointed step (reference: workflow's virtual-actor
+    durable state — methods load state, execute in a task, commit the new
+    state before returning)."""
+
+    def __init__(self, cls, actor_id: str, init_args, init_kwargs,
+                 storage_root: str):
+        self._cls = cls
+        self._actor_id = actor_id
+        self._init = (init_args, init_kwargs)
+        self._root = storage_root
+
+    def _state_rel(self) -> str:
+        return f"_va/{self._actor_id}/state.pkl"
+
+    def _load_state(self):
+        from ray_tpu._private import serialization as ser
+
+        store = _Store(self._root)
+        data = store.read_bytes(self._state_rel())
+        if data is not None:
+            return ser.loads(data)
+        inst = self._cls(*self._init[0], **self._init[1])
+        return inst.__dict__
+
+    def _commit_state(self, state: dict) -> None:
+        from ray_tpu._private import serialization as ser
+
+        _Store(self._root).write_bytes(self._state_rel(), ser.dumps(state))
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        method = getattr(self._cls, name)
+
+        class _Method:
+            def run(me, *args, **kwargs):
+                import ray_tpu
+
+                cls, init, root = self._cls, self._init, self._root
+                rel = self._state_rel()
+
+                @ray_tpu.remote
+                def __virtual_actor_step__(state_dict):
+                    inst = cls.__new__(cls)
+                    inst.__dict__.update(state_dict)
+                    result = method(inst, *args, **kwargs)
+                    return result, inst.__dict__
+
+                state = self._load_state()
+                result, new_state = ray_tpu.get(
+                    __virtual_actor_step__.remote(state))
+                # commit AFTER execution: a crash mid-step replays the
+                # method against the old state (at-least-once, like
+                # workflow steps before their checkpoint lands)
+                self._commit_state(new_state)
+                return result
+
+            def run_async(me, *args, **kwargs):
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = ThreadPoolExecutor(max_workers=1)
+                fut = pool.submit(me.run, *args, **kwargs)
+                fut.add_done_callback(lambda _: pool.shutdown(wait=False))
+                return fut
+
+        return _Method()
+
+    def state(self) -> dict:
+        """Current committed state (for inspection/tests)."""
+        return dict(self._load_state())
+
+
+class VirtualActorClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def get_or_create(self, actor_id: str, *args, **kwargs
+                      ) -> VirtualActorHandle:
+        init()
+        return VirtualActorHandle(self._cls, actor_id, args, kwargs,
+                                  _storage_root)
+
+
+def virtual_actor(cls) -> VirtualActorClass:
+    """Durable-actor decorator (reference: workflow virtual actors)."""
+    return VirtualActorClass(cls)

@@ -165,3 +165,36 @@ def test_workflow_remote_storage(cluster):
     finally:
         get_storage_backend(bucket).delete(bucket)
         workflow.init(os.path.expanduser("~/ray_tpu_workflows"))
+
+
+def test_virtual_actor_durable_state(cluster, tmp_path):
+    """Durable actor: state survives a fresh handle (new 'process'), every
+    method call is a real task, and commits are atomic."""
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path))
+
+    @workflow.virtual_actor
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+        def get(self):
+            return self.n
+
+    c = Counter.get_or_create("c1", 10)
+    assert c.incr.run() == 11
+    assert c.incr.run(5) == 16
+    # a FRESH handle (e.g. a new driver after a crash) sees committed state
+    c2 = Counter.get_or_create("c1")
+    assert c2.get.run() == 16
+    assert c2.state()["n"] == 16
+    # run_async
+    assert c2.incr.run_async(4).result(timeout=60) == 20
+    # an unrelated actor id starts from its own init args
+    other = Counter.get_or_create("c2", 100)
+    assert other.get.run() == 100
