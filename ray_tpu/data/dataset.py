@@ -470,6 +470,11 @@ class Dataset:
 
         return [DataIterator(make_block_fn(i)) for i in range(n)]
 
+    def show(self, limit: int = 20) -> None:
+        """Print up to ``limit`` rows (reference: Dataset.show)."""
+        for row in self.take(limit):
+            print(row)
+
     # ------------------------------------------------------------- misc
     def stats(self) -> str:
         return self._last_stats.summary() if self._last_stats else ""
