@@ -456,6 +456,22 @@ def llama_forward(
     return logits.astype(jnp.float32)
 
 
+def _nll_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """-log p(target) without gather/scatter: the target logit comes from
+    an iota-compare + masked reduce, so the backward is softmax - onehot
+    (pure elementwise). ``take_along_axis`` over a 32k vocab axis lowers
+    to a TPU gather whose BACKWARD is a serialized scatter — profiling
+    the 7B step showed that formulation burning ~27% of the whole step
+    inside the loss (xplane while-loop at ~5% MXU efficiency)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    vocab_ids = jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, logits.ndim - 1)
+    target_logit = jnp.sum(
+        jnp.where(vocab_ids == targets[..., None], logits, 0.0), axis=-1)
+    return lse - target_logit
+
+
 def _chunked_ce(x, lm_head, targets, mask, chunk, dtype):
     """Cross-entropy over seq chunks: logits for one chunk at a time, each
     chunk's logits recomputed in the backward (jax.checkpoint) so peak
@@ -473,8 +489,7 @@ def _chunked_ce(x, lm_head, targets, mask, chunk, dtype):
     def body(carry, inp):
         xi, ti, mi = inp
         logits = jnp.einsum("bch,hv->bcv", xi, lm_head.astype(dtype))
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, ti[..., None], axis=-1)[..., 0]
+        nll = _nll_from_logits(logits, ti)
         tot, cnt = carry
         return (tot + jnp.sum(nll * mi), cnt + jnp.sum(mi)), None
 
@@ -500,8 +515,7 @@ def llama_loss(params: Dict[str, Any], batch: Dict[str, jax.Array],
         return _chunked_ce(x, params["lm_head"], targets, mask,
                            cfg.loss_chunk, cfg.dtype)
     logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"].astype(cfg.dtype))
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = _nll_from_logits(logits, targets)
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)

@@ -12,7 +12,16 @@ import os
 # through jax.config before any backend is initialized. Tests run on the
 # deterministic 8-device virtual CPU mesh (SURVEY §4 fake-TPU-topology note).
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # XLA's in-process CPU collectives SIGABRT when a rendezvous
+    # participant is >40s late; on a 1-core box running 8 virtual devices
+    # the per-shard compute between collectives legitimately starves
+    # threads past that (same rationale as __graft_entry__'s
+    # _ensure_virtual_devices — correctness gate, not latency gate)
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    + " --xla_cpu_collective_timeout_seconds=1200"
+    + " --xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1")
 
 import jax  # noqa: E402
 
