@@ -546,17 +546,26 @@ class NodeAgent:
             "log_out": os.path.join(log_dir, f"worker-{wid[:12]}.out"),
             "log_err": os.path.join(log_dir, f"worker-{wid[:12]}.err"),
         }
+        writer = None
         try:
             reader, writer = await asyncio.open_unix_connection(
                 self._forkserver_sock)
             writer.write((_json.dumps(req) + "\n").encode())
             await writer.drain()
             line = await asyncio.wait_for(reader.readline(), 30)
-            writer.close()
             rep = _json.loads(line)
             return rep.get("pid")
         except Exception:
             return None
+        finally:
+            # the forkserver serves connections serially — a leaked open
+            # connection (timeout/exception path) would stall every
+            # subsequent warm-fork request behind its recv loop
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
 
     def _spawn_slot_freed(self, handle: WorkerHandle) -> None:
         """A launching worker registered or died: free its startup slot."""
@@ -735,7 +744,13 @@ class NodeAgent:
                 await self._handle_worker_exit(handle, "connection closed")
 
     async def _handle_worker_exit(self, handle: WorkerHandle, reason: str) -> None:
-        self.workers.pop(handle.worker_id, None)
+        popped = self.workers.pop(handle.worker_id, None)
+        if popped is not None and not handle.registered.is_set():
+            # died between launch and registration: the register path that
+            # normally decrements the starting count never ran. Pop-guarded
+            # so a handle processed by both the reaper and the actor
+            # watchdog is decremented exactly once.
+            self._starting_workers = max(0, self._starting_workers - 1)
         self._spawn_slot_freed(handle)
         if handle in self.idle_workers:
             self.idle_workers.remove(handle)
@@ -762,6 +777,21 @@ class NodeAgent:
                     await self._handle_worker_exit(
                         handle, f"worker process exited (code {handle.proc.poll()})"
                     )
+                elif (not handle.registered.is_set()
+                      and handle.launched_at is not None
+                      and time.monotonic() - handle.launched_at
+                      > CONFIG.worker_register_timeout_s):
+                    # Launched but never registered (hung before the unix
+                    # socket handshake): the actor path has its own
+                    # watchdog, but plain-task launches would otherwise pin
+                    # their startup slot forever — after
+                    # STARTUP_CONCURRENCY such hangs the admission queue is
+                    # wedged node-wide. Terminate + evict + free the slot
+                    # so queued spawns drain.
+                    handle.terminate()
+                    handle.mark_failed()
+                    await self._handle_worker_exit(
+                        handle, "worker failed to register before timeout")
             # Kill workers idle beyond the cap to reclaim memory.
             cutoff = time.monotonic() - CONFIG.idle_worker_killing_time_ms / 1000
             while len(self.idle_workers) > self.max_workers:
@@ -1126,7 +1156,13 @@ class NodeAgent:
                         # STARTUP_CONCURRENCY such hangs
                         handle.terminate()
                         handle.mark_failed()
-                        self.workers.pop(handle.worker_id, None)
+                        if self.workers.pop(handle.worker_id, None) \
+                                is not None and \
+                                not handle.registered.is_set():
+                            # same accounting as _handle_worker_exit: the
+                            # register path that decrements never ran
+                            self._starting_workers = max(
+                                0, self._starting_workers - 1)
                         self._spawn_slot_freed(handle)
                         await self.head.call(
                             "ActorDied",

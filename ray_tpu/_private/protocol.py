@@ -294,6 +294,17 @@ class AsyncRpcClient:
             self.connected = False
 
     def set_push_handler(self, fn) -> None:
+        """Register the handler for unsolicited (push) frames.
+
+        CONTRACT: a *sync* handler runs INLINE in this connection's read
+        loop — while it runs, no reply future resolves and no further
+        pushed/streamed frame is processed on this connection. Handlers
+        must therefore be O(frame): cheap bookkeeping, waking futures,
+        enqueueing. Anything heavier (large-value deserialization, user
+        callbacks) must be deferred — return a coroutine (async handlers
+        get their own task) or hand the work to ``loop.call_soon`` /
+        an executor inside the handler.
+        """
         self._push_handler = fn
 
     async def _read_loop(self):

@@ -102,22 +102,36 @@ def main() -> None:
         except OSError:
             break
         try:
+            # per-connection recv deadline: the accept loop is serial, so
+            # one client that connects and never sends a full request must
+            # not block every subsequent warm-fork spawn
+            conn.settimeout(5.0)
             buf = b""
             while not buf.endswith(b"\n"):
-                chunk = conn.recv(65536)
+                try:
+                    chunk = conn.recv(65536)
+                except (socket.timeout, OSError):
+                    buf = b""
+                    break
                 if not chunk:
                     buf = b""
                     break
                 buf += chunk
             if not buf:
                 continue
-            req = json.loads(buf)
             try:
+                req = json.loads(buf)
                 pid = _spawn(req, server, conn)
-                conn.sendall((json.dumps({"pid": pid}) + "\n").encode())
+                reply = {"pid": pid}
             except BaseException as e:  # noqa: BLE001
-                conn.sendall(
-                    (json.dumps({"error": repr(e)}) + "\n").encode())
+                reply = {"error": repr(e)}
+            try:
+                conn.sendall((json.dumps(reply) + "\n").encode())
+            except OSError:
+                # the client gave up (agent's 30s wait_for timed out and
+                # closed): a dead peer must not kill the forkserver — the
+                # node would silently lose warm forks forever
+                pass
         finally:
             try:
                 conn.close()

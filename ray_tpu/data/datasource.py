@@ -526,11 +526,20 @@ class MongoDatasource(Datasource):
             return [lambda: read_range(None)]
         try:
             lo, hi, points = self._split_bounds(parallelism)
-        except ImportError:
+        except ImportError as e:
             # gated: keep the task-shape contract (N tasks) so pipelines
-            # compose; each raises the clear error at execution
-            return [lambda: read_range(None)
-                    for _ in range(parallelism)][:1]
+            # compose; each raises the clear ImportError at execution.
+            # The closures must RAISE, not fall back to whole-collection
+            # reads — if workers' runtime_env has pymongo while the driver
+            # doesn't, N whole-collection closures would duplicate every
+            # document N times.
+            msg = (f"MongoDatasource requires pymongo on the driver to "
+                   f"partition reads: {e}")
+
+            def gated() -> None:
+                raise ImportError(msg)
+
+            return [gated for _ in range(parallelism)]
         if lo is None or not points:
             return [lambda: read_range(None)]
         filters = _mongo_range_filters(points, lo, hi)

@@ -294,15 +294,20 @@ class HTTPEventProvider(EventListener):
     survives a crash before the workflow consumes it).
 
     ``poll_for_event`` first checks the durable spool (resume path), then
-    serves one HTTP request. The bound port is written to
-    ``<storage>/_events/<key>.port`` so external senders can discover it.
+    serves one HTTP request. The bound endpoint is written to
+    ``<storage>/_events/<key>.addr`` as ``host:port`` (and the legacy
+    ``.port`` file) so external senders can discover it. The default bind
+    is loopback (the endpoint is unauthenticated); multi-host deployments
+    with shared storage must opt in with ``bind_host="0.0.0.0"``, which
+    advertises the node's outbound IP in the ``.addr`` file.
     """
 
     def __init__(self, event_key: str, port: int = 0,
-                 timeout_s: float = 300.0):
+                 timeout_s: float = 300.0, bind_host: str = "127.0.0.1"):
         self.event_key = event_key
         self.port = port
         self.timeout_s = timeout_s
+        self.bind_host = bind_host
 
     def _spool_rel(self) -> str:
         return f"_events/{self.event_key}.payload"
@@ -337,10 +342,16 @@ class HTTPEventProvider(EventListener):
             def log_message(self, *a):  # quiet
                 pass
 
-        server = HTTPServer(("127.0.0.1", self.port), Handler)
+        server = HTTPServer((self.bind_host, self.port), Handler)
         server.timeout = 1.0
-        store.write_bytes(f"_events/{key}.port",
-                          str(server.server_address[1]).encode())
+        bound_port = server.server_address[1]
+        from ray_tpu._private.worker import node_ip
+
+        host = node_ip() if self.bind_host in ("0.0.0.0", "") \
+            else self.bind_host
+        store.write_bytes(f"_events/{key}.addr",
+                          f"{host}:{bound_port}".encode())
+        store.write_bytes(f"_events/{key}.port", str(bound_port).encode())
         deadline = time.monotonic() + self.timeout_s
         try:
             while not received and time.monotonic() < deadline:
