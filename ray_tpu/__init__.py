@@ -164,8 +164,15 @@ def get(
     w = _require_worker()
     if isinstance(refs, ObjectRef):
         return w.get([refs], timeout=timeout)[0]
+    # compiled-DAG result handles resolve through their channel, not the
+    # object store (reference: CompiledDAGRef supports ray.get)
+    compiled_get = getattr(refs, "_compiled_get", None)
+    if compiled_get is not None:
+        return compiled_get(timeout=timeout)
     if not isinstance(refs, (list, tuple)):
         raise TypeError("ray_tpu.get() expects an ObjectRef or a list of them")
+    if refs and all(hasattr(r, "_compiled_get") for r in refs):
+        return [r._compiled_get(timeout=timeout) for r in refs]
     return w.get(list(refs), timeout=timeout)
 
 

@@ -287,8 +287,16 @@ class Executor:
                                   os.environ.get("RAY_TPU_SESSION_DIR"))
             args, kwargs = self._resolve_args(spec)
             if spec.task_type == ACTOR_TASK:
-                fn = getattr(self.worker.actor_instance, spec.actor_method)
-                result = fn(*args, **kwargs)
+                if spec.actor_method == "__ray_apply__":
+                    # reserved dispatch: args[0] is a callable run WITH the
+                    # actor instance (compiled-DAG stage loops ride this —
+                    # reference compiled_dag_node.py attaches its executor
+                    # loop to participating actors the same way)
+                    result = args[0](self.worker.actor_instance, *args[1:],
+                                     **kwargs)
+                else:
+                    fn = getattr(self.worker.actor_instance, spec.actor_method)
+                    result = fn(*args, **kwargs)
             else:
                 fn = load_function(spec.function_id, spec.function_blob,
                                    self.worker, name=spec.function_name)

@@ -1,19 +1,24 @@
 """Lazy task/actor DAGs (reference: python/ray/dag/ — DAGNode
-dag_node.py:25, InputNode/OutputNode, experimental CompiledDAG
+dag_node.py:25, InputNode/OutputNode, CompiledDAG
 compiled_dag_node.py:141).
 
 ``fn.bind(*args)`` builds the graph lazily; ``dag.execute(input)`` walks it,
 submitting each node as a task with upstream ObjectRefs as args (so the
 object store pipelines the whole graph without materializing on the
-driver). ``dag.experimental_compile()`` returns a CompiledDAG that reuses
-the same walk but keeps per-node submit order cached — the accelerated-DAG
-analog; on TPU the intended use is chaining jitted stages whose arrays stay
-in the object store between nodes.
+driver). ``dag.experimental_compile()`` returns a :class:`CompiledDAG`:
+the graph is planned ONCE, every edge becomes a pre-allocated
+shared-memory :class:`~ray_tpu.experimental.channel.Channel`, and every
+compute node runs a PERSISTENT executor loop in its worker/actor process
+— repeat ``execute()`` calls cost channel writes/reads only, with zero
+per-call task submissions (reference: compiled_dag_node.py:141 +
+experimental/channel.py:171). On TPU the intended use is chaining jitted
+stages whose arrays stay in shm between nodes.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 
@@ -116,16 +121,429 @@ class MultiOutputNode(DAGNode):
                 for o in self._bound_args]
 
 
+class _Sentinel:
+    """Teardown marker: propagates through every channel so all stage
+    loops exit at the same iteration index."""
+
+
+class _StageError:
+    """A stage exception travels the pipeline as a value (the loop stays
+    alive — reference compiled DAGs tear down on error; keeping the
+    pipeline healthy lets later executions proceed)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _StopLoop(Exception):
+    """Raised inside a stage loop when the DAG's force-stop token appears
+    (teardown after a dead stage wedged the graceful sentinel path)."""
+
+
+def _stop_requested(stop_id) -> bool:
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.ids import ObjectID
+
+    if stop_id is None:
+        return False
+    return worker_mod.global_worker.store.contains(ObjectID(stop_id))
+
+
+def _read_with_stop(ch, stop_id):
+    """Blocking channel read that stays interruptible: if an upstream
+    stage died, the graceful sentinel can never arrive — the driver seals
+    the stop token instead and the read resolves to a sentinel, so a
+    USER actor hosting a loop is never wedged forever."""
+    while True:
+        try:
+            return ch.read(timeout=2.0)
+        except TimeoutError:
+            if _stop_requested(stop_id):
+                return _Sentinel()
+
+
+def _write_with_stop(ch, value, stop_id):
+    """Blocking (backpressured) channel write, interruptible like reads.
+    Channel.write only raises BEFORE writing, so retrying is safe."""
+    while True:
+        try:
+            ch.write(value, timeout=2.0)
+            return
+        except TimeoutError:
+            if _stop_requested(stop_id):
+                raise _StopLoop()
+
+
+def _multi_stage_body(stages, stop_id=None):
+    """The persistent executor loop a compiled-DAG worker/actor runs.
+
+    ``stages``: list of ``(call, args_desc, kwargs_desc, in_chs, out_chs)``
+    in topological order (one entry for function stages; all of one
+    actor's nodes share a single loop — a second blocking loop on the same
+    actor would queue forever behind the first).
+
+    Per iteration, per stage: read each distinct input channel ONCE (in
+    fixed order), resolve bound args from read values + constants, run the
+    call, write the result to every output channel. A sentinel read
+    propagates to the stage's outputs; the loop exits after the pass so
+    every channel is drained exactly once.
+    """
+    try:
+        while True:
+            stop = False
+            for call, args_desc, kwargs_desc, in_chs, out_chs in stages:
+                vals = [_read_with_stop(ch, stop_id) for ch in in_chs]
+                if any(isinstance(v, _Sentinel) for v in vals):
+                    stop = True
+                    for ch in out_chs:
+                        _write_with_stop(ch, _Sentinel(), stop_id)
+                    continue
+                err = next((v for v in vals if isinstance(v, _StageError)),
+                           None)
+                if err is None:
+                    args = [vals[d[1]] if d[0] == "c" else d[1]
+                            for d in args_desc]
+                    kwargs = {k: (vals[d[1]] if d[0] == "c" else d[1])
+                              for k, d in kwargs_desc.items()}
+                    try:
+                        result = call(*args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001 — crosses wire
+                        result = _StageError(e)
+                else:
+                    result = err  # upstream failed: forward, don't call
+                for ch in out_chs:
+                    _write_with_stop(ch, result, stop_id)
+            if stop:
+                return "stopped"
+    except _StopLoop:
+        return "force-stopped"
+
+
+def _actor_stage_apply(instance, specs, stop_id=None):
+    """specs: list of (method_name, args_desc, kwargs_desc, in, out)."""
+    return _multi_stage_body(
+        [(getattr(instance, m), a, k, i, o) for m, a, k, i, o in specs],
+        stop_id)
+
+
+class _StageActor:
+    """Dedicated executor process for a compiled function stage. A stage
+    loop blocks its process for the DAG's lifetime, so it must NOT share a
+    pooled task worker (the submitter pipelines tasks onto busy workers —
+    two loops on one worker deadlock the pipeline). Hidden actors give
+    each loop its own process, torn down with the DAG (the reference's
+    compiled DAGs likewise run their loops inside dedicated actor
+    processes, compiled_dag_node.py)."""
+
+    def run(self, fn, args_desc, kwargs_desc, in_chs, out_chs,
+            stop_id=None):
+        return _multi_stage_body(
+            [(fn, args_desc, kwargs_desc, in_chs, out_chs)], stop_id)
+
+
+_STAGE_ACTOR_CLS = None
+
+
+def _stage_actor_cls():
+    global _STAGE_ACTOR_CLS
+    if _STAGE_ACTOR_CLS is None:
+        # zero-CPU so an N-stage pipeline fits any node
+        _STAGE_ACTOR_CLS = ray_tpu.remote(num_cpus=0)(_StageActor)
+    return _STAGE_ACTOR_CLS
+
+
+class CompiledDAGRef:
+    """Result handle for one ``CompiledDAG.execute`` call; ``ray_tpu.get``
+    unwraps it (reference: compiled_dag_node.py CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return self._dag._result_for(self._seq, timeout)
+
+    # duck-typed hook for ray_tpu.get
+    def _compiled_get(self, timeout: Optional[float] = None) -> Any:
+        return self.get(timeout)
+
+    def __repr__(self):
+        return f"CompiledDAGRef(seq={self._seq})"
+
+
 class CompiledDAG:
-    """Repeat-execution wrapper (reference: compiled_dag_node.py:141; the
-    reference pre-allocates shared-memory channels — here the object store
-    already pipelines refs, so compile just fixes the traversal order)."""
+    """Channel-based precompiled execution (reference:
+    compiled_dag_node.py:141).
 
-    def __init__(self, root: DAGNode):
+    ``__init__`` plans the graph once: topological node order, one
+    shared-memory channel per edge (plus driver input / output channels),
+    then launches one persistent executor loop per compute node — function
+    nodes on dedicated leased workers, actor-method nodes INSIDE their
+    actor via the reserved ``__ray_apply__`` dispatch so state semantics
+    match the eager path. ``execute()`` writes the input into the driver-
+    fed channels and returns a :class:`CompiledDAGRef`; no tasks are
+    submitted per call. Channel capacity bounds in-flight executions
+    (backpressure = write blocks). Single-node scope, like the reference
+    prototype.
+    """
+
+    def __init__(self, root: DAGNode, max_inflight: int = 8):
+        from ray_tpu.experimental.channel import Channel
+
         self._root = root
+        self._capacity = max_inflight
+        self._torn_down = False
+        self._seq = 0          # executions issued
+        self._next_read = 0    # next seq to read from output channels
+        self._buffered: Dict[int, Any] = {}
 
-    def execute(self, *args, **kwargs):
-        return self._root.execute(*args, **kwargs)
+        # ---- plan: collect nodes reachable from root (post-order = topo)
+        order: List[DAGNode] = []
+        seen: Dict[int, DAGNode] = {}
 
-    def teardown(self) -> None:
-        pass
+        def visit(n: DAGNode) -> None:
+            if id(n) in seen:
+                return
+            seen[id(n)] = n
+            for dep in n._bound_args:
+                if isinstance(dep, DAGNode):
+                    visit(dep)
+            for dep in n._bound_kwargs.values():
+                if isinstance(dep, DAGNode):
+                    visit(dep)
+            order.append(n)
+
+        visit(root)
+        if isinstance(root, InputNode):
+            raise ValueError("InputNode cannot be the DAG root")
+        compute = [n for n in order
+                   if isinstance(n, (FunctionNode, ClassMethodNode))]
+        if not compute:
+            raise ValueError("compiled DAG needs at least one task/actor node")
+        for n in order:
+            if isinstance(n, MultiOutputNode) and n is not root:
+                raise ValueError("MultiOutputNode must be the DAG root")
+        # force-stop token: sealed by teardown when the graceful sentinel
+        # path can't complete (a dead stage wedges downstream reads)
+        import os as _os
+
+        from ray_tpu._private.ids import ObjectID as _OID
+
+        self._stop_id = _os.urandom(_OID.SIZE)
+
+        # ---- channels: one per (producer, consumer-node) edge
+        def mkch() -> Channel:
+            return Channel(capacity=self._capacity)
+
+        edge_ch: Dict[Tuple[int, int], Channel] = {}
+        self._input_channels: List[Channel] = []  # driver-written
+        node_in: Dict[int, List[Channel]] = {}
+        node_in_idx: Dict[int, Dict[int, int]] = {}  # node -> dep id -> pos
+        for n in compute:
+            ins: List[Channel] = []
+            idx: Dict[int, int] = {}
+            deps = [d for d in list(n._bound_args)
+                    + list(n._bound_kwargs.values())
+                    if isinstance(d, DAGNode)]
+            for d in deps:
+                if id(d) in idx:
+                    continue
+                ch = mkch()
+                edge_ch[(id(d), id(n))] = ch
+                idx[id(d)] = len(ins)
+                ins.append(ch)
+                if isinstance(d, InputNode):
+                    self._input_channels.append(ch)
+            if not ins:
+                # constant-only stage: a driver-fed tick channel triggers
+                # one iteration per execute (and carries the sentinel)
+                ch = mkch()
+                ins.append(ch)
+                self._input_channels.append(ch)
+            node_in[id(n)] = ins
+            node_in_idx[id(n)] = idx
+
+        # driver-read output channels (root, or each MultiOutput branch)
+        self._output_channels: List[Channel] = []
+        node_out: Dict[int, List[Channel]] = {id(n): [] for n in compute}
+        if isinstance(root, MultiOutputNode):
+            for branch in root._bound_args:
+                if not isinstance(branch, (FunctionNode, ClassMethodNode)):
+                    raise ValueError(
+                        "MultiOutputNode branches must be task/actor nodes")
+                ch = mkch()
+                node_out[id(branch)].append(ch)
+                self._output_channels.append(ch)
+        else:
+            ch = mkch()
+            node_out[id(root)].append(ch)
+            self._output_channels.append(ch)
+        for (prod, cons), ch in edge_ch.items():
+            if prod in node_out:  # InputNode edges are driver-written
+                node_out[prod].append(ch)
+
+        # ---- launch persistent loops (one dedicated stage actor per
+        # function node; all of a user actor's nodes share ONE loop, in
+        # topo order)
+        self._loop_refs = []
+        self._stage_actors: List[Any] = []
+        actor_specs: Dict[Any, List] = {}
+        actor_handles: Dict[Any, Any] = {}
+        for n in compute:
+            idx = node_in_idx[id(n)]
+
+            def desc(v, idx=idx):
+                return ("c", idx[id(v)]) if isinstance(v, DAGNode) \
+                    else ("k", v)
+
+            args_desc = [desc(a) for a in n._bound_args]
+            kwargs_desc = {k: desc(v) for k, v in n._bound_kwargs.items()}
+            if isinstance(n, FunctionNode):
+                fn = n._remote_fn
+                raw = getattr(fn, "_function", None) or fn
+                stage = _stage_actor_cls().remote()
+                self._stage_actors.append(stage)
+                ref = stage.run.remote(
+                    raw, args_desc, kwargs_desc,
+                    node_in[id(n)], node_out[id(n)], self._stop_id)
+                self._loop_refs.append(ref)
+            else:
+                key = n._actor._actor_id
+                actor_handles[key] = n._actor
+                actor_specs.setdefault(key, []).append(
+                    (n._method_name, args_desc, kwargs_desc,
+                     node_in[id(n)], node_out[id(n)]))
+        for key, specs in actor_specs.items():
+            from ray_tpu.actor import ActorMethod
+
+            apply_m = ActorMethod(actor_handles[key], "__ray_apply__")
+            self._loop_refs.append(
+                apply_m.remote(_actor_stage_apply, specs, self._stop_id))
+
+    # -------------------------------------------------------------- execute
+    def execute(self, *input_args, **input_kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        if len(input_args) == 1 and not input_kwargs:
+            input_val: Any = input_args[0]
+        elif input_kwargs and not input_args:
+            input_val = input_kwargs
+        else:
+            input_val = input_args
+        for i, ch in enumerate(self._input_channels):
+            try:
+                ch.write(input_val)
+            except TimeoutError:
+                if i == 0:
+                    # nothing written yet: retry-safe, surface backpressure
+                    raise
+                # PARTIAL input write: branches are now desynchronized —
+                # poison the DAG instead of silently skewing executions
+                self.teardown(timeout=5.0)
+                raise RuntimeError(
+                    "compiled DAG wedged mid-execute (a stage stopped "
+                    "consuming); the DAG was torn down") from None
+        ref = CompiledDAGRef(self, self._seq)
+        self._seq += 1
+        return ref
+
+    def _result_for(self, seq: int, timeout: Optional[float]) -> Any:
+        if seq in self._buffered:
+            out = self._buffered.pop(seq)
+        else:
+            if seq < self._next_read:
+                raise ValueError(
+                    f"result for execution #{seq} was already consumed")
+            if self._torn_down:
+                raise RuntimeError("compiled DAG was torn down")
+            while self._next_read <= seq:
+                vals = []
+                for i, ch in enumerate(self._output_channels):
+                    try:
+                        # timeout=None blocks indefinitely, matching the
+                        # eager ray_tpu.get contract
+                        vals.append(ch.read(timeout=timeout))
+                    except TimeoutError:
+                        if i == 0:
+                            raise  # nothing consumed yet: retry-safe
+                        # PARTIAL result read: output channels are now at
+                        # different seqs — poison rather than skew pairs
+                        self.teardown(timeout=5.0)
+                        raise RuntimeError(
+                            "compiled DAG wedged mid-result (one output "
+                            "branch stalled); the DAG was torn down"
+                        ) from None
+                out = vals if len(self._output_channels) > 1 else vals[0]
+                if self._next_read == seq:
+                    self._next_read += 1
+                    break
+                self._buffered[self._next_read] = out
+                self._next_read += 1
+        errs = out if isinstance(out, list) else [out]
+        for v in errs:
+            if isinstance(v, _StageError):
+                raise v.exc
+        return out
+
+    # ------------------------------------------------------------- teardown
+    def teardown(self, timeout: float = 30.0) -> None:
+        """Stop every stage loop and release the channels."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._input_channels:
+            try:
+                ch.write(_Sentinel(), timeout=timeout)
+            except Exception:
+                pass
+        # drain pending results + the sentinel so every slot is consumed
+        deadline = time.monotonic() + timeout
+        for ch in self._output_channels:
+            while time.monotonic() < deadline:
+                try:
+                    v = ch.read(timeout=max(0.1, deadline - time.monotonic()))
+                except Exception:
+                    break
+                if isinstance(v, _Sentinel):
+                    break
+        try:
+            ray_tpu.get(self._loop_refs, timeout=timeout)
+        except Exception:
+            # graceful sentinel drain failed (a stage died mid-pipeline and
+            # can't forward its sentinel): seal the force-stop token so
+            # every surviving loop — including loops INSIDE user actors —
+            # unwedges within its 2 s read poll instead of blocking forever
+            self._seal_stop_token()
+            try:
+                # loops poll the stop token every ~2s; don't exceed the
+                # caller's budget (__del__ tears down with timeout=2)
+                ray_tpu.get(self._loop_refs, timeout=min(timeout, 15.0))
+            except Exception:
+                pass
+        for stage in self._stage_actors:
+            try:
+                ray_tpu.kill(stage)
+            except Exception:
+                pass
+        self._stage_actors = []
+
+    def _seal_stop_token(self) -> None:
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.ids import ObjectID
+
+        try:
+            w = worker_mod.global_worker
+            oid = ObjectID(self._stop_id)
+            if not w.store.contains(oid):
+                view, handle = w.store.create(oid, 1)
+                view[0:1] = b"\x01"
+                w.store.seal(oid, handle)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            if not self._torn_down:
+                self.teardown(timeout=2.0)
+        except Exception:
+            pass

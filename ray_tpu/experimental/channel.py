@@ -7,23 +7,46 @@ Here: a ring of pre-created slots in the node's object store. ``write``
 seals slot ``i % n``, ``read`` blocks for it and deletes after consumption,
 so repeated DAG executions reuse at most ``n`` allocations' worth of shm
 at a time while readers stay zero-copy.
+
+Polling discipline: the hot path (compiled-DAG stage loops) spins with
+``os.sched_yield`` first — on a core-constrained box a plain sleep adds a
+full scheduler quantum per hop, while a yield hands the core straight to
+the peer process that is about to produce/consume the slot — then falls
+back to short sleeps so an idle channel costs ~no CPU.
 """
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
-import ray_tpu
 from ray_tpu._private.ids import ObjectID
+
+_YIELD_ITERS = 64
+
+
+def _poll(pred: Callable[[], bool], timeout: Optional[float],
+          what: str) -> None:
+    """Wait until pred() is true; sched_yield burst, then short sleeps."""
+    if pred():
+        return
+    deadline = time.monotonic() + (timeout if timeout is not None else 1e9)
+    i = 0
+    while not pred():
+        if time.monotonic() > deadline:
+            raise TimeoutError(what)
+        if i < _YIELD_ITERS:
+            os.sched_yield()
+        else:
+            time.sleep(0.0002 if i < _YIELD_ITERS + 256 else 0.002)
+        i += 1
 
 
 class Channel:
     """SPSC channel between two processes on one node."""
 
     def __init__(self, capacity: int = 2, _key: Optional[str] = None):
-        import os
-
         self._key = _key or os.urandom(8).hex()
         self.capacity = capacity
         self._wseq = 0
@@ -45,11 +68,8 @@ class Channel:
         # been consumed (deleted) by the reader
         if self._wseq >= self.capacity:
             old = self._slot_id(self._wseq - self.capacity)
-            deadline = time.monotonic() + (timeout or 1e9)
-            while w.store.contains(old):
-                if time.monotonic() > deadline:
-                    raise TimeoutError("channel full: reader too slow")
-                time.sleep(0.001)
+            _poll(lambda: not w.store.contains(old), timeout,
+                  "channel full: reader too slow")
         sobj = w._serialize_value(value)
         oid = self._slot_id(self._wseq)
         view, handle = w.store.create(oid, sobj.total_size())
@@ -63,19 +83,22 @@ class Channel:
 
         w = worker_mod.global_worker
         oid = self._slot_id(self._rseq)
-        deadline = time.monotonic() + (timeout or 1e9)
-        while True:
-            view = w.store.get_view(oid)
-            if view is not None:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError("channel read timed out")
-            time.sleep(0.001)
+        view_box = []
+
+        def ready() -> bool:
+            v = w.store.get_view(oid)
+            if v is None:
+                return False
+            view_box.append(v)
+            return True
+
+        _poll(ready, timeout, "channel read timed out")
         # copy before deserializing: the slot must be deletable immediately
         # (the native arena refuses to delete while a pinned view aliases
-        # it, which would wedge the writer's backpressure loop)
-        data = bytes(view)
-        del view
+        # it, which would wedge the writer's backpressure loop) — so every
+        # alias of the view, including view_box's, must die before delete
+        data = bytes(view_box[0])
+        view_box.clear()
         value = w.serialization_context.deserialize(memoryview(data))
         w.store.delete(oid)
         self._rseq += 1

@@ -139,6 +139,54 @@ def bench_queued_tasks(ray, n: int) -> dict:
             "drain_per_s": round(n / drain_s, 1)}
 
 
+def bench_compiled_dag(ray, n: int) -> dict:
+    """Compiled vs eager DAG repeat execution (VERDICT r4 #1: ≥5× at 1 KB
+    and 10 MB through a 3-stage pipeline; reference:
+    python/ray/dag/compiled_dag_node.py:141 channel-based execution)."""
+    import numpy as np
+    from ray_tpu.dag import InputNode
+
+    @ray.remote
+    def stage_a(x):
+        return x
+
+    @ray.remote
+    def stage_b(x):
+        return x
+
+    @ray.remote
+    def stage_c(x):
+        return x
+
+    out = {}
+    for label, elems in (("1kb", 256), ("10mb", 10 * 1024 * 1024 // 4)):
+        payload = np.zeros(elems, dtype=np.float32)
+        with InputNode() as inp:
+            dag = stage_c.bind(stage_b.bind(stage_a.bind(inp)))
+        iters = n if label == "1kb" else max(5, n // 10)
+        ray.get(dag.execute(payload), timeout=120)  # warm leases
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ray.get(dag.execute(payload), timeout=120)
+        eager_s = time.perf_counter() - t0
+        compiled = dag.experimental_compile()
+        try:
+            compiled.execute(payload).get(timeout=120)  # warm loops
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                compiled.execute(payload).get(timeout=120)
+            compiled_s = time.perf_counter() - t0
+        finally:
+            compiled.teardown()
+        out[label] = {
+            "iters": iters,
+            "eager_ms_per_exec": round(eager_s / iters * 1000, 3),
+            "compiled_ms_per_exec": round(compiled_s / iters * 1000, 3),
+            "speedup": round(eager_s / compiled_s, 2),
+        }
+    return out
+
+
 def main(quick: bool = False) -> dict:
     import ray_tpu
 
@@ -150,6 +198,7 @@ def main(quick: bool = False) -> dict:
     results["many_pgs"] = bench_many_pgs(ray_tpu, 200 if quick else 1000)
     results["queued_tasks"] = bench_queued_tasks(
         ray_tpu, 20_000 if quick else 100_000)
+    results["compiled_dag"] = bench_compiled_dag(ray_tpu, 20 if quick else 50)
     print(json.dumps(results))
     ray_tpu.shutdown()
     return results
