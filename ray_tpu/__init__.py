@@ -171,7 +171,11 @@ def get(
         return compiled_get(timeout=timeout)
     if not isinstance(refs, (list, tuple)):
         raise TypeError("ray_tpu.get() expects an ObjectRef or a list of them")
-    if refs and all(hasattr(r, "_compiled_get") for r in refs):
+    if refs and any(hasattr(r, "_compiled_get") for r in refs):
+        if not all(hasattr(r, "_compiled_get") for r in refs):
+            raise TypeError(
+                "ray_tpu.get() cannot mix CompiledDAGRefs with ObjectRefs "
+                "in one list")
         return [r._compiled_get(timeout=timeout) for r in refs]
     return w.get(list(refs), timeout=timeout)
 

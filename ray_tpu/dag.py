@@ -47,6 +47,16 @@ class DAGNode:
         return CompiledDAG(self)
 
 
+def _pack_input(input_args: tuple, input_kwargs: dict) -> Any:
+    """The one input-packing rule shared by eager InputNode resolution
+    and CompiledDAG.execute — the two paths must never diverge."""
+    if len(input_args) == 1 and not input_kwargs:
+        return input_args[0]
+    if input_kwargs and not input_args:
+        return input_kwargs
+    return input_args
+
+
 class InputNode(DAGNode):
     """Placeholder for execute()-time input (reference: dag/input_node.py).
 
@@ -62,11 +72,7 @@ class InputNode(DAGNode):
         return False
 
     def _execute_node(self, cache, input_args, input_kwargs):
-        if len(input_args) == 1 and not input_kwargs:
-            return input_args[0]
-        if input_kwargs and not input_args:
-            return input_kwargs
-        return input_args
+        return _pack_input(input_args, input_kwargs)
 
 
 class FunctionNode(DAGNode):
@@ -153,11 +159,14 @@ def _read_with_stop(ch, stop_id):
     """Blocking channel read that stays interruptible: if an upstream
     stage died, the graceful sentinel can never arrive — the driver seals
     the stop token instead and the read resolves to a sentinel, so a
-    USER actor hosting a loop is never wedged forever."""
+    USER actor hosting a loop is never wedged forever. The poll phase
+    carries across retries so an idle loop settles into cheap sleeps."""
+    phase = 0
     while True:
         try:
-            return ch.read(timeout=2.0)
-        except TimeoutError:
+            return ch.read(timeout=2.0, _phase=phase)
+        except TimeoutError as e:
+            phase = getattr(e, "phase", phase)
             if _stop_requested(stop_id):
                 return _Sentinel()
 
@@ -165,11 +174,13 @@ def _read_with_stop(ch, stop_id):
 def _write_with_stop(ch, value, stop_id):
     """Blocking (backpressured) channel write, interruptible like reads.
     Channel.write only raises BEFORE writing, so retrying is safe."""
+    phase = 0
     while True:
         try:
-            ch.write(value, timeout=2.0)
+            ch.write(value, timeout=2.0, _phase=phase)
             return
-        except TimeoutError:
+        except TimeoutError as e:
+            phase = getattr(e, "phase", phase)
             if _stop_requested(stop_id):
                 raise _StopLoop()
 
@@ -296,6 +307,9 @@ class CompiledDAG:
         self._seq = 0          # executions issued
         self._next_read = 0    # next seq to read from output channels
         self._buffered: Dict[int, Any] = {}
+        self._partial_input = None    # (value, next channel idx) on timeout
+        self._partial_read: list = []  # output values read so far this seq
+        self._discard_seqs: set = set()  # voided executions to drop
 
         # ---- plan: collect nodes reachable from root (post-order = topo)
         order: List[DAGNode] = []
@@ -424,28 +438,32 @@ class CompiledDAG:
     def execute(self, *input_args, **input_kwargs) -> CompiledDAGRef:
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
-        if len(input_args) == 1 and not input_kwargs:
-            input_val: Any = input_args[0]
-        elif input_kwargs and not input_args:
-            input_val = input_kwargs
-        else:
-            input_val = input_args
-        for i, ch in enumerate(self._input_channels):
-            try:
-                ch.write(input_val)
-            except TimeoutError:
-                if i == 0:
-                    # nothing written yet: retry-safe, surface backpressure
-                    raise
-                # PARTIAL input write: branches are now desynchronized —
-                # poison the DAG instead of silently skewing executions
-                self.teardown(timeout=5.0)
-                raise RuntimeError(
-                    "compiled DAG wedged mid-execute (a stage stopped "
-                    "consuming); the DAG was torn down") from None
+        input_val = _pack_input(input_args, input_kwargs)
+        if self._partial_input is not None:
+            # a previous execute timed out mid-write: finish delivering its
+            # input FIRST so branches stay in lockstep. That voided call
+            # never issued a ref, so its completed execution is discarded
+            # transparently on the read side.
+            val, idx = self._partial_input
+            self._write_inputs(val, idx)  # progress saved if this raises
+            self._partial_input = None
+            self._discard_seqs.add(self._seq)
+            self._seq += 1
+        self._write_inputs(input_val, 0)
         ref = CompiledDAGRef(self, self._seq)
         self._seq += 1
         return ref
+
+    def _write_inputs(self, input_val: Any, start_idx: int) -> None:
+        """Write one execution's input to every driver-fed channel,
+        recording progress so a backpressure TimeoutError stays retry-safe
+        (a partial write must never silently skew branch iterations)."""
+        for i in range(start_idx, len(self._input_channels)):
+            try:
+                self._input_channels[i].write(input_val)
+            except TimeoutError:
+                self._partial_input = (input_val, i)
+                raise
 
     def _result_for(self, seq: int, timeout: Optional[float]) -> Any:
         if seq in self._buffered:
@@ -457,23 +475,12 @@ class CompiledDAG:
             if self._torn_down:
                 raise RuntimeError("compiled DAG was torn down")
             while self._next_read <= seq:
-                vals = []
-                for i, ch in enumerate(self._output_channels):
-                    try:
-                        # timeout=None blocks indefinitely, matching the
-                        # eager ray_tpu.get contract
-                        vals.append(ch.read(timeout=timeout))
-                    except TimeoutError:
-                        if i == 0:
-                            raise  # nothing consumed yet: retry-safe
-                        # PARTIAL result read: output channels are now at
-                        # different seqs — poison rather than skew pairs
-                        self.teardown(timeout=5.0)
-                        raise RuntimeError(
-                            "compiled DAG wedged mid-result (one output "
-                            "branch stalled); the DAG was torn down"
-                        ) from None
-                out = vals if len(self._output_channels) > 1 else vals[0]
+                out = self._read_output_vector(timeout)
+                if self._next_read in self._discard_seqs:
+                    # a voided (timed-out) execution's result: drop it
+                    self._discard_seqs.discard(self._next_read)
+                    self._next_read += 1
+                    continue
                 if self._next_read == seq:
                     self._next_read += 1
                     break
@@ -484,6 +491,18 @@ class CompiledDAG:
             if isinstance(v, _StageError):
                 raise v.exc
         return out
+
+    def _read_output_vector(self, timeout: Optional[float]) -> Any:
+        """Read one value from every output channel. Partial progress is
+        buffered across calls (``_partial_read``) so a user timeout on a
+        slow branch stays retry-safe instead of skewing branch pairs.
+        timeout=None blocks indefinitely, matching eager ray_tpu.get."""
+        vals = self._partial_read
+        while len(vals) < len(self._output_channels):
+            vals.append(self._output_channels[len(vals)].read(
+                timeout=timeout))
+        self._partial_read = []
+        return vals if len(self._output_channels) > 1 else vals[0]
 
     # ------------------------------------------------------------- teardown
     def teardown(self, timeout: float = 30.0) -> None:

@@ -27,19 +27,27 @@ _YIELD_ITERS = 64
 
 
 def _poll(pred: Callable[[], bool], timeout: Optional[float],
-          what: str) -> None:
-    """Wait until pred() is true; sched_yield burst, then short sleeps."""
+          what: str, phase: int = 0) -> None:
+    """Wait until pred() is true; sched_yield burst, then short sleeps.
+
+    ``phase`` continues the escalation across retries (a caller re-polling
+    the same still-empty slot must not restart the hot yield burst — an
+    idle channel would otherwise cost ~500 wakeups/s forever). A raised
+    TimeoutError carries the reached phase in ``.phase``.
+    """
     if pred():
         return
     deadline = time.monotonic() + (timeout if timeout is not None else 1e9)
-    i = 0
+    i = phase
     while not pred():
         if time.monotonic() > deadline:
-            raise TimeoutError(what)
+            e = TimeoutError(what)
+            e.phase = i
+            raise e
         if i < _YIELD_ITERS:
             os.sched_yield()
         else:
-            time.sleep(0.0002 if i < _YIELD_ITERS + 256 else 0.002)
+            time.sleep(0.0002 if i < _YIELD_ITERS + 256 else 0.005)
         i += 1
 
 
@@ -60,7 +68,8 @@ class Channel:
         return ObjectID(h)
 
     # ------------------------------------------------------------- writing
-    def write(self, value: Any, timeout: Optional[float] = 30.0) -> None:
+    def write(self, value: Any, timeout: Optional[float] = 30.0,
+              _phase: int = 0) -> None:
         from ray_tpu._private import worker as worker_mod
 
         w = worker_mod.global_worker
@@ -69,7 +78,7 @@ class Channel:
         if self._wseq >= self.capacity:
             old = self._slot_id(self._wseq - self.capacity)
             _poll(lambda: not w.store.contains(old), timeout,
-                  "channel full: reader too slow")
+                  "channel full: reader too slow", phase=_phase)
         sobj = w._serialize_value(value)
         oid = self._slot_id(self._wseq)
         view, handle = w.store.create(oid, sobj.total_size())
@@ -78,7 +87,8 @@ class Channel:
         self._wseq += 1
 
     # ------------------------------------------------------------- reading
-    def read(self, timeout: Optional[float] = 30.0) -> Any:
+    def read(self, timeout: Optional[float] = 30.0,
+             _phase: int = 0) -> Any:
         from ray_tpu._private import worker as worker_mod
 
         w = worker_mod.global_worker
@@ -92,7 +102,7 @@ class Channel:
             view_box.append(v)
             return True
 
-        _poll(ready, timeout, "channel read timed out")
+        _poll(ready, timeout, "channel read timed out", phase=_phase)
         # copy before deserializing: the slot must be deletable immediately
         # (the native arena refuses to delete while a pinned view aliases
         # it, which would wedge the writer's backpressure loop) — so every

@@ -38,6 +38,7 @@ __all__ = [
     "get_multiplexed_model_id", "build", "run_config",
     "DeploymentSchema", "ServeApplicationSchema", "ServeDeploySchema",
     "HTTPOptionsSchema", "ServeGrpcClient", "get_grpc_port",
+    "get_proxy_info",
     "ingress", "Response", "StreamingResponse",
     "DAGDriver", "InputNode", "json_request", "starlette_request",
 ]
@@ -49,24 +50,25 @@ _grpc_port: Optional[int] = None
 
 def start(http_options: Optional[Dict] = None, detached: bool = True,
           grpc_options: Optional[Dict] = None):
-    """Start the Serve control plane: controller + HTTP (+ gRPC) proxy
-    (reference: serve.start / _private/api.py; gRPC ingress via
-    grpc_options={"port": ...})."""
+    """Start the Serve control plane: controller + one HTTP (+ gRPC)
+    proxy PER NODE, controller-managed (reference: serve.start /
+    _private/api.py; per-node proxies proxy.py:1097 + proxy_state.py;
+    gRPC ingress via grpc_options={"port": ...})."""
     global _http_port, _grpc_port
     http_options = http_options or {}
     try:
-        ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
         if (grpc_options or {}).get("port") is not None:
-            # only reject when the live proxy DEFINITIVELY reports no gRPC
-            # ingress — a failed/slow port query must not produce a false
+            # only reject when the controller DEFINITIVELY reports no gRPC
+            # ingress — a failed/slow query must not produce a false
             # "running without gRPC" error
             try:
-                proxy = ray_tpu.get_actor(PROXY_NAME,
-                                          namespace=SERVE_NAMESPACE)
-                port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=10)
+                info = ray_tpu.get(ctrl.get_proxy_info.remote(), timeout=10)
+                has_grpc = any(p.get("grpc_port") is not None
+                               for p in info.values()) if info else True
             except Exception:
-                port = True  # unknown: assume configured
-            if port is None:
+                has_grpc = True  # unknown: assume configured
+            if not has_grpc:
                 raise RuntimeError(
                     "serve is already running without a gRPC ingress; call "
                     "serve.shutdown() first to start with grpc_options")
@@ -78,52 +80,92 @@ def start(http_options: Optional[Dict] = None, detached: bool = True,
     port = http_options.get("port", 8000)
     host = http_options.get("host", "127.0.0.1")
     grpc_port = (grpc_options or {}).get("port")
-    ray_tpu.remote(ServeController).options(
+    ctrl = ray_tpu.remote(ServeController).options(
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
         max_concurrency=64, num_cpus=0.1).remote(http_port=port)
-    proxy = ray_tpu.remote(ProxyActor).options(
-        name=PROXY_NAME, namespace=SERVE_NAMESPACE,
-        max_concurrency=64, num_cpus=0.1).remote(
-            port=port, host=host, grpc_port=grpc_port)
-    _http_port = ray_tpu.get(proxy.ready.remote(), timeout=60)
-    if grpc_port is not None:
-        _grpc_port = ray_tpu.get(proxy.get_grpc_port.remote(), timeout=30)
+    ray_tpu.get(
+        ctrl.start_proxies.remote(port=port, host=host, grpc_port=grpc_port),
+        timeout=120)
+    info = _local_proxy_info(ctrl, timeout=60)
+    if info is not None:
+        _http_port = info.get("http_port")
+        _grpc_port = info.get("grpc_port")
+
+
+def _local_proxy_info(ctrl=None, timeout: float = 30.0) -> Optional[Dict]:
+    """The proxy record for THIS driver's node (falling back to any
+    healthy proxy): requests should enter through the node-local ingress
+    (reference: proxy_router picking the local proxy)."""
+    ctrl = ctrl or _controller()
+    my_node = None
+    try:
+        my_node = ray_tpu.get_runtime_context().get_node_id()
+    except Exception:
+        pass
+    deadline = time.monotonic() + timeout
+    while True:
+        info = ray_tpu.get(ctrl.get_proxy_info.remote(), timeout=30)
+        healthy = {nid: p for nid, p in info.items() if p.get("healthy")}
+        if healthy:
+            if my_node in healthy:
+                return healthy[my_node]
+            if my_node is None or time.monotonic() > deadline - timeout / 2:
+                # node id unknown, or the local proxy is slow to come up.
+                # Another node's proxy is only reachable through its
+                # advertised host — a loopback bind on a DIFFERENT host is
+                # useless, but on single-host (test) clusters every
+                # "node" shares this machine, so loopback still works.
+                return next(iter(healthy.values()))
+        if time.monotonic() > deadline:
+            return None
+        time.sleep(0.2)
 
 
 _PORT_UNQUERIED = object()  # distinct from "queried, ingress absent"
 
 
 def get_http_port() -> Optional[int]:
-    """The proxy's bound port (0 in http_options picks a free one).
-    Queried from the live proxy actor when this process didn't start
+    """The node-local proxy's bound port (0 in http_options picks a free
+    one). Queried from the controller when this process didn't start
     Serve itself (a second driver connecting to a running cluster)."""
     global _http_port
     if _http_port is None:
-        _http_port = _proxy_port("ready", default=None)
+        _http_port = _proxy_port("http_port", default=None)
     return _http_port
 
 
 def get_grpc_port() -> Optional[int]:
     global _grpc_port
     if _grpc_port is None:
-        _grpc_port = _proxy_port("get_grpc_port", default=None)
+        _grpc_port = _proxy_port("grpc_port", default=None)
     return _grpc_port
+
+
+def get_proxy_info() -> Dict[str, Dict]:
+    """{node_id: {name, http_port, grpc_port, healthy}} for every node's
+    ingress proxy (reference: serve status proxies section)."""
+    try:
+        return ray_tpu.get(_controller().get_proxy_info.remote(), timeout=30)
+    except Exception:
+        return {}
 
 
 _port_cache: dict = {}
 
 
-def _proxy_port(method: str, default=None):
+def _proxy_port(field: str, default=None):
     # cache definitive answers (including "no such ingress") so pollers
     # don't pay an actor round trip per call; failures are NOT cached
-    if method in _port_cache:
-        return _port_cache[method]
+    if field in _port_cache:
+        return _port_cache[field]
     try:
-        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=SERVE_NAMESPACE)
-        value = ray_tpu.get(getattr(proxy, method).remote(), timeout=10)
+        info = _local_proxy_info(timeout=10)
+        if info is None:
+            return default
+        value = info.get(field)
     except Exception:
         return default
-    _port_cache[method] = value
+    _port_cache[field] = value
     return value
 
 
@@ -280,11 +322,19 @@ def shutdown() -> None:
         ctrl = _controller()
     except Exception:
         return
+    # controller.shutdown kills the per-node proxies; sweep by name as a
+    # backup in case the controller wedged mid-shutdown
+    try:
+        proxy_names = [p["name"] for p in
+                       ray_tpu.get(ctrl.get_proxy_info.remote(),
+                                   timeout=10).values()]
+    except Exception:
+        proxy_names = []
     try:
         ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
     except Exception:
         pass
-    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+    for actor_name in (*proxy_names, PROXY_NAME, CONTROLLER_NAME):
         try:
             ray_tpu.kill(
                 ray_tpu.get_actor(actor_name, namespace=SERVE_NAMESPACE))

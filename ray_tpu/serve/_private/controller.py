@@ -50,6 +50,22 @@ class _DeploymentInfo:
         self._ongoing_history: List = []  # (t, total_ongoing)
 
 
+class _ProxyState:
+    # restart only after this many consecutive probe failures — one slow
+    # 5s probe on a busy node must not bounce a live serving proxy
+    # (reference: proxy_state.py PROXY_HEALTH_CHECK_UNHEALTHY_THRESHOLD)
+    FAILURE_THRESHOLD = 3
+
+    def __init__(self, name: str, handle, node_id: str):
+        self.name = name
+        self.handle = handle
+        self.node_id = node_id
+        self.http_port: Optional[int] = None
+        self.grpc_port: Optional[int] = None
+        self.healthy = False
+        self.consecutive_failures = 0
+
+
 class ServeController(LongPollHost):
     def __init__(self, http_port: int = 8000):
         LongPollHost.__init__(self)
@@ -58,6 +74,12 @@ class ServeController(LongPollHost):
         self._routes: Dict[str, tuple] = {}  # prefix -> (app, ingress dep)
         self._loop_task = None
         self._shutdown = False
+        # per-node ingress (reference: proxy.py:1097 — one ProxyActor per
+        # node; proxy_state.py health-checks and restarts them)
+        self._proxy_config: Optional[Dict] = None
+        self._proxies: Dict[str, _ProxyState] = {}  # node_id -> state
+        self._proxy_generation = 0
+        self._last_proxy_check = 0.0
 
     async def _ensure_loop(self):
         if self._loop_task is None:
@@ -113,6 +135,133 @@ class ServeController(LongPollHost):
         self._shutdown = True
         for app in list(self._apps):
             await self.delete_application(app)
+        for ps in self._proxies.values():
+            try:
+                ray_tpu.kill(ps.handle)
+            except Exception:
+                pass
+        self._proxies.clear()
+
+    # --------------------------------------------------------------- proxies
+    async def start_proxies(self, port: int = 8000, host: str = "127.0.0.1",
+                            grpc_port: Optional[int] = None) -> None:
+        """Record the ingress config; the reconcile loop keeps one
+        ProxyActor alive on EVERY alive node (reference:
+        serve/_private/proxy_state.py ProxyStateManager — per-node
+        proxies, controller-driven health checks + restarts)."""
+        await self._ensure_loop()
+        if self._proxy_config is None:
+            self._proxy_config = {
+                "port": port, "host": host, "grpc_port": grpc_port}
+            await self._reconcile_proxies(force=True)
+
+    def get_proxy_info(self) -> Dict[str, Dict]:
+        """{node_id: {name, http_port, grpc_port, healthy}} for routers,
+        CLI status, and drivers discovering their node-local ingress."""
+        host = (self._proxy_config or {}).get("host", "127.0.0.1")
+        return {
+            nid: {"name": ps.name, "http_port": ps.http_port,
+                  "grpc_port": ps.grpc_port, "healthy": ps.healthy,
+                  "host": host}
+            for nid, ps in self._proxies.items()
+        }
+
+    async def _reconcile_proxies(self, force: bool = False) -> None:
+        if self._proxy_config is None or self._shutdown:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_proxy_check < 2.0:
+            return
+        self._last_proxy_check = now
+        try:
+            nodes = await asyncio.to_thread(ray_tpu.nodes)
+        except Exception:
+            return
+        alive = {n["node_id"] for n in nodes if n.get("alive", True)}
+        # drop proxies on dead nodes
+        for nid in list(self._proxies):
+            if nid not in alive:
+                try:
+                    ray_tpu.kill(self._proxies[nid].handle)
+                except Exception:
+                    pass
+                del self._proxies[nid]
+        # health-check existing, restart dead, start missing — concurrently
+        await asyncio.gather(
+            *[self._ensure_node_proxy(nid) for nid in alive],
+            return_exceptions=True)
+
+    async def _ensure_node_proxy(self, node_id: str) -> None:
+        ps = self._proxies.get(node_id)
+        if ps is not None:
+            try:
+                port = await asyncio.to_thread(
+                    ray_tpu.get, ps.handle.ready.remote(), timeout=5.0)
+                ps.http_port = port
+                ps.healthy = True
+                ps.consecutive_failures = 0
+                return
+            except Exception:
+                ps.consecutive_failures += 1
+                if ps.consecutive_failures < ps.FAILURE_THRESHOLD:
+                    return  # one slow probe must not bounce a live proxy
+                ps.healthy = False
+                try:
+                    ray_tpu.kill(ps.handle)
+                except Exception:
+                    pass
+        await self._start_proxy(node_id)
+
+    async def _start_proxy(self, node_id: str) -> None:
+        from ray_tpu.serve._private.proxy import ProxyActor
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+
+        cfg = self._proxy_config or {}
+        self._proxy_generation += 1
+        name = f"SERVE_PROXY::{node_id[:12]}::{self._proxy_generation}"
+
+        def create():
+            return ray_tpu.remote(ProxyActor).options(
+                name=name, namespace=SERVE_NAMESPACE,
+                max_concurrency=64, num_cpus=0.05,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(node_id),
+            ).remote(port=cfg.get("port", 8000),
+                     host=cfg.get("host", "127.0.0.1"),
+                     grpc_port=cfg.get("grpc_port"))
+
+        actor = None
+        try:
+            actor = await asyncio.to_thread(create)
+            http_port = await asyncio.to_thread(
+                ray_tpu.get, actor.ready.remote(), timeout=60.0)
+            grpc_port = None
+            if cfg.get("grpc_port") is not None:
+                grpc_port = await asyncio.to_thread(
+                    ray_tpu.get, actor.get_grpc_port.remote(), timeout=30.0)
+        except Exception:
+            # next reconcile pass retries — but the actor may be ALIVE
+            # (ready just slow): kill it or the orphan keeps the node's
+            # configured port bound forever while unknown to the manager
+            if actor is not None:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+            return
+        ps = _ProxyState(name, actor, node_id)
+        ps.http_port = http_port
+        ps.grpc_port = grpc_port
+        ps.healthy = True
+        if self._shutdown:
+            # shutdown raced this start: don't register a proxy nothing
+            # will ever reap
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+            return
+        self._proxies[node_id] = ps
 
     # ---------------------------------------------------------------- status
     def get_routes(self) -> Dict[str, tuple]:
@@ -155,6 +304,7 @@ class ServeController(LongPollHost):
                 for app_name, deps in list(self._apps.items()):
                     for info in list(deps.values()):
                         await self._reconcile_deployment(app_name, info)
+                await self._reconcile_proxies()
             except Exception:
                 import traceback
 

@@ -92,10 +92,19 @@ class ProxyActor:
         self._poll_task = None
 
     async def ready(self) -> int:
-        """Start the HTTP server + route long-poll; returns bound port."""
+        """Start the HTTP server + route long-poll; returns bound port.
+        Idempotent — the controller reuses it as the health probe."""
         if self._server is None:
-            self._server = await asyncio.start_server(
-                self._handle_conn, self.host, self.port)
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, self.port)
+            except OSError:
+                # per-node proxies all request the configured port; on a
+                # single-host test cluster only one can have it — the
+                # others fall back to an ephemeral port (real multi-host
+                # deployments bind the same port on every node)
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, 0)
             self.port = self._server.sockets[0].getsockname()[1]
             loop = asyncio.get_running_loop()
             self._poll_task = loop.create_task(self._poll_routes())
@@ -115,6 +124,9 @@ class ProxyActor:
             (make_generic_handler(self._get_handle, lambda: self._routes),))
         bound = self._grpc_server.add_insecure_port(
             f"{self.host}:{self.grpc_port}")
+        if bound == 0:
+            # same single-host fallback as the HTTP listener
+            bound = self._grpc_server.add_insecure_port(f"{self.host}:0")
         if bound == 0:
             raise RuntimeError(
                 f"gRPC ingress could not bind {self.host}:{self.grpc_port}"
