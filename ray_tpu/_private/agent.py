@@ -72,6 +72,11 @@ class WorkerHandle:
         self.conn: Optional[Connection] = None  # registration connection
         self.direct_addr: Optional[Dict] = None  # {"host","port","unix"} for PushTask
         self.registered = asyncio.Event()
+        # set when the agent observes the worker gone (exit handler or
+        # watchdog eviction): liveness watchers await this instead of
+        # polling — 1,000 live actors at a 0.5s poll each cost the agent
+        # loop ~2,000 timer wakeups + proc.poll syscalls per second
+        self.exited = asyncio.Event()
         self.leased_to: Optional[str] = None  # lease id
         self.assigned_resources: Optional[ResourceSet] = None
         self.is_actor = False
@@ -751,6 +756,7 @@ class NodeAgent:
             # so a handle processed by both the reaper and the actor
             # watchdog is decremented exactly once.
             self._starting_workers = max(0, self._starting_workers - 1)
+        handle.exited.set()
         self._spawn_slot_freed(handle)
         if handle in self.idle_workers:
             self.idle_workers.remove(handle)
@@ -1163,6 +1169,7 @@ class NodeAgent:
                             # register path that decrements never ran
                             self._starting_workers = max(
                                 0, self._starting_workers - 1)
+                        handle.exited.set()
                         self._spawn_slot_freed(handle)
                         await self.head.call(
                             "ActorDied",
@@ -1183,8 +1190,15 @@ class NodeAgent:
         # resources must flow back (the spawn may have failed with
         # proc=None, which `alive` alone reads as still-starting).
         async def watch_release():
+            # event-driven with a slow fallback poll: N live actors must
+            # not cost the loop N wakeups per poll period
             while handle.alive and handle.worker_id in self.workers:
-                await asyncio.sleep(CONFIG.actor_liveness_poll_s)
+                try:
+                    await asyncio.wait_for(
+                        handle.exited.wait(),
+                        timeout=CONFIG.actor_liveness_poll_s)
+                except asyncio.TimeoutError:
+                    pass
             if pg:
                 pool = self._pg_available.get((pg[0], pg[1]))
                 if pool is not None:

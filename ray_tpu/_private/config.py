@@ -110,10 +110,18 @@ _flag("actor_call_batch_max", 64)  # specs per PushTaskBatch frame
 
 # --- round-3 sweep 2: poll cadences + 2PC/bootstrap deadlines ----------------
 _flag("actor_resource_wait_poll_s", 0.1)  # actor waiting on node/PG capacity
-_flag("actor_liveness_poll_s", 0.5)  # agent's hold-resources-until-death poll
+# Fallback poll for the agent's hold-resources-until-death watcher. The
+# watcher is event-driven (WorkerHandle.exited); this bounds release lag
+# only for death paths that miss the event.
+_flag("actor_liveness_poll_s", 5.0)
 _flag("object_unlocated_retry_s", 0.1)  # owner knows no location yet
 _flag("object_pull_round_s", 0.2)  # pull-plane round pacing
-_flag("head_save_debounce_s", 0.05)  # snapshot write coalescing window
+# Snapshot write coalescing window. The snapshot is O(cluster state) and
+# is rebuilt on the head loop (+ pickled under the GIL): at 0.05s a
+# 1,000-actor creation burst spent ~20 full-state saves/s on the one
+# core that also schedules the burst. 0.25s bounds the durability gap
+# while cutting that 5x (Redis-backed HA is the real durability path).
+_flag("head_save_debounce_s", 0.25)
 _flag("pg_prepare_timeout_s", 10.0)  # 2PC bundle-prepare RPC deadline
 _flag("pg_retry_place_period_s", 0.5)  # pending-PG placement retry cadence
 _flag("pg_resolve_poll_s", 0.1)  # lease pool waiting for PG placement

@@ -19,6 +19,7 @@ import asyncio
 import json
 import os
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.config import CONFIG
@@ -94,6 +95,10 @@ class HeadServer:
         self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> key -> value
         self.jobs: Dict[str, Dict] = {}
         self.placement_groups: Dict[str, Dict] = {}
+        # (placed_at, ActorInfo) of in-flight placements younger than the
+        # gossip window — the anti-double-booking scan reads this instead
+        # of every actor in the cluster (O(N^2) across a creation burst)
+        self._recent_placements: deque = deque()
         self.subscribers: Dict[str, set] = {}  # channel -> set[Connection]
         self.task_events: List[Dict] = []  # ring buffer of task state transitions
         self.cluster_config = CONFIG.snapshot()
@@ -587,20 +592,23 @@ class HeadServer:
         # count resources already committed to in-flight actor placements
         # against each candidate: a burst of actor creations scheduled off
         # the same gossip snapshot must not all pick the same node
-        # (reference: GcsActorScheduler tracks leased resources per node)
+        # (reference: GcsActorScheduler tracks leased resources per node).
+        # Only RECENT placements count — once the target agent's next
+        # resource report lands (~one gossip period), its advertised
+        # availability already reflects the allocation. The recency window
+        # is tracked in a deque so a 1,000-actor burst scans a handful of
+        # entries per placement instead of every actor in the cluster
+        # (that full scan was O(N^2) across the burst).
         committed: Dict[str, ResourceSet] = {}
         now = time.monotonic()
-        for other in self.actors.values():
+        window = max(1.5, 3 * CONFIG.gossip_period_ms / 1000.0)
+        recent = self._recent_placements
+        while recent and now - recent[0][0] > window:
+            recent.popleft()
+        for placed_at, other in recent:
             if other is info or other.node_id is None:
                 continue
             if other.state not in (ACTOR_PENDING, ACTOR_RESTARTING):
-                continue
-            # only count RECENT placements: once the target agent's next
-            # resource report lands (~one gossip period), its advertised
-            # availability already reflects the allocation and counting it
-            # again would double-book the node for the whole worker boot
-            window = max(1.5, 3 * CONFIG.gossip_period_ms / 1000.0)
-            if now - getattr(other, "placed_at", 0.0) > window:
                 continue
             req = ResourceSet.from_wire(
                 other.spec_wire.get("resources", {}))
@@ -627,6 +635,7 @@ class HeadServer:
         node = pool[0]
         info.node_id = node.node_id
         info.placed_at = time.monotonic()
+        self._recent_placements.append((info.placed_at, info))
         try:
             await node.conn.push("StartActor", {"spec": info.spec_wire,
                                                 "actor_id": info.actor_id})
@@ -807,8 +816,14 @@ class HeadServer:
         return True
 
     async def _retry_place_pg(self, pg_id: str) -> None:
+        first = True
         while True:
-            await asyncio.sleep(CONFIG.pg_retry_place_period_s)
+            # fast first retry: a create racing its predecessor's bundle
+            # return (concurrent handler dispatch) should land on the
+            # next tick, not pay the full retry period
+            await asyncio.sleep(0.05 if first
+                                else CONFIG.pg_retry_place_period_s)
+            first = False
             pg = self.placement_groups.get(pg_id)
             if pg is None or pg["state"] != "PENDING":
                 return

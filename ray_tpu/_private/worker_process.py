@@ -513,15 +513,27 @@ class Executor:
         pg = spec.get("pg")
         if pg:
             self.worker.current_placement_group_id = pg[0]
-        await self.worker.head.call(
-            "ActorReady",
-            {
-                "actor_id": payload["actor_id"],
-                "addr": self.worker.direct_addr(),
-                "node_id": self.worker.node_id,
-                "pid": os.getpid(),
-            },
-        )
+        # The readiness report MUST land or this process must die: a
+        # dropped head connection here (seen under 1,000-actor bursts)
+        # would otherwise leave a zombie — alive, never ALIVE in the head,
+        # its callers hanging forever. The head watchdog reconnects
+        # between attempts; persistent failure exits so the agent reports
+        # ActorDied and callers fail fast.
+        ready_payload = {
+            "actor_id": payload["actor_id"],
+            "addr": self.worker.direct_addr(),
+            "node_id": self.worker.node_id,
+            "pid": os.getpid(),
+        }
+        for attempt in range(10):
+            try:
+                await self.worker.head.call("ActorReady", ready_payload)
+                break
+            except Exception:
+                if attempt == 9:
+                    traceback.print_exc()
+                    os._exit(1)
+                await asyncio.sleep(0.5 + 0.5 * attempt)
 
 
 def _u32(i: int) -> bytes:

@@ -462,7 +462,10 @@ class CompiledDAG:
             try:
                 self._input_channels[i].write(input_val)
             except TimeoutError:
-                self._partial_input = (input_val, i)
+                if i > 0 or start_idx > 0:
+                    # genuinely partial: must resume with THIS value
+                    self._partial_input = (input_val, i)
+                # else nothing was written — plain retry-safe backpressure
                 raise
 
     def _result_for(self, seq: int, timeout: Optional[float]) -> Any:

@@ -13,6 +13,7 @@ slice-name custom resource (see ray_tpu._private.accelerators.tpu).
 
 from __future__ import annotations
 
+import asyncio
 import os
 import time
 from typing import Dict, List, Optional
@@ -35,6 +36,10 @@ class PlacementGroup:
     def __init__(self, id_hex: str, bundles: Optional[List[Dict[str, float]]] = None):
         self.id_hex = id_hex
         self._bundles = bundles
+        # state from the create reply: a PG born CREATED lets wait()
+        # return without a head round trip (the churn hot path —
+        # reference: ray_perf.py PG section). Cleared on remove.
+        self._create_state: Optional[str] = None
 
     @property
     def id(self) -> str:
@@ -68,6 +73,12 @@ class PlacementGroup:
     def wait(self, timeout_seconds: float = 30) -> bool:
         """Block until all bundles are reserved (reference:
         placement_group.py wait)."""
+        if self._create_state == "CREATED":
+            # one-shot: the create reply proves the FIRST wait; later
+            # waits re-query so a removal through another handle (e.g.
+            # get_placement_group(name)) can't be masked by this cache
+            self._create_state = None
+            return True
         deadline = time.monotonic() + timeout_seconds
         while True:
             t = self._table()
@@ -121,7 +132,7 @@ def placement_group(
 
     w = _worker()
     pg_id = os.urandom(14).hex()
-    w._acall(w.head.call("CreatePlacementGroup", {
+    reply = w._acall(w.head.call("CreatePlacementGroup", {
         "pg_id": pg_id,
         # Head-side bundle state is fixed-point wire form (resources.py).
         "bundles": [ResourceSet(b).to_wire() for b in bundles],
@@ -129,13 +140,36 @@ def placement_group(
         "name": name,
         "lifetime": lifetime or "",
     }))
-    return PlacementGroup(pg_id, [dict(b) for b in bundles])
+    pg = PlacementGroup(pg_id, [dict(b) for b in bundles])
+    pg._create_state = (reply or {}).get("state")
+    return pg
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
-    """Release all bundles (reference: placement_group.py:257)."""
+    """Release all bundles (reference: placement_group.py:257).
+
+    Fire-and-forget: not awaiting the reply halves the churn cycle's
+    round trips (reference removal is likewise asynchronous
+    server-side). The remove frame is queued before any later call's
+    frame, but the head dispatches handlers concurrently, so a
+    create-after-remove can race the bundle return — such a create lands
+    PENDING and the head's retry loop places it once the bundles are
+    back (first retry is fast). A dropped head connection retries after
+    the watchdog reconnects; only a permanently-gone head is abandoned
+    (the PG dies with it)."""
     w = _worker()
-    w._acall(w.head.call("RemovePlacementGroup", {"pg_id": pg.id_hex}))
+    pg._create_state = None  # wait() must re-query after removal
+
+    async def send() -> None:
+        for attempt in range(5):
+            try:
+                await w.head.call("RemovePlacementGroup",
+                                  {"pg_id": pg.id_hex})
+                return
+            except Exception:
+                await asyncio.sleep(0.5 * (attempt + 1))
+
+    asyncio.run_coroutine_threadsafe(send(), w.loop)
 
 
 def get_placement_group(name: str) -> PlacementGroup:
