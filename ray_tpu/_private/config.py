@@ -82,6 +82,10 @@ _flag("max_lineage_bytes", 64 * 1024 * 1024)
 
 # --- control plane ----------------------------------------------------------
 _flag("gossip_period_ms", 100)  # resource-view sync cadence (ray_syncer analog)
+# Collective payloads above this ride the object plane (put/get between
+# members, worker<->worker); below it they inline through the rendezvous
+# store (one RPC beats put+get for metadata-sized tensors).
+_flag("collective_inline_max_bytes", 65536)
 _flag("pubsub_poll_timeout_s", 30)
 _flag("kv_namespace_default", "default")
 _flag("metrics_report_interval_ms", 5_000)

@@ -605,7 +605,13 @@ class HeadServer:
         recent = self._recent_placements
         while recent and now - recent[0][0] > window:
             recent.popleft()
+        # dedupe by actor: a retried placement appends a second entry for
+        # the same (mutated) ActorInfo — counting both would double-book
+        # its request against its current node
+        latest = {}
         for placed_at, other in recent:
+            latest[id(other)] = other
+        for other in latest.values():
             if other is info or other.node_id is None:
                 continue
             if other.state not in (ACTOR_PENDING, ACTOR_RESTARTING):
@@ -899,13 +905,17 @@ class HeadServer:
         pg = self.placement_groups.get(p["pg_id"])
         if not pg:
             return {"ok": False}
-        if pg.get("placement"):
-            for idx, node_id in enumerate(pg["placement"]):
+        # mark REMOVED before any await: handlers dispatch concurrently,
+        # so a Get/Create processed mid-removal must already see the
+        # terminal state (and _try_place_pg's state check must abort)
+        placement = pg.get("placement")
+        pg["state"] = "REMOVED"
+        if placement:
+            for idx, node_id in enumerate(placement):
                 node = self.nodes.get(node_id)
                 if node and node.alive:
                     await node.conn.push("ReturnPGBundle",
                                          {"pg_id": p["pg_id"], "bundle_index": idx})
-        pg["state"] = "REMOVED"
         self._schedule_save()
         return {"ok": True}
 

@@ -1,11 +1,18 @@
 """Host-memory (gloo-equivalent) collective group.
 
 Reference analog: python/ray/util/collective/collective_group/
-gloo_collective_group.py (565 LoC). Transport is the named store actor; every
-collective is gather-compute: all members contribute their tensor, each
-member pulls the completed set and reduces locally. That is O(n) traffic like
-gloo's default ring for the small host tensors this backend is for (rendezvous
-metadata, rewards, eval scalars) — device tensors belong on the XLA backend.
+gloo_collective_group.py (565 LoC). Rendezvous rides the named store actor;
+every collective is gather-compute: all members contribute, each member
+pulls the completed set and reduces locally.
+
+Payload transport is SIZE-SPLIT (reference: NCCL/gloo groups move bulk
+tensors peer-to-peer, nccl_collective_group.py:127): tensors above
+``collective_inline_max_bytes`` are ``ray_tpu.put`` into the object plane
+and only their ObjectRefs cross the rendezvous store — members fetch the
+bytes worker<->worker through the owner service/object plane (zero-copy
+shm on one node, chunked pull across nodes), so the store never relays
+O(members x bytes) through one process. Metadata-sized tensors stay
+inline (one RPC beats put+get).
 """
 
 from __future__ import annotations
@@ -59,6 +66,10 @@ class CPUGroup(BaseGroup):
         _rt.get(self._store.register.remote(rank))
         self._seq = 0
         self._p2p_seq: dict = {}
+        # owner-side pins for object-plane payloads: the CONTRIBUTOR must
+        # hold its ref until every member fetched (refs relayed through
+        # the store do not keep the owner's record alive on their own)
+        self._p2p_pins: dict = {}
 
     @classmethod
     def backend(cls) -> str:
@@ -80,22 +91,72 @@ class CPUGroup(BaseGroup):
         self._seq += 1
         return f"{op}:{self._seq}"
 
+    @staticmethod
+    def _wire_nbytes(wire) -> int:
+        if wire is None:
+            return 0
+        if isinstance(wire, (list, tuple)):
+            return sum(CPUGroup._wire_nbytes(w) for w in wire)
+        return int(getattr(wire, "nbytes", 0) or 0)
+
+    def _boxed(self, wire):
+        """("v", payload) inline, or ("r", ObjectRef) via the object plane
+        for bulk tensors — the rendezvous store then carries ~20 bytes."""
+        from ray_tpu._private.config import CONFIG
+
+        if self._wire_nbytes(wire) <= CONFIG.collective_inline_max_bytes:
+            return ("v", wire)
+        import ray_tpu
+
+        return ("r", ray_tpu.put(wire))
+
+    @staticmethod
+    def _unboxed(boxed):
+        tag, v = boxed
+        if tag == "v":
+            return v
+        import ray_tpu
+
+        return ray_tpu.get(v)
+
     def _exchange(self, op: str, payload: Any, timeout_ms: int) -> List[Any]:
         import ray_tpu
 
         key = self._next_key(op)
-        ray_tpu.get(self._store.contribute.remote(key, self._rank, payload))
+        boxed = self._boxed(payload)
+        # OWNER pin: our put ref must outlive every member's fetch — the
+        # copies relayed through the store don't keep the owner's record
+        pin = boxed[1] if boxed[0] == "r" else None
+        ray_tpu.get(self._store.contribute.remote(key, self._rank, boxed))
         deadline = time.time() + timeout_ms / 1000.0
         while True:
             out = ray_tpu.get(
                 self._store.collect.remote(key, self._world_size))
             if out is not None:
-                return out
+                break
             if time.time() > deadline:
                 raise TimeoutError(
                     f"collective {op} timed out in group "
                     f"{self._group_name!r} (rank {self._rank})")
             time.sleep(_POLL_S)
+        vals = [self._unboxed(b) for b in out]
+        if any(isinstance(b, tuple) and b and b[0] == "r" for b in out):
+            # bytes fetched: count our confirm, then hold the pin until
+            # EVERY member confirmed (the op is already a barrier — this
+            # only extends it to the slowest fetcher). The pin phase gets
+            # its OWN full timeout window: collect may have consumed most
+            # of the shared deadline, and dropping the only pin while a
+            # slower member is mid-fetch would lose its payload.
+            ray_tpu.get(self._store.confirm.remote(key, self._world_size))
+            pin_deadline = time.time() + timeout_ms / 1000.0
+            while pin is not None:
+                if ray_tpu.get(self._store.op_done.remote(key)):
+                    break
+                if time.time() > pin_deadline:
+                    break  # give up pinning, not the result
+                time.sleep(_POLL_S)
+            del pin
+        return vals
 
     # host<->transport hooks, overridden by the XLA group
     def _to_wire(self, tensor) -> np.ndarray:
@@ -153,7 +214,17 @@ class CPUGroup(BaseGroup):
         seq = self._p2p_seq.get(pair, 0) + 1
         self._p2p_seq[pair] = seq
         key = f"sr:{self._rank}:{opts.dst_rank}:{seq}"
-        ray_tpu.get(self._store.put_p2p.remote(key, self._to_wire(tensor)))
+        boxed = self._boxed(self._to_wire(tensor))
+        if boxed[0] == "r":
+            # owner pin until the receiver confirms the fetch; pruned
+            # lazily on later sends and at destroy_group
+            self._p2p_pins[key] = boxed[1]
+        ray_tpu.get(self._store.put_p2p.remote(key, boxed))
+        if self._p2p_pins:
+            gone = ray_tpu.get(
+                self._store.p2p_absent.remote(list(self._p2p_pins)))
+            for k in gone:
+                self._p2p_pins.pop(k, None)
 
     def recv(self, like, opts: RecvOptions):
         import ray_tpu
@@ -168,7 +239,10 @@ class CPUGroup(BaseGroup):
                 # Commit the sequence number only on success so a timed-out
                 # recv can be retried without desynchronizing the pair.
                 self._p2p_seq[pair] = seq
-                return self._from_wire(np.asarray(boxed[0]), like)
+                value = self._unboxed(boxed[0])
+                # bytes are fetched: the store may now drop its pin
+                ray_tpu.get(self._store.confirm_p2p.remote(key))
+                return self._from_wire(np.asarray(value), like)
             if time.time() > deadline:
                 raise TimeoutError(
                     f"recv from rank {opts.src_rank} timed out "

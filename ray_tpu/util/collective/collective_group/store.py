@@ -1,10 +1,12 @@
-"""Named rendezvous/transport store actor for host-side collectives.
+"""Named rendezvous store actor for host-side collectives.
 
 Reference analog: python/ray/util/collective/collective_group/gloo_util.py:29-98
-(the named-actor Store used for gloo rendezvous). Here the store carries both
-rendezvous *and* the cross-member payloads of the DCN fallback path: on a real
-multi-host TPU pod, bulk traffic rides ICI inside the global XLA mesh and this
-store only ever sees group metadata.
+(the named-actor Store used for gloo rendezvous). The store carries
+rendezvous state and INLINE payloads only for metadata-sized tensors;
+bulk tensors cross as ObjectRefs whose bytes move worker<->worker through
+the object plane (cpu_group._boxed), so this actor never relays
+O(members x bytes). On a real multi-host TPU pod, bulk traffic rides ICI
+inside the global XLA mesh and this store only ever sees group metadata.
 
 All methods are non-blocking so a ``max_concurrency=1`` actor can serve every
 member; callers poll.
@@ -24,6 +26,8 @@ class CollectiveStore:
         self._parts: Dict[str, Dict[int, Any]] = {}
         # op_key -> number of members that already read the completed set
         self._reads: Dict[str, int] = {}
+        # op_key -> number of members that finished fetching boxed refs
+        self._confirms: Dict[str, int] = {}
         self._p2p: Dict[str, Any] = {}
         self._members: Dict[int, float] = {}
 
@@ -46,25 +50,55 @@ class CollectiveStore:
     def collect(self, op_key: str, world_size: int) -> Optional[List[Any]]:
         """Return payloads ordered by rank once all members contributed.
 
-        The entry is garbage-collected after ``world_size`` successful reads.
+        Inline entries are garbage-collected after ``world_size``
+        successful reads. Entries holding ObjectRefs (bulk payloads riding
+        the object plane) are kept until every member ``confirm``s its
+        fetch — this actor's copies are what pin the objects while slower
+        members are still pulling the bytes.
         """
         parts = self._parts.get(op_key)
         if parts is None or len(parts) < world_size:
             return None
         out = [parts[r] for r in range(world_size)]
+        boxed_refs = any(isinstance(p, tuple) and p and p[0] == "r"
+                         for p in out)
         reads = self._reads.get(op_key, 0) + 1
-        if reads >= world_size:
+        if reads >= world_size and not boxed_refs:
             del self._parts[op_key]
             self._reads.pop(op_key, None)
         else:
             self._reads[op_key] = reads
         return out
 
+    def confirm(self, op_key: str, world_size: int) -> None:
+        """A member finished FETCHING a boxed entry's payloads; the entry
+        (and the refs pinning the objects) drops after the last one."""
+        confirms = self._confirms.get(op_key, 0) + 1
+        if confirms >= world_size:
+            self._parts.pop(op_key, None)
+            self._reads.pop(op_key, None)
+            self._confirms.pop(op_key, None)
+        else:
+            self._confirms[op_key] = confirms
+
     def put_p2p(self, key: str, payload: Any) -> None:
         self._p2p[key] = payload
 
     def take_p2p(self, key: str) -> Optional[List[Any]]:
-        """Boxed result ([payload] or None) so None payloads round-trip."""
+        """Boxed result ([payload] or None) so None payloads round-trip.
+        NON-destructive: the entry (whose ref pins an object-plane
+        payload) drops only on confirm_p2p, after the receiver fetched."""
         if key in self._p2p:
-            return [self._p2p.pop(key)]
+            return [self._p2p[key]]
         return None
+
+    def confirm_p2p(self, key: str) -> None:
+        self._p2p.pop(key, None)
+
+    def op_done(self, op_key: str) -> bool:
+        """True once the entry is fully confirmed and dropped."""
+        return op_key not in self._parts
+
+    def p2p_absent(self, keys: List[str]) -> List[str]:
+        """Which of these p2p entries are gone (receiver confirmed)."""
+        return [k for k in keys if k not in self._p2p]

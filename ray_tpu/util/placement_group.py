@@ -157,6 +157,8 @@ def remove_placement_group(pg: PlacementGroup) -> None:
     back (first retry is fast). A dropped head connection retries after
     the watchdog reconnects; only a permanently-gone head is abandoned
     (the PG dies with it)."""
+    import threading
+
     w = _worker()
     pg._create_state = None  # wait() must re-query after removal
 
@@ -169,7 +171,30 @@ def remove_placement_group(pg: PlacementGroup) -> None:
             except Exception:
                 await asyncio.sleep(0.5 * (attempt + 1))
 
-    asyncio.run_coroutine_threadsafe(send(), w.loop)
+    queued = threading.Event()
+
+    def kick() -> None:
+        # call_future queues the remove frame SYNCHRONOUSLY (loop thread),
+        # so by the time this function returns the frame is ordered ahead
+        # of any later head call from this driver and a driver that
+        # removes-and-exits can't lose the removal; failures fall back to
+        # the retrying coroutine (reconnect via the head watchdog)
+        try:
+            fut = w.head.call_future("RemovePlacementGroup",
+                                     {"pg_id": pg.id_hex})
+
+            def on_done(f) -> None:
+                if not f.cancelled() and f.exception() is not None:
+                    asyncio.ensure_future(send(), loop=w.loop)
+
+            fut.add_done_callback(on_done)
+        except Exception:
+            asyncio.ensure_future(send(), loop=w.loop)
+        finally:
+            queued.set()
+
+    w.loop.call_soon_threadsafe(kick)
+    queued.wait(timeout=5.0)
 
 
 def get_placement_group(name: str) -> PlacementGroup:

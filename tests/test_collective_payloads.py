@@ -1,0 +1,99 @@
+"""P2P payload plane for the collective API (VERDICT r4 #5): bulk
+tensors cross between members through the owner service/object plane
+(ObjectRefs over the rendezvous store, bytes worker<->worker); the store
+relays only metadata. Correctness at 100 MB across 4 member actors, and
+the object path beats forced store-relay ≥2x (reference:
+nccl_collective_group.py:127 p2p semantics, gloo_collective_group.py)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture(scope="module")
+def ray8():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class BulkMember:
+    def __init__(self, rank, world_size, group, inline_max=None):
+        if inline_max is not None:
+            # env is authoritative on every CONFIG read — lets the bench
+            # force the store-relay path in this member's process
+            os.environ["RAY_TPU_COLLECTIVE_INLINE_MAX_BYTES"] = \
+                str(inline_max)
+        self.rank = rank
+        self.ws = world_size
+        self.group = group
+        col.init_collective_group(world_size, rank, backend="cpu",
+                                  group_name=group)
+
+    def allreduce_mb(self, mbytes: int, check: bool = True):
+        n = mbytes * 1024 * 1024 // 4
+        x = np.full((n,), float(self.rank + 1), np.float32)
+        t0 = time.perf_counter()
+        out = col.allreduce(x, group_name=self.group)
+        dt = time.perf_counter() - t0
+        if check:
+            want = float(self.ws * (self.ws + 1) / 2)
+            assert out.shape == (n,), out.shape
+            assert float(out[0]) == want and float(out[-1]) == want, (
+                out[0], out[-1], want)
+        return dt
+
+    def sendrecv_mb(self, mbytes: int):
+        n = mbytes * 1024 * 1024 // 4
+        if self.rank == 0:
+            col.send(np.full((n,), 7.0, np.float32), dst_rank=1,
+                     group_name=self.group)
+            return True
+        out = col.recv(np.empty((n,), np.float32), src_rank=0,
+                       group_name=self.group)
+        return bool(out[0] == 7.0 and out[-1] == 7.0)
+
+
+def test_100mb_allreduce_4_members(ray8):
+    ms = [BulkMember.remote(r, 4, "bulk100") for r in range(4)]
+    times = ray_tpu.get(
+        [m.allreduce_mb.remote(100) for m in ms], timeout=600)
+    assert len(times) == 4
+    # and a bulk p2p send/recv through the same plane
+    ms2 = [BulkMember.remote(r, 2, "bulkp2p") for r in range(2)]
+    ok = ray_tpu.get([m.sendrecv_mb.remote(32) for m in ms2], timeout=300)
+    assert ok[1] is True
+    for m in ms + ms2:
+        ray_tpu.kill(m)
+
+
+def test_object_plane_beats_store_relay(ray8):
+    """The point of the split: the store must not relay O(members x
+    bytes). Forced-inline members funnel every byte through the
+    rendezvous actor; default members move bytes via the object plane."""
+    mb = 24
+    relay = [BulkMember.remote(r, 4, "relay", inline_max=1 << 40)
+             for r in range(4)]
+    ray_tpu.get([m.allreduce_mb.remote(1, False) for m in relay],
+                timeout=300)  # warm
+    t_relay = max(ray_tpu.get(
+        [m.allreduce_mb.remote(mb, False) for m in relay], timeout=600))
+
+    plane = [BulkMember.remote(r, 4, "plane") for r in range(4)]
+    ray_tpu.get([m.allreduce_mb.remote(1, False) for m in plane],
+                timeout=300)  # warm
+    t_plane = max(ray_tpu.get(
+        [m.allreduce_mb.remote(mb, False) for m in plane], timeout=600))
+
+    for m in relay + plane:
+        ray_tpu.kill(m)
+    assert t_plane * 2 <= t_relay, (
+        f"object plane {t_plane:.2f}s not ≥2x faster than "
+        f"store relay {t_relay:.2f}s")
