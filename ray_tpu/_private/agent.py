@@ -1790,6 +1790,11 @@ def main() -> None:
     args = parser.parse_args()
 
     async def run():
+        import signal
+
+        from ray_tpu._private import proc_profile
+
+        prof = proc_profile.maybe_start()
         agent = NodeAgent(
             node_id=args.node_id,
             session_dir=args.session_dir,
@@ -1805,7 +1810,15 @@ def main() -> None:
             with open(args.ready_file, "w") as f:
                 f.write(json.dumps({"unix_path": agent.unix_path,
                                     "tcp_port": agent.tcp_port}))
-        await asyncio.Event().wait()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+        await stop.wait()
+        proc_profile.dump(prof, "agent")
 
     asyncio.run(run())
 

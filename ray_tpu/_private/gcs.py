@@ -995,8 +995,10 @@ def main() -> None:
     async def run():
         import signal
 
+        from ray_tpu._private import proc_profile
         from ray_tpu._private.event import init_event_log, report_event
 
+        prof = proc_profile.maybe_start()
         init_event_log(args.session_dir, "head")
         report_event("INFO", "HEAD_STARTED", "head control plane starting")
         head = HeadServer(args.session_dir, args.port,
@@ -1012,6 +1014,7 @@ def main() -> None:
         await stop.wait()
         # flush the last debounce window so a clean stop loses nothing
         head._save_state()
+        proc_profile.dump(prof, "head")
 
     asyncio.run(run())
 

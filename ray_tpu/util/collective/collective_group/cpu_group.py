@@ -78,6 +78,9 @@ class CPUGroup(BaseGroup):
     def destroy_group(self) -> None:
         import ray_tpu
 
+        # drop owner-side pins for any still-unfetched bulk sends (the
+        # store's TTL sweep reclaims the matching entries)
+        self._p2p_pins.clear()
         try:
             remaining = ray_tpu.get(self._store.deregister.remote(self._rank))
             if remaining == 0:
@@ -119,6 +122,18 @@ class CPUGroup(BaseGroup):
 
         return ray_tpu.get(v)
 
+    @staticmethod
+    def _unbox_all(boxed_list):
+        """Resolve a whole collected set: all object-plane refs fetch in
+        ONE batched get so cross-worker pulls overlap instead of running
+        back-to-back (the win grows with world size)."""
+        import ray_tpu
+
+        refs = [b[1] for b in boxed_list if b[0] == "r"]
+        fetched = iter(ray_tpu.get(refs) if refs else [])
+        return [next(fetched) if b[0] == "r" else b[1]
+                for b in boxed_list]
+
     def _exchange(self, op: str, payload: Any, timeout_ms: int) -> List[Any]:
         import ray_tpu
 
@@ -139,7 +154,7 @@ class CPUGroup(BaseGroup):
                     f"collective {op} timed out in group "
                     f"{self._group_name!r} (rank {self._rank})")
             time.sleep(_POLL_S)
-        vals = [self._unboxed(b) for b in out]
+        vals = self._unbox_all(out)
         if any(isinstance(b, tuple) and b and b[0] == "r" for b in out):
             # bytes fetched: count our confirm, then hold the pin until
             # EVERY member confirmed (the op is already a barrier — this
@@ -240,8 +255,10 @@ class CPUGroup(BaseGroup):
                 # recv can be retried without desynchronizing the pair.
                 self._p2p_seq[pair] = seq
                 value = self._unboxed(boxed[0])
-                # bytes are fetched: the store may now drop its pin
-                ray_tpu.get(self._store.confirm_p2p.remote(key))
+                if boxed[0][0] == "r":
+                    # bytes fetched: the store may now drop its pin
+                    # (inline entries were popped by take_p2p itself)
+                    ray_tpu.get(self._store.confirm_p2p.remote(key))
                 return self._from_wire(np.asarray(value), like)
             if time.time() > deadline:
                 raise TimeoutError(

@@ -28,6 +28,8 @@ class CollectiveStore:
         self._reads: Dict[str, int] = {}
         # op_key -> number of members that finished fetching boxed refs
         self._confirms: Dict[str, int] = {}
+        # op_key / p2p key -> creation time (orphan TTL sweeps)
+        self._born: Dict[str, float] = {}
         self._p2p: Dict[str, Any] = {}
         self._members: Dict[int, float] = {}
 
@@ -42,8 +44,25 @@ class CollectiveStore:
         self._members.pop(rank, None)
         return len(self._members)
 
+    # entries older than this are orphans (a member died/timed out and
+    # its confirms will never arrive): drop them so their ObjectRefs stop
+    # pinning bulk payloads forever
+    ORPHAN_TTL_S = 600.0
+
+    def _sweep_orphans(self) -> None:
+        now = time.time()
+        for key, born in list(self._born.items()):
+            if now - born > self.ORPHAN_TTL_S:
+                self._parts.pop(key, None)
+                self._reads.pop(key, None)
+                self._confirms.pop(key, None)
+                self._p2p.pop(key, None)
+                del self._born[key]
+
     def contribute(self, op_key: str, rank: int, payload: Any) -> int:
+        self._sweep_orphans()
         parts = self._parts.setdefault(op_key, {})
+        self._born.setdefault(op_key, time.time())
         parts[rank] = payload
         return len(parts)
 
@@ -78,22 +97,33 @@ class CollectiveStore:
             self._parts.pop(op_key, None)
             self._reads.pop(op_key, None)
             self._confirms.pop(op_key, None)
+            self._born.pop(op_key, None)
         else:
             self._confirms[op_key] = confirms
 
     def put_p2p(self, key: str, payload: Any) -> None:
+        self._sweep_orphans()
         self._p2p[key] = payload
+        self._born.setdefault(key, time.time())
 
     def take_p2p(self, key: str) -> Optional[List[Any]]:
         """Boxed result ([payload] or None) so None payloads round-trip.
-        NON-destructive: the entry (whose ref pins an object-plane
-        payload) drops only on confirm_p2p, after the receiver fetched."""
-        if key in self._p2p:
-            return [self._p2p[key]]
-        return None
+
+        Inline ("v") entries pop destructively — one round trip, the
+        common metadata-sized path. Object-plane ("r") entries stay until
+        confirm_p2p (their ref pins the payload while the receiver is
+        still fetching the bytes)."""
+        boxed = self._p2p.get(key)
+        if boxed is None:
+            return None
+        if isinstance(boxed, tuple) and boxed and boxed[0] == "v":
+            self._p2p.pop(key, None)
+            self._born.pop(key, None)
+        return [boxed]
 
     def confirm_p2p(self, key: str) -> None:
         self._p2p.pop(key, None)
+        self._born.pop(key, None)
 
     def op_done(self, op_key: str) -> bool:
         """True once the entry is fully confirmed and dropped."""

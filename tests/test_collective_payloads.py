@@ -79,18 +79,25 @@ def test_object_plane_beats_store_relay(ray8):
     bytes). Forced-inline members funnel every byte through the
     rendezvous actor; default members move bytes via the object plane."""
     mb = 24
+
+    def best_of_2(members):
+        times = []
+        for _ in range(2):
+            times.append(max(ray_tpu.get(
+                [m.allreduce_mb.remote(mb, False) for m in members],
+                timeout=600)))
+        return min(times)  # best-of-N damps shared-box noise
+
     relay = [BulkMember.remote(r, 4, "relay", inline_max=1 << 40)
              for r in range(4)]
     ray_tpu.get([m.allreduce_mb.remote(1, False) for m in relay],
                 timeout=300)  # warm
-    t_relay = max(ray_tpu.get(
-        [m.allreduce_mb.remote(mb, False) for m in relay], timeout=600))
+    t_relay = best_of_2(relay)
 
     plane = [BulkMember.remote(r, 4, "plane") for r in range(4)]
     ray_tpu.get([m.allreduce_mb.remote(1, False) for m in plane],
                 timeout=300)  # warm
-    t_plane = max(ray_tpu.get(
-        [m.allreduce_mb.remote(mb, False) for m in plane], timeout=600))
+    t_plane = best_of_2(plane)
 
     for m in relay + plane:
         ray_tpu.kill(m)

@@ -11,14 +11,17 @@ Reference analog: multi-slice data parallelism over DCN
 communication backend', §7 Phase 3 v5e-multi-slice shape).
 """
 
+import os
 import sys
 
 import pytest
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 @pytest.mark.timeout(900)
 def test_two_slice_hierarchical_psum_and_grad_step():
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, _REPO_ROOT)
     import __graft_entry__ as ge
 
     outs = ge._spawn_entry_workers("--two-slice-worker", 2)
