@@ -53,6 +53,8 @@ FAST_FILES = {
     "test_accelerators.py",
     "test_cpp_client.py",
     "test_tune_bayesopt.py",
+    "test_compiled_dag.py",
+    "test_optional_adapters.py",
 }
 SLOW_TESTS: set = set()
 
