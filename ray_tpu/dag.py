@@ -60,7 +60,10 @@ def _pack_input(input_args: tuple, input_kwargs: dict) -> Any:
 class InputNode(DAGNode):
     """Placeholder for execute()-time input (reference: dag/input_node.py).
 
-    Supports ``with InputNode() as inp:`` for API parity."""
+    Supports ``with InputNode() as inp:`` for API parity, plus
+    ``inp[key]`` / ``inp.attr`` projections (reference:
+    dag/input_node.py InputAttributeNode) usable in both eager and
+    compiled execution."""
 
     def __init__(self):
         super().__init__((), {})
@@ -71,8 +74,38 @@ class InputNode(DAGNode):
     def __exit__(self, *exc):
         return False
 
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key, "getitem")
+
+    def __getattr__(self, name: str) -> "InputAttributeNode":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputAttributeNode(self, name, "getattr")
+
     def _execute_node(self, cache, input_args, input_kwargs):
         return _pack_input(input_args, input_kwargs)
+
+
+class InputAttributeNode(DAGNode):
+    """A projection of the runtime input — ``inp[0]``, ``inp["x"]``,
+    ``inp.field`` (reference: dag/input_node.py InputAttributeNode)."""
+
+    def __init__(self, parent: InputNode, key, kind: str):
+        super().__init__((), {})
+        self._parent = parent
+        self._key = key
+        self._kind = kind
+
+    def _extract(self, value):
+        if self._kind == "getattr":
+            if isinstance(value, dict):
+                return value[self._key]
+            return getattr(value, self._key)
+        return value[self._key]
+
+    def _execute_node(self, cache, input_args, input_kwargs):
+        return self._extract(
+            self._parent._execute_node(cache, input_args, input_kwargs))
 
 
 class FunctionNode(DAGNode):
@@ -350,7 +383,9 @@ class CompiledDAG:
             return Channel(capacity=self._capacity)
 
         edge_ch: Dict[Tuple[int, int], Channel] = {}
-        self._input_channels: List[Channel] = []  # driver-written
+        # driver-written channels: (channel, extractor) — the extractor
+        # projects the execute() input for InputAttributeNode edges
+        self._input_channels: List[Tuple[Channel, Any]] = []
         node_in: Dict[int, List[Channel]] = {}
         node_in_idx: Dict[int, Dict[int, int]] = {}  # node -> dep id -> pos
         for n in compute:
@@ -366,14 +401,16 @@ class CompiledDAG:
                 edge_ch[(id(d), id(n))] = ch
                 idx[id(d)] = len(ins)
                 ins.append(ch)
-                if isinstance(d, InputNode):
-                    self._input_channels.append(ch)
+                if isinstance(d, InputAttributeNode):
+                    self._input_channels.append((ch, d._extract))
+                elif isinstance(d, InputNode):
+                    self._input_channels.append((ch, None))
             if not ins:
                 # constant-only stage: a driver-fed tick channel triggers
                 # one iteration per execute (and carries the sentinel)
                 ch = mkch()
                 ins.append(ch)
-                self._input_channels.append(ch)
+                self._input_channels.append((ch, None))
             node_in[id(n)] = ins
             node_in_idx[id(n)] = idx
 
@@ -458,9 +495,15 @@ class CompiledDAG:
         """Write one execution's input to every driver-fed channel,
         recording progress so a backpressure TimeoutError stays retry-safe
         (a partial write must never silently skew branch iterations)."""
-        for i in range(start_idx, len(self._input_channels)):
+        # project FIRST: a bad input (e.g. KeyError in an inp["x"]
+        # extractor) must fail before ANY channel write, not mid-vector
+        projected = [
+            (extract(input_val) if extract is not None else input_val)
+            for _ch, extract in self._input_channels[start_idx:]]
+        for i, value in zip(range(start_idx, len(self._input_channels)),
+                            projected):
             try:
-                self._input_channels[i].write(input_val)
+                self._input_channels[i][0].write(value)
             except TimeoutError:
                 if i > 0 or start_idx > 0:
                     # genuinely partial: must resume with THIS value
@@ -513,7 +556,7 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
-        for ch in self._input_channels:
+        for ch, _extract in self._input_channels:
             try:
                 ch.write(_Sentinel(), timeout=timeout)
             except Exception:

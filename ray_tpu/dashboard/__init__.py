@@ -139,14 +139,21 @@ class DashboardActor:
         from ray_tpu import serve
 
         try:
-            routes = ray_tpu.get(
-                serve._controller().get_routes.remote(), timeout=10)
+            ctrl = serve._controller()
+            routes = ray_tpu.get(ctrl.get_routes.remote(), timeout=10)
         except Exception:
-            return {"applications": {}}
+            return {"applications": {}, "proxies": {}}
+        try:
+            # per-node ingress map (reference: serve status proxies
+            # section fed by ProxyStateManager)
+            proxies = ray_tpu.get(ctrl.get_proxy_info.remote(), timeout=10)
+        except Exception:
+            proxies = {}
         return {"applications": {
             app: {**serve.status(app), "route_prefix": prefix,
                   "ingress": ingress}
-            for prefix, (app, ingress) in routes.items()}}
+            for prefix, (app, ingress) in routes.items()},
+            "proxies": proxies}
 
     def _api(self, path: str, query=None):
         from ray_tpu.util import state as state_api

@@ -1,0 +1,84 @@
+"""AxSearch adapter (reference: python/ray/tune/search/ax/ax_search.py —
+wraps the Ax service API AxClient). Gated: `ax-platform` is not in this
+image's baked package set — construction raises a clear ImportError."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class AxSearch(Searcher):
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 **kwargs):
+        try:
+            from ax.service.ax_client import AxClient  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "AxSearch requires `ax-platform`, which is not installed "
+                "in this environment. Use the native GP searcher "
+                "(ray_tpu.tune.search.bayesopt) instead.") from e
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self._trials: Dict[str, int] = {}
+        self._build()
+
+    def _build(self) -> None:
+        from ax.service.ax_client import AxClient
+
+        parameters = []
+        self._constants: Dict[str, object] = {}
+        for k, dom in self._space.items():
+            if isinstance(dom, Categorical):
+                parameters.append({"name": k, "type": "choice",
+                                   "values": list(dom.categories)})
+            elif isinstance(dom, Integer):
+                parameters.append({"name": k, "type": "range",
+                                   "bounds": [dom.lower, dom.upper - 1],
+                                   "value_type": "int"})
+            elif isinstance(dom, Float):
+                parameters.append({
+                    "name": k, "type": "range",
+                    "bounds": [dom.lower, dom.upper],
+                    "value_type": "float",
+                    "log_scale": bool(getattr(dom, "log", False))})
+            else:
+                self._constants[k] = dom
+        self._client = AxClient(verbose_logging=False)
+        self._client.create_experiment(
+            parameters=parameters,
+            objective_name=self.metric or "objective",
+            minimize=(self.mode == "min"))
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        """Adopt the Tuner-supplied metric/mode/param_space: Ax bakes the
+        objective name AND direction into the experiment, so rebuild it
+        while no trials are in flight (reference: ax_search.py
+        set_search_properties)."""
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = dict(config)
+        if not self._trials:
+            self._build()
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        params, index = self._client.get_next_trial()
+        self._trials[trial_id] = index
+        out = dict(params)
+        out.update(self._constants)
+        return out
+
+    def on_trial_complete(self, trial_id, result=None,
+                          error: bool = False) -> None:
+        index = self._trials.pop(trial_id, None)
+        if index is None:
+            return
+        if error or not result or self.metric not in result:
+            self._client.log_trial_failure(index)
+            return
+        self._client.complete_trial(
+            index, raw_data=float(result[self.metric]))

@@ -24,10 +24,20 @@ class HyperOptSearch(Searcher):
                 "BasicVariantGenerator (random/grid) instead.") from e
         super().__init__(metric, mode)
         import numpy as np
+
+        self._space = space or {}
+        self._rng = np.random.default_rng(random_state_seed)
+        self._n_initial = n_initial_points
+        self._tid_map: Dict[str, int] = {}
+        self._build()
+
+    def _build(self) -> None:
+        import hyperopt
+        import numpy as np
         from hyperopt import hp
 
         self._hp_space = {}
-        for k, dom in (space or {}).items():
+        for k, dom in self._space.items():
             if isinstance(dom, Categorical):
                 self._hp_space[k] = hp.choice(k, list(dom.categories))
             elif isinstance(dom, Integer):
@@ -41,13 +51,17 @@ class HyperOptSearch(Searcher):
                     self._hp_space[k] = hp.uniform(k, dom.lower, dom.upper)
             else:
                 self._hp_space[k] = dom
-        import hyperopt
-
         self._domain = hyperopt.Domain(lambda c: 0, self._hp_space)
         self._hpopt_trials = hyperopt.Trials()
-        self._rng = np.random.default_rng(random_state_seed)
-        self._n_initial = n_initial_points
-        self._tid_map: Dict[str, int] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        """Adopt the Tuner-supplied metric/mode/param_space (reference:
+        hyperopt_search.py set_search_properties)."""
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = dict(config)
+            self._build()
+        return True
 
     def suggest(self, trial_id: str) -> Optional[Dict]:
         import hyperopt

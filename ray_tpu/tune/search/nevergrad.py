@@ -1,0 +1,78 @@
+"""NevergradSearch adapter (reference: python/ray/tune/search/nevergrad/
+nevergrad_search.py). Gated: `nevergrad` is not in this image's baked
+package set — construction raises a clear ImportError."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class NevergradSearch(Searcher):
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 optimizer: str = "NGOpt", budget: int = 100, **kwargs):
+        try:
+            import nevergrad  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "NevergradSearch requires `nevergrad`, which is not "
+                "installed in this environment. Use BasicVariantGenerator "
+                "or the native TPE searcher instead.") from e
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self._optimizer_name = optimizer
+        self._budget = budget
+        self._candidates: Dict[str, object] = {}
+        self._build()
+
+    def _build(self) -> None:
+        import nevergrad as ng
+
+        params = {}
+        self._constants: Dict[str, object] = {}
+        for k, dom in self._space.items():
+            if isinstance(dom, Categorical):
+                params[k] = ng.p.Choice(list(dom.categories))
+            elif isinstance(dom, Integer):
+                params[k] = ng.p.Scalar(
+                    lower=dom.lower,
+                    upper=dom.upper - 1).set_integer_casting()
+            elif isinstance(dom, Float):
+                if getattr(dom, "log", False):
+                    params[k] = ng.p.Log(lower=dom.lower, upper=dom.upper)
+                else:
+                    params[k] = ng.p.Scalar(lower=dom.lower,
+                                            upper=dom.upper)
+            else:
+                self._constants[k] = dom
+        self._opt = ng.optimizers.registry[self._optimizer_name](
+            parametrization=ng.p.Dict(**params), budget=self._budget)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        """Adopt the Tuner-supplied metric/mode/param_space (reference:
+        nevergrad_search.py set_search_properties)."""
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = dict(config)
+            self._build()
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        cand = self._opt.ask()
+        self._candidates[trial_id] = cand
+        out = dict(cand.value)
+        out.update(self._constants)
+        return out
+
+    def on_trial_complete(self, trial_id, result=None,
+                          error: bool = False) -> None:
+        cand = self._candidates.pop(trial_id, None)
+        if cand is None or error or not result or \
+                self.metric not in result:
+            return
+        val = float(result[self.metric])
+        # nevergrad minimizes; flip for max mode
+        self._opt.tell(cand, -val if self.mode == "max" else val)

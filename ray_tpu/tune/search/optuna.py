@@ -23,13 +23,29 @@ class OptunaSearch(Searcher):
                 "in this environment. Use BasicVariantGenerator (random/"
                 "grid) or HyperOptSearch where available.") from e
         super().__init__(metric, mode)
+        self._space = space or {}
+        self._seed = seed
+        self._trials: Dict[str, object] = {}
+        self._build()
+
+    def _build(self) -> None:
         import optuna
 
-        self._space = space or {}
         self._study = optuna.create_study(
-            direction="maximize" if (mode or "max") == "max" else "minimize",
-            sampler=optuna.samplers.TPESampler(seed=seed))
-        self._trials: Dict[str, "optuna.trial.Trial"] = {}
+            direction="maximize" if (self.mode or "max") == "max"
+            else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=self._seed))
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        """Adopt the Tuner-supplied metric/mode/param_space (reference:
+        optuna_search.py set_search_properties): the study's DIRECTION is
+        baked at creation, so rebuild it while no trials are in flight."""
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = config
+        if not self._trials:
+            self._build()
+        return True
 
     def _suggest_param(self, ot, name, dom):
         if isinstance(dom, Categorical):

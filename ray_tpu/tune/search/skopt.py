@@ -1,0 +1,78 @@
+"""SkOptSearch adapter (reference: python/ray/tune/search/skopt/
+skopt_search.py). Gated: `scikit-optimize` is not in this image's baked
+package set — construction raises a clear ImportError; the adapter logic
+activates when skopt is importable."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class SkOptSearch(Searcher):
+    def __init__(self, space: Optional[Dict] = None,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 **kwargs):
+        try:
+            import skopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "SkOptSearch requires `scikit-optimize` (skopt), which is "
+                "not installed in this environment. Use the native "
+                "GP searcher (ray_tpu.tune.search.bayesopt) instead.") from e
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self._points: Dict[str, list] = {}
+        self._build()
+
+    def _build(self) -> None:
+        import skopt
+
+        self._names: List[str] = []
+        self._constants: Dict[str, object] = {}
+        dims = []
+        for k, dom in self._space.items():
+            if isinstance(dom, Categorical):
+                dims.append(skopt.space.Categorical(
+                    list(dom.categories), name=k))
+            elif isinstance(dom, Integer):
+                dims.append(skopt.space.Integer(
+                    dom.lower, dom.upper - 1, name=k))
+            elif isinstance(dom, Float):
+                prior = "log-uniform" if getattr(dom, "log", False) \
+                    else "uniform"
+                dims.append(skopt.space.Real(
+                    dom.lower, dom.upper, prior=prior, name=k))
+            else:
+                self._constants[k] = dom
+                continue
+            self._names.append(k)
+        self._opt = skopt.Optimizer(dims)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        """Adopt the Tuner-supplied metric/mode/param_space (reference:
+        skopt_search.py set_search_properties)."""
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = dict(config)
+            self._build()
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        point = self._opt.ask()
+        self._points[trial_id] = point
+        out = dict(zip(self._names, point))
+        out.update(self._constants)
+        return out
+
+    def on_trial_complete(self, trial_id, result=None,
+                          error: bool = False) -> None:
+        point = self._points.pop(trial_id, None)
+        if point is None or error or not result or \
+                self.metric not in result:
+            return
+        val = float(result[self.metric])
+        # skopt minimizes; flip for max mode
+        self._opt.tell(point, -val if self.mode == "max" else val)

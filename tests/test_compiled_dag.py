@@ -98,6 +98,28 @@ class TestCompiledCorrectness:
         finally:
             compiled.teardown()
 
+    def test_input_attribute_projections(self, ray4):
+        """inp[key] / inp.field projections (reference:
+        dag/input_node.py InputAttributeNode) in eager AND compiled
+        execution — each branch receives only its projection."""
+        with InputNode() as inp:
+            a = plus_one.bind(inp["x"])
+            b = times_two.bind(inp["y"])
+            dag = add.bind(a, b)
+        # eager
+        assert ray_tpu.get(dag.execute({"x": 3, "y": 5})) == 14
+        # compiled: the driver projects per input channel
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute({"x": 3, "y": 5}).get(timeout=60) == 14
+            assert compiled.execute({"x": 0, "y": 1}).get(timeout=60) == 3
+            # a bad input fails BEFORE any channel write (no desync)
+            with pytest.raises(KeyError):
+                compiled.execute({"x": 1})
+            assert compiled.execute({"x": 2, "y": 2}).get(timeout=60) == 7
+        finally:
+            compiled.teardown()
+
     def test_numpy_payload(self, ray4):
         @ray_tpu.remote
         def double(x):

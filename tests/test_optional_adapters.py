@@ -120,6 +120,13 @@ def test_optuna_adapter_call_surface(fake_module):
     s2 = OptunaSearch({"x": Float(0, 1)}, metric="loss", mode="min")
     assert s2._study.direction == "minimize"
 
+    # Tuner path: space+mode arrive AFTER construction via
+    # set_search_properties — direction must follow the late mode
+    s3 = OptunaSearch(metric=None, mode=None)
+    s3.set_search_properties("loss", "min", {"x": Float(0, 1)})
+    assert s3._study.direction == "minimize"
+    assert set(s3.suggest("t")) == {"x"}
+
 
 def test_optuna_real_tiny(ray4):
     pytest.importorskip("optuna")
@@ -227,6 +234,209 @@ def test_hyperopt_real_tiny(ray4):
             num_samples=4),
     ).fit()
     assert results.get_best_result("score", "max") is not None
+
+
+# ------------------------------------------------------------------ skopt
+def test_skopt_adapter_call_surface(fake_module):
+    skopt = types.ModuleType("skopt")
+    space_mod = types.ModuleType("skopt.space")
+    made = []
+
+    class _Dim:
+        def __init__(self, kind, *args, **kw):
+            self.kind, self.args, self.kw = kind, args, kw
+            made.append(self)
+
+    space_mod.Categorical = lambda *a, **k: _Dim("cat", *a, **k)
+    space_mod.Integer = lambda *a, **k: _Dim("int", *a, **k)
+    space_mod.Real = lambda *a, **k: _Dim("real", *a, **k)
+
+    class _Opt:
+        def __init__(self, dims):
+            self.dims = dims
+            self.told = []
+
+        def ask(self):
+            out = []
+            for d in self.dims:
+                if d.kind == "cat":
+                    out.append(d.args[0][0])
+                else:
+                    out.append(d.args[0])
+            return out
+
+        def tell(self, point, loss):
+            self.told.append((point, loss))
+
+    skopt.Optimizer = _Opt
+    skopt.space = space_mod
+    fake_module("skopt", skopt)
+    fake_module("skopt.space", space_mod)
+    from ray_tpu.tune.search.skopt import SkOptSearch
+
+    s = SkOptSearch({"lr": Float(1e-4, 1e-1, log=True),
+                     "n": Integer(1, 4),
+                     "act": Categorical(["a", "b"]), "c": 5},
+                    metric="score", mode="max")
+    # log-uniform prior plumbed through
+    real = [d for d in made if d.kind == "real"][0]
+    assert real.kw.get("prior") == "log-uniform"
+    p = s.suggest("t1")
+    assert p == {"lr": 1e-4, "n": 1, "act": "a", "c": 5}
+    s.on_trial_complete("t1", {"score": 3.0})
+    assert s._opt.told[-1][1] == -3.0  # max mode negates
+    s.suggest("t2")
+    s.on_trial_complete("t2", error=True)  # no tell on error
+    assert len(s._opt.told) == 1
+
+    # late param_space via set_search_properties builds the optimizer
+    s2 = SkOptSearch(metric="score", mode="max")
+    s2.set_search_properties(None, None, {"x": Float(0.0, 1.0)})
+    assert s2.suggest("t") == {"x": 0.0}
+
+
+# -------------------------------------------------------------- nevergrad
+def test_nevergrad_adapter_call_surface(fake_module):
+    ng = types.ModuleType("nevergrad")
+    p_mod = types.ModuleType("nevergrad.p")
+
+    class _Param:
+        def __init__(self, kind, **kw):
+            self.kind, self.kw = kind, kw
+
+        def set_integer_casting(self):
+            self.int_cast = True
+            return self
+
+    p_mod.Choice = lambda choices: _Param("choice", choices=choices)
+    p_mod.Scalar = lambda lower=None, upper=None: _Param(
+        "scalar", lower=lower, upper=upper)
+    p_mod.Log = lambda lower=None, upper=None: _Param(
+        "log", lower=lower, upper=upper)
+
+    class _PDict:
+        def __init__(self, **params):
+            self.params = params
+
+    p_mod.Dict = _PDict
+
+    class _Cand:
+        def __init__(self, value):
+            self.value = value
+
+    class _Opt:
+        def __init__(self, parametrization=None, budget=None):
+            self.parametrization = parametrization
+            self.budget = budget
+            self.told = []
+
+        def ask(self):
+            value = {}
+            for k, prm in self.parametrization.params.items():
+                if prm.kind == "choice":
+                    value[k] = prm.kw["choices"][0]
+                else:
+                    value[k] = prm.kw["lower"]
+            return _Cand(value)
+
+        def tell(self, cand, loss):
+            self.told.append((cand, loss))
+
+    opt_mod = types.ModuleType("nevergrad.optimizers")
+    opt_mod.registry = {"NGOpt": _Opt}
+    ng.p = p_mod
+    ng.optimizers = opt_mod
+    fake_module("nevergrad", ng)
+    fake_module("nevergrad.p", p_mod)
+    fake_module("nevergrad.optimizers", opt_mod)
+    from ray_tpu.tune.search.nevergrad import NevergradSearch
+
+    s = NevergradSearch({"lr": Float(1e-4, 1e-1, log=True),
+                         "n": Integer(1, 4),
+                         "act": Categorical(["x", "y"])},
+                        metric="score", mode="min", budget=7)
+    assert s._opt.budget == 7
+    assert s._opt.parametrization.params["lr"].kind == "log"
+    assert getattr(s._opt.parametrization.params["n"], "int_cast", False)
+    p = s.suggest("t1")
+    assert p == {"lr": 1e-4, "n": 1, "act": "x"}
+    s.on_trial_complete("t1", {"score": 2.5})
+    assert s._opt.told[-1][1] == 2.5  # min mode passes through
+
+    # late param_space via set_search_properties builds the optimizer
+    s2 = NevergradSearch(metric="score", mode="min")
+    s2.set_search_properties(None, None, {"n": Integer(3, 9)})
+    assert s2.suggest("t") == {"n": 3}
+
+
+# -------------------------------------------------------------------- ax
+def test_ax_adapter_call_surface(fake_module):
+    ax = types.ModuleType("ax")
+    service = types.ModuleType("ax.service")
+    ax_client_mod = types.ModuleType("ax.service.ax_client")
+
+    class AxClient:
+        def __init__(self, verbose_logging=True):
+            self.experiment = None
+            self.completed = []
+            self.failed = []
+            self._n = 0
+
+        def create_experiment(self, parameters=None, objective_name=None,
+                              minimize=False):
+            self.experiment = {"parameters": parameters,
+                               "objective_name": objective_name,
+                               "minimize": minimize}
+
+        def get_next_trial(self):
+            params = {}
+            for spec in self.experiment["parameters"]:
+                if spec["type"] == "choice":
+                    params[spec["name"]] = spec["values"][0]
+                else:
+                    params[spec["name"]] = spec["bounds"][0]
+            self._n += 1
+            return params, self._n
+
+        def complete_trial(self, index, raw_data=None):
+            self.completed.append((index, raw_data))
+
+        def log_trial_failure(self, index):
+            self.failed.append(index)
+
+    ax_client_mod.AxClient = AxClient
+    service.ax_client = ax_client_mod
+    ax.service = service
+    fake_module("ax", ax)
+    fake_module("ax.service", service)
+    fake_module("ax.service.ax_client", ax_client_mod)
+    from ray_tpu.tune.search.ax import AxSearch
+
+    s = AxSearch({"lr": Float(1e-3, 1e-1, log=True),
+                  "n": Integer(2, 6),
+                  "act": Categorical(["gelu", "relu"])},
+                 metric="acc", mode="max")
+    exp = s._client.experiment
+    assert exp["objective_name"] == "acc" and exp["minimize"] is False
+    lr_spec = next(p for p in exp["parameters"] if p["name"] == "lr")
+    assert lr_spec["log_scale"] is True
+    n_spec = next(p for p in exp["parameters"] if p["name"] == "n")
+    assert n_spec["bounds"] == [2, 5] and n_spec["value_type"] == "int"
+    p = s.suggest("t1")
+    assert p == {"lr": 1e-3, "n": 2, "act": "gelu"}
+    s.on_trial_complete("t1", {"acc": 0.97})
+    assert s._client.completed == [(1, 0.97)]
+    s.suggest("t2")
+    s.on_trial_complete("t2", error=True)
+    assert s._client.failed == [2]
+
+    # Tuner path: metric/mode/space arrive after construction — Ax bakes
+    # the direction into the experiment, so it must be rebuilt
+    s2 = AxSearch()
+    s2.set_search_properties("loss", "min", {"x": Float(0.0, 1.0)})
+    assert s2._client.experiment["minimize"] is True
+    assert s2._client.experiment["objective_name"] == "loss"
+    assert s2.suggest("t") == {"x": 0.0}
 
 
 # ------------------------------------------------------------------ gbdt

@@ -139,4 +139,12 @@ def test_dashboard_serve_rest(ray4):
         time.sleep(0.5)
     assert apps["restapp"]["status"] == "RUNNING"
     assert apps["restapp"]["ingress"] == "Doubler"
+    # the per-node ingress map rides the same endpoint (reference:
+    # serve status proxies section)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/serve/applications",
+            timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert any(p.get("healthy") and p.get("http_port")
+               for p in body.get("proxies", {}).values()), body
     serve.delete("restapp")
