@@ -46,6 +46,56 @@ class DAGNode:
     def experimental_compile(self) -> "CompiledDAG":
         return CompiledDAG(self)
 
+    def visualize(self, filename: Optional[str] = None) -> str:
+        """GraphViz DOT text for the DAG (reference: dag_node.py
+        visualization via graphviz — emitted here as dependency-free DOT;
+        pipe to `dot -Tsvg` to render). Writes ``filename`` if given."""
+        lines = ["digraph dag {", "  rankdir=LR;"]
+        seen: Dict[int, str] = {}
+
+        def label(n: "DAGNode") -> str:
+            if isinstance(n, InputNode):
+                raw = "INPUT"
+            elif isinstance(n, InputAttributeNode):
+                raw = f"INPUT[{n._key!r}]"
+            elif isinstance(n, MultiOutputNode):
+                raw = "OUTPUT"
+            elif isinstance(n, FunctionNode):
+                fn = n._remote_fn
+                raw = getattr(fn, "__name__", None) or getattr(
+                    getattr(fn, "_function", None), "__name__", "task")
+            elif isinstance(n, ClassMethodNode):
+                raw = f"{n._actor._class_name}.{n._method_name}"
+            else:
+                raw = type(n).__name__
+            # DOT double-quoted strings: escape embedded quotes/backslashes
+            return raw.replace("\\", "\\\\").replace('"', '\\"')
+
+        def visit(n: "DAGNode") -> str:
+            if id(n) in seen:
+                return seen[id(n)]
+            name = f"n{len(seen)}"
+            seen[id(n)] = name
+            shape = ("ellipse" if isinstance(
+                n, (InputNode, InputAttributeNode, MultiOutputNode))
+                else "box")
+            lines.append(f'  {name} [label="{label(n)}", shape={shape}];')
+            deps = list(n._bound_args) + list(n._bound_kwargs.values())
+            if isinstance(n, InputAttributeNode):
+                deps = [n._parent]
+            for d in deps:
+                if isinstance(d, DAGNode):
+                    lines.append(f"  {visit(d)} -> {name};")
+            return name
+
+        visit(self)
+        lines.append("}")
+        dot = "\n".join(lines)
+        if filename:
+            with open(filename, "w") as f:
+                f.write(dot)
+        return dot
+
 
 def _pack_input(input_args: tuple, input_kwargs: dict) -> Any:
     """The one input-packing rule shared by eager InputNode resolution
@@ -307,6 +357,31 @@ class CompiledDAGRef:
     def get(self, timeout: Optional[float] = None) -> Any:
         return self._dag._result_for(self._seq, timeout)
 
+    async def get_async(self, timeout: Optional[float] = None) -> Any:
+        """Await the result without blocking the event loop (reference:
+        CompiledDAGRef await support for async serving callers).
+
+        Polls in short chunks so asyncio cancellation (wait_for) takes
+        effect between chunks — a cancelled get must not leave a zombie
+        thread camped on the DAG's consumer lock, and any worker thread
+        outliving the cancellation is bounded by one chunk."""
+        import asyncio
+
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while True:
+            chunk = 2.0 if deadline is None else min(
+                2.0, max(0.05, deadline - time.monotonic()))
+            try:
+                return await asyncio.to_thread(self.get, chunk)
+            except TimeoutError:
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    raise
+
+    def __await__(self):
+        return self.get_async().__await__()
+
     # duck-typed hook for ray_tpu.get
     def _compiled_get(self, timeout: Optional[float] = None) -> Any:
         return self.get(timeout)
@@ -343,6 +418,17 @@ class CompiledDAG:
         self._partial_input = None    # (value, next channel idx) on timeout
         self._partial_read: list = []  # output values read so far this seq
         self._discard_seqs: set = set()  # voided executions to drop
+        # SPSC bookkeeping is single-writer/single-reader state. Two
+        # INDEPENDENT locks so a backpressured producer (execute holding
+        # the input lock across a blocking channel write) can never starve
+        # the consumer that would drain the outputs and unblock it:
+        #   _in_mu:  _seq, _partial_input, input-channel writes
+        #   _out_mu: _next_read, _partial_read, _buffered, output reads
+        # (_discard_seqs crosses the two; set add/discard are GIL-atomic)
+        import threading
+
+        self._in_mu = threading.RLock()
+        self._out_mu = threading.RLock()
 
         # ---- plan: collect nodes reachable from root (post-order = topo)
         order: List[DAGNode] = []
@@ -473,6 +559,10 @@ class CompiledDAG:
 
     # -------------------------------------------------------------- execute
     def execute(self, *input_args, **input_kwargs) -> CompiledDAGRef:
+        with self._in_mu:
+            return self._execute_locked(input_args, input_kwargs)
+
+    def _execute_locked(self, input_args, input_kwargs) -> CompiledDAGRef:
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
         input_val = _pack_input(input_args, input_kwargs)
@@ -490,6 +580,17 @@ class CompiledDAG:
         ref = CompiledDAGRef(self, self._seq)
         self._seq += 1
         return ref
+
+    async def execute_async(self, *input_args,
+                            **input_kwargs) -> CompiledDAGRef:
+        """execute() for asyncio callers: the (possibly backpressured)
+        input-channel writes run off-loop (reference:
+        compiled_dag_node.py execute_async)."""
+        import asyncio
+        from functools import partial
+
+        return await asyncio.to_thread(
+            partial(self.execute, *input_args, **input_kwargs))
 
     def _write_inputs(self, input_val: Any, start_idx: int) -> None:
         """Write one execution's input to every driver-fed channel,
@@ -512,6 +613,23 @@ class CompiledDAG:
                 raise
 
     def _result_for(self, seq: int, timeout: Optional[float]) -> Any:
+        # honor a finite timeout on the LOCK acquisition too — a 0.5s get
+        # must not wait forever behind another getter holding the lock in
+        # an unbounded read
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if not self._out_mu.acquire(
+                timeout=-1 if timeout is None else timeout):
+            raise TimeoutError(
+                f"result for execution #{seq} blocked behind another "
+                "consumer past the timeout")
+        try:
+            remaining = None if deadline is None else max(
+                0.005, deadline - time.monotonic())
+            return self._result_for_locked(seq, remaining)
+        finally:
+            self._out_mu.release()
+
+    def _result_for_locked(self, seq: int, timeout: Optional[float]) -> Any:
         if seq in self._buffered:
             out = self._buffered.pop(seq)
         else:
@@ -556,21 +674,37 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
-        for ch, _extract in self._input_channels:
-            try:
-                ch.write(_Sentinel(), timeout=timeout)
-            except Exception:
-                pass
-        # drain pending results + the sentinel so every slot is consumed
-        deadline = time.monotonic() + timeout
-        for ch in self._output_channels:
-            while time.monotonic() < deadline:
+        # the input channels are SPSC — the sentinel writes must not race
+        # a concurrent execute's writes. If a wedged execute holds the
+        # lock (blocked on backpressure), seal the stop token so the
+        # pipeline unwedges; the writer's own timeout then releases it.
+        if not self._in_mu.acquire(timeout=min(timeout, 5.0)):
+            self._seal_stop_token()
+            self._in_mu.acquire()
+        try:
+            for ch, _extract in self._input_channels:
                 try:
-                    v = ch.read(timeout=max(0.1, deadline - time.monotonic()))
+                    ch.write(_Sentinel(), timeout=timeout)
                 except Exception:
-                    break
-                if isinstance(v, _Sentinel):
-                    break
+                    pass
+        finally:
+            self._in_mu.release()
+        # drain pending results + the sentinel so every slot is consumed;
+        # skip if a getter camps on the consumer lock (force-stop covers)
+        deadline = time.monotonic() + timeout
+        if self._out_mu.acquire(timeout=min(timeout, 5.0)):
+            try:
+                for ch in self._output_channels:
+                    while time.monotonic() < deadline:
+                        try:
+                            v = ch.read(timeout=max(
+                                0.1, deadline - time.monotonic()))
+                        except Exception:
+                            break
+                        if isinstance(v, _Sentinel):
+                            break
+            finally:
+                self._out_mu.release()
         try:
             ray_tpu.get(self._loop_refs, timeout=timeout)
         except Exception:
