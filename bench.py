@@ -24,6 +24,14 @@ import numpy as np
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
 
 
+def _mesh_ctx(mesh):
+    """Version-portable mesh activation — a jax bump must not zero the
+    headline bench (shared shim: parallel/sharding.py)."""
+    from ray_tpu.parallel.sharding import compat_mesh_ctx
+
+    return compat_mesh_ctx(mesh)
+
+
 def _tpu_configs():
     """Largest-first ladder; each entry is (cfg, batch, seq, steps)."""
     from ray_tpu.models.llama import LlamaConfig
@@ -89,7 +97,7 @@ def _run_one(kind, cfg, batch, seq, steps, platform):
     if kind == "lora":
         lcfg = LoraConfig(rank=16)
         tx = optax.adamw(1e-4)
-        with jax.set_mesh(mesh):
+        with _mesh_ctx(mesh):
             base = jax.jit(
                 lambda k: init_llama(cfg, k),
                 out_shardings=param_shardings(llama_logical_axes(cfg), mesh),
@@ -107,7 +115,7 @@ def _run_one(kind, cfg, batch, seq, steps, platform):
         # adafactor (factored second moment, the T5X/PaLM TPU standard):
         # adam's fp32 mu+nu alone would put the 1B config past 16 GiB HBM
         tx = optax.adafactor(1e-3)
-        with jax.set_mesh(mesh):
+        with _mesh_ctx(mesh):
             state, shardings = create_train_state(
                 lambda k: init_llama(cfg, k), tx, mesh,
                 llama_logical_axes(cfg))
@@ -162,7 +170,7 @@ def _run_dense_datafed(cfg, batch, seq, steps, platform):
 
         mesh = create_mesh(MeshConfig(data=-1), devices=jax.devices()[:1])
         tx = optax.adafactor(1e-3)
-        with jax.set_mesh(mesh):
+        with _mesh_ctx(mesh):
             state, shardings = create_train_state(
                 lambda k: init_llama(cfg, k), tx, mesh,
                 llama_logical_axes(cfg))
@@ -298,6 +306,24 @@ def main() -> None:
     raise last_err or RuntimeError("no config ran")
 
 
+def _reap_on_exit() -> None:
+    """Leak gate (ISSUE 1): the benchmark must never poison the next run.
+    Shut down any runtime this process still holds, then GC stale session
+    dirs/daemons through the same lifecycle reaper the tests use."""
+    try:
+        ray_tpu = sys.modules.get("ray_tpu")
+        if ray_tpu is not None and ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+    except Exception:
+        pass
+    try:
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    except Exception:
+        pass
+
+
 if __name__ == "__main__":
     try:
         main()
@@ -306,4 +332,6 @@ if __name__ == "__main__":
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": f"tokens/s (failed: {type(e).__name__}: {e})",
             "vs_baseline": 0.0}))
+        _reap_on_exit()
         sys.exit(1)
+    _reap_on_exit()

@@ -83,6 +83,15 @@ def init(
             if ignore_reinit_error:
                 return ClientContext(_worker_mod.global_worker)
             raise RuntimeError("ray_tpu.init() called twice")
+        # stale-session GC: reclaim /dev/shm segments and session dirs
+        # whose registered pids are all dead — a previous run's leak must
+        # not starve this one (lifecycle supervisor contract)
+        try:
+            from ray_tpu._private import lifecycle as _lifecycle
+
+            _lifecycle.gc_stale_sessions()
+        except Exception:
+            pass
         res = dict(resources or {})
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)

@@ -995,9 +995,13 @@ def main() -> None:
     async def run():
         import signal
 
-        from ray_tpu._private import proc_profile
+        from ray_tpu._private import lifecycle, proc_profile
         from ray_tpu._private.event import init_event_log, report_event
 
+        lifecycle.register_self("gcs", args.session_dir)
+        # die with the spawning driver/runner: a SIGKILL'd driver must not
+        # strand the head control plane (lifecycle supervisor contract)
+        lifecycle.fate_share_with_parent()
         prof = proc_profile.maybe_start()
         init_event_log(args.session_dir, "head")
         report_event("INFO", "HEAD_STARTED", "head control plane starting")
@@ -1015,6 +1019,7 @@ def main() -> None:
         # flush the last debounce window so a clean stop loses nothing
         head._save_state()
         proc_profile.dump(prof, "head")
+        lifecycle.unregister_process(args.session_dir, os.getpid())
 
     asyncio.run(run())
 

@@ -21,6 +21,7 @@ import time
 import uuid
 from typing import Dict, Optional
 
+from ray_tpu._private import lifecycle
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID
 
@@ -101,13 +102,17 @@ class Node:
             self._start_head()
         self._start_agent()
 
-    @staticmethod
-    def _subprocess_env() -> dict:
+    def _subprocess_env(self) -> dict:
         """Control-plane processes (head/agent) never touch jax: drop the
-        axon dev-tunnel bootstrap (config.scrub_axon_bootstrap_env)."""
+        axon dev-tunnel bootstrap (config.scrub_axon_bootstrap_env). The
+        lifecycle variables tie the daemon to this session's registry and
+        fate-share it with this (spawning) process."""
         from ray_tpu._private.config import scrub_axon_bootstrap_env
 
-        return scrub_axon_bootstrap_env(dict(os.environ))
+        env = scrub_axon_bootstrap_env(dict(os.environ))
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_PARENT_PID"] = str(os.getpid())
+        return env
 
     def _start_head(self) -> None:
         log = open(os.path.join(self.session_dir, "logs", "head.log"), "ab")
@@ -123,6 +128,8 @@ class Node:
             start_new_session=True,
         )
         log.close()
+        lifecycle.register_process(self.session_dir, "gcs",
+                                   self.head_proc.pid, self.node_id)
         port_file = os.path.join(self.session_dir, "head_port")
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
@@ -167,6 +174,8 @@ class Node:
             start_new_session=True,
         )
         log.close()
+        lifecycle.register_process(self.session_dir, "agent",
+                                   self.agent_proc.pid, self.node_id)
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             if os.path.exists(ready_file):
@@ -188,6 +197,18 @@ class Node:
 
     # ---------------------------------------------------------------- down
     def stop(self, cleanup_session: bool = False) -> None:
+        """Stop this node's daemons, then walk the session pid registry.
+
+        The direct SIGTERM gives the agent its graceful window (it kills
+        its own workers/forkserver on SIGTERM); the registry sweep then
+        catches anything that escaped its spawner's process group —
+        forkserver grandchildren setsid into foreign pgids, so signalling
+        ``head_proc``/``agent_proc`` groups alone leaks them.
+        ``cleanup_session`` sweeps the WHOLE session (every node) and
+        unlinks the dir with its shm segments; otherwise only this node's
+        registered processes are reaped (a worker node leaving a shared
+        session must not take the cluster down).
+        """
         for proc in (self.agent_proc, self.head_proc):
             if proc is not None and proc.poll() is None:
                 try:
@@ -211,5 +232,11 @@ class Node:
                         proc.kill()
                     except Exception:
                         pass
-        if cleanup_session:
-            shutil.rmtree(self.session_dir, ignore_errors=True)
+        try:
+            lifecycle.reap_session(
+                self.session_dir,
+                node_id=None if cleanup_session else self.node_id,
+                remove=cleanup_session)
+        except Exception:
+            if cleanup_session:
+                shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -407,6 +407,11 @@ class AsyncRpcClient:
     def close(self) -> None:
         self.connected = False
         if self._read_task:
+            # request cancellation; the cancelled task still needs one
+            # loop tick to actually finish. aclose() (clean shutdown) and
+            # worker.disconnect's final gather consume it — a loop that
+            # stops without either emits "Task was destroyed but it is
+            # pending!" at teardown.
             self._read_task.cancel()
         # calls issued after the read loop already died registered futures
         # nothing will ever resolve; fail them out
@@ -418,6 +423,21 @@ class AsyncRpcClient:
             try:
                 self._writer.close()
             except Exception:
+                pass
+
+    async def aclose(self) -> None:
+        """close() that cancels AND AWAITS the read loop — the clean
+        shutdown path (worker.disconnect) must leave no pending task
+        behind for the dying loop to warn about."""
+        task = self._read_task
+        self._read_task = None
+        if task is not None and not task.done():
+            task.cancel()
+        self.close()
+        if task is not None and not task.done():
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
                 pass
 
 

@@ -471,12 +471,13 @@ class Worker:
 
         async def _close():
             await self.direct_server.close()
-            if self.agent:
-                self.agent.close()
-            if self.head:
-                self.head.close()
-            for c in self._owner_conn_pool.values():
-                c.close()
+            # cancel AND await each client's read loop (aclose): a
+            # cancelled-but-never-awaited task left on a stopping loop is
+            # exactly the "Task was destroyed but it is pending!" warning
+            for client in (self.agent, self.head,
+                           *self._owner_conn_pool.values()):
+                if client is not None:
+                    await client.aclose()
 
         try:
             self._acall(_close(), timeout=5)
@@ -484,11 +485,25 @@ class Worker:
             pass
         if self.loop:
             def _stop():
-                for task in asyncio.all_tasks(self.loop):
+                pending = [t for t in asyncio.all_tasks(self.loop)
+                           if t is not asyncio.current_task(self.loop)]
+                for task in pending:
                     task.cancel()
-                self.loop.stop()
+
+                async def _drain():
+                    # consume every cancellation before the loop dies so
+                    # no task is destroyed while pending; bounded so one
+                    # uncancellable straggler can't wedge disconnect
+                    if pending:
+                        await asyncio.wait(pending, timeout=3)
+                    self.loop.stop()
+
+                self.loop.create_task(_drain())
 
             self.loop.call_soon_threadsafe(_stop)
+            thread = getattr(self, "_loop_thread", None)
+            if thread is not None and thread is not threading.current_thread():
+                thread.join(timeout=5)
         global global_worker
         if global_worker is self:
             global_worker = None

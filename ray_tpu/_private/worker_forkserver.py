@@ -50,6 +50,13 @@ def _spawn(req: dict, server: socket.socket, conn: socket.socket) -> int:
     # ---- child ----
     try:
         os.setsid()
+        # setsid detaches the worker into its own pgid — nothing reaps it
+        # by group, so fate-share with this forkserver (whose own death is
+        # tied to the agent): PDEATHSIG fires even if the agent is
+        # SIGKILL'd before it can walk the registry
+        from ray_tpu._private.lifecycle import _set_pdeathsig
+
+        _set_pdeathsig(signal.SIGTERM)
         server.close()
         conn.close()
         signal.signal(signal.SIGCHLD, signal.SIG_DFL)
@@ -80,6 +87,13 @@ def main() -> None:
     except FileNotFoundError:
         pass
     signal.signal(signal.SIGCHLD, _reap)
+    # register in the session pid registry + die with the agent even when
+    # the ppid poll below never gets to run (wedged accept, SIGKILL races)
+    from ray_tpu._private import lifecycle
+
+    lifecycle.register_self("forkserver",
+                            node_id=os.environ.get("RAY_TPU_NODE_ID", ""))
+    lifecycle._set_pdeathsig(signal.SIGTERM)
     parent = os.getppid()
     server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     server.bind(sock_path)

@@ -626,7 +626,15 @@ async def _handle_capture_jax_trace(conn, p) -> Dict:
 
 def main() -> None:
     agent_sock = os.environ["RAY_TPU_AGENT_SOCK"]
+    from ray_tpu._private import lifecycle
     from ray_tpu._private.ids import WorkerID
+
+    # fate-share with the node agent (RAY_TPU_PARENT_PID): the park loop
+    # below exits when the agent CONNECTION drops, but a worker stuck in
+    # user code / a jitted computation never reaches that check — the
+    # PDEATHSIG + supervisor-poll watchdog covers it (escalates to
+    # os._exit if SIGTERM is swallowed)
+    lifecycle.fate_share_with_parent()
 
     worker = Worker()
     worker.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])

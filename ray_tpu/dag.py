@@ -306,7 +306,26 @@ def _multi_stage_body(stages, stop_id=None):
                 else:
                     result = err  # upstream failed: forward, don't call
                 for ch in out_chs:
-                    _write_with_stop(ch, result, stop_id)
+                    # a result that fails to SERIALIZE must forward as a
+                    # _StageError, not kill the loop: a dead loop wedges
+                    # every downstream read until the force-stop token
+                    try:
+                        _write_with_stop(ch, result, stop_id)
+                    except _StopLoop:
+                        raise
+                    except BaseException as e:  # noqa: BLE001
+                        try:
+                            _write_with_stop(ch, _StageError(e), stop_id)
+                        except _StopLoop:
+                            raise
+                        except BaseException:
+                            # the exception itself is unserializable:
+                            # forward a stringified stand-in
+                            _write_with_stop(
+                                ch,
+                                _StageError(RuntimeError(
+                                    f"{type(e).__name__}: {e}")),
+                                stop_id)
             if stop:
                 return "stopped"
     except _StopLoop:
@@ -333,6 +352,23 @@ class _StageActor:
             stop_id=None):
         return _multi_stage_body(
             [(fn, args_desc, kwargs_desc, in_chs, out_chs)], stop_id)
+
+
+def _actor_node_id(handle) -> Optional[str]:
+    """Node an actor currently lives on (from the head's actor table),
+    or None when unknown (actor still PENDING / head unreachable)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None or not w.connected:
+        return None
+    try:
+        view = w._acall(
+            w.head.call("GetActor", {"actor_id": handle._actor_id.hex()}),
+            timeout=5)
+    except Exception:
+        return None
+    return (view or {}).get("node_id") or None
 
 
 _STAGE_ACTOR_CLS = None
@@ -521,11 +557,33 @@ class CompiledDAG:
 
         # ---- launch persistent loops (one dedicated stage actor per
         # function node; all of a user actor's nodes share ONE loop, in
-        # topo order)
+        # topo order). Channels are node-local shm: every participant
+        # MUST live on the driver's node — stage actors are pinned there
+        # via node affinity, and a user actor on a different node is a
+        # compile-time error instead of a read that hangs forever.
+        from ray_tpu._private import worker as worker_mod
+
+        driver_node = getattr(worker_mod.global_worker, "node_id", "")
         self._loop_refs = []
         self._stage_actors: List[Any] = []
         actor_specs: Dict[Any, List] = {}
         actor_handles: Dict[Any, Any] = {}
+        checked_actors: set = set()
+        for n in compute:
+            if isinstance(n, ClassMethodNode):
+                if n._actor._actor_id in checked_actors:
+                    continue  # one GetActor RPC per actor, not per method
+                checked_actors.add(n._actor._actor_id)
+                actor_node = _actor_node_id(n._actor)
+                if driver_node and actor_node and actor_node != driver_node:
+                    raise ValueError(
+                        f"compiled DAG actor {n._actor._class_name} "
+                        f"(method {n._method_name!r}) lives on node "
+                        f"{actor_node[:12]} but the driver is on "
+                        f"{driver_node[:12]}: compiled-DAG channels are "
+                        "node-local shared memory, so every participating "
+                        "actor must be created on the driver's node (e.g. "
+                        "with NodeAffinitySchedulingStrategy)")
         for n in compute:
             idx = node_in_idx[id(n)]
 
@@ -538,7 +596,15 @@ class CompiledDAG:
             if isinstance(n, FunctionNode):
                 fn = n._remote_fn
                 raw = getattr(fn, "_function", None) or fn
-                stage = _stage_actor_cls().remote()
+                stage_cls = _stage_actor_cls()
+                if driver_node:
+                    from ray_tpu.util.scheduling_strategies import (
+                        NodeAffinitySchedulingStrategy)
+
+                    stage_cls = stage_cls.options(
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            driver_node))
+                stage = stage_cls.remote()
                 self._stage_actors.append(stage)
                 ref = stage.run.remote(
                     raw, args_desc, kwargs_desc,
@@ -630,6 +696,11 @@ class CompiledDAG:
             self._out_mu.release()
 
     def _result_for_locked(self, seq: int, timeout: Optional[float]) -> Any:
+        # one absolute deadline for the WHOLE call: each channel read gets
+        # the time remaining, not a fresh copy of the user's timeout (a
+        # get(timeout=t) over N channels × M buffered seqs must not be
+        # able to block ~N*M*t)
+        deadline = None if timeout is None else time.monotonic() + timeout
         if seq in self._buffered:
             out = self._buffered.pop(seq)
         else:
@@ -639,7 +710,7 @@ class CompiledDAG:
             if self._torn_down:
                 raise RuntimeError("compiled DAG was torn down")
             while self._next_read <= seq:
-                out = self._read_output_vector(timeout)
+                out = self._read_output_vector(deadline)
                 if self._next_read in self._discard_seqs:
                     # a voided (timed-out) execution's result: drop it
                     self._discard_seqs.discard(self._next_read)
@@ -656,15 +727,61 @@ class CompiledDAG:
                 raise v.exc
         return out
 
-    def _read_output_vector(self, timeout: Optional[float]) -> Any:
+    # between blocking-read chunks, check the stage loops for EARLY death
+    # so a killed stage surfaces as an error instead of a hang
+    _LIVENESS_POLL_S = 1.0
+
+    def _raise_if_stage_dead(self) -> None:
+        """A stage loop that completed while the DAG is live means a dead
+        stage (loops only return at teardown): surface its error — a
+        SIGKILL'd stage process otherwise leaves every downstream channel
+        empty and ``CompiledDAGRef.get()`` blocked forever."""
+        if self._torn_down or not self._loop_refs:
+            return
+        try:
+            done, _ = ray_tpu.wait(list(self._loop_refs), num_returns=1,
+                                   timeout=0)
+        except Exception:
+            return
+        if not done or self._torn_down:
+            return
+        try:
+            ray_tpu.get(done[0], timeout=5.0)
+        except Exception as e:
+            raise RuntimeError(
+                "compiled DAG stage died mid-pipeline: "
+                f"{type(e).__name__}: {e}") from e
+        raise RuntimeError(
+            "compiled DAG stage loop exited unexpectedly (worker killed "
+            "or loop crashed); tear the DAG down and recompile")
+
+    def _read_output_vector(self, deadline: Optional[float]) -> Any:
         """Read one value from every output channel. Partial progress is
         buffered across calls (``_partial_read``) so a user timeout on a
         slow branch stays retry-safe instead of skewing branch pairs.
-        timeout=None blocks indefinitely, matching eager ray_tpu.get."""
+        deadline=None blocks indefinitely, matching eager ray_tpu.get —
+        but reads are chunked so dead stages are detected either way."""
         vals = self._partial_read
         while len(vals) < len(self._output_channels):
-            vals.append(self._output_channels[len(vals)].read(
-                timeout=timeout))
+            ch = self._output_channels[len(vals)]
+            phase = 0
+            while True:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                # an expired deadline still attempts one timeout=0 read:
+                # get(timeout=0) is the documented nonblocking poll and
+                # must return a READY result, not raise unconditionally
+                chunk = self._LIVENESS_POLL_S if remaining is None \
+                    else min(self._LIVENESS_POLL_S, max(0.0, remaining))
+                try:
+                    vals.append(ch.read(timeout=chunk, _phase=phase))
+                    break
+                except TimeoutError as e:
+                    phase = getattr(e, "phase", phase)
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(
+                            "compiled DAG result not ready within timeout")
+                    self._raise_if_stage_dead()
         self._partial_read = []
         return vals if len(self._output_channels) > 1 else vals[0]
 

@@ -81,7 +81,20 @@ class _JobSupervisor:
                     self.entrypoint, shell=True, stdout=log,
                     stderr=subprocess.STDOUT, env=env, cwd=cwd,
                     start_new_session=True)
+                # the entrypoint setsids into its own pgid: registering it
+                # in the session pid registry is what lets teardown reap
+                # it if this supervisor's worker dies mid-job
+                session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
+                if session_dir:
+                    from ray_tpu._private import lifecycle
+
+                    lifecycle.register_process(
+                        session_dir, "job", self._proc.pid,
+                        os.environ.get("RAY_TPU_NODE_ID", ""))
                 code = self._proc.wait()
+                if session_dir:
+                    lifecycle.unregister_process(session_dir,
+                                                 self._proc.pid)
             if self._stopped:
                 # user-initiated stop: keep STOPPED, don't report FAILED
                 return JobStatus.STOPPED

@@ -35,10 +35,10 @@ def _ring_seq_attention(q, k, v):
     """Sequence-parallel exact attention: shard_map over the ambient mesh's
     ``seq`` axis; kv chunks ride the ICI ring (ops.ring_attention)."""
     from ray_tpu.ops.ring_attention import ring_attention
-    from ray_tpu.parallel.sharding import logical_to_spec
+    from ray_tpu.parallel.sharding import compat_shard_map, logical_to_spec
 
     qs = logical_to_spec(("batch", "seq", "heads", "head_dim"))
-    fn = jax.shard_map(
+    fn = compat_shard_map(
         partial(ring_attention, axis_name="seq", causal=True),
         in_specs=(qs, qs, qs), out_specs=qs, check_vma=False)
     return fn(q, k, v)

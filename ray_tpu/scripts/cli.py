@@ -52,7 +52,10 @@ def cmd_start(args) -> int:
         f"    open({ADDR_FILE!r}, 'w').write("
         "f'{node.head_host}:{node.head_port}')\n"
         "print('NODE_READY', node.session_dir, flush=True)\n"
-        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+        "def _stop(*a):\n"
+        "    node.stop(cleanup_session=head)\n"
+        "    sys.exit(0)\n"
+        "signal.signal(signal.SIGTERM, _stop)\n"
         "while True:\n"
         "    time.sleep(3600)\n"
     )
@@ -104,21 +107,45 @@ def cmd_down(args) -> int:
 
 
 def cmd_stop(args) -> int:
-    n = 0
+    from ray_tpu._private import lifecycle
+
+    signalled = []
     if os.path.exists(PID_FILE):
         with open(PID_FILE) as f:
             pids = json.load(f)
         for pid in pids:
             try:
                 os.killpg(os.getpgid(pid), signal.SIGTERM)
-                n += 1
+                signalled.append(pid)
             except (ProcessLookupError, PermissionError):
                 pass
         os.remove(PID_FILE)
     for f in (ADDR_FILE,):
         if os.path.exists(f):
             os.remove(f)
-    print(f"stopped {n} node(s)")
+    # wait (bounded) for the signalled runners to finish their graceful
+    # node.stop — returning while their teardown is in flight would make
+    # the post-stop `status` race its own cluster
+    deadline = time.monotonic() + 15
+    pending = list(signalled)
+    while pending and time.monotonic() < deadline:
+        pending = [p for p in pending if lifecycle._pid_alive(p)]
+        if pending:
+            time.sleep(0.1)
+    for pid in pending:
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    print(f"stopped {len(signalled)} node(s)")
+    # sweep sessions: with --all, kill live registered daemons too
+    # (escalating SIGTERM→SIGKILL); otherwise just unlink session dirs
+    # whose pids are all dead so their shm segments are reclaimed
+    removed = lifecycle.gc_stale_sessions(
+        kill_live=getattr(args, "all", False))
+    print(f"reaped {len(removed)} session(s)")
+    for path in removed:
+        print(f"  {path}")
     return 0
 
 
@@ -137,10 +164,32 @@ def _connect():
 
 
 def cmd_status(args) -> int:
-    ray_tpu = _connect()
-    total = ray_tpu.cluster_resources()
-    avail = ray_tpu.available_resources()
-    print("Node status")
+    # session lifecycle view first: it needs no running head, and "zero
+    # live sessions" is the leak-gate signal benches/CI assert on
+    from ray_tpu._private import lifecycle
+
+    sessions = lifecycle.list_sessions()
+    print(lifecycle.format_sessions(sessions))
+    live = sum(1 for s in sessions if s["live"])
+    print(f"\nlive sessions: {live}")
+    if not os.path.exists(ADDR_FILE):
+        import ray_tpu as _rt
+
+        if not _rt.is_initialized():
+            return 0
+    try:
+        ray_tpu = _connect()
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+    except SystemExit:
+        raise
+    except Exception as e:
+        # a stale ADDR_FILE (runner SIGKILL'd, machine rebooted) must not
+        # turn the lifecycle view into a traceback — that headless view
+        # is the whole point of `status` after a crash
+        print(f"\n(head at {ADDR_FILE} unreachable: {type(e).__name__})")
+        return 0
+    print("\nNode status")
     print("-" * 40)
     for n in ray_tpu.nodes():
         state = "ALIVE" if n["alive"] else "DEAD"
@@ -265,6 +314,9 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_start)
 
     s = sub.add_parser("stop", help="stop all locally-started nodes")
+    s.add_argument("--all", action="store_true",
+                   help="also reap every registered session daemon and "
+                        "remove session dirs/shm segments")
     s.set_defaults(fn=cmd_stop)
 
     s = sub.add_parser(

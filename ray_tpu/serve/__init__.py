@@ -87,9 +87,23 @@ def start(http_options: Optional[Dict] = None, detached: bool = True,
         ctrl.start_proxies.remote(port=port, host=host, grpc_port=grpc_port),
         timeout=120)
     info = _local_proxy_info(ctrl, timeout=60)
-    if info is not None:
-        _http_port = info.get("http_port")
-        _grpc_port = info.get("grpc_port")
+    if info is None:
+        # fail fast: a control plane without a single healthy ingress is
+        # not "started" — silently continuing surfaces later as opaque
+        # connection refusals on the first request. Tear the just-created
+        # controller down too, or a retrying start() would hit the
+        # "already running" early-return above and report success with
+        # zero proxies.
+        try:
+            ray_tpu.kill(ctrl)
+        except Exception:
+            pass
+        raise RuntimeError(
+            "serve.start(): no healthy proxy became available within the "
+            "deadline; check the controller/proxy actor logs in the "
+            "session's logs/ directory")
+    _http_port = info.get("http_port")
+    _grpc_port = info.get("grpc_port")
 
 
 def _local_proxy_info(ctrl=None, timeout: float = 30.0) -> Optional[Dict]:

@@ -62,6 +62,7 @@ class _ProxyState:
         self.node_id = node_id
         self.http_port: Optional[int] = None
         self.grpc_port: Optional[int] = None
+        self.host: Optional[str] = None  # the proxy's ACTUAL node host
         self.healthy = False
         self.consecutive_failures = 0
 
@@ -158,11 +159,14 @@ class ServeController(LongPollHost):
     def get_proxy_info(self) -> Dict[str, Dict]:
         """{node_id: {name, http_port, grpc_port, healthy}} for routers,
         CLI status, and drivers discovering their node-local ingress."""
-        host = (self._proxy_config or {}).get("host", "127.0.0.1")
+        # each record carries the proxy's OWN reachable host (queried from
+        # the actor on its node) — echoing the shared config host made
+        # every remote node's ingress look like it lived on the driver
+        default_host = (self._proxy_config or {}).get("host", "127.0.0.1")
         return {
             nid: {"name": ps.name, "http_port": ps.http_port,
                   "grpc_port": ps.grpc_port, "healthy": ps.healthy,
-                  "host": host}
+                  "host": ps.host or default_host}
             for nid, ps in self._proxies.items()
         }
 
@@ -239,6 +243,11 @@ class ServeController(LongPollHost):
             if cfg.get("grpc_port") is not None:
                 grpc_port = await asyncio.to_thread(
                     ray_tpu.get, actor.get_grpc_port.remote(), timeout=30.0)
+            try:
+                actual_host = await asyncio.to_thread(
+                    ray_tpu.get, actor.get_host.remote(), timeout=10.0)
+            except Exception:
+                actual_host = None
         except Exception:
             # next reconcile pass retries — but the actor may be ALIVE
             # (ready just slow): kill it or the orphan keeps the node's
@@ -252,6 +261,7 @@ class ServeController(LongPollHost):
         ps = _ProxyState(name, actor, node_id)
         ps.http_port = http_port
         ps.grpc_port = grpc_port
+        ps.host = actual_host
         ps.healthy = True
         if self._shutdown:
             # shutdown raced this start: don't register a proxy nothing

@@ -137,6 +137,17 @@ class ProxyActor:
     async def get_grpc_port(self) -> Optional[int]:
         return self.grpc_port
 
+    async def get_host(self) -> str:
+        """The host this proxy is actually reachable on: its node's IP
+        when bound to a wildcard/loopback-on-remote-node address — the
+        controller records THIS, not the shared config host, so clients
+        on other machines get a usable ingress address."""
+        if self.host not in ("0.0.0.0", "::", ""):
+            return self.host
+        from ray_tpu._private.worker import node_ip
+
+        return node_ip()
+
     def _controller(self):
         from ray_tpu.serve._private.controller import (
             CONTROLLER_NAME, SERVE_NAMESPACE)

@@ -24,6 +24,7 @@ class AxSearch(Searcher):
         super().__init__(metric, mode)
         self._space = space or {}
         self._trials: Dict[str, int] = {}
+        self._completed = 0
         self._build()
 
     def _build(self) -> None:
@@ -55,13 +56,19 @@ class AxSearch(Searcher):
 
     def set_search_properties(self, metric, mode, config) -> bool:
         """Adopt the Tuner-supplied metric/mode/param_space: Ax bakes the
-        objective name AND direction into the experiment, so rebuild it
-        while no trials are in flight (reference: ax_search.py
-        set_search_properties)."""
+        objective name AND direction into the experiment, so a rebuild is
+        needed when they change — but ONLY then. Rebuilding whenever
+        in-flight trials happened to be empty silently discarded the
+        optimizer's accumulated observations between scheduling waves
+        (reference: ax_search.py set_search_properties guards the same
+        way)."""
+        changed = (metric is not None and metric != self.metric) or \
+            (mode is not None and mode != self.mode)
         super().set_search_properties(metric, mode, config)
         if config and not self._space:
             self._space = dict(config)
-        if not self._trials:
+            changed = True
+        if (changed or not self._completed) and not self._trials:
             self._build()
         return True
 
@@ -77,6 +84,7 @@ class AxSearch(Searcher):
         index = self._trials.pop(trial_id, None)
         if index is None:
             return
+        self._completed += 1  # any logged outcome is optimizer history
         if error or not result or self.metric not in result:
             self._client.log_trial_failure(index)
             return

@@ -26,6 +26,7 @@ class OptunaSearch(Searcher):
         self._space = space or {}
         self._seed = seed
         self._trials: Dict[str, object] = {}
+        self._completed = 0
         self._build()
 
     def _build(self) -> None:
@@ -39,11 +40,17 @@ class OptunaSearch(Searcher):
     def set_search_properties(self, metric, mode, config) -> bool:
         """Adopt the Tuner-supplied metric/mode/param_space (reference:
         optuna_search.py set_search_properties): the study's DIRECTION is
-        baked at creation, so rebuild it while no trials are in flight."""
+        baked at creation, so it must be rebuilt when mode/metric change
+        — but only then, or when there is no history yet. Rebuilding
+        whenever in-flight trials happened to be empty discarded the
+        TPE sampler's accumulated observations between waves."""
+        changed = (metric is not None and metric != self.metric) or \
+            (mode is not None and mode != self.mode)
         super().set_search_properties(metric, mode, config)
         if config and not self._space:
             self._space = config
-        if not self._trials:
+            changed = True
+        if (changed or not self._completed) and not self._trials:
             self._build()
         return True
 
@@ -71,6 +78,7 @@ class OptunaSearch(Searcher):
         ot = self._trials.pop(trial_id, None)
         if ot is None:
             return
+        self._completed += 1  # any logged outcome is optimizer history
         if error or not result or self.metric not in result:
             self._study.tell(ot, state=optuna.trial.TrialState.FAIL)
         else:

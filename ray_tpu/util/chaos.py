@@ -128,6 +128,37 @@ class NodeKiller(ResourceKiller):
         return f"node {node_id[:12]}"
 
 
+class DaemonKiller(ResourceKiller):
+    """SIGKILL registered session daemons (agent / forkserver / gcs /
+    worker) picked from the lifecycle pid registry — the chaos probe for
+    the teardown supervisor itself: after a kill, fate-sharing must reap
+    the victim's subtree and the session registry must converge to zero
+    live pids on shutdown."""
+
+    def __init__(self, session_dir: str, roles=("agent",),
+                 interval_s: float = 2.0, max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
+        super().__init__(interval_s, max_kills, seed)
+        self.session_dir = session_dir
+        self.roles = tuple(roles)
+
+    def find_target(self):
+        from ray_tpu._private import lifecycle
+
+        candidates = [
+            r for r in lifecycle.live_registered(self.session_dir)
+            if r.get("role") in self.roles and r["pid"] != os.getpid()
+        ]
+        return self.rng.choice(candidates) if candidates else None
+
+    def kill_target(self, target) -> Optional[str]:
+        try:
+            os.kill(target["pid"], signal.SIGKILL)
+            return f"{target.get('role', 'daemon')} pid={target['pid']}"
+        except ProcessLookupError:
+            return None
+
+
 def kill_random_node(cluster, exclude_head: bool = True) -> Optional[str]:
     """One-shot helper (the `ray kill-random-node` CLI analog)."""
     killer = NodeKiller(cluster, max_kills=1)

@@ -197,16 +197,26 @@ def main(quick: bool = False) -> dict:
     import ray_tpu
 
     ray_tpu.init(num_cpus=4)
-    results = {}
-    results["many_tasks"] = bench_many_tasks(ray_tpu, 2000 if quick else 10_000)
-    results["many_actors"] = bench_many_actors(ray_tpu, 200 if quick else 1000)
-    results["pg_churn"] = bench_pg_churn(ray_tpu, 50 if quick else 200)
-    results["many_pgs"] = bench_many_pgs(ray_tpu, 200 if quick else 1000)
-    results["queued_tasks"] = bench_queued_tasks(
-        ray_tpu, 20_000 if quick else 100_000)
-    results["compiled_dag"] = bench_compiled_dag(ray_tpu, 20 if quick else 50)
-    print(json.dumps(results))
-    ray_tpu.shutdown()
+    try:
+        results = {}
+        results["many_tasks"] = bench_many_tasks(
+            ray_tpu, 2000 if quick else 10_000)
+        results["many_actors"] = bench_many_actors(
+            ray_tpu, 200 if quick else 1000)
+        results["pg_churn"] = bench_pg_churn(ray_tpu, 50 if quick else 200)
+        results["many_pgs"] = bench_many_pgs(ray_tpu, 200 if quick else 1000)
+        results["queued_tasks"] = bench_queued_tasks(
+            ray_tpu, 20_000 if quick else 100_000)
+        results["compiled_dag"] = bench_compiled_dag(
+            ray_tpu, 20 if quick else 50)
+        print(json.dumps(results))
+    finally:
+        # leak gate: even a partial run must not leave daemons/shm
+        # segments behind to starve the next benchmark
+        ray_tpu.shutdown()
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
     return results
 
 

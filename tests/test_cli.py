@@ -42,6 +42,8 @@ def test_cli_lifecycle():
         r = _cli("stop")
     assert r.returncode == 0, r.stdout + r.stderr
 
+    # headless status is now valid (lifecycle view): it must report the
+    # stopped cluster as fully reaped — zero live sessions
     r = _cli("status")
-    assert r.returncode != 0
-    assert "no running head" in r.stdout
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "live sessions: 0" in r.stdout
