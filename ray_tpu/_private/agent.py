@@ -21,14 +21,16 @@ import os
 import subprocess
 import sys
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import lifecycle
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import StoreDirectory
-from ray_tpu._private.protocol import AsyncRpcClient, Connection, RpcServer
+from ray_tpu._private.protocol import (
+    AsyncRpcClient, Connection, ConnectionPool, RawData, RpcServer)
+from ray_tpu._private.pull_manager import PullManager
 from ray_tpu._private.resources import (
     NodeResources, ResourceSet, label_constraints_match)
 
@@ -108,34 +110,6 @@ class WorkerHandle:
             self.proc = _NeverLaunched()
 
 
-class ConnectionPool:
-    """Cached async clients to remote endpoints, keyed by (host, port)."""
-
-    def __init__(self):
-        self._clients: Dict[Tuple[str, int], AsyncRpcClient] = {}
-        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
-
-    async def get(self, host: str, port: int) -> AsyncRpcClient:
-        key = (host, port)
-        client = self._clients.get(key)
-        if client and client.connected:
-            return client
-        lock = self._locks.setdefault(key, asyncio.Lock())
-        async with lock:
-            client = self._clients.get(key)
-            if client and client.connected:
-                return client
-            client = AsyncRpcClient()
-            await client.connect_tcp(host, port)
-            self._clients[key] = client
-            return client
-
-    def drop(self, host: str, port: int) -> None:
-        client = self._clients.pop((host, port), None)
-        if client:
-            client.close()
-
-
 class NodeAgent:
     def __init__(
         self,
@@ -199,6 +173,18 @@ class NodeAgent:
         # object plane
         self._object_waits: Dict[str, List[asyncio.Future]] = {}
         self._pulls_inflight: Dict[str, asyncio.Task] = {}
+        # cancelled pulls whose cleanup (stripe teardown + store abort) is
+        # still running; a NEW pull of the same object must wait for ALL
+        # of them or an old abort unlinks the new transfer's unsealed
+        # allocation (list: rapid waiter churn can park several)
+        self._pulls_draining: Dict[str, List[asyncio.Task]] = {}
+        # hex -> monotonic stamp of the LAST waiter departure; only the
+        # reap timer matching the current stamp may cancel, so the grace
+        # window always runs full length from the latest detach
+        self._pull_orphan_stamp: Dict[str, float] = {}
+        # serve-side view cache: see _fetch_object_chunk
+        self._serve_view_cache: "OrderedDict[str, list]" = OrderedDict()
+        self.pulls = PullManager(self)
 
         # placement groups: (pg_id, bundle_index) -> reserved ResourceSet
         self._pg_bundles: Dict[Tuple[str, int], ResourceSet] = {}
@@ -271,6 +257,19 @@ class NodeAgent:
         if CONFIG.prestart_workers:
             loop.create_task(self._prestart())
 
+    async def aclose_clients(self) -> None:
+        """Await every outbound client's read loop (head + the per-peer
+        control/data connection pool) so shutdown leaves no pending task."""
+        await self.pool.aclose_all()
+        try:
+            await self.head.aclose()
+        except Exception:
+            pass
+        try:
+            await self.server.close()
+        except Exception:
+            pass
+
     def teardown_processes(self) -> None:
         """Reap everything this agent spawned (workers, forkserver, and —
         via the session registry — grandchildren in foreign pgids). The
@@ -303,6 +302,7 @@ class NodeAgent:
         r("PinObject", self._pin_object)
         r("UnpinObject", self._unpin_object)
         r("GetStoreStats", self._get_store_stats)
+        r("GetPullStats", self._get_pull_stats)
         r("GetNodeInfo", self._get_node_info)
         r("ListWorkers", self._list_workers)
         r("ListEvents", self._list_events)
@@ -362,7 +362,7 @@ class NodeAgent:
             down_since = time.monotonic()
             while True:
                 try:
-                    self.head.close()
+                    await self.head.aclose()
                 except Exception:
                     pass
                 try:
@@ -1317,6 +1317,8 @@ class NodeAgent:
                 continue
             fut = asyncio.get_running_loop().create_future()
             self._object_waits.setdefault(hex_id, []).append(fut)
+            # re-attaching invalidates any pending orphan-reap timer
+            self._pull_orphan_stamp.pop(hex_id, None)
             futs[hex_id] = fut
             owner = owners.get(hex_id)
             if owner and hex_id not in self._pulls_inflight:
@@ -1328,37 +1330,113 @@ class NodeAgent:
             return sum(1 for h in ids if self.store.contains(h))
 
         deadline = None if timeout_ms is None else time.monotonic() + timeout_ms / 1000
-        while ready_count() < num_returns:
-            pending = [f for f in futs.values() if not f.done()]
-            if not pending:
-                break
-            wait_timeout = None
-            if deadline is not None:
-                wait_timeout = deadline - time.monotonic()
-                if wait_timeout <= 0:
+        try:
+            while ready_count() < num_returns:
+                pending = [f for f in futs.values() if not f.done()]
+                if not pending:
                     break
-            # Cap each wait to re-poll the (filesystem-authoritative) store:
-            # seal notifications are fire-and-forget and can be lost if the
-            # sealing worker dies right after store.seal — the object is
-            # still on disk, so the poll keeps waiters from hanging forever.
-            poll_s = CONFIG.object_wait_poll_ms / 1000.0
-            poll = poll_s if wait_timeout is None \
-                else min(wait_timeout, poll_s)
-            done, _ = await asyncio.wait(
-                pending, timeout=poll, return_when=asyncio.FIRST_COMPLETED
-            )
-            if not done and deadline is not None \
-                    and time.monotonic() >= deadline:
-                break
+                wait_timeout = None
+                if deadline is not None:
+                    wait_timeout = deadline - time.monotonic()
+                    if wait_timeout <= 0:
+                        break
+                # Cap each wait to re-poll the (filesystem-authoritative) store:
+                # seal notifications are fire-and-forget and can be lost if the
+                # sealing worker dies right after store.seal — the object is
+                # still on disk, so the poll keeps waiters from hanging forever.
+                poll_s = CONFIG.object_wait_poll_ms / 1000.0
+                poll = poll_s if wait_timeout is None \
+                    else min(wait_timeout, poll_s)
+                done, _ = await asyncio.wait(
+                    pending, timeout=poll, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done and deadline is not None \
+                        and time.monotonic() >= deadline:
+                    break
+        finally:
+            # Deregister this call's waiters; when an object's LAST waiter
+            # leaves (get timed out, caller gone), cancel its in-flight
+            # pull instead of letting it burn the full pull deadline
+            # re-locating an object nobody wants.
+            for hex_id, fut in futs.items():
+                waiters = self._object_waits.get(hex_id)
+                if waiters is None:
+                    continue
+                try:
+                    waiters.remove(fut)
+                except ValueError:
+                    pass
+                if not waiters:
+                    del self._object_waits[hex_id]
+                    self._cancel_orphan_pull(hex_id)
         ready = [h for h in ids if self.store.contains(h)]
         not_ready = [h for h in ids if h not in set(ready)]
         return {"ready": ready, "not_ready": not_ready}
 
+    def _cancel_orphan_pull(self, hex_id: str) -> None:
+        """Schedule cancellation of the pull task for an object with no
+        waiters left — after a grace window, so a get() retried on a short
+        timeout re-attaches to the running transfer instead of restarting
+        it from byte 0. If the grace expires with still no waiter, the
+        task is popped + cancelled (eagerly popped so a later waiter
+        starts fresh instead of parking behind a zombie) and parks in
+        ``_pulls_draining`` so that fresh pull defers to its cleanup (the
+        old abort would unlink the new transfer's unsealed allocation)."""
+        task = self._pulls_inflight.get(hex_id)
+        if task is None or task.done():
+            return
+        stamp = time.monotonic()
+        self._pull_orphan_stamp[hex_id] = stamp
+
+        async def reap():
+            await asyncio.sleep(CONFIG.object_pull_orphan_grace_s)
+            if self._pull_orphan_stamp.get(hex_id) != stamp:
+                # a waiter re-attached (stamp popped) or a LATER detach
+                # re-stamped — only the newest timer may cancel, so the
+                # grace always runs full length from the last departure
+                return
+            self._pull_orphan_stamp.pop(hex_id, None)
+            if self._object_waits.get(hex_id):
+                return  # a new waiter re-attached; keep the pull
+            if self._pulls_inflight.get(hex_id) is not task or task.done():
+                return  # finished, or a different pull took the slot
+            self._pulls_inflight.pop(hex_id, None)
+            task.cancel()
+            self._pulls_draining.setdefault(hex_id, []).append(task)
+
+            def _drained(t, h=hex_id):
+                lst = self._pulls_draining.get(h)
+                if lst is not None:
+                    try:
+                        lst.remove(t)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        self._pulls_draining.pop(h, None)
+
+            task.add_done_callback(_drained)
+
+        asyncio.get_running_loop().create_task(reap())
+
     async def _pull_object(self, hex_id: str, owner: Dict) -> None:
         """Owner-directed pull (reference: pull_manager.h + ownership-based
-        object directory): ask the owner where the object lives, then fetch
-        chunks from that node's agent, or the inline value from the owner."""
+        object directory): ask the owner where the object lives, then hand
+        the holder set to the pull manager — windowed pipeline, multi-
+        holder striping, budgeted admission (pull_manager.py) — or take
+        the inline value from the owner."""
+        task = asyncio.current_task()
         try:
+            while True:
+                # cancelled predecessors may still be tearing down their
+                # transfers (aborting the unsealed store allocation we
+                # would otherwise collide with). asyncio.wait — NOT gather
+                # — so cancelling THIS pull mid-wait never re-cancels a
+                # predecessor out of its cleanup.
+                draining = [t for t in self._pulls_draining.get(hex_id, [])
+                            if not t.done()]
+                if not draining:
+                    break
+                await asyncio.wait(draining)
             deadline = time.monotonic() + CONFIG.object_pull_deadline_s
             dead_rounds = 0
             while time.monotonic() < deadline:
@@ -1370,6 +1448,8 @@ class NodeAgent:
                         "LocateObject", {"object_id": hex_id},
                         timeout=CONFIG.object_locate_timeout_s
                     )
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     await asyncio.sleep(CONFIG.object_pull_retry_s)
                     continue
@@ -1386,27 +1466,22 @@ class NodeAgent:
                     a for a in loc.get("locations", [])
                     if not (a.get("host") == "127.0.0.1"
                             and a.get("port") == self.tcp_port)]
-                statuses = []
-                done = False
-                for node_addr in remote_locs:
-                    st = await self._fetch_from_node(hex_id, node_addr)
-                    statuses.append(st)
-                    if st == "ok":
-                        done = True
-                        self._notify_sealed(hex_id)
-                        # Tell the owner we now hold a copy.
-                        try:
-                            await client.push(
-                                "ObjectLocationAdded",
-                                {"object_id": hex_id,
-                                 "addr": {"host": "127.0.0.1", "port": self.tcp_port}},
-                            )
-                        except Exception:
-                            pass
-                        break
-                if done:
+                st = "absent"
+                if remote_locs:
+                    st = await self.pulls.fetch(hex_id, remote_locs)
+                if st == "ok":
+                    self._notify_sealed(hex_id)
+                    # Tell the owner we now hold a copy.
+                    try:
+                        await client.push(
+                            "ObjectLocationAdded",
+                            {"object_id": hex_id,
+                             "addr": {"host": "127.0.0.1", "port": self.tcp_port}},
+                        )
+                    except Exception:
+                        pass
                     return
-                if remote_locs and all(st == "conn" for st in statuses):
+                if remote_locs and st == "conn":
                     # Every advertised holder is connection-dead (not merely
                     # missing the object or a local hiccup). After a few
                     # rounds, fail the wait so the owner's lineage recovery
@@ -1422,56 +1497,23 @@ class NodeAgent:
                 else:
                     dead_rounds = 0
                 await asyncio.sleep(CONFIG.object_pull_round_s)
+            # deadline exhausted: fail the waiters so a timeout-less
+            # WaitObjects (and the get() blocked on it) sees a lost
+            # verdict instead of polling forever on futures nobody will
+            # ever resolve
+            for fut in self._object_waits.pop(hex_id, []):
+                if not fut.done():
+                    fut.set_result(False)
         finally:
-            self._pulls_inflight.pop(hex_id, None)
+            # identity-guarded: an orphan-cancel may have popped this task
+            # already and a NEW pull registered under the same object
+            if self._pulls_inflight.get(hex_id) is task:
+                self._pulls_inflight.pop(hex_id, None)
 
     def _notify_sealed(self, hex_id: str) -> None:
         for fut in self._object_waits.pop(hex_id, []):
             if not fut.done():
                 fut.set_result(True)
-
-    async def _fetch_from_node(self, hex_id: str, addr: Dict) -> str:
-        """Returns 'ok' | 'absent' (holder alive, object not there) |
-        'conn' (holder unreachable) | 'local' (local store error). Only
-        'conn' counts toward the pull loop's dead-holder fast-fail."""
-        try:
-            client = await self.pool.get(addr["host"], addr["port"])
-            meta = await client.call(
-            "FetchObjectMeta", {"object_id": hex_id},
-            timeout=CONFIG.object_locate_timeout_s)
-        except Exception:
-            self.pool.drop(addr["host"], addr["port"])
-            return "conn"
-        if not meta or not meta.get("exists"):
-            return "absent"
-        size = meta["size"]
-        oid = ObjectID.from_hex(hex_id)
-        try:
-            view, handle = self.store.client.create(oid, size)
-        except Exception:
-            return "local"
-        try:
-            chunk = CONFIG.object_chunk_size_bytes
-            off = 0
-            while off < size:
-                n = min(chunk, size - off)
-                data = await client.call(
-                    "FetchObjectChunk",
-                    {"object_id": hex_id, "offset": off, "length": n},
-                    timeout=CONFIG.object_chunk_fetch_timeout_s,
-                )
-                if data is None:
-                    raise IOError("remote chunk missing")
-                view[off : off + len(data)] = data
-                off += len(data)
-                self._chunks_fetched = getattr(
-                    self, "_chunks_fetched", 0) + 1
-            self.store.client.seal(oid, handle)
-            self.store.on_sealed(hex_id, size)
-            return "ok"
-        except Exception:
-            self.store.client.abort(handle)
-            return "conn"
 
     async def _fetch_object_meta(self, conn: Connection, p: Dict) -> Dict:
         hex_id = p["object_id"]
@@ -1480,16 +1522,46 @@ class NodeAgent:
             return {"exists": False}
         return {"exists": True, "size": len(view)}
 
-    async def _fetch_object_chunk(self, conn: Connection, p: Dict) -> Optional[bytes]:
-        view = self.store.read_maybe_spilled(p["object_id"])
-        if view is None:
-            return None
+    async def _fetch_object_chunk(self, conn: Connection, p: Dict):
+        hex_id = p["object_id"]
+        # Per-transfer view cache: a windowed pull asks for the SAME object
+        # dozens of times in a burst; re-resolving the store view per chunk
+        # (native store: lock + pin + finalizer each) was measurable on the
+        # serve hot path. Tiny LRU, and TIME-BOUNDED: a cached view pins
+        # its object (native arena LRU cannot evict it), so entries idle
+        # past the TTL are purged by the node-stats loop — the cache only
+        # ever holds objects mid-transfer, never cold ones.
+        cache = self._serve_view_cache
+        entry = cache.get(hex_id)
+        if entry is None:
+            view = self.store.read_maybe_spilled(hex_id)
+            if view is None:
+                return None
+            cache[hex_id] = [view, time.monotonic()]
+            # cap must exceed the batched-get fan-in (8 concurrent
+            # transfers from one holder is the common burst) or the LRU
+            # thrashes mid-transfer entries on every insert
+            while len(cache) > 16:
+                cache.popitem(last=False)
+        else:
+            view = entry[0]
+            entry[1] = time.monotonic()
+            cache.move_to_end(hex_id)
         off, length = p["offset"], p["length"]
         self._chunks_served = getattr(self, "_chunks_served", 0) + 1
-        return bytes(view[off : off + length])
+        # RawData: header + raw writer.write of the store view slice — no
+        # bytes() materialization, no msgpack re-pack of the payload
+        return RawData(view[off : off + length])
+
+    async def _get_pull_stats(self, conn: Connection, p) -> Dict:
+        stats = self.pulls.stats()
+        stats["chunks_served"] = getattr(self, "_chunks_served", 0)
+        return stats
 
     async def _free_objects(self, conn: Connection, p: Dict) -> None:
         for hex_id in p["ids"]:
+            # release the serve view (and its pin) before the store delete
+            self._serve_view_cache.pop(hex_id, None)
             self.store.delete(hex_id)
 
     async def _pin_object(self, conn: Connection, p: Dict) -> None:
@@ -1577,18 +1649,29 @@ class NodeAgent:
         period = max(CONFIG.metrics_report_interval_ms, 1000) / 1000
         self.node_stats: Dict = {}
         while True:
+            # purge serve-view cache entries idle past ~2 ticks: a held
+            # view pins its object against store eviction, so the cache
+            # must never outlive the transfer burst it accelerates
+            cache = self._serve_view_cache
+            cutoff = time.monotonic() - 2 * period
+            for hex_id in [h for h, e in cache.items() if e[1] < cutoff]:
+                cache.pop(hex_id, None)
             try:
                 self.node_stats = await asyncio.to_thread(
                     self._sample_node_stats)
                 # publish as Prometheus-schema gauges through the same KV
                 # pipeline user metrics ride (util/metrics.py flush_now)
-                from ray_tpu.util.metrics import make_gauge_snapshot
+                from ray_tpu.util.metrics import (
+                    make_counter_snapshot, make_gauge_snapshot)
 
                 st = self.node_stats
                 tags = {"node_id": self.node_id}
 
                 def gauge(name, desc, value):
                     return make_gauge_snapshot(name, desc, value, tags)
+
+                def counter(name, desc, value):
+                    return make_counter_snapshot(name, desc, value, tags)
 
                 store_stats = st["object_store"]
                 disk = st.get("disk") or {}
@@ -1657,15 +1740,35 @@ class NodeAgent:
                     gauge("ray_tpu_object_restored_total",
                           "Spilled objects restored.",
                           getattr(self, "_restored_count", 0)),
-                    gauge("ray_tpu_object_chunks_served_total",
-                          "Object chunks served to remote nodes.",
-                          getattr(self, "_chunks_served", 0)),
-                    gauge("ray_tpu_object_chunks_fetched_total",
-                          "Object chunks fetched from remote nodes.",
-                          getattr(self, "_chunks_fetched", 0)),
+                    counter("ray_tpu_object_chunks_served_total",
+                            "Object chunks served to remote nodes.",
+                            getattr(self, "_chunks_served", 0)),
+                    counter("ray_tpu_object_chunks_fetched_total",
+                            "Object chunks fetched from remote nodes.",
+                            self.pulls.chunks_fetched),
                     gauge("ray_tpu_object_pulls_inflight",
                           "Cross-node object pulls in progress.",
                           len(self._pulls_inflight)),
+                    # pull pipeline (reference: object_manager chunk/window
+                    # stats + pull_manager admission counters)
+                    gauge("ray_tpu_object_pull_window_occupancy",
+                          "Chunk RPCs in flight across all transfers.",
+                          self.pulls.window_occupancy),
+                    gauge("ray_tpu_object_pull_inflight_bytes",
+                          "Unsealed pull bytes admitted on the node.",
+                          self.pulls.budget.inflight),
+                    gauge("ray_tpu_object_pull_queued",
+                          "Transfers waiting on the pull byte budget.",
+                          self.pulls.budget.queued),
+                    counter("ray_tpu_object_pull_queued_total",
+                            "Transfers that ever queued on the budget.",
+                            self.pulls.budget.queued_total),
+                    counter("ray_tpu_object_pull_bytes_total",
+                            "Bytes fetched from remote nodes.",
+                            self.pulls.bytes_fetched),
+                    counter("ray_tpu_object_pull_stripe_failovers_total",
+                            "Chunk stripes failed over to another holder.",
+                            self.pulls.stripe_failovers),
                     gauge("ray_tpu_object_waits_pending",
                           "Local seal-wait futures outstanding.",
                           sum(len(v) for v in self._object_waits.values())),
@@ -1855,6 +1958,14 @@ def main() -> None:
         except (NotImplementedError, RuntimeError):
             pass
         await stop.wait()
+        # close RPC clients cleanly (cancel + await read loops) BEFORE the
+        # loop dies: a close() here would strand cancelled tasks and spray
+        # "Task was destroyed but it is pending!" into the agent log the
+        # log monitor streams to the driver
+        try:
+            await asyncio.wait_for(agent.aclose_clients(), timeout=2)
+        except Exception:
+            pass
         # guaranteed teardown: the agent owns its node's process tree
         await asyncio.to_thread(agent.teardown_processes)
         proc_profile.dump(prof, "agent")

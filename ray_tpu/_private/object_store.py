@@ -396,7 +396,11 @@ class StoreDirectory:
         return True
 
     def restore(self, object_id_hex: str) -> bool:
-        """Bring a spilled object back into shm."""
+        """Bring a spilled object back into shm, streaming the file through
+        ``create()``/``seal()`` in chunks — a whole-file ``read()`` held the
+        object twice (bytes blob + store copy), so restoring a
+        near-capacity object doubled peak memory exactly when the store
+        was under the most pressure."""
         with self._lock:
             if object_id_hex in self._objects:
                 return True
@@ -404,12 +408,26 @@ class StoreDirectory:
             if size is None:
                 return False
             path = os.path.join(self.spill_dir, object_id_hex)
-            with open(path, "rb") as f:
-                data = f.read()
-            self._ensure_space(len(data))
-            self.client.put_bytes(ObjectID.from_hex(object_id_hex), data)
-            self._objects[object_id_hex] = len(data)
-            self.used += len(data)
+            self._ensure_space(size)
+            oid = ObjectID.from_hex(object_id_hex)
+            view, handle = self.client.create(oid, size)
+            chunk = max(1, CONFIG.object_chunk_size_bytes)
+            try:
+                with open(path, "rb") as f:
+                    off = 0
+                    while off < size:
+                        n = f.readinto(view[off:off + min(chunk, size - off)])
+                        if not n:
+                            raise IOError(
+                                f"spilled object {object_id_hex} truncated "
+                                f"at {off}/{size} bytes")
+                        off += n
+            except Exception:
+                self.client.abort(handle)
+                raise
+            self.client.seal(oid, handle)
+            self._objects[object_id_hex] = size
+            self.used += size
             self._spilled.pop(object_id_hex)
             os.unlink(path)
             return True

@@ -37,6 +37,7 @@ from ray_tpu._private.object_store import StoreClient, make_store_client
 from ray_tpu._private.protocol import (
     AsyncRpcClient,
     Connection,
+    ConnectionPool,
     RpcError,
     RpcServer,
 )
@@ -288,7 +289,7 @@ class Worker:
         self._tasks: Dict[bytes, TaskRecord] = {}
         self._actor_states: Dict[bytes, "_ActorState"] = {}
         self._actor_sub_started = False
-        self._owner_conn_pool: Dict[Tuple[str, int], AsyncRpcClient] = {}
+        self._owner_conn_pool = ConnectionPool()
         self.current_task_info = threading.local()
         self.task_events: List[Dict] = []
         self.actor_instance = None  # set in actor workers
@@ -454,7 +455,7 @@ class Worker:
             delay = 0.2
             while self.connected:
                 try:
-                    self.head.close()
+                    await self.head.aclose()
                 except Exception:
                     pass
                 try:
@@ -474,10 +475,10 @@ class Worker:
             # cancel AND await each client's read loop (aclose): a
             # cancelled-but-never-awaited task left on a stopping loop is
             # exactly the "Task was destroyed but it is pending!" warning
-            for client in (self.agent, self.head,
-                           *self._owner_conn_pool.values()):
+            for client in (self.agent, self.head):
                 if client is not None:
                     await client.aclose()
+            await self._owner_conn_pool.aclose_all()
 
         try:
             self._acall(_close(), timeout=5)
@@ -485,17 +486,22 @@ class Worker:
             pass
         if self.loop:
             def _stop():
-                pending = [t for t in asyncio.all_tasks(self.loop)
-                           if t is not asyncio.current_task(self.loop)]
-                for task in pending:
-                    task.cancel()
-
                 async def _drain():
                     # consume every cancellation before the loop dies so
-                    # no task is destroyed while pending; bounded so one
-                    # uncancellable straggler can't wedge disconnect
-                    if pending:
-                        await asyncio.wait(pending, timeout=3)
+                    # no task is destroyed while pending. Multi-round: a
+                    # cancelled task's cleanup (close_soon, disconnect
+                    # handlers) can SPAWN new tasks after the first
+                    # snapshot — each round re-snapshots; bounded so one
+                    # uncancellable straggler can't wedge disconnect.
+                    me = asyncio.current_task(self.loop)
+                    for _ in range(3):
+                        pending = [t for t in asyncio.all_tasks(self.loop)
+                                   if t is not me and not t.done()]
+                        if not pending:
+                            break
+                        for task in pending:
+                            task.cancel()
+                        await asyncio.wait(pending, timeout=2)
                     self.loop.stop()
 
                 self.loop.create_task(_drain())
@@ -686,14 +692,11 @@ class Worker:
             pass
 
     async def _owner_client(self, addr: Dict) -> AsyncRpcClient:
-        key = (addr["host"], addr["port"])
-        client = self._owner_conn_pool.get(key)
-        if client and client.connected:
-            return client
-        client = AsyncRpcClient()
-        await client.connect_tcp(addr["host"], addr["port"])
-        self._owner_conn_pool[key] = client
-        return client
+        # shared race-guarded pool (protocol.ConnectionPool): concurrent
+        # spillback leases to one agent used to both connect and leak the
+        # overwritten loser's read loop — the bench-tail "second client in
+        # the connection pool" destroyed-pending warning
+        return await self._owner_conn_pool.get(addr["host"], addr["port"])
 
     # ------------------------------------------------------------------ put
     def put(self, value: Any) -> ObjectRef:
@@ -736,6 +739,7 @@ class Worker:
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         self._n_gets = getattr(self, "_n_gets", 0) + 1
         deadline = None if timeout is None else time.monotonic() + timeout
+        self._prefetch_plasma(refs)
         out: List[Any] = [None] * len(refs)
         for i, ref in enumerate(refs):
             remaining = None
@@ -743,6 +747,42 @@ class Worker:
                 remaining = max(0.0, deadline - time.monotonic())
             out[i] = self._get_one(ref, remaining)
         return out
+
+    def _prefetch_plasma(self, refs: List[ObjectRef]) -> None:
+        """One WaitObjects frame covering every plasma-backed ref not yet
+        local, so the agent STARTS all the pulls concurrently. Without
+        this, the per-ref loop below paid one sequential cross-node pull
+        latency per ref (N remote args -> N round trips); with it, N refs
+        cost ~1 pull latency. num_returns=0 makes it pure initiation — it
+        never blocks, so a lost/evicted ref costs exactly the serial
+        path's verdict time, not a doubled one; the started pulls survive
+        waiter-less stretches via the orphan grace window while the
+        per-ref loop (full timeout/lost/recovery handling) catches up."""
+        need: Dict[str, ObjectRef] = {}
+        for ref in refs:
+            hex_id = ref.hex()
+            if hex_id in need:
+                continue
+            entry = self.memory_store.get(ref.binary())
+            meta = self.reference_counter.get_owned_meta(ref.binary())
+            in_plasma = (entry is not None and entry[1] == IN_PLASMA) or (
+                meta is not None and meta.state == "plasma")
+            if not in_plasma or self.store.contains(ref.id()):
+                continue
+            need[hex_id] = ref
+        if len(need) < 2:
+            return  # the serial path's own WaitObjects is one call anyway
+        try:
+            # bounded: a stalled agent loop must surface as the per-ref
+            # path's GetTimeoutError, not hang the prefetch forever
+            self._acall(self.agent.call("WaitObjects", {
+                "ids": list(need),
+                "owners": {h: r.owner_addr() for h, r in need.items()},
+                "num_returns": 0,
+                "timeout_ms": 0,
+            }), timeout=5)
+        except Exception:
+            pass
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
         binary = ref.binary()
@@ -2032,7 +2072,7 @@ class _LeasePool:
         except Exception:
             pass
         if conn.client:
-            conn.client.close()
+            await conn.client.aclose()
 
 
 class _ActorState:
@@ -2081,12 +2121,12 @@ class _ActorState:
             worker._loop_call(self._flush, worker)
         elif self.state in ("RESTARTING",):
             if self.client:
-                self.client.close()
+                self.client.close_soon()
                 self.client = None
             self.addr = None
         elif self.state == "DEAD" and old_state != "DEAD":
             if self.client:
-                self.client.close()
+                self.client.close_soon()
                 self.client = None
             worker._loop_call(self._fail_all, worker)
 

@@ -121,6 +121,17 @@ def make_gauge_snapshot(name: str, description: str, value: float,
             "values": [[tag_list, value]]}
 
 
+def make_counter_snapshot(name: str, description: str, value: float,
+                          tags: Optional[Dict[str, str]] = None) -> Dict:
+    """Counter-kind snapshot for monotonically increasing runtime totals
+    (chunks served, pull bytes, ...). Distinct from make_gauge_snapshot
+    because the merge in prometheus_text() SUMS counters across
+    publishers that share a tag set, while gauges overwrite."""
+    tag_list = [[k, v] for k, v in (tags or {}).items()]
+    return {"name": name, "kind": "counter", "description": description,
+            "values": [[tag_list, value]]}
+
+
 # ------------------------------------------------------------- aggregation
 def _ensure_flusher() -> None:
     global _flusher_started

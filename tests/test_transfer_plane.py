@@ -1,0 +1,303 @@
+"""Pipelined multi-stream object transfer plane (reference:
+``object_manager.h:117`` windowed Push/Pull chunking + ``pull_manager.h``
+admission control).
+
+Two-node (localhost) integration: a large pull lands byte-identical under
+the windowed pipeline; a holder killed mid-transfer yields failover or a
+clean lost verdict (never a hung ``get``); the pull byte budget queues a
+burst of concurrent large gets. Plus event-loop unit tests for the raw
+chunk framing, the FIFO budget, and the streaming spill restore.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import StoreDirectory
+from ray_tpu._private.protocol import AsyncRpcClient, RawData, RpcServer
+from ray_tpu._private.pull_manager import PullBudget
+from ray_tpu.cluster_utils import Cluster
+
+MB = 1024 * 1024
+
+
+def _pull_stats():
+    """Pull-plane counters of the agent THIS driver is attached to (the
+    pulling side of every cross-node get below)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    return w._acall(w.agent.call("GetPullStats", {}))
+
+
+@pytest.fixture
+def two_node(monkeypatch):
+    """Factory: env knobs -> (cluster, far_node). Env must be set before
+    the cluster boots — agents read RAY_TPU_* from their inherited env."""
+    made = []
+
+    def boot(env=None):
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        made.append(cluster)
+        ray_tpu.init(_node=cluster.head_node)
+        node = cluster.add_node(num_cpus=2, resources={"far": 4})
+        cluster.wait_for_nodes()
+        return cluster, node
+
+    yield boot
+    try:
+        ray_tpu.shutdown()
+    finally:
+        for cluster in made:
+            cluster.shutdown()
+
+
+def test_large_pull_byte_identical(two_node):
+    """64 MB produced on the far node arrives byte-identical through the
+    windowed, striped, raw-framed pipeline (out-of-order chunk completion
+    must not scramble offsets)."""
+    two_node()
+
+    @ray_tpu.remote(resources={"far": 1})
+    def produce():
+        rng = np.random.default_rng(1234)
+        return rng.integers(0, 255, 64 * MB, dtype=np.uint8)
+
+    ref = produce.remote()
+    value = ray_tpu.get(ref, timeout=300)
+    expected = np.random.default_rng(1234).integers(
+        0, 255, 64 * MB, dtype=np.uint8)
+    assert value.dtype == np.uint8 and value.nbytes == 64 * MB
+    assert np.array_equal(value, expected)
+    stats = _pull_stats()
+    assert stats["transfers_ok"] >= 1
+    # a real multi-chunk pipeline ran (64 chunks at the 1 MB default;
+    # still >= 13 for any chunk size up to ~4.9 MB)
+    assert stats["chunks_fetched"] >= 13
+    assert stats["bytes_fetched"] >= 64 * MB
+    assert stats["inflight_bytes"] == 0  # budget fully retired
+
+
+def test_batched_get_pulls_concurrently(two_node):
+    """One `get` of 8 cross-node refs issues ONE WaitObjects frame, so the
+    agent overlaps all 8 transfers: wall time must look like ~1 pull, not
+    ~8 sequential pulls."""
+    two_node()
+
+    @ray_tpu.remote(resources={"far": 0.25})
+    def produce(i):
+        return np.full(4 * MB, i, dtype=np.uint8)
+
+    refs = [produce.remote(i) for i in range(8)]
+    ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+    assert len(ready) == len(refs)
+
+    t0 = time.perf_counter()
+    one = ray_tpu.get(refs[0], timeout=120)
+    t_one = time.perf_counter() - t0
+
+    refs2 = [produce.remote(i) for i in range(8)]  # fresh object ids
+    ready, _ = ray_tpu.wait(refs2, num_returns=len(refs2), timeout=120)
+    assert len(ready) == len(refs2)
+    t0 = time.perf_counter()
+    values = ray_tpu.get(refs2, timeout=120)
+    t_all = time.perf_counter() - t0
+
+    assert one[0] == 0
+    for i, v in enumerate(values):
+        assert v[0] == i and v.nbytes == 4 * MB
+    # generous bound (CI boxes jitter): 8 concurrent pulls must not cost
+    # anywhere near 8 sequential ones
+    assert t_all < max(8 * t_one * 0.75, t_one + 2.0), (
+        f"batched get looks sequential: one={t_one:.3f}s all={t_all:.3f}s")
+
+
+def test_holder_killed_mid_transfer_no_hang(two_node):
+    """SIGKILL the only holder's agent while chunks stream (tiny chunks +
+    narrow window stretch the transfer). The get must end — value (raced
+    the kill) or clean lost verdict — never hang."""
+    from ray_tpu.util.chaos import DaemonKiller
+
+    cluster, node = two_node(env={
+        "RAY_TPU_OBJECT_CHUNK_SIZE_BYTES": str(128 * 1024),
+        "RAY_TPU_OBJECT_PULL_WINDOW": "2",
+        "RAY_TPU_PULL_DEAD_HOLDER_ROUNDS": "2",
+        "RAY_TPU_OBJECT_PULL_DEADLINE_S": "45",
+    })
+
+    @ray_tpu.remote(resources={"far": 1}, max_retries=0)
+    def produce():
+        return np.ones(48 * MB, dtype=np.uint8)
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=120)
+    assert ready, "produce() did not finish"
+
+    outcome = {}
+
+    def getter():
+        try:
+            outcome["value"] = ray_tpu.get(ref, timeout=90)
+        except Exception as e:  # noqa: BLE001 — the verdict IS the test
+            outcome["error"] = e
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.05)  # let the transfer start
+    killer = DaemonKiller(cluster.session_dir, roles=("agent",), max_kills=1)
+    record = killer.kill_target(
+        {"role": "agent", "pid": node.agent_proc.pid})
+    assert record is not None, "holder agent was not killed"
+    t.join(timeout=120)
+    assert not t.is_alive(), "get() hung after the holder died"
+    assert outcome, "getter finished without a verdict"
+    if "value" in outcome:  # transfer raced the kill and won
+        assert outcome["value"].nbytes == 48 * MB
+        assert int(outcome["value"][0]) == 1
+    else:
+        # clean lost/timeout verdict — never a partial object, never a hang
+        assert isinstance(outcome["error"], Exception)
+
+
+def test_pull_budget_queues_burst(two_node):
+    """A burst of concurrent large gets must queue on the admission budget
+    (cap unsealed pull bytes), admit FIFO as bytes retire, and still land
+    every object intact."""
+    two_node(env={
+        # one ~8 MB transfer in flight at a time; the other three queue
+        "RAY_TPU_OBJECT_PULL_MAX_INFLIGHT_BYTES": str(9 * MB),
+        "RAY_TPU_OBJECT_CHUNK_SIZE_BYTES": str(1 * MB),
+    })
+
+    @ray_tpu.remote(resources={"far": 0.25})
+    def produce(i):
+        return np.full(8 * MB, i, dtype=np.uint8)
+
+    refs = [produce.remote(i) for i in range(4)]
+    ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+    assert len(ready) == len(refs)
+    values = ray_tpu.get(refs, timeout=300)  # batched -> concurrent pulls
+    for i, v in enumerate(values):
+        assert v.nbytes == 8 * MB and int(v[0]) == i
+    stats = _pull_stats()
+    assert stats["transfers_ok"] >= 4
+    assert stats["pulls_queued_total"] >= 1, (
+        f"budget never queued a transfer: {stats}")
+    assert stats["inflight_bytes"] == 0
+    assert stats["pulls_queued"] == 0
+
+
+# ---------------------------------------------------------------------------
+# event-loop / store unit tests (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_raw_chunk_framing_roundtrip():
+    """RawData replies (header + raw bytes on the wire) resolve to the
+    exact payload and interleave safely with normal msgpack replies on one
+    connection."""
+    payload = os.urandom(MB)
+
+    async def scenario():
+        server = RpcServer("raw-test")
+
+        async def fetch(conn, p):
+            off, length = p["offset"], p["length"]
+            return RawData(memoryview(payload)[off:off + length])
+
+        async def ping(conn, p):
+            return {"pong": True}
+
+        server.add_handler("Fetch", fetch)
+        server.add_handler("Ping", ping)
+        port = await server.start_tcp("127.0.0.1", 0)
+        client = AsyncRpcClient()
+        await client.connect_tcp("127.0.0.1", port)
+        try:
+            out = await client.call("Fetch", {"offset": 100, "length": 1000})
+            assert out == payload[100:1100]
+            empty = await client.call("Fetch", {"offset": 0, "length": 0})
+            assert empty == b""
+            results = await asyncio.gather(
+                client.call("Fetch", {"offset": 0, "length": MB}),
+                client.call("Ping", {}),
+                client.call("Fetch", {"offset": 5, "length": 7}),
+            )
+            assert results[0] == payload
+            assert results[1] == {"pong": True}
+            assert results[2] == payload[5:12]
+        finally:
+            await client.aclose()
+            await server.close()
+
+    asyncio.run(scenario())
+
+
+def test_pull_budget_fifo():
+    """FIFO admission: a waiter admits only when bytes retire, in arrival
+    order; an oversized transfer admits alone once the pipe is empty; a
+    cancelled waiter neither admits nor wedges the queue."""
+
+    async def scenario():
+        b = PullBudget(10)
+        await b.acquire(6)
+        assert b.inflight == 6
+        second = asyncio.ensure_future(b.acquire(6))
+        third = asyncio.ensure_future(b.acquire(2))
+        await asyncio.sleep(0)
+        # 2 would fit, but FIFO order holds it behind the queued 6
+        assert b.queued == 2 and b.inflight == 6
+        b.release(6)
+        await second
+        await third
+        assert b.inflight == 8 and b.queued == 0
+        assert b.queued_total == 2
+        b.release(6)
+        b.release(2)
+        # oversized admits alone on an empty pipe
+        await b.acquire(100)
+        assert b.inflight == 100
+        follower = asyncio.ensure_future(b.acquire(1))
+        await asyncio.sleep(0)
+        assert b.queued == 1
+        follower.cancel()
+        await asyncio.gather(follower, return_exceptions=True)
+        b.release(100)
+        # the cancelled waiter must not have admitted or blocked anyone
+        assert b.inflight == 0 and b.queued == 0
+        await b.acquire(5)
+        assert b.inflight == 5
+
+    asyncio.run(scenario())
+
+
+def test_restore_streams_spilled_object(tmp_path, monkeypatch):
+    """restore() streams the spilled file through create()/seal() in
+    chunks — byte-identical round trip without a whole-file bytes blob."""
+    monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
+    monkeypatch.setenv("RAY_TPU_OBJECT_CHUNK_SIZE_BYTES", str(64 * 1024))
+    store = StoreDirectory(str(tmp_path / "store"), capacity=64 * MB)
+    oid = ObjectID(os.urandom(20))
+    data = os.urandom(3 * MB + 12345)  # not chunk-aligned on purpose
+    store.client.put_bytes(oid, data)
+    store.on_sealed(oid.hex(), len(data))
+
+    assert store._spill(oid.hex())
+    assert store.is_spilled(oid.hex())
+    assert store.client.get_view(oid) is None
+
+    assert store.restore(oid.hex())
+    view = store.client.get_view(oid)
+    assert view is not None
+    assert bytes(view[:len(data)]) == data
+    assert not store.is_spilled(oid.hex())
+    assert store.used == len(data)
