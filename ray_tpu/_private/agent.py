@@ -29,7 +29,8 @@ from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import StoreDirectory
 from ray_tpu._private.protocol import (
-    AsyncRpcClient, Connection, ConnectionPool, RawData, RpcServer)
+    AsyncRpcClient, Connection, ConnectionPool, RawData, RpcServer,
+    retry_call, set_fault_self_id)
 from ray_tpu._private.pull_manager import PullManager
 from ray_tpu._private.resources import (
     NodeResources, ResourceSet, label_constraints_match)
@@ -50,6 +51,13 @@ def _env_key_language(env_key):
         return None
     lang = env.get("language") if isinstance(env, dict) else None
     return lang if isinstance(lang, str) else None
+
+
+class NodeFencedError(Exception):
+    """The head rejected this agent's registration: the node's incarnation
+    was fenced after a death verdict (we were partitioned away and the
+    cluster moved on). The only safe move is to stop existing — any lease
+    we still hold or object we would still serve is a zombie."""
 
 
 class _NeverLaunched:
@@ -123,6 +131,11 @@ class NodeAgent:
         object_store_memory: Optional[int] = None,
     ):
         self.node_id = node_id
+        # per-boot incarnation: strictly increases across restarts of an
+        # agent under the same node_id, so the head can fence a dead
+        # incarnation while letting a fresh boot rejoin (ns resolution —
+        # two boots within one tick would defeat the fence)
+        self.incarnation = time.time_ns()
         self.session_dir = session_dir
         self.head_host = head_host
         self.head_port = head_port
@@ -313,6 +326,12 @@ class NodeAgent:
         # remote agents
         r("FetchObjectMeta", self._fetch_object_meta)
         r("FetchObjectChunk", self._fetch_object_chunk)
+        r("Ping", self._ping)
+
+    async def _ping(self, conn: Connection, p) -> Dict:
+        """Liveness probe target (idle-deadline monitors, chaos tooling)."""
+        return {"ok": True, "node_id": self.node_id,
+                "incarnation": self.incarnation}
 
     async def _prestart(self) -> None:
         for _ in range(min(self.max_workers, int(self.resources.total.get("CPU")) or 1)):
@@ -324,17 +343,49 @@ class NodeAgent:
     async def _connect_head(self) -> None:
         await self.head.connect_tcp(self.head_host, self.head_port)
         self.head.set_push_handler(self._on_head_push)
+        # bounded: a one-way partition eats the request without an RST, and
+        # an unbounded call would wedge the watchdog's reconnect loop on
+        # its very first attempt (it could then never deliver a fence
+        # verdict after the partition heals)
         reply = await self.head.call(
             "RegisterNode",
             {
                 "node_id": self.node_id,
+                "incarnation": self.incarnation,
                 "addr": {"host": "127.0.0.1", "port": self.tcp_port},
                 "resources": self.resources.to_wire(),
             },
+            timeout=max(CONFIG.head_ping_timeout_s * 2, 5.0),
         )
+        if reply.get("fenced"):
+            raise NodeFencedError(
+                f"node {self.node_id[:12]} incarnation {self.incarnation} "
+                "was fenced by the head")
         CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
         self.cluster_view = reply.get("cluster_view", {})
         self._resources_dirty = True
+
+    def _fenced_suicide(self) -> None:
+        """The head fenced us: tear down every process this node spawned
+        (workers holding zombie leases, the forkserver) and exit. After a
+        healed partition this is what converges the lifecycle pid
+        registry to zero instead of leaving a shadow cluster."""
+        from ray_tpu._private.event import report_event
+
+        try:
+            report_event("ERROR", "NODE_FENCED_EXIT",
+                         f"node {self.node_id[:12]} fenced by head; "
+                         "terminating",
+                         node_id=self.node_id,
+                         incarnation=self.incarnation)
+        except Exception:
+            pass
+        self.teardown_processes()
+        try:
+            lifecycle.unregister_process(self.session_dir, os.getpid())
+        except Exception:
+            pass
+        os._exit(1)
 
     async def _head_watchdog_loop(self) -> None:
         """Survive a head restart (reference: GCS fault tolerance —
@@ -370,6 +421,10 @@ class NodeAgent:
                     # stream and restarts the read loop on self.head
                     await self._connect_head()
                     break
+                except NodeFencedError:
+                    # the cluster declared this incarnation dead while we
+                    # were partitioned; self-terminate (no zombie leases)
+                    self._fenced_suicide()
                 except Exception:
                     if time.monotonic() - down_since > give_up_s:
                         self.teardown_processes()
@@ -393,10 +448,26 @@ class NodeAgent:
             )
         elif method == "ReturnPGBundle":
             self._return_pg_bundle(payload)
+        elif method == "NodeRemoved":
+            self._on_peer_node_removed(payload)
         elif method == "Pub":
             pass
         elif method == "Drain":
             pass
+
+    def _on_peer_node_removed(self, payload: Dict) -> None:
+        """Fail-fast on a peer's death verdict: purge it from the gossip
+        view immediately (spillback must stop targeting it) and drop the
+        cached control/data channels so every in-flight RPC to it — chunk
+        fetches mid-pull, spilled lease requests — fails NOW instead of
+        waiting out a 60 s chunk deadline on a socket a partition will
+        never reset."""
+        node_id = payload.get("node_id")
+        if node_id:
+            self.cluster_view.pop(node_id, None)
+        addr = payload.get("addr") or {}
+        if addr.get("host") is not None and addr.get("port") is not None:
+            self.pulls.on_peer_removed(addr)  # drops ctrl+data channels
 
     async def _resource_report_loop(self) -> None:
         """Versioned delta gossip (reference: ray_syncer.h:88 — versioned
@@ -796,10 +867,15 @@ class NodeAgent:
         if handle.leased_to:
             self._release_lease(handle.leased_to, handle)
         if handle.is_actor and handle.actor_id:
+            # bounded retry with jitter: ActorDied is idempotent, and
+            # dropping it during a head blip would leave the actor ALIVE
+            # in the registry forever (callers keep dispatching into a
+            # dead worker)
             try:
-                await self.head.call(
-                    "ActorDied", {"actor_id": handle.actor_id, "reason": reason}
-                )
+                await retry_call(lambda: self.head.call(
+                    "ActorDied",
+                    {"actor_id": handle.actor_id, "reason": reason},
+                    timeout=CONFIG.head_ping_timeout_s))
             except Exception:
                 pass
         if handle.alive:
@@ -1931,6 +2007,9 @@ def main() -> None:
         from ray_tpu._private import proc_profile
 
         lifecycle.register_self("agent", args.session_dir, args.node_id)
+        # chaos rules target processes by node id (workers inherit it via
+        # RAY_TPU_NODE_ID; the agent gets its id as an argv flag)
+        set_fault_self_id(args.node_id)
         prof = proc_profile.maybe_start()
         agent = NodeAgent(
             node_id=args.node_id,

@@ -96,6 +96,29 @@ _flag("actor_max_restarts_default", 0)
 _flag("health_check_period_ms", 3_000)
 _flag("health_check_failure_threshold", 5)
 _flag("max_lineage_bytes", 64 * 1024 * 1024)
+# Node fencing (partition tolerance): a node marked dead has its
+# incarnation fenced; a late re-register from that incarnation (the
+# partition healed) is rejected and the agent self-terminates, so no
+# zombie leases/object writes outlive the head's death verdict.
+_flag("node_fence_enabled", True)
+# Reconnect grace after an agent's TCP connection drops: a transient
+# blip (head restart, one lost socket) no longer instantly kills a
+# healthy node's actors — the node is only marked dead if it fails to
+# re-register within the window. Keep BELOW the heartbeat budget
+# (health_check_period_ms * health_check_failure_threshold), which stays
+# the authoritative liveness verdict for silent (partitioned) nodes.
+_flag("node_disconnect_grace_s", 5.0)
+# Application-level idle deadline for direct worker/actor channels: with
+# calls outstanding and the channel silent past this, a ping probes it;
+# an unanswered probe fails every pending call with ConnectionLost
+# (partitions never RST). 0 disables. A ping that round-trips proves
+# liveness, so long-running remote methods never trip this.
+_flag("client_idle_deadline_s", 0.0)
+# Bounded-retry-with-jitter defaults for idempotent control RPCs
+# (protocol.retry_call): attempts, base backoff, backoff cap.
+_flag("rpc_retry_max_attempts", 5)
+_flag("rpc_retry_base_s", 0.1)
+_flag("rpc_retry_max_s", 2.0)
 
 # --- control plane ----------------------------------------------------------
 _flag("gossip_period_ms", 100)  # resource-view sync cadence (ray_syncer analog)

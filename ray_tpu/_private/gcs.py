@@ -35,13 +35,20 @@ ACTOR_DEAD = "DEAD"
 
 class NodeInfo:
     def __init__(self, node_id: str, addr: Dict, resources: NodeResources,
-                 conn: Connection):
+                 conn: Connection, incarnation: int = 0):
         self.node_id = node_id
         self.addr = addr  # {"host":..., "port":...} of the agent's TCP server
         self.resources = resources
         self.conn = conn
         self.alive = True
+        # per-boot monotonic stamp from the agent; fenced on death so a
+        # partition survivor re-registering the SAME incarnation is
+        # rejected (a fresh agent process carries a higher one)
+        self.incarnation = incarnation
         self.last_heartbeat = time.monotonic()
+        # set while the agent's connection is down but the reconnect
+        # grace window is still open
+        self.disconnected_at: Optional[float] = None
         self.labels = resources.labels
         self.pending_demand: List[Dict] = []  # unfulfilled lease requests
 
@@ -59,11 +66,22 @@ class ActorInfo:
         self.max_restarts = max_restarts
         self.num_restarts = 0
         self.death_cause = ""
+        # structured failure provenance: (unix_time, event) transitions +
+        # the death's node/incarnation, shipped in every actor event so
+        # caller-side ActorDiedError carries the full story
+        self.timeline: List = [(time.time(), "created")]
+        self.death_node_id: str = ""
+        self.death_incarnation: int = 0
         self.owner_conn = owner_conn
         self.owner_job: Optional[str] = None  # job_id of the owning driver
         self.detached = bool(spec_wire.get("detached"))
         self.class_name = spec_wire.get("class_name", "")
         self.pid: int = 0
+
+    def note(self, event: str) -> None:
+        self.timeline.append((time.time(), event))
+        if len(self.timeline) > 20:  # bounded: restart loops must not grow it
+            self.timeline = self.timeline[:1] + self.timeline[-19:]
 
     def public_view(self) -> Dict:
         return {
@@ -76,6 +94,12 @@ class ActorInfo:
             "class_name": self.class_name,
             "num_restarts": self.num_restarts,
             "death_cause": self.death_cause,
+            "death_context": {
+                "node_id": self.death_node_id or (self.node_id or ""),
+                "incarnation": self.death_incarnation,
+                "reason": self.death_cause,
+                "timeline": [list(ev) for ev in self.timeline],
+            },
             "pid": self.pid,
         }
 
@@ -89,6 +113,11 @@ class HeadServer:
         self.port = port
         self.server = RpcServer("head")
         self.nodes: Dict[str, NodeInfo] = {}
+        # node_id -> highest fenced incarnation: dead incarnations may
+        # never rejoin (their leases/objects were already declared lost)
+        self.fenced_incarnations: Dict[str, int] = {}
+        # loop name -> restart count (ray_tpu_gcs_loop_restarts)
+        self.loop_restarts: Dict[str, int] = {}
         self.report_stats = {}
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[tuple, str] = {}  # (namespace, name) -> actor_id
@@ -222,13 +251,47 @@ class HeadServer:
     async def start(self) -> int:
         self.port = await self.server.start_tcp("0.0.0.0", self.port)
         self.server.set_disconnect_handler(self._on_disconnect)
-        self._hold_task(
-            asyncio.get_running_loop().create_task(self._health_check_loop()))
-        self._hold_task(
-            asyncio.get_running_loop().create_task(self._broadcast_loop()))
-        self._hold_task(
-            asyncio.get_running_loop().create_task(self._metrics_loop()))
+        loop = asyncio.get_running_loop()
+        for name, factory in (
+                ("health_check", self._health_check_loop),
+                ("broadcast", self._broadcast_loop),
+                ("metrics", self._metrics_loop)):
+            self._hold_task(loop.create_task(self._supervise(name, factory)))
         return self.port
+
+    async def _supervise(self, name: str, factory) -> None:
+        """Restart-on-crash supervisor for the head's background loops. A
+        bare create_task'd loop that raises (one bad node record, one
+        psutil hiccup) would otherwise silently stop health checking /
+        gossip FOREVER — the cluster keeps accepting work while dead
+        nodes stay 'alive'. Crashes are logged, counted
+        (ray_tpu_gcs_loop_restarts), and restarted with a short backoff
+        so a deterministic crash can't spin the head at 100% CPU."""
+        import logging
+
+        delay = 0.1
+        while True:
+            try:
+                await factory()
+                return  # a loop that RETURNS chose to stop; respect it
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.loop_restarts[name] = self.loop_restarts.get(name, 0) + 1
+                logging.getLogger("ray_tpu").exception(
+                    "head background loop %r crashed (restart #%d)",
+                    name, self.loop_restarts[name])
+                from ray_tpu._private.event import report_event
+
+                try:
+                    report_event("ERROR", "GCS_LOOP_CRASH",
+                                 f"head loop {name} crashed; restarting",
+                                 loop=name,
+                                 restarts=self.loop_restarts[name])
+                except Exception:
+                    pass
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 5.0)
 
     def _register_routes(self) -> None:
         r = self.server.add_handler
@@ -269,12 +332,54 @@ class HeadServer:
     # ------------------------------------------------------ node membership
     async def _register_node(self, conn: Connection, p: Dict) -> Dict:
         node_id = p["node_id"]
-        info = NodeInfo(node_id, p["addr"], NodeResources.from_wire(p["resources"]), conn)
+        incarnation = int(p.get("incarnation", 0))
+        # fencing: this incarnation was declared dead (its actors were
+        # failed over, its leases voided). Letting it back in after the
+        # partition heals would resurrect zombie state — reject, and the
+        # agent self-terminates on seeing the verdict.
+        if CONFIG.node_fence_enabled and \
+                incarnation <= self.fenced_incarnations.get(node_id, -1):
+            from ray_tpu._private.event import report_event
+
+            report_event("WARNING", "NODE_FENCED",
+                         f"rejected re-register of fenced node "
+                         f"{node_id[:12]} (incarnation {incarnation})",
+                         node_id=node_id, incarnation=incarnation)
+            return {"fenced": True, "node_id": node_id,
+                    "incarnation": incarnation,
+                    "fenced_incarnation":
+                        self.fenced_incarnations.get(node_id, -1)}
+        existing = self.nodes.get(node_id)
+        if existing is not None and existing.alive:
+            if existing.incarnation == incarnation:
+                # same boot reconnecting (head restart / TCP blip inside
+                # the grace window): adopt the new connection in place —
+                # the node never died, so no removed/added events fire
+                existing.conn = conn
+                existing.addr = p["addr"]
+                existing.resources = NodeResources.from_wire(p["resources"])
+                existing.labels = existing.resources.labels
+                existing.last_heartbeat = time.monotonic()
+                existing.disconnected_at = None
+                conn.meta["node_id"] = node_id
+                conn.meta["role"] = "agent"
+                return {"cluster_config": self.cluster_config,
+                        "cluster_view": self._cluster_view()}
+            # a NEWER boot superseding a still-"alive" record (the old
+            # agent crashed; its grace window hasn't expired): the old
+            # incarnation must die properly — fail its actors over and
+            # fence it — or they'd sit ALIVE with a stale addr forever
+            await self._mark_node_dead(
+                existing, f"superseded by incarnation {incarnation}")
+        info = NodeInfo(node_id, p["addr"],
+                        NodeResources.from_wire(p["resources"]), conn,
+                        incarnation=incarnation)
         self.nodes[node_id] = info
         conn.meta["node_id"] = node_id
         conn.meta["role"] = "agent"
         await self._publish_event("node", {"event": "added", "node_id": node_id,
-                                           "addr": p["addr"]})
+                                           "addr": p["addr"],
+                                           "incarnation": incarnation})
         return {"cluster_config": self.cluster_config,
                 "cluster_view": self._cluster_view()}
 
@@ -366,6 +471,13 @@ class HeadServer:
         if not node.alive:
             return
         node.alive = False
+        if CONFIG.node_fence_enabled:
+            # fence THIS incarnation: a later re-register from it (the
+            # partition healed) is rejected; a fresh boot (higher
+            # incarnation) may rejoin under the same node_id
+            self.fenced_incarnations[node.node_id] = max(
+                self.fenced_incarnations.get(node.node_id, -1),
+                node.incarnation)
         from ray_tpu._private.event import report_event
 
         report_event("ERROR", "NODE_DEAD",
@@ -378,14 +490,28 @@ class HeadServer:
             prefix = f"metrics::{node.node_id}".encode()
             for key in [k for k in metrics_ns if bytes(k).startswith(prefix)]:
                 metrics_ns.pop(key, None)
-        await self._publish_event(
-            "node", {"event": "removed", "node_id": node.node_id, "reason": reason}
-        )
+        removed_msg = {"event": "removed", "node_id": node.node_id,
+                       "reason": reason, "incarnation": node.incarnation,
+                       "addr": node.addr, "time": time.time()}
+        await self._publish_event("node", removed_msg)
+        # fail-fast fan-out to the surviving agents (they don't subscribe
+        # to pubsub channels): each drops its cached channels to the dead
+        # peer so in-flight pulls/leases fail NOW instead of waiting out
+        # chunk/RPC deadlines on a black-holed socket
+        for other in list(self.nodes.values()):
+            if other.alive and other is not node:
+                try:
+                    await other.conn.push("NodeRemoved", removed_msg)
+                except Exception:
+                    pass
         # Every actor on that node dies with it.
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state in (
                 ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
             ):
+                actor.death_node_id = node.node_id
+                actor.death_incarnation = node.incarnation
+                actor.note(f"node {node.node_id[:12]} died: {reason}")
                 await self._handle_actor_failure(actor, f"node died: {reason}")
 
     async def _metrics_loop(self) -> None:
@@ -431,6 +557,12 @@ class HeadServer:
                     g("ray_tpu_gcs_pubsub_subscriptions",
                       "Total (channel, subscriber) pairs.",
                       sum(len(s) for s in self.subscribers.values())),
+                    g("ray_tpu_gcs_loop_restarts",
+                      "Supervised head background-loop crash restarts.",
+                      sum(self.loop_restarts.values())),
+                    g("ray_tpu_gcs_nodes_fenced",
+                      "Node incarnations fenced after death verdicts.",
+                      len(self.fenced_incarnations)),
                     g("ray_tpu_rpc_frames_in_total",
                       "Control-plane frames received by the head.",
                       _rpc_stats["frames_in"]),
@@ -471,7 +603,20 @@ class HeadServer:
         node_id = conn.meta.get("node_id")
         if node_id and node_id in self.nodes and \
                 self.nodes[node_id].conn is conn:
-            await self._mark_node_dead(self.nodes[node_id], "agent disconnected")
+            node = self.nodes[node_id]
+            grace = float(CONFIG.node_disconnect_grace_s)
+            if grace <= 0 or not node.alive:
+                await self._mark_node_dead(node, "agent disconnected")
+            elif node.disconnected_at is None:
+                # reconnect grace: one lost TCP connection is not a dead
+                # node — give the agent's watchdog a window to re-register
+                # before its actors are failed over. The heartbeat budget
+                # (health check loop) still bounds a SILENT node's
+                # lifetime, so grace only shortens nothing and saves
+                # healthy nodes from transient blips.
+                node.disconnected_at = time.monotonic()
+                self._hold_task(asyncio.get_running_loop().create_task(
+                    self._disconnect_grace(node, conn, grace)))
         if conn.meta.get("role") == "driver":
             job_id = conn.meta.get("job_id")
             if self._driver_conns.get(job_id) is conn:
@@ -486,6 +631,18 @@ class HeadServer:
                             actor, "owner driver exited")
         for subs in self.subscribers.values():
             subs.discard(conn)
+
+    async def _disconnect_grace(self, node: NodeInfo, old_conn: Connection,
+                                grace: float) -> None:
+        await asyncio.sleep(grace)
+        current = self.nodes.get(node.node_id)
+        if current is not node or not node.alive:
+            return  # replaced by a fresh boot, or already dead
+        if node.conn is not old_conn or node.disconnected_at is None:
+            return  # re-registered within the window
+        await self._mark_node_dead(
+            node, f"agent disconnected (no re-register within {grace:g}s "
+                  "grace)")
 
     # ------------------------------------------------------------------- kv
     async def _kv_put(self, conn, p) -> bool:
@@ -639,6 +796,13 @@ class HeadServer:
         else:
             pool.sort(key=lambda n: n.resources.utilization())
         node = pool[0]
+        if node.conn.closed:
+            # mid-grace-window: the agent's connection is down and push()
+            # would silently no-op — the StartActor frame would be LOST
+            # and the actor wedged PENDING with no retry task. Report
+            # failure so _retry_schedule keeps polling until the agent
+            # re-registers (or the grace expires and the node dies).
+            return False
         info.node_id = node.node_id
         info.placed_at = time.monotonic()
         self._recent_placements.append((info.placed_at, info))
@@ -668,6 +832,9 @@ class HeadServer:
         info.addr = p["addr"]
         info.pid = p.get("pid", 0)
         info.node_id = conn.meta.get("node_id", info.node_id)
+        # ActorReady arrives on the WORKER's head connection (no node_id
+        # in conn.meta) — note after the node_id fallback above resolves
+        info.note(f"alive on {(info.node_id or '?')[:12]}")
         self._schedule_save()
         await self._publish_event("actor", info.public_view())
 
@@ -688,6 +855,7 @@ class HeadServer:
         if info.num_restarts < info.max_restarts or info.max_restarts == -1:
             info.num_restarts += 1
             info.state = ACTOR_RESTARTING
+            info.note(f"restarting (#{info.num_restarts}): {reason}")
             info.addr = None
             await self._publish_event("actor", info.public_view())
             if not await self._schedule_actor(info):
@@ -699,6 +867,7 @@ class HeadServer:
     async def _handle_actor_death(self, info: ActorInfo, reason: str) -> None:
         info.state = ACTOR_DEAD
         info.death_cause = reason
+        info.note(f"dead: {reason}")
         info.addr = None
         if (info.namespace, info.name) in self.named_actors:
             if self.named_actors[(info.namespace, info.name)] == info.actor_id:
@@ -998,6 +1167,9 @@ def main() -> None:
         from ray_tpu._private import lifecycle, proc_profile
         from ray_tpu._private.event import init_event_log, report_event
 
+        from ray_tpu._private.protocol import set_fault_self_id
+
+        set_fault_self_id("head")  # chaos rules may target the head
         lifecycle.register_self("gcs", args.session_dir)
         # die with the spawning driver/runner: a SIGKILL'd driver must not
         # strand the head control plane (lifecycle supervisor contract)

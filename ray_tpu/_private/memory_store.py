@@ -37,7 +37,15 @@ class _Waiter:
 
 class MemoryStore:
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock, NOT Lock: ObjectRef.__del__ (GC-triggered, any thread,
+        # any bytecode boundary) reaches delete() via the reference
+        # counter. A garbage cycle collected while THIS thread is inside
+        # a critical section — e.g. wait() allocating its _Waiter —
+        # would deadlock the whole process on a plain Lock (observed:
+        # driver wedged in wait → __del__ → delete with the io thread
+        # stuck behind it in put). Re-entry is safe: every method does
+        # point dict/list operations.
+        self._lock = threading.RLock()
         self._objects: Dict[bytes, _Entry] = {}
         self._waiters: List[_Waiter] = []
 

@@ -15,11 +15,13 @@ Push:     {"m": method, "i": 0, "p": payload}     (one-way, no reply)
 from __future__ import annotations
 
 import asyncio
+import os
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Any, Awaitable, Callable, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -48,6 +50,201 @@ STATS = {"frames_in": 0, "bytes_in": 0, "frames_out": 0, "bytes_out": 0}
 def pack(msg: Any) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
     return _HDR.pack(len(body)) + body
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (partition simulation)
+# ---------------------------------------------------------------------------
+#
+# Every transport in this module consults a process-local FaultSchedule
+# before sending and after receiving a frame. A matching rule can DROP the
+# frame (silently — the socket stays open, no RST, exactly what a network
+# partition or a gray failure looks like to the peer) or DELAY it. Rules
+# match on:
+#   self:      node_id of THIS process ("*" = any). Agents/workers carry
+#              RAY_TPU_NODE_ID; the head tags itself "head".
+#   peer:      "tcp" (cross-host traffic only — unix sockets to the local
+#              agent are spared, so a "partition" cuts the network, not
+#              the host), "unix", or "*".
+#   direction: "in" | "out" | "both".
+#   method:    frame method name, or "*" (replies match only "*" — a
+#              blackhole rule covers them).
+#   action:    "drop" | "blackhole" (alias of drop) | "delay" (delay_s).
+#
+# Two control planes share the same schedule object:
+#   - in-process: set_fault_schedule(FaultSchedule(...)) — unit tests.
+#   - cross-process: RAY_TPU_FAULT_INJECTION=1 + a JSON rule file at
+#     $RAY_TPU_FAULT_FILE (default <session_dir>/fault_schedule.json),
+#     polled with a short TTL so util/chaos.NetworkPartitioner can flip
+#     partitions on live daemons it never execs into. The TTL check is a
+#     single monotonic() compare per frame; with injection disabled the
+#     whole feature costs one global load + `is None`.
+
+
+class FaultRule:
+    __slots__ = ("self_id", "peer", "direction", "method", "action",
+                 "delay_s")
+
+    def __init__(self, self_id: str = "*", peer: str = "tcp",
+                 direction: str = "both", method: str = "*",
+                 action: str = "drop", delay_s: float = 0.0):
+        self.self_id = self_id
+        self.peer = peer
+        self.direction = direction
+        self.method = method
+        self.action = "drop" if action == "blackhole" else action
+        self.delay_s = float(delay_s)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FaultRule":
+        return cls(d.get("self", "*"), d.get("peer", "tcp"),
+                   d.get("direction", "both"), d.get("method", "*"),
+                   d.get("action", "drop"), d.get("delay_s", 0.0))
+
+
+class FaultSchedule:
+    """An ordered rule list; first match wins."""
+
+    def __init__(self, rules: Optional[List[FaultRule]] = None):
+        self.rules = list(rules or [])
+
+    def match(self, direction: str, method: Optional[str],
+              kind: str) -> Optional[FaultRule]:
+        self_id = _fault_self_id()
+        for r in self.rules:
+            if r.self_id != "*" and r.self_id != self_id:
+                continue
+            if r.peer != "*" and r.peer != kind:
+                continue
+            if r.direction != "both" and r.direction != direction:
+                continue
+            if r.method != "*" and r.method != method:
+                continue
+            return r
+        return None
+
+    @classmethod
+    def from_json_dict(cls, d: Dict) -> "FaultSchedule":
+        return cls([FaultRule.from_dict(r) for r in d.get("rules", [])])
+
+
+_INPROC_FAULTS: List[Optional[FaultSchedule]] = [None]
+_FAULT_SELF_ID: List[Optional[str]] = [None]
+# (next_check_monotonic, schedule, file_mtime)
+_fault_file_cache: List[Any] = [0.0, None, None]
+FAULT_POLL_S = 0.2
+
+
+def set_fault_schedule(schedule: Optional[FaultSchedule]) -> None:
+    """Install (or clear, with None) an in-process fault schedule. Takes
+    precedence over the file-based plane."""
+    _INPROC_FAULTS[0] = schedule
+
+
+def set_fault_self_id(self_id: str) -> None:
+    _FAULT_SELF_ID[0] = self_id
+
+
+def _fault_self_id() -> str:
+    sid = _FAULT_SELF_ID[0]
+    if sid is None:
+        sid = _FAULT_SELF_ID[0] = os.environ.get("RAY_TPU_NODE_ID", "")
+    return sid
+
+
+def fault_file_path() -> Optional[str]:
+    path = os.environ.get("RAY_TPU_FAULT_FILE")
+    if path:
+        return path
+    session = os.environ.get("RAY_TPU_SESSION_DIR")
+    if session:
+        return os.path.join(session, "fault_schedule.json")
+    return None
+
+
+def _load_fault_file() -> Optional[FaultSchedule]:
+    if os.environ.get("RAY_TPU_FAULT_INJECTION", "0").lower() not in (
+            "1", "true", "yes"):
+        return None
+    path = fault_file_path()
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        _fault_file_cache[2] = None
+        return None
+    if mtime == _fault_file_cache[2]:
+        return _fault_file_cache[1]
+    try:
+        import json
+
+        with open(path) as f:
+            schedule = FaultSchedule.from_json_dict(json.load(f))
+    except Exception:
+        return _fault_file_cache[1]  # mid-write; keep the previous rules
+    _fault_file_cache[2] = mtime
+    return schedule
+
+
+def faults() -> Optional[FaultSchedule]:
+    sched = _INPROC_FAULTS[0]
+    if sched is not None:
+        return sched
+    now = time.monotonic()
+    if now >= _fault_file_cache[0]:
+        _fault_file_cache[0] = now + FAULT_POLL_S
+        _fault_file_cache[1] = _load_fault_file()
+    return _fault_file_cache[1]
+
+
+def _fault_check(direction: str, method: Optional[str],
+                 kind: str) -> Optional[FaultRule]:
+    sched = faults()
+    if sched is None:
+        return None
+    return sched.match(direction, method, kind)
+
+
+async def retry_call(fn, *, attempts: Optional[int] = None,
+                     base_s: Optional[float] = None,
+                     max_s: Optional[float] = None,
+                     jitter: float = 0.5,
+                     retry_on: Tuple = None,
+                     rng: Optional[random.Random] = None):
+    """Bounded retry with exponential backoff + jitter for IDEMPOTENT
+    control RPCs (ActorDied notifications, re-registration, subscribes).
+
+    `fn` is a zero-arg callable returning a fresh coroutine per attempt
+    (a coroutine object can only be awaited once). Retries on transport-
+    class failures only by default — an application error (RpcError from
+    the handler) means the call ARRIVED and must not be replayed blindly.
+    """
+    from ray_tpu._private.config import CONFIG
+
+    if attempts is None:
+        attempts = CONFIG.rpc_retry_max_attempts
+    if base_s is None:
+        base_s = CONFIG.rpc_retry_base_s
+    if max_s is None:
+        max_s = CONFIG.rpc_retry_max_s
+    if retry_on is None:
+        retry_on = (ConnectionLost, ConnectionError, asyncio.TimeoutError,
+                    OSError)
+    rng = rng or random
+    delay = base_s
+    for attempt in range(max(1, attempts)):
+        try:
+            return await fn()
+        except asyncio.CancelledError:
+            raise
+        except retry_on:
+            if attempt + 1 >= attempts:
+                raise
+            # full jitter on top of the exponential base: synchronized
+            # retry storms from many clients decorrelate
+            await asyncio.sleep(delay * (1.0 + jitter * rng.random()))
+            delay = min(delay * 2, max_s)
 
 
 def enable_nodelay(writer: "asyncio.StreamWriter") -> None:
@@ -132,6 +329,10 @@ class Connection:
         self.writer = writer
         self.meta: Dict[str, Any] = {}  # handshake info (worker id, role, ...)
         self.closed = False
+        # fault-injection peer class: accepted TCP sockets report a
+        # (host, port) peername, unix sockets a path/empty string
+        self.kind = "tcp" if isinstance(
+            writer.get_extra_info("peername"), tuple) else "unix"
         self._loop = asyncio.get_event_loop()
         self._outbuf: list = []
         self._buffered = 0
@@ -146,6 +347,17 @@ class Connection:
         if self.closed:
             return
         body = pack(msg)
+        rule = _fault_check("out", msg.get("m"), self.kind)
+        if rule is not None:
+            if rule.action == "drop":
+                return  # silently eaten: the peer sees a stall, no RST
+            self._loop.call_later(rule.delay_s, self._enqueue, body)
+            return
+        self._enqueue(body)
+
+    def _enqueue(self, body: bytes) -> None:
+        if self.closed:
+            return
         self._outbuf.append(body)
         self._buffered += len(body)
         if not self._flush_scheduled:
@@ -177,6 +389,11 @@ class Connection:
         if self.closed:
             return
         body = pack(msg)
+        rule = _fault_check("out", msg.get("m"), self.kind)
+        if rule is not None:
+            if rule.action == "drop":
+                return
+            await asyncio.sleep(rule.delay_s)
         self._outbuf.append(body)
         self._buffered += len(body)
         if not self._flush_scheduled:
@@ -210,6 +427,11 @@ class Connection:
         parks ordinary flushes so no control frame splits the body."""
         if self.closed:
             return
+        rule = _fault_check("out", None, self.kind)
+        if rule is not None:
+            if rule.action == "drop":
+                return  # the puller's chunk RPC stalls, no RST
+            await asyncio.sleep(rule.delay_s)
         if self._raw_lock is None:
             self._raw_lock = asyncio.Lock()
         view = raw.view
@@ -309,6 +531,11 @@ class RpcServer:
                 STATS["bytes_in"] += 4 + length
                 body = await reader.readexactly(length)
                 msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
+                rule = _fault_check("in", msg.get("m"), conn.kind)
+                if rule is not None:
+                    if rule.action == "drop":
+                        continue  # frame read, never dispatched
+                    await asyncio.sleep(rule.delay_s)
                 asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
@@ -362,6 +589,12 @@ class AsyncRpcClient:
         self._buffered = 0
         self._flush_scheduled = False
         self.connected = False
+        self._kind = "tcp"  # fault-injection peer class; set on connect
+        # idle-deadline detection (application-level): monotonic stamp of
+        # the last inbound frame + the optional monitor task probing a
+        # silent channel with pings (partitions don't RST)
+        self.last_recv = time.monotonic()
+        self._idle_task: Optional[asyncio.Task] = None
 
     async def connect_tcp(self, host: str, port: int,
                           limit: Optional[int] = None) -> None:
@@ -376,10 +609,12 @@ class AsyncRpcClient:
             self._reader, self._writer = await asyncio.open_connection(
                 host, port)
         enable_nodelay(self._writer)
+        self._kind = "tcp"
         self._start(f"rpc-read-{host}:{port}")
 
     async def connect_unix(self, path: str) -> None:
         self._reader, self._writer = await asyncio.open_unix_connection(path)
+        self._kind = "unix"
         self._start(f"rpc-read-{path}")
 
     def _start(self, label: str = "rpc-read"):
@@ -398,6 +633,19 @@ class AsyncRpcClient:
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush_out)
+
+    def _send_frame(self, data: bytes, method: Optional[str]) -> bool:
+        """Fault-aware frame send; returns False when a rule ate it (the
+        caller's reply future then pends exactly like a partitioned
+        request — timeouts/idle monitors are the recovery path)."""
+        rule = _fault_check("out", method, self._kind)
+        if rule is not None:
+            if rule.action == "drop":
+                return False
+            self._loop.call_later(rule.delay_s, self._queue_frame, data)
+            return True
+        self._queue_frame(data)
+        return True
 
     def _flush_out(self) -> None:
         self._flush_scheduled = False
@@ -437,6 +685,28 @@ class AsyncRpcClient:
                 STATS["bytes_in"] += 4 + length
                 body = await self._reader.readexactly(length)
                 msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
+                rule = _fault_check("in", msg.get("m"), self._kind)
+                # last_recv counts only DELIVERED frames: an injected
+                # inbound partition must look like silence to the idle
+                # monitor, or it could never trip on simulated faults
+                if rule is None:
+                    self.last_recv = time.monotonic()
+                if rule is not None:
+                    if rule.action == "delay":
+                        await asyncio.sleep(rule.delay_s)
+                    else:
+                        # drop: consume a raw body (stay framed) but never
+                        # resolve the future / run the push handler
+                        raw_len = msg.get("z") or 0
+                        got = 0
+                        while got < raw_len:
+                            piece = await self._reader.read(
+                                min(raw_len - got, 1 << 20))
+                            if not piece:
+                                raise asyncio.IncompleteReadError(
+                                    b"", raw_len - got)
+                            got += len(piece)
+                        continue
                 if "r" in msg:
                     fut = self._pending.pop(msg["r"], None)
                     raw_len = msg.get("z")
@@ -545,7 +815,7 @@ class AsyncRpcClient:
         req_id = self._next_id
         self._pending[req_id] = fut
         fut.add_done_callback(lambda _f, rid=req_id: self._pending.pop(rid, None))
-        self._queue_frame(pack({"m": method, "i": req_id, "p": payload}))
+        self._send_frame(pack({"m": method, "i": req_id, "p": payload}), method)
         return fut
 
     async def call(self, method: str, payload: Any, timeout: Optional[float] = None) -> Any:
@@ -557,8 +827,9 @@ class AsyncRpcClient:
         self._pending[req_id] = fut
         try:
             body = pack({"m": method, "i": req_id, "p": payload})
-            self._queue_frame(body)
-            if len(body) >= _drain_threshold() or self._buffered >= 4 * _drain_threshold():
+            sent = self._send_frame(body, method)
+            if sent and (len(body) >= _drain_threshold()
+                         or self._buffered >= 4 * _drain_threshold()):
                 self._flush_out()
                 try:
                     await self._writer.drain()
@@ -591,7 +862,8 @@ class AsyncRpcClient:
         self._pending[req_id] = fut
         self._raw_dest[req_id] = dest
         try:
-            self._queue_frame(pack({"m": method, "i": req_id, "p": payload}))
+            self._send_frame(pack({"m": method, "i": req_id, "p": payload}),
+                             method)
             if timeout:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
@@ -601,11 +873,12 @@ class AsyncRpcClient:
 
     def push_nowait(self, method: str, payload: Any) -> None:
         """One-way fire-and-forget push; loop-thread only, write-combined."""
-        self._queue_frame(pack({"m": method, "i": 0, "p": payload}))
+        self._send_frame(pack({"m": method, "i": 0, "p": payload}), method)
 
     async def push(self, method: str, payload: Any) -> None:
         body = pack({"m": method, "i": 0, "p": payload})
-        self._queue_frame(body)
+        if not self._send_frame(body, method):
+            return
         if (len(body) >= _drain_threshold()
                 or self._buffered >= 4 * _drain_threshold()
                 or Connection._transport_backlog(self._writer)
@@ -616,8 +889,53 @@ class AsyncRpcClient:
             except (ConnectionError, RuntimeError):
                 self.connected = False
 
+    def start_idle_monitor(self, idle_s: float,
+                           ping_method: str = "Ping") -> None:
+        """Application-level idle-deadline detection for long-lived
+        channels: a partitioned peer never RSTs, so a pending call can
+        otherwise hang for its full (possibly infinite) deadline. While
+        calls are outstanding and the channel has been silent past
+        `idle_s`, a ping probes it; an unanswered probe declares the
+        channel dead and fails every pending future with ConnectionLost.
+        A ping that round-trips proves liveness, so a long-running remote
+        method never trips this."""
+        if idle_s <= 0 or self._idle_task is not None:
+            return
+        self._idle_task = self._loop.create_task(
+            self._idle_monitor(idle_s, ping_method))
+        try:
+            self._idle_task.set_name("rpc-idle-monitor")
+        except AttributeError:
+            pass
+
+    async def _idle_monitor(self, idle_s: float, ping_method: str) -> None:
+        try:
+            while self.connected:
+                await asyncio.sleep(max(idle_s / 2, 0.05))
+                if not self._pending or not self.connected:
+                    continue  # nothing outstanding: silence is fine
+                if time.monotonic() - self.last_recv < idle_s:
+                    continue
+                try:
+                    await self.call(ping_method, {}, timeout=idle_s)
+                    continue  # alive, just busy
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
+                if not self.connected:
+                    return
+                self._idle_task = None  # close() must not cancel us
+                self.close()
+                return
+        except asyncio.CancelledError:
+            pass
+
     def close(self) -> None:
         self.connected = False
+        if self._idle_task is not None:
+            self._idle_task.cancel()
+            self._idle_task = None
         if self._read_task:
             # request cancellation; the cancelled task still needs one
             # loop tick to actually finish. aclose() (clean shutdown) and
@@ -739,8 +1057,10 @@ class SyncRpcClient:
         self._push_handler = push_handler
         self._reader_thread: Optional[threading.Thread] = None
         self.connected = False
+        self._kind = "tcp"
 
     def connect_unix(self, path: str, timeout: float = 30.0) -> None:
+        self._kind = "unix"
         deadline = time.monotonic() + timeout
         while True:
             try:
@@ -795,6 +1115,11 @@ class SyncRpcClient:
                     buf += chunk
                 msg = msgpack.unpackb(buf[4:need], raw=False, strict_map_key=False)
                 buf = buf[need:]
+                rule = _fault_check("in", msg.get("m"), self._kind)
+                if rule is not None:
+                    if rule.action == "drop":
+                        continue
+                    time.sleep(rule.delay_s)
                 if "r" in msg:
                     with self._lock:
                         fut = self._pending.pop(msg["r"], None)
@@ -823,6 +1148,11 @@ class SyncRpcClient:
             self._pending[req_id] = fut
         try:
             data = pack({"m": method, "i": req_id, "p": payload})
+            rule = _fault_check("out", method, self._kind)
+            if rule is not None and rule.action == "drop":
+                return fut.result(timeout)  # request eaten: wait it out
+            if rule is not None:
+                time.sleep(rule.delay_s)
             with self._send_lock:
                 self._sock.sendall(data)
             return fut.result(timeout)
@@ -832,6 +1162,11 @@ class SyncRpcClient:
 
     def push(self, method: str, payload: Any) -> None:
         data = pack({"m": method, "i": 0, "p": payload})
+        rule = _fault_check("out", method, self._kind)
+        if rule is not None:
+            if rule.action == "drop":
+                return
+            time.sleep(rule.delay_s)
         with self._send_lock:
             self._sock.sendall(data)
 

@@ -106,7 +106,18 @@ class PullManager:
         self.transfers_failed = 0
         self.stripe_failovers = 0
         self.pulls_cancelled = 0
+        self.peer_removed_failfasts = 0  # node-death verdicts applied
         self.transfer_seconds = 0.0  # time inside _transfer (ok ones)
+
+    def on_peer_removed(self, addr: Dict) -> None:
+        """A cluster-level death verdict for a holder peer: drop BOTH its
+        channels so every in-flight chunk RPC to it fails immediately
+        (ConnectionLost on the pending futures) and the stripes fail the
+        dead holder's chunks over to survivors — the fail-fast path for
+        partitions, where the socket itself would stay silently open
+        until the 60 s chunk deadline."""
+        self.peer_removed_failfasts += 1
+        self.agent.pool.drop(addr["host"], addr["port"])
 
     def stats(self) -> Dict:
         return {
@@ -117,6 +128,7 @@ class PullManager:
             "transfers_failed": self.transfers_failed,
             "stripe_failovers": self.stripe_failovers,
             "pulls_cancelled": self.pulls_cancelled,
+            "peer_removed_failfasts": self.peer_removed_failfasts,
             "inflight_bytes": self.budget.inflight,
             "budget_limit_bytes": self.budget.limit,
             "pulls_queued": self.budget.queued,

@@ -50,6 +50,7 @@ from ray_tpu._private.task_spec import (
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    NodeDiedError,
     ObjectLostError,
     RayActorError,
     RayTaskError,
@@ -289,6 +290,10 @@ class Worker:
         self._tasks: Dict[bytes, TaskRecord] = {}
         self._actor_states: Dict[bytes, "_ActorState"] = {}
         self._actor_sub_started = False
+        # node_id -> {"incarnation", "reason", "time"}: death verdicts from
+        # the GCS node channel; work targeting these nodes fails fast with
+        # NodeDiedError instead of waiting out network deadlines
+        self._dead_nodes: Dict[str, Dict] = {}
         self._owner_conn_pool = ConnectionPool()
         self.current_task_info = threading.local()
         self.task_events: List[Dict] = []
@@ -420,6 +425,11 @@ class Worker:
                 # monitors (log_monitor.py) -> "(worker-x) line" output
                 await self.head.call("Subscribe",
                                      {"channels": ["logs:all"]})
+        # every process (driver AND executor workers) watches node
+        # membership: a `removed` verdict fails pending leases/calls/pulls
+        # aimed at that node promptly — under a partition the sockets
+        # never RST, so this event is the ONLY fast death signal
+        await self.head.call("Subscribe", {"channels": ["node"]})
         # a restarted head has an empty subscriber table: re-subscribe the
         # actor channel so restart/death/address events keep flowing
         if self._actor_sub_started:
@@ -668,12 +678,58 @@ class Worker:
             channel = payload.get("channel")
             if channel == "actor":
                 self._on_actor_event(payload["message"])
+            elif channel == "node":
+                self._on_node_event(payload["message"])
             elif channel and channel.startswith("logs:"):
                 msg = payload["message"]
                 src = msg.get("src", "worker")
                 for line in msg.get("lines") or \
                         ([msg["line"]] if msg.get("line") else []):
                     print(f"({src}) {line}")
+
+    def _on_node_event(self, msg: Dict) -> None:
+        """GCS node-channel event (loop thread). A `removed` verdict is
+        the partition-tolerant fail-fast trigger: sockets to the dead
+        node will never RST, so without this every pending lease, actor
+        call, and pull targeting it would ride its own (up to 600 s)
+        deadline."""
+        event = msg.get("event")
+        node_id = msg.get("node_id")
+        if not node_id:
+            return
+        if event == "added":
+            # a fresh incarnation rejoined under the same node_id: new
+            # work may target it again
+            self._dead_nodes.pop(node_id, None)
+            return
+        if event != "removed":
+            return
+        self._dead_nodes[node_id] = {
+            "incarnation": msg.get("incarnation", 0),
+            "reason": msg.get("reason", ""),
+            "time": msg.get("time") or time.time(),
+        }
+        addr = msg.get("addr") or {}
+        if addr.get("host") is not None and addr.get("port") is not None:
+            # spilled lease requests / owner RPCs in flight to that agent
+            # fail now (close() fails their pending futures)
+            self._owner_conn_pool.drop(addr["host"], addr["port"])
+        for pool in list(self._lease_pools.values()):
+            pool.on_node_removed(node_id)
+
+    def node_death_error(self, node_id: str,
+                         detail: str = "") -> Optional[NodeDiedError]:
+        info = self._dead_nodes.get(node_id)
+        if info is None:
+            return None
+        reason = info.get("reason", "")
+        timeline = [(info.get("time", time.time()),
+                     f"node removed: {reason}")]
+        if detail:
+            timeline.append((time.time(), detail))
+        return NodeDiedError(node_id=node_id,
+                             incarnation=info.get("incarnation", 0),
+                             reason=reason, timeline=timeline)
 
     def _notify_owner_async(self, owner_addr: Dict, method: str, payload: Dict):
         if not owner_addr or not self.loop or not self.connected:
@@ -1825,9 +1881,15 @@ class _LeasePool:
                 grant["node_id"],
                 agent_addr,
             )
+            if grant["node_id"] in w._dead_nodes:
+                # the node died between grant and now (partition verdict
+                # raced the lease reply); don't connect into a zombie
+                raise w.node_death_error(grant["node_id"],
+                                         "lease granted by dead node")
             conn.assigned_instances = grant.get("assigned_instances", {})
             client = AsyncRpcClient()
             await client.connect_tcp(conn.addr["host"], conn.addr["port"])
+            client.start_idle_monitor(CONFIG.client_idle_deadline_s)
             conn.client = client
             self.conns.append(conn)
             self.inflight_leases -= 1
@@ -1994,6 +2056,32 @@ class _LeasePool:
             self.idle.append(conn)
             self._ensure_reaper()
 
+    def _push_failure_error(self, conn: WorkerConn,
+                            record: TaskRecord) -> Exception:
+        """WorkerCrashedError for a lone worker death; NodeDiedError
+        (with node_id / incarnation / reason / timeline) when the whole
+        node was declared dead — retries still reroute either way, but
+        an exhausted retry budget surfaces the true cause."""
+        err = self.worker.node_death_error(
+            conn.node_id,
+            f"in-flight task {record.spec.function_name} failed fast")
+        if err is not None:
+            return err
+        return WorkerCrashedError(
+            f"worker died while running {record.spec.function_name}")
+
+    def on_node_removed(self, node_id: str) -> None:
+        """Cluster-level death verdict: fail this pool's connections to
+        the node NOW. close() fails every pending PushTask future with
+        ConnectionLost, which routes through _on_push_failed →
+        NodeDiedError-aware retry — no 600 s wait on a partitioned
+        socket."""
+        for conn in list(self.conns):
+            if conn.node_id == node_id and not conn.dead:
+                conn.dead = True
+                if conn.client is not None:
+                    conn.client.close()
+
     def _on_batch_failed(self, conn: WorkerConn,
                          records: List[TaskRecord]) -> None:
         conn.dead = True
@@ -2001,9 +2089,7 @@ class _LeasePool:
             self._drop_conn(conn, worker_exited=True))
         for record in records:
             self.worker._on_task_failure(
-                record, WorkerCrashedError(
-                    f"worker died while running {record.spec.function_name}"
-                ),
+                record, self._push_failure_error(conn, record),
                 retriable=True,
             )
         self._pump()
@@ -2013,9 +2099,7 @@ class _LeasePool:
         asyncio.get_running_loop().create_task(
             self._drop_conn(conn, worker_exited=True))
         self.worker._on_task_failure(
-            record, WorkerCrashedError(
-                f"worker died while running {record.spec.function_name}"
-            ),
+            record, self._push_failure_error(conn, record),
             retriable=True,
         )
         self._pump()
@@ -2062,13 +2146,17 @@ class _LeasePool:
             self.idle.remove(conn)
         w = self.worker
         try:
-            payload = {"lease_id": conn.lease_id, "worker_id": conn.worker_id,
-                       "worker_exiting": worker_exited}
-            if conn.agent_addr:
-                client = await w._owner_client(conn.agent_addr)
-                await client.call("ReturnWorker", payload)
-            else:
-                await w.agent.call("ReturnWorker", payload)
+            # a dead node's agent can't take the lease back (the RPC would
+            # only stall on a partitioned socket); bounded either way
+            if conn.node_id not in w._dead_nodes:
+                payload = {"lease_id": conn.lease_id,
+                           "worker_id": conn.worker_id,
+                           "worker_exiting": worker_exited}
+                if conn.agent_addr:
+                    client = await w._owner_client(conn.agent_addr)
+                    await client.call("ReturnWorker", payload, timeout=10)
+                else:
+                    await w.agent.call("ReturnWorker", payload, timeout=10)
         except Exception:
             pass
         if conn.client:
@@ -2093,6 +2181,9 @@ class _ActorState:
         self._seq = _Counter()
         self.queue: deque = deque()
         self.death_cause = ""
+        # structured provenance from the GCS actor view (node_id,
+        # incarnation, reason, timeline) — rides every ActorDiedError
+        self.death_context: Optional[Dict] = None
         self._connecting = False
         self._flush_scheduled = False
         # in-flight records awaiting retry after a broken push; flushed
@@ -2115,6 +2206,7 @@ class _ActorState:
             return  # stale tracker registration must not regress a live state
         self.state = new_state
         self.death_cause = view.get("death_cause", "") or self.death_cause
+        self.death_context = view.get("death_context") or self.death_context
         addr = view.get("addr")
         if self.state == "ALIVE" and addr:
             self.addr = addr
@@ -2130,13 +2222,19 @@ class _ActorState:
                 self.client = None
             worker._loop_call(self._fail_all, worker)
 
+    def _died_error(self, reason: str = "") -> ActorDiedError:
+        ctx = self.death_context or {}
+        return ActorDiedError(
+            self.actor_id.hex(),
+            reason or self.death_cause or "actor died",
+            node_id=ctx.get("node_id", ""),
+            incarnation=ctx.get("incarnation", 0),
+            timeline=ctx.get("timeline") or [])
+
     def enqueue(self, worker: Worker, record: TaskRecord) -> None:
         if self.state == "DEAD":
-            worker._on_task_failure(
-                record,
-                ActorDiedError(self.actor_id.hex(), self.death_cause or "actor dead"),
-                retriable=False,
-            )
+            worker._on_task_failure(record, self._died_error(),
+                                    retriable=False)
             return
         self.queue.append(record)
         # defer the flush one loop tick: a burst of enqueues drained from
@@ -2194,6 +2292,7 @@ class _ActorState:
         try:
             client = AsyncRpcClient()
             await client.connect_tcp(addr["host"], addr["port"])
+            client.start_idle_monitor(CONFIG.client_idle_deadline_s)
             self.client = client
         except Exception:
             self.client = None
@@ -2313,10 +2412,8 @@ class _ActorState:
             return
         worker._on_task_failure(
             record,
-            ActorDiedError(
-                self.actor_id.hex(),
-                self.death_cause or "actor died while this call was in flight",
-            ),
+            self._died_error(
+                self.death_cause or "actor died while this call was in flight"),
             retriable=False,
         )
 
@@ -2329,12 +2426,8 @@ class _ActorState:
         buf, self._retry_buf = self._retry_buf, []
         if self.state == "DEAD":
             for record in buf:
-                worker._on_task_failure(
-                    record,
-                    ActorDiedError(self.actor_id.hex(),
-                                   self.death_cause or "actor died"),
-                    retriable=False,
-                )
+                worker._on_task_failure(record, self._died_error(),
+                                        retriable=False)
             return
         self.queue.extendleft(reversed(buf))
 
@@ -2344,8 +2437,5 @@ class _ActorState:
         self._retry_buf = []
         while self.queue:
             record = self.queue.popleft()
-            worker._on_task_failure(
-                record,
-                ActorDiedError(self.actor_id.hex(), self.death_cause or "actor died"),
-                retriable=False,
-            )
+            worker._on_task_failure(record, self._died_error(),
+                                    retriable=False)

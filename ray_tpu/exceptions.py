@@ -8,12 +8,61 @@ distinct type so user retry logic can discriminate.
 
 from __future__ import annotations
 
+import time
 import traceback
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class RayTpuError(Exception):
     """Base class for all framework errors."""
+
+
+class DeathContext:
+    """Structured failure provenance carried by death-class exceptions.
+
+    Built once where a failure is *detected* (usually the GCS) and handed
+    through every propagation hop unchanged, so the exception a driver
+    finally catches answers "which node, which incarnation, why, and
+    when" — not just a flattened message string. Plain-data only (str /
+    int / float tuples) so it survives pickle, msgpack-adjacent wire
+    dicts, and the framework serializer identically.
+    """
+
+    __slots__ = ("node_id", "incarnation", "reason", "timeline")
+
+    def __init__(self, node_id: str = "", incarnation: int = 0,
+                 reason: str = "",
+                 timeline: Optional[List[Tuple[float, str]]] = None):
+        self.node_id = node_id or ""
+        self.incarnation = int(incarnation or 0)
+        # normalize to plain (float, str) tuples: wire dicts arrive as lists
+        self.reason = reason or ""
+        self.timeline = [(float(t), str(ev)) for t, ev in (timeline or [])]
+
+    def add_event(self, event: str, at: Optional[float] = None) -> None:
+        self.timeline.append((float(at if at is not None else time.time()),
+                              str(event)))
+
+    def to_dict(self) -> Dict:
+        return {"node_id": self.node_id, "incarnation": self.incarnation,
+                "reason": self.reason,
+                "timeline": [list(ev) for ev in self.timeline]}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> "DeathContext":
+        d = d or {}
+        return cls(d.get("node_id", ""), d.get("incarnation", 0),
+                   d.get("reason", ""), d.get("timeline") or [])
+
+    def describe(self) -> str:
+        parts = []
+        if self.node_id:
+            parts.append(f"node={self.node_id[:12]}")
+        if self.incarnation:
+            parts.append(f"incarnation={self.incarnation}")
+        if self.reason:
+            parts.append(f"reason={self.reason}")
+        return ", ".join(parts)
 
 
 class RayTaskError(RayTpuError):
@@ -53,12 +102,36 @@ class RayTaskError(RayTpuError):
 
 
 class RayActorError(RayTpuError):
-    """The actor died before or during this method call."""
+    """The actor died before or during this method call.
 
-    def __init__(self, actor_id: str = "", reason: str = ""):
+    Carries a :class:`DeathContext` (node_id, incarnation, reason,
+    timeline) so retry logic and postmortems can discriminate a worker
+    crash from a node death from a fenced partition survivor. The
+    context round-trips serialization via ``__reduce__``.
+    """
+
+    def __init__(self, actor_id: str = "", reason: str = "",
+                 node_id: str = "", incarnation: int = 0,
+                 timeline: Optional[List[Tuple[float, str]]] = None):
         self.actor_id = actor_id
         self.reason = reason
-        super().__init__(f"Actor {actor_id} died: {reason}")
+        self.context = DeathContext(node_id, incarnation, reason, timeline)
+        msg = f"Actor {actor_id} died: {reason}"
+        extra = self.context.describe()
+        if node_id or incarnation:
+            msg += f" ({extra})"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (_rebuild_actor_error,
+                (type(self), self.actor_id, self.reason,
+                 self.context.to_dict()))
+
+
+def _rebuild_actor_error(cls, actor_id, reason, ctx_dict):
+    ctx = DeathContext.from_dict(ctx_dict)
+    return cls(actor_id, reason, node_id=ctx.node_id,
+               incarnation=ctx.incarnation, timeline=ctx.timeline)
 
 
 class ActorDiedError(RayActorError):
@@ -80,8 +153,27 @@ class ObjectFetchTimedOutError(ObjectLostError):
 
 
 class OwnerDiedError(ObjectLostError):
-    def __init__(self, object_id_hex: str = ""):
-        super().__init__(object_id_hex, "lost because its owner died")
+    def __init__(self, object_id_hex: str = "", node_id: str = "",
+                 incarnation: int = 0, reason: str = "",
+                 timeline: Optional[List[Tuple[float, str]]] = None):
+        self.context = DeathContext(node_id, incarnation,
+                                    reason or "owner died", timeline)
+        detail = "lost because its owner died"
+        extra = self.context.describe()
+        if node_id or incarnation:
+            detail += f" ({extra})"
+        super().__init__(object_id_hex, detail)
+
+    def __reduce__(self):
+        return (_rebuild_owner_error,
+                (self.object_id_hex, self.context.to_dict()))
+
+
+def _rebuild_owner_error(object_id_hex, ctx_dict):
+    ctx = DeathContext.from_dict(ctx_dict)
+    return OwnerDiedError(object_id_hex, node_id=ctx.node_id,
+                          incarnation=ctx.incarnation, reason=ctx.reason,
+                          timeline=ctx.timeline)
 
 
 class ObjectStoreFullError(RayTpuError):
@@ -106,7 +198,36 @@ class WorkerCrashedError(RayTpuError):
 
 
 class NodeDiedError(RayTpuError):
-    pass
+    """A node left the cluster (crash, kill, or partition fencing) while
+    work targeting it was in flight. Pending leases, actor calls and
+    pulls aimed at the node resolve to this instead of hanging out a
+    network deadline that a partition (no TCP RST) would never trip."""
+
+    def __init__(self, message: str = "", node_id: str = "",
+                 incarnation: int = 0, reason: str = "",
+                 timeline: Optional[List[Tuple[float, str]]] = None):
+        self.context = DeathContext(node_id, incarnation, reason, timeline)
+        if not message:
+            message = f"Node {node_id[:12] if node_id else '?'} died"
+            extra = self.context.describe()
+            if extra:
+                message += f" ({extra})"
+        super().__init__(message)
+        self.message = message
+
+    @property
+    def node_id(self) -> str:
+        return self.context.node_id
+
+    def __reduce__(self):
+        return (_rebuild_node_error, (self.message, self.context.to_dict()))
+
+
+def _rebuild_node_error(message, ctx_dict):
+    ctx = DeathContext.from_dict(ctx_dict)
+    return NodeDiedError(message, node_id=ctx.node_id,
+                         incarnation=ctx.incarnation, reason=ctx.reason,
+                         timeline=ctx.timeline)
 
 
 class RuntimeEnvSetupError(RayTpuError):

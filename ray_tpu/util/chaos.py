@@ -13,12 +13,13 @@ fixtures and real deployments.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import signal
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import ray_tpu
 
@@ -157,6 +158,106 @@ class DaemonKiller(ResourceKiller):
             return f"{target.get('role', 'daemon')} pid={target['pid']}"
         except ProcessLookupError:
             return None
+
+
+class NetworkPartitioner(ResourceKiller):
+    """Partition nodes off the cluster's NETWORK without touching their
+    processes (built on protocol.FaultSchedule — reference lineage: the
+    Jepsen/mesh-partition testing tradition the process killers above
+    cannot reach). The victim's daemons stay alive and its sockets stay
+    open; frames just stop flowing, which is exactly the failure mode —
+    hung host, one-way link, gray failure — that RST-driven recovery
+    paths never see.
+
+    Requires the cluster to run with ``RAY_TPU_FAULT_INJECTION=1`` in the
+    daemons' environment (set it before ``Cluster()``/``init()``); rules
+    are published through ``<session_dir>/fault_schedule.json`` and
+    picked up by every process within ``protocol.FAULT_POLL_S``.
+
+    Modes: ``"both"`` (symmetric partition), ``"out"`` (one-way: the node
+    hears the cluster but nothing it says gets out — heartbeats vanish,
+    no RST), ``"in"`` (the node goes deaf). Unix sockets (worker ↔ local
+    agent) are spared: the HOST is healthy, its network is not.
+
+    Use directly (``partition(node_id)`` / ``heal()``) or as a periodic
+    killer: each round partitions a random worker node for
+    ``duration_s``, then heals it.
+    """
+
+    def __init__(self, cluster=None, session_dir: Optional[str] = None,
+                 mode: str = "both", duration_s: float = 10.0,
+                 interval_s: float = 5.0, max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
+        super().__init__(interval_s, max_kills, seed)
+        if session_dir is None:
+            if cluster is None:
+                raise ValueError("need a cluster or a session_dir")
+            session_dir = cluster.session_dir
+        self.cluster = cluster
+        self.session_dir = session_dir
+        self.mode = mode
+        self.duration_s = duration_s
+        self.partitioned: Dict[str, str] = {}  # node_id -> mode
+        self._rules_lock = threading.Lock()
+
+    @property
+    def fault_file(self) -> str:
+        return os.path.join(self.session_dir, "fault_schedule.json")
+
+    def _write_rules(self) -> None:
+        rules = []
+        for node_id, mode in self.partitioned.items():
+            directions = {"both": ["both"], "out": ["out"],
+                          "in": ["in"]}[mode]
+            for direction in directions:
+                rules.append({"self": node_id, "peer": "tcp",
+                              "direction": direction, "method": "*",
+                              "action": "drop"})
+        tmp = self.fault_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rules": rules}, f)
+        os.replace(tmp, self.fault_file)  # atomic: pollers never see a
+        # half-written schedule
+
+    def partition(self, node_id: str, mode: Optional[str] = None) -> None:
+        """Cut node `node_id` off per `mode`, effective within one poll."""
+        with self._rules_lock:
+            self.partitioned[node_id] = mode or self.mode
+            self._write_rules()
+
+    def heal(self, node_id: Optional[str] = None) -> None:
+        """Restore connectivity for one node (or all)."""
+        with self._rules_lock:
+            if node_id is None:
+                self.partitioned.clear()
+            else:
+                self.partitioned.pop(node_id, None)
+            self._write_rules()
+
+    # -- ResourceKiller hooks ---------------------------------------------
+    def find_target(self):
+        head_id = None
+        if self.cluster is not None and self.cluster.head_node is not None:
+            head_id = self.cluster.head_node.node_id
+        try:
+            nodes = [n["node_id"] for n in ray_tpu.nodes()
+                     if n["alive"] and n["node_id"] != head_id
+                     and n["node_id"] not in self.partitioned]
+        except Exception:
+            return None
+        return self.rng.choice(nodes) if nodes else None
+
+    def kill_target(self, target) -> Optional[str]:
+        self.partition(target)
+        timer = threading.Timer(self.duration_s, self.heal, args=(target,))
+        timer.daemon = True
+        timer.start()
+        return f"partition {target[:12]} mode={self.mode}"
+
+    def stop(self) -> List[str]:
+        kills = super().stop()
+        self.heal()  # never leave a standing partition behind
+        return kills
 
 
 def kill_random_node(cluster, exclude_head: bool = True) -> Optional[str]:

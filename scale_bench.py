@@ -267,6 +267,107 @@ def bench_cross_node(quick: bool = False) -> dict:
     return out
 
 
+def bench_chaos(quick: bool = False) -> dict:
+    """Recovery-latency trajectory (robustness budget, tracked like a
+    perf number): node-death detection time under a one-way partition
+    (no RST), pending-call fail-fast time for a driver blocked on the
+    dead node, fenced-agent exit time after the partition heals, and
+    actor restart time after a SIGKILL. Tight detection budget via env
+    so the phase stays in seconds."""
+    import os
+    import signal as _signal
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import (
+        ActorDiedError, NodeDiedError, RayActorError)
+    from ray_tpu.util.chaos import NetworkPartitioner
+
+    env = {"RAY_TPU_FAULT_INJECTION": "1",
+           "RAY_TPU_HEALTH_CHECK_PERIOD_MS": "500",
+           "RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD": "4",
+           "RAY_TPU_NODE_DISCONNECT_GRACE_S": "2.0"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    out = {"health_budget_s": 2.0}
+    cluster = partitioner = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        ray_tpu.init(_node=cluster.head_node)
+        node = cluster.add_node(num_cpus=2, resources={"far": 4})
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(resources={"far": 0.01})
+        class FarProbe:
+            def ping(self):
+                return "pong"
+
+            def stall(self, seconds):
+                import time as _t
+
+                _t.sleep(seconds)
+                return "done"
+
+        probe = FarProbe.remote()
+        ray_tpu.get(probe.ping.remote(), timeout=120)
+        pending = probe.stall.remote(600)
+        partitioner = NetworkPartitioner(cluster, mode="out")
+        t0 = time.perf_counter()
+        partitioner.partition(node.node_id)
+        deadline = t0 + 60
+        while time.perf_counter() < deadline and any(
+                n["node_id"] == node.node_id and n["alive"]
+                for n in ray_tpu.nodes()):
+            time.sleep(0.05)
+        out["node_death_detection_s"] = round(time.perf_counter() - t0, 3)
+        try:
+            ray_tpu.get(pending, timeout=60)
+            out["pending_call_failfast_s"] = None  # should not happen
+        except (ActorDiedError, NodeDiedError, RayActorError):
+            out["pending_call_failfast_s"] = round(
+                time.perf_counter() - t0, 3)
+        t1 = time.perf_counter()
+        partitioner.heal(node.node_id)
+        while time.perf_counter() - t1 < 90 and \
+                node.agent_proc.poll() is None:
+            time.sleep(0.1)
+        out["fenced_agent_exit_s"] = (
+            round(time.perf_counter() - t1, 3)
+            if node.agent_proc.poll() is not None else None)
+
+        # actor restart latency: SIGKILL the (local) actor worker, time
+        # until the restarted incarnation answers
+        @ray_tpu.remote(max_restarts=4, max_task_retries=4)
+        class LocalProbe:
+            def pid(self):
+                return os.getpid()
+
+        lp = LocalProbe.remote()
+        victim_pid = ray_tpu.get(lp.pid.remote(), timeout=120)
+        t2 = time.perf_counter()
+        os.kill(victim_pid, _signal.SIGKILL)
+        while time.perf_counter() - t2 < 120:
+            try:
+                if ray_tpu.get(lp.pid.remote(), timeout=10) != victim_pid:
+                    break
+            except Exception:
+                time.sleep(0.1)
+        out["actor_restart_s"] = round(time.perf_counter() - t2, 3)
+    finally:
+        if partitioner is not None:
+            partitioner.heal()
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def main(quick: bool = False) -> dict:
     import ray_tpu
 
@@ -296,6 +397,12 @@ def main(quick: bool = False) -> dict:
         results["cross_node"] = bench_cross_node(quick)
     except Exception as e:  # noqa: BLE001 — partial results still print
         results["cross_node"] = {"error": f"{type(e).__name__}: {e}"}
+    # chaos phase: recovery latencies tracked like a perf number, same
+    # isolation story as cross_node (own cluster, flake-tolerant)
+    try:
+        results["chaos"] = bench_chaos(quick)
+    except Exception as e:  # noqa: BLE001
+        results["chaos"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(results))
     try:
         from ray_tpu._private import lifecycle

@@ -72,6 +72,7 @@ FAST_FILES = {
     "test_optional_adapters.py",
     "test_lifecycle.py",
     "test_transfer_plane.py",
+    "test_partition.py",
 }
 SLOW_TESTS: set = set()
 
