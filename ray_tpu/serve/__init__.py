@@ -13,6 +13,8 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import BackPressureError
+from ray_tpu.serve._private.engine import ContinuousBatchingEngine
 from ray_tpu.serve.batching import batch, pad_batch
 from ray_tpu.serve.deployment import (
     Application, AutoscalingConfig, Deployment, deployment)
@@ -35,6 +37,7 @@ __all__ = [
     "DeploymentHandle", "DeploymentResponse", "Request",
     "start", "run", "shutdown", "delete", "status", "get_app_handle",
     "get_deployment_handle", "batch", "pad_batch", "multiplexed",
+    "BackPressureError", "ContinuousBatchingEngine",
     "get_multiplexed_model_id", "build", "run_config",
     "DeploymentSchema", "ServeApplicationSchema", "ServeDeploySchema",
     "HTTPOptionsSchema", "ServeGrpcClient", "get_grpc_port",
@@ -224,6 +227,7 @@ def _build_specs(app: Application):
             "init_blob": cloudpickle.dumps((args, kwargs)),
             "num_replicas": d.num_replicas,
             "max_ongoing_requests": d.max_ongoing_requests,
+            "max_queued_requests": d.max_queued_requests,
             "user_config": d.user_config,
             "autoscaling_config": auto.__dict__ if auto else None,
             "ray_actor_options": d.ray_actor_options,

@@ -29,7 +29,10 @@ class _ReplicaState:
         self.handle = handle
         self.started_at = time.monotonic()
         self.healthy = True
-        self.last_queue_len = 0
+        self.last_queue_len = 0   # running + queued (total demand parked)
+        self.last_ongoing = 0
+        self.last_queued = 0
+        self.last_shed_total = 0
 
 
 class _DeploymentInfo:
@@ -47,7 +50,10 @@ class _DeploymentInfo:
         self.status = "UPDATING"
         self._last_scale_up = 0.0
         self._last_scale_down = 0.0
-        self._ongoing_history: List = []  # (t, total_ongoing)
+        # (t, total_ongoing, total_queued) — the autoscaler's load signal
+        self._ongoing_history: List = []
+        self.shed_total = 0       # monotonic across replica generations
+        self._shed_seen: Dict[str, int] = {}  # replica -> last shed_total
 
 
 class _ProxyState:
@@ -71,6 +77,28 @@ class ServeController(LongPollHost):
     def __init__(self, http_port: int = 8000):
         LongPollHost.__init__(self)
         self.http_port = http_port
+        # serving-plane gauges (exported through the util.metrics KV
+        # plane like every other process's metrics; tags discriminate
+        # deployments): queue depth + shed totals are what the
+        # autoscaler acts on, so they must be observable
+        from ray_tpu.util import metrics as _metrics
+
+        self._g_depth = _metrics.Gauge(
+            "ray_tpu_serve_queue_depth",
+            "Total requests running+queued across a deployment's replicas.",
+            tag_keys=("app", "deployment"))
+        self._g_ongoing = _metrics.Gauge(
+            "ray_tpu_serve_ongoing",
+            "Requests executing across a deployment's replicas.",
+            tag_keys=("app", "deployment"))
+        self._g_replicas = _metrics.Gauge(
+            "ray_tpu_serve_replicas",
+            "Live replica count per deployment.",
+            tag_keys=("app", "deployment"))
+        self._c_shed = _metrics.Counter(
+            "ray_tpu_serve_shed_total",
+            "Requests shed with BackPressureError (admission queue full).",
+            tag_keys=("app", "deployment"))
         self._apps: Dict[str, Dict[str, _DeploymentInfo]] = {}
         self._routes: Dict[str, tuple] = {}  # prefix -> (app, ingress dep)
         self._loop_task = None
@@ -291,6 +319,10 @@ class ServeController(LongPollHost):
                 "status": "RUNNING" if ok else "UPDATING",
                 "replicas": running,
                 "target_replicas": info.target_replicas,
+                "queue_depth": sum(r.last_queue_len for r in info.replicas),
+                "ongoing": sum(r.last_ongoing for r in info.replicas),
+                "queued": sum(r.last_queued for r in info.replicas),
+                "shed_total": info.shed_total,
             }
         return {"status": "RUNNING" if all_running else "UPDATING",
                 "deployments": out}
@@ -354,15 +386,28 @@ class ServeController(LongPollHost):
         name = f"SERVE_REPLICA::{app_name}#{info.name}#{uuid.uuid4().hex[:6]}"
         opts = dict(spec.get("ray_actor_options") or {})
         opts.setdefault("num_cpus", 0.1)
+        max_ongoing = spec.get("max_ongoing_requests", 8)
+        max_queued = spec.get("max_queued_requests", 64)
+        # queued streaming requests each hold an actor pool thread and
+        # queued async requests each hold a concurrency-semaphore slot, so
+        # concurrency must cover running + queued + control RPC headroom
+        # (health checks share the pool — an under-sized pool would turn a
+        # full queue into a false "unhealthy, kill it" verdict). The
+        # unbounded queue mode (-1) gets a generous finite slot budget:
+        # actor concurrency cannot be infinite, and past ~256 parked
+        # requests the queue is failing anyway.
+        queue_slots = max_queued if max_queued >= 0 else 256
+        concurrency = max(8, max_ongoing + queue_slots + 8)
         actor = await asyncio.to_thread(
             lambda: ray_tpu.remote(Replica).options(
                 name=name, namespace=SERVE_NAMESPACE,
-                max_concurrency=max(8, spec.get("max_ongoing_requests", 8) + 4),
+                max_concurrency=concurrency,
                 **opts,
             ).remote(
                 spec["blob"], spec["init_blob"], app_name, info.name,
-                spec.get("max_ongoing_requests", 8),
+                max_ongoing,
                 spec.get("user_config"),
+                max_queued_requests=max_queued,
             ))
         replica = _ReplicaState(name, actor)
         try:
@@ -409,41 +454,69 @@ class ServeController(LongPollHost):
         alive: List[_ReplicaState] = []
         changed = False
         total_ongoing = 0
+        total_queued = 0
         for r in info.replicas:
             try:
-                qlen = await asyncio.to_thread(
+                probe = await asyncio.to_thread(
                     ray_tpu.get, r.handle.health_check.remote(), timeout=5)
-                r.last_queue_len = int(qlen)
-                total_ongoing += r.last_queue_len
+                if isinstance(probe, dict):
+                    r.last_ongoing = int(probe.get("ongoing", 0))
+                    r.last_queued = int(probe.get("queued", 0))
+                    r.last_queue_len = int(
+                        probe.get("depth", r.last_ongoing + r.last_queued))
+                    shed = int(probe.get("shed_total", 0))
+                    prev = info._shed_seen.get(r.name, 0)
+                    if shed > prev:
+                        info.shed_total += shed - prev
+                        self._c_shed.inc(shed - prev,
+                                         tags={"app": app_name,
+                                               "deployment": info.name})
+                    info._shed_seen[r.name] = shed
+                else:  # pre-queue replica: plain ongoing int
+                    r.last_ongoing = r.last_queue_len = int(probe)
+                    r.last_queued = 0
+                total_ongoing += r.last_ongoing
+                total_queued += r.last_queued
                 alive.append(r)
             except Exception:
                 changed = True
+                info._shed_seen.pop(r.name, None)
                 try:
                     ray_tpu.kill(r.handle)
                 except Exception:
                     pass
         info.replicas = alive
-        info._ongoing_history.append((now, total_ongoing))
+        info._ongoing_history.append((now, total_ongoing, total_queued))
         info._ongoing_history = info._ongoing_history[-60:]
+        tags = {"app": app_name, "deployment": info.name}
+        self._g_depth.set(total_ongoing + total_queued, tags=tags)
+        self._g_ongoing.set(total_ongoing, tags=tags)
+        self._g_replicas.set(len(alive), tags=tags)
         if changed:
             self._publish(app_name, info)
 
     # ------------------------------------------------------------- autoscale
     def _autoscale(self, info: _DeploymentInfo):
-        """Request-based policy (reference: serve/autoscaling_policy.py):
-        keep ~target_ongoing_requests per replica, with delays to avoid
-        flapping."""
+        """Queue-aware request-based policy (reference:
+        serve/autoscaling_policy.py): size the fleet for
+        ~target_ongoing_requests per replica, where load counts BOTH
+        executing requests and requests parked in admission queues
+        (weighted by ``queue_depth_weight``) — queue depth is demand the
+        current fleet failed to absorb, the earliest scale-up signal and
+        the precursor of sheds. Delays avoid flapping; scale-down drains
+        via Replica.drain before the kill."""
         cfg = info.autoscaling
         hist = info._ongoing_history
         if not hist:
             return
         now = time.monotonic()
-        window = [v for (t, v) in hist if now - t < 5.0]
+        qw = cfg.get("queue_depth_weight", 1.0)
+        window = [rec[1] + qw * rec[2] for rec in hist
+                  if now - rec[0] < 5.0]
         if not window:
             return
-        avg_ongoing = sum(window) / len(window)
-        cur = max(1, len(info.replicas))
-        desired = avg_ongoing / cfg["target_ongoing_requests"]
+        avg_load = sum(window) / len(window)
+        desired = avg_load / cfg["target_ongoing_requests"]
         import math
 
         desired = int(min(max(math.ceil(desired), cfg["min_replicas"]),

@@ -16,6 +16,7 @@ import urllib.parse
 from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.exceptions import BackPressureError
 
 
 class Request:
@@ -279,11 +280,25 @@ class ProxyActor:
                     meta.get("media_type") or "application/octet-stream",
                     meta.get("headers") or {}, result)
             return self._encode(result)
+        except BackPressureError as e:
+            # the plane shed this request (admission queues full): tell
+            # the client to back off — a typed 503, never a spin-retry
+            return ("503 Service Unavailable",
+                    json.dumps({"error": str(e),
+                                "reason": "backpressure"}).encode(),
+                    "application/json", {"Retry-After": "1"})
         except TimeoutError as e:
             return ("503 Service Unavailable",
                     json.dumps({"error": str(e)}).encode(),
                     "application/json", None)
         except Exception as e:
+            if isinstance(getattr(e, "cause", None), BackPressureError):
+                # shed inside the replica (e.g. a batching engine's
+                # pending cap), surfaced as RayTaskError(cause=...)
+                return ("503 Service Unavailable",
+                        json.dumps({"error": str(e.cause),
+                                    "reason": "backpressure"}).encode(),
+                        "application/json", {"Retry-After": "1"})
             return ("500 Internal Server Error",
                     json.dumps({"error": f"{type(e).__name__}: {e}"}
                                ).encode(), "application/json", None)

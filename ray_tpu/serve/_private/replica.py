@@ -1,19 +1,107 @@
 """Replica actor (reference: python/ray/serve/_private/replica.py —
-ReplicaActor :233, handle_request :391, rejection-based backpressure :487
-``max_ongoing_requests``).
+ReplicaActor :233, handle_request :391, queue-based admission control
+``max_queued_requests`` + ``max_ongoing_requests``).
 
-Hosts one instance of the user's deployment class/function. Requests above
-``max_ongoing_requests`` are rejected with a sentinel so the router retries
-elsewhere — backpressure flows to the caller instead of queueing here.
+Hosts one instance of the user's deployment class/function. Admission is a
+bounded queue: up to ``max_ongoing_requests`` execute concurrently, up to
+``max_queued_requests`` more wait in FIFO order, and anything beyond that is
+SHED with a typed reply the router surfaces as ``BackPressureError`` —
+backpressure reaches the client as a fast typed error instead of the old
+reject-and-spin retry loop. Every reply piggybacks the replica's current
+queue depth so routers route on cached depths without probe RPCs.
 """
 
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import inspect
+import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
-REJECTED = "__serve_rejected__"
+# admission-shed sentinel (kept under the old name too: external routers
+# from this repo's earlier rounds knew it as REJECTED)
+SHED = "__serve_shed__"
+REJECTED = SHED
+
+
+class AdmissionQueue:
+    """Bounded FIFO admission shared by the async request path (actor
+    event loop) and the sync streaming path (actor thread pool).
+
+    ``acquire()`` returns ``None`` for immediate admission, a
+    ``concurrent.futures.Future`` to wait on when queued (async callers
+    ``wrap_future`` it — no thread is consumed while waiting), or raises
+    ``_Shed`` when the queue is full or the replica is draining. Release
+    hands the slot directly to the head waiter, preserving FIFO order.
+    """
+
+    def __init__(self, max_ongoing: int, max_queued: int):
+        self.max_ongoing = max(1, int(max_ongoing))
+        # max_queued < 0 means unbounded (reference default); 0 disables
+        # queueing entirely (round-5 reject semantics, typed now)
+        self.max_queued = int(max_queued)
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._waiters: list = []  # FIFO of Futures
+        self.shed_total = 0
+
+    class _Shed(Exception):
+        pass
+
+    @property
+    def ongoing(self) -> int:
+        return self._ongoing
+
+    @property
+    def queued(self) -> int:
+        return len(self._waiters)
+
+    @property
+    def depth(self) -> int:
+        """Total demand parked on this replica: running + queued."""
+        with self._lock:
+            return self._ongoing + len(self._waiters)
+
+    def acquire(self, draining: bool = False):
+        with self._lock:
+            if draining:
+                self.shed_total += 1
+                raise self._Shed()
+            if self._ongoing < self.max_ongoing and not self._waiters:
+                self._ongoing += 1
+                return None
+            if self.max_queued >= 0 and len(self._waiters) >= self.max_queued:
+                self.shed_total += 1
+                raise self._Shed()
+            fut: "concurrent.futures.Future" = concurrent.futures.Future()
+            self._waiters.append(fut)
+            return fut
+
+    def release(self) -> None:
+        with self._lock:
+            # hand-off: the slot passes to the head waiter without the
+            # ongoing count ever dipping (no thundering herd, strict FIFO)
+            while self._waiters:
+                fut = self._waiters.pop(0)
+                if fut.set_running_or_notify_cancel():
+                    fut.set_result(None)
+                    return
+            self._ongoing -= 1
+
+    def abandon(self, fut) -> None:
+        """A queued waiter gave up (cancelled/timed out upstream)."""
+        with self._lock:
+            try:
+                self._waiters.remove(fut)
+            except ValueError:
+                pass
+
+    def note_shed(self) -> None:
+        """Count a shed decided outside acquire (e.g. TTL expiry)."""
+        with self._lock:
+            self.shed_total += 1
 
 
 class _HandlePlaceholder:
@@ -28,13 +116,13 @@ class _HandlePlaceholder:
 class Replica:
     def __init__(self, blob: bytes, init_blob: bytes, app_name: str,
                  dep_name: str, max_ongoing_requests: int,
-                 user_config: Any):
+                 user_config: Any, max_queued_requests: int = 64):
         import cloudpickle
 
         self._app_name = app_name
         self._dep_name = dep_name
-        self._max_ongoing = max_ongoing_requests
-        self._ongoing = 0
+        self._admission = AdmissionQueue(max_ongoing_requests,
+                                         max_queued_requests)
         self._draining = False
 
         func_or_class = cloudpickle.loads(blob)
@@ -76,24 +164,52 @@ class Replica:
     def ready(self) -> bool:
         return True
 
-    def health_check(self) -> int:
-        """Doubles as queue-len probe: returns ongoing request count."""
+    def health_check(self) -> Dict[str, int]:
+        """Health probe + serving metrics in one RPC: the controller's
+        autoscaler consumes queue depth and shed totals, not just ongoing
+        counts (reference: replica queue-len metrics pushed to the
+        controller for autoscaling_policy.py)."""
         check = getattr(self._callable, "check_health", None)
         if check is not None:
             check()
-        return self._ongoing
+        eng = getattr(self._callable, "engine", None)
+        stats = {}
+        try:
+            from ray_tpu.serve._private.engine import ContinuousBatchingEngine
+
+            if isinstance(eng, ContinuousBatchingEngine):
+                stats = eng.stats()
+        except Exception:
+            stats = {}
+        return {
+            "ongoing": self._admission.ongoing,
+            "queued": self._admission.queued,
+            "depth": self._admission.ongoing + self._admission.queued,
+            "shed_total": self._admission.shed_total
+            + int(stats.get("shed", 0)),
+            "engine_steps": int(stats.get("steps", 0)),
+        }
 
     def get_queue_len(self) -> int:
-        return self._ongoing
+        return self._admission.depth
 
     def reconfigure(self, user_config) -> bool:
         self._apply_user_config(user_config)
         return True
 
     async def drain(self) -> bool:
+        """Stop admitting (new requests shed), let running AND queued
+        requests finish, then stop any batching engine the user callable
+        owns — the controller's scale-down path awaits this before kill."""
         self._draining = True
-        while self._ongoing > 0:
+        while self._admission.depth > 0:
             await asyncio.sleep(0.02)
+        eng = getattr(self._callable, "engine", None)
+        if eng is not None and hasattr(eng, "shutdown"):
+            try:
+                await asyncio.to_thread(eng.shutdown)
+            except Exception:
+                pass
         return True
 
     def _target(self, method_name: Optional[str]):
@@ -101,29 +217,54 @@ class Replica:
             return self._callable
         return getattr(self._callable, method_name or "__call__")
 
+    def _shed_reply(self) -> Tuple:
+        return (SHED, None, self._admission.depth)
+
     # ------------------------------------------------------------- requests
     async def handle_request(self, method_name: Optional[str], args: Tuple,
-                             kwargs: Dict, multiplexed_model_id: str = ""):
-        if self._ongoing >= self._max_ongoing or self._draining:
-            return (REJECTED, self._ongoing)
-        self._ongoing += 1
+                             kwargs: Dict, multiplexed_model_id: str = "",
+                             ttl: Optional[float] = None):
+        target = self._target(method_name)
+        if inspect.isgeneratorfunction(target) or \
+                inspect.isasyncgenfunction(target):
+            # generator endpoint: the caller must re-issue through the
+            # streaming path (checked BEFORE admission, so the slot is
+            # taken once, by the streaming call that does the work)
+            return ("stream", None, self._admission.depth)
+        t0 = time.monotonic()
+        try:
+            ticket = self._admission.acquire(self._draining)
+        except AdmissionQueue._Shed:
+            return self._shed_reply()
+        if isinstance(ticket, concurrent.futures.Future):
+            # queued: await admission without holding a thread
+            try:
+                await asyncio.wrap_future(ticket)
+            except asyncio.CancelledError:
+                # raced an in-flight hand-off: if the slot was already
+                # granted, give it back, else just leave the queue
+                if ticket.done() and not ticket.cancelled():
+                    self._admission.release()
+                else:
+                    self._admission.abandon(ticket)
+                raise
+            if ttl is not None and time.monotonic() - t0 > ttl:
+                # the caller's deadline passed while we were queued: the
+                # client already saw TimeoutError (and may have retried) —
+                # running user code now would double side effects
+                self._admission.release()
+                self._admission.note_shed()
+                return self._shed_reply()
         try:
             from ray_tpu.serve import multiplex
 
             if multiplexed_model_id:
                 multiplex._set_request_model_id(multiplexed_model_id)
-            target = self._target(method_name)
-            if inspect.isgeneratorfunction(target) or \
-                    inspect.isasyncgenfunction(target):
-                # generator endpoint: the caller must re-issue through the
-                # streaming path (checked BEFORE calling, so user code does
-                # not run twice); reference replicas always stream (ASGI)
-                return ("stream", None)
             if inspect.iscoroutinefunction(target):
                 result = await target(*args, **kwargs)
             else:
                 # sync user code runs off-loop so concurrent requests (and
-                # the rejection check) aren't serialized behind it
+                # the admission check) aren't serialized behind it
                 result = await asyncio.to_thread(target, *args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = await result
@@ -145,29 +286,49 @@ class Replica:
                             {"chunks": chunks,
                              "status_code": result.status_code,
                              "media_type": result.media_type,
-                             "headers": result.headers})
+                             "headers": result.headers},
+                            self._admission.depth)
                 chunks = await asyncio.to_thread(lambda: list(result))
                 return ("stream_buffered",
                         {"chunks": chunks, "status_code": 200,
                          "media_type": "application/octet-stream",
-                         "headers": {}})
-            return ("ok", result)
+                         "headers": {}}, self._admission.depth)
+            return ("ok", result, self._admission.depth)
         finally:
-            self._ongoing -= 1
+            self._admission.release()
             if multiplexed_model_id:
                 multiplex._set_request_model_id("")
 
     def handle_request_streaming(self, method_name: Optional[str],
                                  args: Tuple, kwargs: Dict,
-                                 multiplexed_model_id: str = ""):
+                                 multiplexed_model_id: str = "",
+                                 ttl: Optional[float] = None):
         """Streaming execution path (reference: replica.py:471): a sync
         generator method — called with num_returns='streaming', each yield
         becomes an ObjectRef at the caller as it is produced. First item is
-        the admission handshake."""
-        if self._ongoing >= self._max_ongoing or self._draining:
-            yield (REJECTED, self._ongoing)
+        the admission handshake. Runs in the actor's thread pool, so a
+        queued request blocks its pool thread (the controller sizes
+        max_concurrency for max_ongoing + max_queued + headroom)."""
+        t0 = time.monotonic()
+        try:
+            ticket = self._admission.acquire(self._draining)
+        except AdmissionQueue._Shed:
+            yield self._shed_reply()
             return
-        self._ongoing += 1
+        if isinstance(ticket, concurrent.futures.Future):
+            try:
+                ticket.result()
+            except BaseException:
+                if ticket.done() and not ticket.cancelled():
+                    self._admission.release()
+                else:
+                    self._admission.abandon(ticket)
+                raise
+            if ttl is not None and time.monotonic() - t0 > ttl:
+                self._admission.release()
+                self._admission.note_shed()
+                yield self._shed_reply()
+                return
         try:
             from ray_tpu.serve import multiplex
             from ray_tpu.serve.asgi import StreamingResponse, iterate_sync
@@ -181,25 +342,28 @@ class Replica:
                 result = asyncio.run(target(*args, **kwargs))
             else:
                 result = target(*args, **kwargs)
+            depth = self._admission.ongoing + self._admission.queued
             if isinstance(result, StreamingResponse):
                 yield ("start", {"status_code": result.status_code,
                                  "media_type": result.media_type,
-                                 "headers": result.headers})
+                                 "headers": result.headers,
+                                 "queue_depth": depth})
                 for chunk in iterate_sync(result.content):
                     yield ("chunk", chunk)
             elif inspect.isgenerator(result) or hasattr(result, "__aiter__"):
                 yield ("start", {"status_code": 200,
                                  "media_type": "application/octet-stream",
-                                 "headers": {}})
+                                 "headers": {},
+                                 "queue_depth": depth})
                 for chunk in iterate_sync(result):
                     yield ("chunk", chunk)
             else:
                 # non-streaming endpoint called through the streaming path:
                 # a single-chunk stream
                 yield ("start", {"status_code": 200, "media_type": None,
-                                 "headers": {}})
+                                 "headers": {}, "queue_depth": depth})
                 yield ("chunk", result)
         finally:
-            self._ongoing -= 1
+            self._admission.release()
             if multiplexed_model_id:
                 multiplex._set_request_model_id("")

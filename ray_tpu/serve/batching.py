@@ -11,7 +11,30 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import weakref
 from typing import Any, Callable, List, Optional, Sequence
+
+# Per-instance batch queues keyed by the OWNER ITSELF, weakly: an id(owner)
+# key is never evicted, and a GC'd instance's id can be reused by a new
+# object — which would silently feed two instances' requests into one stale
+# batch queue. Module-level (NOT decorator-closure state) on purpose:
+# deployment classes are cloudpickled to replicas, and a WeakKeyDictionary
+# reachable from the wrapper (closure cell OR captured global — cloudpickle
+# serializes both by value for a by-value-pickled function) is unpicklable.
+# The wrapper only ever touches it through ``_queues_for``, which IS
+# importable from this module and therefore pickles by reference.
+# Values are {method qualname: _BatchQueue} so two @serve.batch methods on
+# one instance keep separate queues.
+_owner_queues: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _queues_for(owner) -> dict:
+    """The per-owner {method qualname: _BatchQueue} dict, created weakly on
+    first use. Raises TypeError for non-weakrefable owners."""
+    per_owner = _owner_queues.get(owner)
+    if per_owner is None:
+        per_owner = _owner_queues[owner] = {}
+    return per_owner
 
 
 class _BatchQueue:
@@ -91,21 +114,55 @@ def batch(_fn=None, *, max_batch_size: int = 8,
     """Decorator for async methods taking a list of inputs."""
 
     def deco(fn):
-        queues = {}  # per-instance (bound self) queue
+        qkey = f"{fn.__module__}.{fn.__qualname__}"
+        ATTR = f"__serve_batch_queue_{fn.__name__}__"
 
         @functools.wraps(fn)
         async def wrapper(*args):
             if len(args) == 2:  # bound method: (self, item)
                 owner, item = args
-                key = id(owner)
-                bound = functools.partial(fn, owner)
-            else:
+            else:  # plain-function deployment: anchor on the wrapper
                 (item,) = args
-                key, bound = None, fn
-            q = queues.get(key)
+                owner = wrapper
+            try:
+                per_owner = _queues_for(owner)
+            except TypeError:  # non-weakrefable owner (e.g. __slots__)
+                q = getattr(owner, ATTR, None)
+                if q is None:
+                    # a strong bound partial is fine HERE: the queue
+                    # lives on the owner itself, so their lifetimes match
+                    q = _BatchQueue(functools.partial(fn, owner),
+                                    max_batch_size, batch_wait_timeout_s,
+                                    allowed_batch_sizes)
+                    try:
+                        setattr(owner, ATTR, q)
+                    except (AttributeError, TypeError):
+                        raise TypeError(
+                            f"@serve.batch owner {type(owner).__name__} "
+                            "is neither weak-referenceable nor "
+                            "attribute-assignable; batching needs one "
+                            "place to anchor its per-instance queue")
+                return await q.put(item)
+            q = per_owner.get(qkey)
             if q is None:
-                q = queues[key] = _BatchQueue(
-                    bound, max_batch_size, batch_wait_timeout_s,
+                if owner is wrapper:
+                    call = fn
+                else:
+                    # bind the owner WEAKLY: the registry's value must not
+                    # strongly reference its weak key, or the owner (and
+                    # its queue) would live forever anyway
+                    ref = weakref.ref(owner)
+
+                    async def call(items, _ref=ref):
+                        o = _ref()
+                        if o is None:
+                            raise RuntimeError(
+                                "@serve.batch owner was garbage collected "
+                                "with requests still queued")
+                        return await fn(o, items)
+
+                q = per_owner[qkey] = _BatchQueue(
+                    call, max_batch_size, batch_wait_timeout_s,
                     allowed_batch_sizes)
             return await q.put(item)
 

@@ -24,12 +24,18 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # weight of QUEUED (admitted-but-waiting) requests in the load signal:
+    # 1.0 treats a queued request like a running one — queue depth is
+    # demand the fleet failed to absorb, so it scales up replicas just as
+    # hard. 0.0 restores the ongoing-only round-5 policy.
+    queue_depth_weight: float = 1.0
 
 
 class Deployment:
     def __init__(self, func_or_class: Union[Callable, type], name: str,
                  *, num_replicas: Optional[int] = 1,
                  max_ongoing_requests: int = 8,
+                 max_queued_requests: int = 64,
                  user_config: Optional[Any] = None,
                  autoscaling_config: Optional[Union[Dict,
                                                     AutoscalingConfig]] = None,
@@ -40,6 +46,9 @@ class Deployment:
         self.name = name
         self.num_replicas = num_replicas or 1
         self.max_ongoing_requests = max_ongoing_requests
+        # bounded per-replica admission queue; -1 = unbounded (reference
+        # default), 0 = typed fast-reject with no queueing
+        self.max_queued_requests = max_queued_requests
         self.user_config = user_config
         if isinstance(autoscaling_config, dict):
             autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -56,6 +65,7 @@ class Deployment:
         fields = dict(
             num_replicas=self.num_replicas,
             max_ongoing_requests=self.max_ongoing_requests,
+            max_queued_requests=self.max_queued_requests,
             user_config=self.user_config,
             autoscaling_config=self.autoscaling_config,
             ray_actor_options=self.ray_actor_options,

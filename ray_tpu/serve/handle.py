@@ -1,11 +1,13 @@
 """DeploymentHandle + Router (reference: python/ray/serve/handle.py:613 —
 ``remote`` :685; _private/router.py:37; power-of-two-choices replica
-scheduling replica_scheduler/pow_2_scheduler.py:44 with queue-len probing
-and rejection retry).
+scheduling replica_scheduler/pow_2_scheduler.py:44 on CACHED queue depths).
 
 ``handle.remote(*args)`` returns a ``DeploymentResponse``; resolution picks
-two random replicas, probes their queue lengths, sends to the shorter, and
-retries elsewhere when a replica rejects (it is at ``max_ongoing_requests``).
+two random replicas and sends to the one with the lower cached queue depth
+(depths piggyback on every reply — no per-request probe RPCs; a cold cache
+falls back to random choice). A replica whose admission queue is full sheds
+the request; the router tries the remaining replicas once each and then
+raises a typed ``BackPressureError`` instead of spin-retrying.
 """
 
 from __future__ import annotations
@@ -15,12 +17,12 @@ import concurrent.futures
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
-from ray_tpu.exceptions import RayTaskError
+from ray_tpu.exceptions import BackPressureError, RayTaskError
 from ray_tpu.serve._private.controller import SERVE_NAMESPACE
-from ray_tpu.serve._private.replica import REJECTED
+from ray_tpu.serve._private.replica import SHED
 
 # Shared bounded pool driving request resolution: one task per in-flight
 # handle.remote(), instead of an unbounded thread per request. Daemon
@@ -119,7 +121,7 @@ class _ReplicaSet:
             self._handles = {n: h for n, h in self._handles.items()
                              if n in names}
 
-    def handles(self) -> List:
+    def handles(self) -> List[Tuple[str, Any]]:
         self.refresh()
         out = []
         for n in self._names:
@@ -130,27 +132,69 @@ class _ReplicaSet:
                     self._handles[n] = h
                 except Exception:
                     continue
-            out.append(h)
+            out.append((n, h))
         return out
 
 
 class Router:
-    """Pow-2 choice with queue-len probing + rejection retry."""
+    """Pow-2 choice over piggybacked queue depths + typed shed."""
+
+    # piggybacked depths go stale as OTHER routers send traffic; past the
+    # TTL a cached depth is no better than random choice
+    DEPTH_TTL_S = 5.0
 
     def __init__(self, app_name: str, dep_name: str):
         self.replica_set = _ReplicaSet(app_name, dep_name)
+        self._depths: Dict[str, Tuple[int, float]] = {}  # name -> (depth, t)
+        self._depth_lock = threading.Lock()
 
-    def _pick(self, handles: List) -> Any:
-        if len(handles) == 1:
-            return handles[0]
-        a, b = random.sample(handles, 2)
-        try:
-            qa, qb = ray_tpu.get(
-                [a.get_queue_len.remote(), b.get_queue_len.remote()],
-                timeout=2)
-        except Exception:
+    def _note_depth(self, name: str, depth: Any) -> None:
+        if not isinstance(depth, (int, float)):
+            return
+        with self._depth_lock:
+            self._depths[name] = (int(depth), time.monotonic())
+            if len(self._depths) > 4 * max(1, len(self.replica_set._names)):
+                # drop entries for replicas long gone
+                live = set(self.replica_set._names)
+                for n in list(self._depths):
+                    if n not in live:
+                        del self._depths[n]
+
+    def _cached_depth(self, name: str) -> Optional[int]:
+        with self._depth_lock:
+            rec = self._depths.get(name)
+        if rec is None or time.monotonic() - rec[1] > self.DEPTH_TTL_S:
+            return None
+        return rec[0]
+
+    def _pick(self, handles: List[Tuple[str, Any]],
+              exclude: Optional[set] = None) -> Optional[Tuple[str, Any]]:
+        """Two random candidates, lower cached depth wins; cold cache (no
+        fresh depth for either) falls back to random — never a probe RPC
+        on the request path."""
+        pool = [h for h in handles if not exclude or h[0] not in exclude]
+        if not pool:
+            return None
+        if len(pool) == 1:
+            return pool[0]
+        a, b = random.sample(pool, 2)
+        da, db = self._cached_depth(a[0]), self._cached_depth(b[0])
+        if da is None and db is None:
             return random.choice((a, b))
-        return a if qa <= qb else b
+        if da is None:
+            return a  # unknown: optimistically assume idle (it gets a
+            # request either way, and its reply warms the cache)
+        if db is None:
+            return b
+        return a if da <= db else b
+
+    def _backpressure(self) -> BackPressureError:
+        with self._depth_lock:
+            depths = {n: d for n, (d, _) in self._depths.items()}
+        return BackPressureError(
+            deployment=f"{self.replica_set.app_name}#"
+                       f"{self.replica_set.dep_name}",
+            queue_depths=depths)
 
     def assign(self, method_name: Optional[str], args, kwargs,
                multiplexed_model_id: str = "",
@@ -158,6 +202,7 @@ class Router:
         deadline = time.monotonic() + (timeout if timeout is not None
                                        else 60.0)
         backoff = 0.02
+        shed_by: set = set()
         while True:
             handles = self.replica_set.handles()
             if not handles:
@@ -169,12 +214,23 @@ class Router:
                 backoff = min(backoff * 2, 0.5)
                 self.replica_set.refresh(force=True)
                 continue
-            replica = self._pick(handles)
+            picked = self._pick(handles, exclude=shed_by)
+            if picked is None:
+                # every live replica shed this request: typed backpressure,
+                # not a spin-retry loop (clients own the retry policy)
+                raise self._backpressure()
+            name, replica = picked
             try:
+                # ttl rides along so a request still parked in the
+                # admission queue when this get's deadline passes is shed
+                # at admission instead of running user code the client
+                # already gave up on (double side effects on retry)
+                remaining = max(0.5, deadline - time.monotonic())
                 reply = ray_tpu.get(
                     replica.handle_request.remote(
-                        method_name, args, kwargs, multiplexed_model_id),
-                    timeout=max(0.5, deadline - time.monotonic()))
+                        method_name, args, kwargs, multiplexed_model_id,
+                        remaining),
+                    timeout=remaining)
             except RayTaskError:
                 # deterministic application error from user code: surface
                 # immediately, do NOT re-execute (side effects!)
@@ -187,24 +243,19 @@ class Router:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.5)
                 continue
-            if isinstance(reply, tuple) and len(reply) == 2 and \
-                    reply[0] == REJECTED:
-                # replica at max_ongoing_requests: back off, try another
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"{self.replica_set.dep_name}: all replicas busy")
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 0.5)
+            kind = reply[0] if isinstance(reply, tuple) else None
+            if kind is not None and len(reply) > 2:
+                self._note_depth(name, reply[2])
+            if kind == SHED:
+                shed_by.add(name)
                 continue
-            if isinstance(reply, tuple) and len(reply) == 2 and \
-                    reply[0] == "stream":
+            if kind == "stream":
                 # generator endpoint: re-issue through the streaming path
                 # (the replica detected this before running user code)
                 return _BufferedStream(
                     self.assign_streaming(method_name, args, kwargs,
                                           multiplexed_model_id, timeout))
-            if isinstance(reply, tuple) and len(reply) == 2 and \
-                    reply[0] == "stream_buffered":
+            if kind == "stream_buffered":
                 meta = reply[1]
                 return _BufferedStream(
                     iter([("start", {k: meta[k] for k in
@@ -222,6 +273,7 @@ class Router:
         deadline = time.monotonic() + (timeout if timeout is not None
                                        else 60.0)
         backoff = 0.02
+        shed_by: set = set()
         while True:
             handles = self.replica_set.handles()
             if not handles:
@@ -233,16 +285,19 @@ class Router:
                 backoff = min(backoff * 2, 0.5)
                 self.replica_set.refresh(force=True)
                 continue
-            replica = self._pick(handles)
+            picked = self._pick(handles, exclude=shed_by)
+            if picked is None:
+                raise self._backpressure()
+            name, replica = picked
             try:
+                remaining = max(0.5, deadline - time.monotonic())
                 gen = replica.handle_request_streaming.options(
                     num_returns="streaming").remote(
-                        method_name, args, kwargs, multiplexed_model_id)
+                        method_name, args, kwargs, multiplexed_model_id,
+                        remaining)
                 it = iter(gen)
                 first_ref = next(it)
-                first = ray_tpu.get(first_ref,
-                                    timeout=max(0.5,
-                                                deadline - time.monotonic()))
+                first = ray_tpu.get(first_ref, timeout=remaining)
             except RayTaskError:
                 raise
             except StopIteration:
@@ -254,13 +309,13 @@ class Router:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.5)
                 continue
-            if isinstance(first, tuple) and first[0] == REJECTED:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"{self.replica_set.dep_name}: all replicas busy")
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 0.5)
+            if isinstance(first, tuple) and first[0] == SHED:
+                if len(first) > 2:
+                    self._note_depth(name, first[2])
+                shed_by.add(name)
                 continue
+            if isinstance(first, tuple) and first[0] == "start":
+                self._note_depth(name, first[1].get("queue_depth"))
 
             def stream():
                 yield first

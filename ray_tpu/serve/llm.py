@@ -1,0 +1,199 @@
+"""LoRA-adapter llama generation on the continuous batching engine.
+
+This is the serving shape the repo's ``models/`` path is meant to run at
+production RPS (ROADMAP item 1; reference: Ray Serve LLM deployments —
+multiplexed LoRA adapters over a shared base model, iteration-level
+batching): one frozen base model per replica, per-request LoRA adapters
+multiplexed by model id, greedy decode driven step-by-step by
+:class:`~ray_tpu.serve._private.engine.ContinuousBatchingEngine` so
+mixed-length generations share the compiled batch.
+
+TPU notes: the per-step forward is jitted per (batch bucket, padded seq)
+shape pair — the engine's ``allowed_batch_sizes`` snapping plus a seq-pad
+bucket keep the compile-cache menu finite. Decoding here recomputes the
+full prefix each step (tiny demo configs; a kv-cache paged-attention
+variant slots into ``_step`` without touching the engine contract).
+
+Usage::
+
+    from ray_tpu.serve import llm
+    app = llm.build_llama_app(config="debug_1l", adapters=("a1", "a2"))
+    handle = serve.run(app, name="llama")
+    toks = list(handle.options(stream=True).remote(
+        {"prompt": [3, 5, 7], "max_new": 8, "adapter": "a1"}))
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.serve._private.engine import ContinuousBatchingEngine
+from ray_tpu.serve.deployment import Application, Deployment
+
+
+class LlamaGenerator:
+    """Deployment callable: streaming greedy generation with multiplexed
+    LoRA adapters, continuously batched."""
+
+    def __init__(self, config: str = "tiny", lora_rank: int = 4,
+                 max_batch_size: int = 4,
+                 allowed_batch_sizes: Optional[Sequence[int]] = (1, 2, 4),
+                 max_new_tokens: int = 16, seq_bucket: int = 32,
+                 max_adapters: int = 4, seed: int = 0):
+        import jax
+
+        from ray_tpu.models.llama import (
+            LlamaConfig, LoraConfig, init_llama)
+
+        self._cfg = getattr(LlamaConfig, config)() \
+            if isinstance(config, str) else config
+        # adapt only the attention q/v projections: the cheap standard
+        # LoRA target set, and enough for adapters to produce distinct
+        # generations
+        self._lcfg = LoraConfig(rank=lora_rank, targets=("wq", "wv"))
+        self._params = init_llama(self._cfg, jax.random.PRNGKey(seed))
+        self.max_new_tokens = max_new_tokens
+        self.seq_bucket = max(8, int(seq_bucket))
+        self._max_adapters = max_adapters
+        self._adapters: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._adapter_lock = threading.Lock()
+
+        cfg, lcfg, params = self._cfg, self._lcfg, self._params
+
+        def fwd(tokens, lora):
+            from ray_tpu.models.llama import llama_forward
+
+            return llama_forward(params, tokens, cfg,
+                                 lora=lora, lora_cfg=lcfg)
+
+        # one jit; the trace cache keys on (shape, adapter-pytree
+        # structure), so base (lora=None) and adapted calls coexist
+        self._fwd = jax.jit(fwd)
+        self.engine = ContinuousBatchingEngine(
+            self._step, prefill_fn=self._prefill,
+            max_batch_size=max_batch_size,
+            allowed_batch_sizes=allowed_batch_sizes,
+            name="llama")
+
+    # ------------------------------------------------------------- adapters
+    def _adapter(self, model_id: str):
+        """Deterministic per-id LoRA pytree, LRU-cached (the sync-path
+        analog of ``@serve.multiplexed`` — loads happen in the stepper
+        thread, so the cache is lock-guarded, not loop-bound)."""
+        if not model_id:
+            return None
+        with self._adapter_lock:
+            if model_id in self._adapters:
+                self._adapters.move_to_end(model_id)
+                return self._adapters[model_id]
+        import jax
+
+        from ray_tpu.models.llama import init_lora
+
+        key = jax.random.PRNGKey(zlib.crc32(model_id.encode()) & 0x7FFFFFFF)
+        lora = init_lora(self._cfg, self._lcfg, key)
+        # B starts at 0 in real LoRA (adapted == base); nudge it so
+        # distinct adapters actually generate distinct tokens in demos
+        k2 = jax.random.split(key, 1)[0]
+        lora["layers"] = {
+            name: {"a": ab["a"],
+                   "b": jax.random.normal(k2, ab["b"].shape,
+                                          ab["b"].dtype) * 0.02}
+            for name, ab in lora["layers"].items()}
+        with self._adapter_lock:
+            self._adapters[model_id] = lora
+            while len(self._adapters) > self._max_adapters:
+                self._adapters.popitem(last=False)
+        return lora
+
+    # -------------------------------------------------------------- serving
+    @staticmethod
+    def _normalize(payload: Any) -> Dict[str, Any]:
+        if isinstance(payload, dict):
+            return payload
+        return {"prompt": list(payload)}
+
+    def _prefill(self, payload: Any, model_id: str) -> Dict[str, Any]:
+        p = self._normalize(payload)
+        prompt = [int(t) for t in p.get("prompt", [0])] or [0]
+        vocab = self._cfg.vocab_size
+        prompt = [t % vocab for t in prompt]
+        return {
+            "tokens": prompt,
+            "prompt_len": len(prompt),
+            "max_new": min(int(p.get("max_new", self.max_new_tokens)),
+                           self.max_new_tokens),
+        }
+
+    def _step(self, model_id: str, states: List[Optional[Dict]]) -> List:
+        """One decode iteration for one adapter group: pad the live rows
+        to (bucket, seq_bucket-multiple), one jitted forward, greedy next
+        token per row."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        live = [(i, s) for i, s in enumerate(states) if s is not None]
+        bucket = len(states)
+        max_len = max(len(s["tokens"]) for _, s in live)
+        pad_len = -(-max_len // self.seq_bucket) * self.seq_bucket
+        pad_len = min(pad_len, self._cfg.max_seq_len)
+        tokens = np.zeros((bucket, pad_len), np.int32)
+        for row, (_, s) in enumerate(live):
+            ts = s["tokens"][-pad_len:]
+            tokens[row, :len(ts)] = ts
+        logits = self._fwd(jnp.asarray(tokens), self._adapter(model_id))
+        logits = np.asarray(logits)
+        results: List[Optional[tuple]] = [None] * len(states)
+        for row, (idx, s) in enumerate(live):
+            last = min(len(s["tokens"]), pad_len) - 1
+            nxt = int(np.argmax(logits[row, last]))
+            s["tokens"].append(nxt)
+            done = len(s["tokens"]) - s["prompt_len"] >= s["max_new"]
+            results[idx] = (nxt, done)
+        return results
+
+    def __call__(self, payload: Any):
+        """Streaming endpoint: yields generated token ids one at a time
+        (sync generator → the replica's streaming path relays each token
+        as it is produced)."""
+        from ray_tpu.serve.multiplex import get_multiplexed_model_id
+
+        p = self._normalize(payload)
+        model_id = get_multiplexed_model_id() or str(p.get("adapter", ""))
+        yield from self.engine.submit(p, model_id)
+
+    def engine_stats(self) -> Dict[str, int]:
+        return self.engine.stats()
+
+
+def build_llama_app(*, config: str = "tiny", lora_rank: int = 4,
+                    max_batch_size: int = 4,
+                    allowed_batch_sizes: Optional[Sequence[int]] = (1, 2, 4),
+                    max_new_tokens: int = 16, seq_bucket: int = 32,
+                    num_replicas: int = 1,
+                    max_ongoing_requests: int = 16,
+                    max_queued_requests: int = 32,
+                    autoscaling_config: Optional[Dict] = None,
+                    ray_actor_options: Optional[Dict] = None) -> Application:
+    """Bind a continuously-batched LoRA llama generator deployment.
+
+    ``max_ongoing_requests`` must exceed the engine batch width: each
+    in-flight generation holds a replica admission slot while the engine
+    multiplexes them onto the compiled batch.
+    """
+    dep = Deployment(
+        LlamaGenerator, "LlamaGenerator",
+        num_replicas=num_replicas,
+        max_ongoing_requests=max(max_ongoing_requests, 2 * max_batch_size),
+        max_queued_requests=max_queued_requests,
+        autoscaling_config=autoscaling_config,
+        ray_actor_options=ray_actor_options or {},
+    )
+    return dep.bind(config=config, lora_rank=lora_rank,
+                    max_batch_size=max_batch_size,
+                    allowed_batch_sizes=allowed_batch_sizes,
+                    max_new_tokens=max_new_tokens, seq_bucket=seq_bucket)

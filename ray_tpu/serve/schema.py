@@ -24,6 +24,7 @@ class DeploymentSchema:
     name: str
     num_replicas: Optional[int] = None
     max_ongoing_requests: Optional[int] = None
+    max_queued_requests: Optional[int] = None
     user_config: Optional[Dict] = None
     autoscaling_config: Optional[Dict] = None
     ray_actor_options: Optional[Dict] = None
@@ -156,6 +157,7 @@ def build_app_schema(app, *, name: str = "default",
             name=d.name,
             num_replicas=d.num_replicas,
             max_ongoing_requests=d.max_ongoing_requests,
+            max_queued_requests=d.max_queued_requests,
             user_config=d.user_config,
             autoscaling_config=dict(auto.__dict__) if auto else None,
             ray_actor_options=d.ray_actor_options or None,

@@ -368,6 +368,287 @@ def bench_chaos(quick: bool = False) -> dict:
     return out
 
 
+def bench_serve_load(quick: bool = False) -> dict:
+    """Serving-plane load phase (ISSUE 6; ROADMAP item 1): sustained
+    multi-client RPS against a deployed app, tracked across rounds like
+    MFU is. Reports (a) continuous-batching engine vs static @serve.batch
+    throughput on the same mixed-length generative workload, (b) RPS +
+    p50/p99 latency + shed rate + autoscale reaction/drain time under
+    sustained overload, and (c) a chaos variant — SIGKILL one replica
+    mid-load — proving the phase completes with no hang and no unshed
+    request lost."""
+    import functools
+    import os
+    import signal as _signal
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.exceptions import BackPressureError, RayTpuError
+
+    # one hardware iteration for a whole batch costs STEP_S regardless of
+    # occupancy (the XLA-compiled-step model), and ONE device runs ONE
+    # batch at a time — the static path serializes its batches on a
+    # simulated device lock exactly like the engine's stepper thread
+    # serializes its steps. Mixed generation lengths are the workload that
+    # makes static whole-request batching hold every slot hostage to the
+    # longest member.
+    STEP_S = 0.01
+    LENS = [2, 3, 4, 6, 8, 12]
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=32,
+                      max_queued_requests=64)
+    class StaticGen:
+        def __init__(self, step_s):
+            import asyncio
+
+            self._step_s = step_s
+            self._device = asyncio.Lock()
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.005)
+        async def gen(self, items):
+            import asyncio
+
+            # whole-request batching: the batch occupies the device until
+            # its LONGEST generation finishes; every short member waits
+            async with self._device:
+                await asyncio.sleep(self._step_s * max(items))
+            return [n for n in items]
+
+        async def __call__(self, n):
+            return await self.gen(int(n))
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=32,
+                      max_queued_requests=64)
+    class EngineGen:
+        def __init__(self, step_s):
+            import time as _t
+
+            def step(mid, states):
+                _t.sleep(step_s)  # one iteration for the whole batch
+                res = [None] * len(states)
+                for i, s in enumerate(states):
+                    if s is None:
+                        continue
+                    s["i"] += 1
+                    res[i] = (s["i"], s["i"] >= s["n"])
+                return res
+
+            self.engine = serve.ContinuousBatchingEngine(
+                step, prefill_fn=lambda p, m: {"n": int(p), "i": 0},
+                max_batch_size=8, allowed_batch_sizes=(2, 4, 8),
+                name="bench")
+
+        def pid(self):
+            return os.getpid()
+
+        def generate(self, n):
+            # non-streaming endpoint: iteration-level batching on the
+            # device without paying one chunk round-trip per token
+            return list(self.engine.submit(int(n)))
+
+        def __call__(self, n):
+            yield from self.engine.submit(int(n))
+
+    def drive(issue, seconds, clients, counters, latencies):
+        """Closed-loop clients; ``issue(n)`` returns the token count."""
+        stop = time.perf_counter() + seconds
+        lock = threading.Lock()
+
+        def client(seed):
+            k = seed
+            while time.perf_counter() < stop:
+                n = LENS[k % len(LENS)]
+                k += 1
+                t0 = time.perf_counter()
+                try:
+                    toks = issue(n)
+                except BackPressureError:
+                    with lock:
+                        counters["shed"] += 1
+                        counters["issued"] += 1
+                    time.sleep(0.01)  # client-owned backoff
+                    continue
+                except (RayTpuError, ConnectionError, TimeoutError):
+                    with lock:
+                        counters["typed_errors"] += 1
+                        counters["issued"] += 1
+                    continue
+                with lock:
+                    counters["issued"] += 1
+                    counters["completed"] += 1
+                    counters["tokens"] += toks
+                    latencies.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 120)
+        return time.perf_counter() - t0
+
+    def pctl(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 4)
+
+    out = {"step_s": STEP_S}
+    load_s = 6.0 if quick else 10.0
+    clients = 8
+    ray_tpu.init(num_cpus=4)
+    try:
+        serve.start(http_options={"port": 0})
+
+        # -- (a) static @serve.batch vs continuous engine, same workload --
+        def static_issue(n, _h=None):
+            return _h.remote(n).result(timeout_s=120)
+
+        def engine_issue(n, _h=None):
+            return len(_h.generate.remote(n).result(timeout_s=120))
+
+        for label, app, mk_issue in (
+                ("static_batch", StaticGen.bind(STEP_S), static_issue),
+                ("engine", EngineGen.bind(STEP_S), engine_issue)):
+            handle = serve.run(app, name=label, route_prefix=f"/{label}")
+            issue = functools.partial(mk_issue, _h=handle)
+            counters = {"issued": 0, "completed": 0, "tokens": 0,
+                        "shed": 0, "typed_errors": 0}
+            lat = []
+            issue(2)  # warm the route + (for the engine) the stepper
+            took = drive(issue, load_s, 16, counters, lat)
+            out[label] = {
+                "gens_per_s": round(counters["completed"] / took, 1),
+                "tokens_per_s": round(counters["tokens"] / took, 1),
+                "p50_s": pctl(lat, 0.50), "p99_s": pctl(lat, 0.99),
+                "shed": counters["shed"],
+            }
+            serve.delete(label)
+        if out["static_batch"]["tokens_per_s"]:
+            out["engine_speedup"] = round(
+                out["engine"]["tokens_per_s"]
+                / out["static_batch"]["tokens_per_s"], 2)
+
+        # -- (b) sustained overload: autoscale up, shed typed, drain ------
+        # per-replica capacity (4 running + 4 queued) is deliberately under
+        # the 16-client offered load: one replica MUST shed typed
+        # backpressure until the autoscaler absorbs the demand
+        auto = EngineGen.options(
+            max_ongoing_requests=4, max_queued_requests=4,
+            autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                                "target_ongoing_requests": 2.0,
+                                "upscale_delay_s": 0.5,
+                                "downscale_delay_s": 1.0}).bind(STEP_S)
+        handle = serve.run(auto, name="autoload", route_prefix="/autoload")
+        replicas_seen = []
+        mon_stop = threading.Event()
+
+        def monitor():
+            while not mon_stop.is_set():
+                st = serve.status("autoload")["deployments"].get(
+                    "EngineGen", {})
+                replicas_seen.append(
+                    (time.perf_counter(), st.get("replicas", 0)))
+                time.sleep(0.2)
+
+        mon = threading.Thread(target=monitor)
+        mon.start()
+        counters = {"issued": 0, "completed": 0, "tokens": 0,
+                    "shed": 0, "typed_errors": 0}
+        lat = []
+
+        def stream_issue(n, _h=handle):
+            return len(list(_h.options(stream=True).remote(n)))
+
+        t_load0 = time.perf_counter()
+        took = drive(stream_issue, load_s * 1.5, 16, counters, lat)
+        scale_up = next((t - t_load0 for t, r in replicas_seen if r > 1),
+                        None)
+        t_drain0 = time.perf_counter()
+        drained = None
+        while time.perf_counter() - t_drain0 < 90:
+            st = serve.status("autoload")["deployments"].get("EngineGen", {})
+            if st.get("replicas") == 1 and st.get("target_replicas") == 1:
+                drained = time.perf_counter() - t_drain0
+                break
+            time.sleep(0.5)
+        mon_stop.set()
+        mon.join(timeout=10)
+        out["serve_overload"] = {
+            "clients": 16, "duration_s": round(took, 1),
+            "rps": round(counters["completed"] / took, 1),
+            "p50_s": pctl(lat, 0.50), "p99_s": pctl(lat, 0.99),
+            "shed": counters["shed"],
+            "shed_rate": round(counters["shed"]
+                               / max(1, counters["issued"]), 3),
+            "typed_errors": counters["typed_errors"],
+            "lost": counters["issued"] - counters["completed"]
+            - counters["shed"] - counters["typed_errors"],
+            "peak_replicas": max((r for _, r in replicas_seen), default=1),
+            "autoscale_reaction_s": (round(scale_up, 2)
+                                     if scale_up is not None else None),
+            "drain_to_min_s": (round(drained, 2)
+                               if drained is not None else None),
+        }
+        serve.delete("autoload")
+
+        # -- (c) chaos variant: SIGKILL a replica mid-load ----------------
+        chaos_app = EngineGen.options(num_replicas=2).bind(STEP_S)
+        handle = serve.run(chaos_app, name="chaosload",
+                           route_prefix="/chaosload")
+        victim = handle.pid.remote().result(timeout_s=60)
+        counters = {"issued": 0, "completed": 0, "tokens": 0,
+                    "shed": 0, "typed_errors": 0}
+        lat = []
+        killer_fired = []
+
+        def killer():
+            time.sleep(load_s / 3)
+            os.kill(victim, _signal.SIGKILL)
+            killer_fired.append(time.perf_counter())
+
+        def chaos_issue(n, _h=handle):
+            return len(list(_h.options(stream=True).remote(n)))
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        took = drive(chaos_issue, load_s, clients, counters, lat)
+        kt.join(timeout=30)
+        recovered = None
+        t_rec0 = killer_fired[0] if killer_fired else time.perf_counter()
+        while time.perf_counter() - t_rec0 < 90:
+            st = serve.status("chaosload")["deployments"].get(
+                "EngineGen", {})
+            if st.get("replicas", 0) >= 2:
+                recovered = time.perf_counter() - t_rec0
+                break
+            time.sleep(0.5)
+        out["serve_chaos"] = {
+            "rps": round(counters["completed"] / took, 1),
+            "p50_s": pctl(lat, 0.50), "p99_s": pctl(lat, 0.99),
+            "shed": counters["shed"],
+            "typed_errors_on_kill": counters["typed_errors"],
+            "lost": counters["issued"] - counters["completed"]
+            - counters["shed"] - counters["typed_errors"],
+            "replica_replaced_s": (round(recovered, 2)
+                                   if recovered is not None else None),
+        }
+        serve.delete("chaosload")
+        serve.shutdown()
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    return out
+
+
 def main(quick: bool = False) -> dict:
     import ray_tpu
 
@@ -403,6 +684,22 @@ def main(quick: bool = False) -> dict:
         results["chaos"] = bench_chaos(quick)
     except Exception as e:  # noqa: BLE001
         results["chaos"] = {"error": f"{type(e).__name__}: {e}"}
+    # serving-plane phase (own cluster + serve control plane, same
+    # flake-isolation story); its result is ALSO written standalone so the
+    # serving trajectory is diffable across rounds like RAYPERF_rNN
+    try:
+        results["serve_load"] = bench_serve_load(quick)
+    except Exception as e:  # noqa: BLE001
+        results["serve_load"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        import os
+
+        art = os.environ.get("RAY_TPU_SERVELOAD_OUT",
+                             "SERVE_LOAD_latest.json")
+        with open(art, "w") as f:
+            json.dump(results["serve_load"], f, indent=2, sort_keys=True)
+    except Exception:
+        pass
     print(json.dumps(results))
     try:
         from ray_tpu._private import lifecycle

@@ -73,6 +73,7 @@ FAST_FILES = {
     "test_lifecycle.py",
     "test_transfer_plane.py",
     "test_partition.py",
+    "test_serve_load.py",
 }
 SLOW_TESTS: set = set()
 
@@ -110,6 +111,30 @@ def lifecycle_leak_gate():
     if os.environ.get("RAY_TPU_LEAK_CHECK", "1") == "0":
         return  # disabled: report nothing, and never reap what may be a
         # deliberately long-lived external cluster
+    # serving-plane stepper gate: a ContinuousBatchingEngine stepper
+    # thread surviving the whole run means some engine was neither
+    # drained (serve.shutdown → Replica.drain → engine.shutdown) nor
+    # idle-expired — the exact daemon-leak class that turned the round-5
+    # MULTICHIP gate red. Idle exit takes idle_timeout_s, so give the
+    # threads a short window to wind down before calling it a leak.
+    import sys as _sys
+    import time as _time
+
+    failures = []
+    eng_mod = _sys.modules.get("ray_tpu.serve._private.engine")
+    if eng_mod is not None:
+        deadline = _time.monotonic() + 3.0
+        steppers = eng_mod.live_stepper_threads()
+        while steppers and _time.monotonic() < deadline:
+            _time.sleep(0.1)
+            steppers = eng_mod.live_stepper_threads()
+        if steppers:
+            failures.append(
+                "continuous-batching engine stepper threads leaked past "
+                "the end of the test run (engines must be shut down or "
+                "left idle): " + ", ".join(steppers))
+    # the session sweep must run even when the stepper gate failed — one
+    # leak class must never shield another from being reaped
     leaked = [s for s in lifecycle.list_sessions()
               if s["path"] not in baseline]
     report = []
@@ -120,10 +145,12 @@ def lifecycle_leak_gate():
                       + (f" [live: {live}]" if live else " [stale dir]"))
         lifecycle.reap_session(sess["path"], remove=True)
     if report:
-        pytest.fail(
+        failures.append(
             "ray_tpu sessions leaked past the end of the test run "
             "(reaped now, but the teardown path that should have cleaned "
-            "them is broken):\n  " + "\n  ".join(report), pytrace=False)
+            "them is broken):\n  " + "\n  ".join(report))
+    if failures:
+        pytest.fail("\n".join(failures), pytrace=False)
 
 
 @pytest.fixture(scope="module")

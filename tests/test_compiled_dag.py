@@ -326,6 +326,38 @@ class TestCompiledErrors:
         with pytest.raises(ValueError):
             CompiledDAG(inp)
 
+    def test_get_timeout_is_absolute_across_catchup(self, ray4):
+        """ADVICE dag.py:632: get(timeout=t) lagging N executions behind
+        must honor ONE absolute deadline across its whole catch-up loop —
+        not hand each buffered-seq channel read a fresh copy of t (which
+        let a lagging get block ~N*M*t)."""
+        @ray_tpu.remote
+        def slow_bump(x):
+            time.sleep(0.4)
+            return x + 1
+
+        with InputNode() as inp:
+            dag = slow_bump.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            compiled.execute(0).get(timeout=60)  # warm the loop
+            refs = [compiled.execute(i) for i in range(4)]
+            t0 = time.perf_counter()
+            # the LAST ref needs ~1.6s of pipeline progress; a 0.5s get
+            # must raise at ~0.5s — with per-read timeout reuse it would
+            # instead catch up seq-by-seq (each read under its own fresh
+            # 0.5s budget) and RETURN after ~1.6s
+            with pytest.raises(TimeoutError):
+                refs[-1].get(timeout=0.5)
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 1.4, (
+                f"get(timeout=0.5) blocked {elapsed:.2f}s — timeout is "
+                "being re-applied per channel read, not per call")
+            # the results are still deliverable afterwards
+            assert [r.get(timeout=60) for r in refs] == [1, 2, 3, 4]
+        finally:
+            compiled.teardown()
+
 
 class TestCompiledSpeed:
     def test_repeat_execution_beats_eager(self, ray4):
