@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu import exceptions
 from ray_tpu._private import worker as _worker_mod
+from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from ray_tpu._private.node import Node
 from ray_tpu._private.object_ref import ObjectRef
@@ -228,7 +229,7 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
 
 def nodes() -> List[Dict]:
     w = _require_worker()
-    return w._acall(w.head.call("ListNodes", {}))
+    return w._acall(w.head.call("ListNodes", {}, timeout=CONFIG.control_rpc_timeout_s))
 
 
 def cluster_resources() -> Dict[str, float]:
@@ -265,7 +266,8 @@ def timeline(filename: Optional[str] = None) -> List[Dict]:
     w = _require_worker()
     w.flush_task_events()
     time.sleep(0.05)
-    events = w._acall(w.head.call("ListTaskEvents", {"limit": 100000}))
+    events = w._acall(w.head.call("ListTaskEvents", {"limit": 100000},
+                              timeout=CONFIG.control_rpc_timeout_s))
     open_start: Dict[str, Dict] = {}
     out: List[Dict] = []
     for e in sorted(events, key=lambda e: e.get("time", 0)):

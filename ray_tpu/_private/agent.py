@@ -25,6 +25,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import lifecycle
+from ray_tpu._private.async_util import spawn_tracked
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import StoreDirectory
@@ -218,21 +219,21 @@ class NodeAgent:
         self.tcp_port = await self.server.start_tcp("0.0.0.0", 0)
         self.server.set_disconnect_handler(self._on_disconnect)
         await self._connect_head()
-        loop = asyncio.get_running_loop()
-        loop.create_task(self._resource_report_loop())
-        loop.create_task(self._worker_reaper_loop())
-        loop.create_task(self._node_stats_loop())
-        loop.create_task(self._head_watchdog_loop())
+        spawn_tracked(self._resource_report_loop(), "agent-resource-report")
+        spawn_tracked(self._worker_reaper_loop(), "agent-worker-reaper")
+        spawn_tracked(self._node_stats_loop(), "agent-node-stats")
+        spawn_tracked(self._head_watchdog_loop(), "agent-head-watchdog")
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             from ray_tpu._private.log_monitor import LogMonitor
 
             async def publish(channel, message):
                 await self.head.call("Publish",
-                                     {"channel": channel, "message": message})
+                                     {"channel": channel, "message": message},
+                                     timeout=CONFIG.control_rpc_timeout_s)
 
             monitor = LogMonitor(os.path.join(self.session_dir, "logs"),
                                  self.node_id, publish)
-            loop.create_task(monitor.run())
+            spawn_tracked(monitor.run(), "agent-log-monitor")
         if os.environ.get("RAY_TPU_MEMORY_MONITOR", "1") != "0":
             from ray_tpu._private.memory_monitor import (
                 MemoryMonitor,
@@ -266,9 +267,9 @@ class NodeAgent:
                 os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.95"))
             self.oom_killer = OomKiller(
                 MemoryMonitor(usage_threshold=threshold), list_leases, kill)
-            loop.create_task(self.oom_killer.run())
+            spawn_tracked(self.oom_killer.run(), "agent-oom-killer")
         if CONFIG.prestart_workers:
-            loop.create_task(self._prestart())
+            spawn_tracked(self._prestart(), "agent-prestart")
 
     async def aclose_clients(self) -> None:
         """Await every outbound client's read loop (head + the per-peer
@@ -445,6 +446,7 @@ class NodeAgent:
             await self.head.call(
                 "Publish",
                 {"channel": payload["reply_channel"], "message": {"ok": ok}},
+                timeout=CONFIG.control_rpc_timeout_s,
             )
         elif method == "ReturnPGBundle":
             self._return_pg_bundle(payload)
@@ -492,12 +494,14 @@ class NodeAgent:
                     version += 1
                     await self.head.call(
                         "UpdateResources",
-                        {"node_id": self.node_id, "v": version, **snapshot})
+                        {"node_id": self.node_id, "v": version, **snapshot},
+                        timeout=CONFIG.control_rpc_timeout_s)
                     last_sent = snapshot
                 else:
                     await self.head.call(
                         "UpdateResources",
-                        {"node_id": self.node_id, "hb": True, "v": version})
+                        {"node_id": self.node_id, "hb": True, "v": version},
+                        timeout=CONFIG.control_rpc_timeout_s)
             except Exception:
                 # head unreachable or restarted: resend full on recovery
                 last_sent = None
@@ -553,8 +557,8 @@ class NodeAgent:
                     handle.mark_failed()
                     self.workers.pop(handle.worker_id, None)
             else:
-                asyncio.get_running_loop().create_task(
-                    self._launch_via_forkserver(handle, env_key))
+                spawn_tracked(self._launch_via_forkserver(handle, env_key),
+                              "agent-forkserver-launch")
 
     async def _launch_via_forkserver(self, handle: WorkerHandle,
                                      env_key: Optional[str]) -> None:
@@ -807,7 +811,7 @@ class NodeAgent:
             self._spawn_worker(conda_prefix=prefix, env_key=env_key)
             await self._drain_pending_leases()
 
-        asyncio.get_running_loop().create_task(run())
+        spawn_tracked(run(), "agent-conda-spawn")
 
     async def _register_client(self, conn: Connection, p: Dict) -> Dict:
         role = p.get("role")
@@ -1228,6 +1232,7 @@ class NodeAgent:
                         "ActorDied",
                         {"actor_id": p["actor_id"],
                          "reason": "pg bundle unavailable"},
+                        timeout=CONFIG.control_rpc_timeout_s,
                     )
                     return
                 await asyncio.sleep(CONFIG.actor_resource_wait_poll_s)
@@ -1242,6 +1247,7 @@ class NodeAgent:
                         "ActorDied",
                         {"actor_id": p["actor_id"],
                          "reason": "timed out waiting for actor resources"},
+                        timeout=CONFIG.control_rpc_timeout_s,
                     )
                     return
                 await asyncio.sleep(CONFIG.actor_resource_wait_poll_s)
@@ -1284,6 +1290,7 @@ class NodeAgent:
                             "ActorDied",
                             {"actor_id": p["actor_id"],
                              "reason": "worker failed to start"},
+                            timeout=CONFIG.control_rpc_timeout_s,
                         )
                         return
             await handle.conn.push(
@@ -1292,7 +1299,7 @@ class NodeAgent:
                  "assigned_instances": assigned},
             )
 
-        asyncio.get_running_loop().create_task(finish())
+        spawn_tracked(finish(), "agent-actor-finish")
 
         # Hold the resources until the actor dies. An evicted/never-
         # launched handle (no longer in the pool) counts as dead — its
@@ -1316,7 +1323,7 @@ class NodeAgent:
                 self.resources.release(request, owner=p["actor_id"])
                 self._resources_dirty = True
 
-        asyncio.get_running_loop().create_task(watch_release())
+        spawn_tracked(watch_release(), "agent-actor-release")
 
     def _kill_actor_worker(self, actor_id: str) -> None:
         for handle in self.workers.values():
@@ -1368,7 +1375,7 @@ class NodeAgent:
             self._resources_dirty = True
         # Queued leases targeting this group must fail now, not hang: the
         # drain's _try_grant sees the bundles are gone and replies pg_removed.
-        asyncio.get_running_loop().create_task(self._drain_pending_leases())
+        spawn_tracked(self._drain_pending_leases(), "agent-pg-drain")
 
     # --------------------------------------------------------- object plane
     async def _object_sealed(self, conn: Connection, p: Dict) -> None:
@@ -1492,7 +1499,7 @@ class NodeAgent:
 
             task.add_done_callback(_drained)
 
-        asyncio.get_running_loop().create_task(reap())
+        spawn_tracked(reap(), "agent-orphan-pull-reap")
 
     async def _pull_object(self, hex_id: str, owner: Dict) -> None:
         """Owner-directed pull (reference: pull_manager.h + ownership-based
@@ -1897,7 +1904,8 @@ class NodeAgent:
                 await self.head.call("KvPut", {
                     "key": f"metrics::{self.node_id}::agent".encode(),
                     "value": _json.dumps(snaps).encode(),
-                    "ns": "_metrics", "overwrite": True})
+                    "ns": "_metrics", "overwrite": True},
+                    timeout=CONFIG.control_rpc_timeout_s)
             except Exception:
                 pass
             await asyncio.sleep(period)

@@ -114,6 +114,16 @@ _flag("node_disconnect_grace_s", 5.0)
 # (partitions never RST). 0 disables. A ping that round-trips proves
 # liveness, so long-running remote methods never trip this.
 _flag("client_idle_deadline_s", 0.0)
+# Default deadline for fire-and-check control RPCs (publishes, KV puts,
+# registrations, death reports — anything the server answers immediately).
+# Under a one-way partition the request is silently eaten (no TCP RST)
+# and an untimed .call parks its caller forever (the pre-PR 5 watchdog
+# wedge); raylint R6 requires every control .call to be bounded, and this
+# is the budget those sites reach for. Generous: it only has to beat
+# "forever", not the health-check verdict. Long-poll RPCs (lease grants,
+# object-seal waits) are exempt by design and carry inline raylint
+# disables at the call site.
+_flag("control_rpc_timeout_s", 60.0)
 # Bounded-retry-with-jitter defaults for idempotent control RPCs
 # (protocol.retry_call): attempts, base backoff, backoff cap.
 _flag("rpc_retry_max_attempts", 5)

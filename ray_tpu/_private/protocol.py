@@ -25,6 +25,8 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from .async_util import hold_task
+
 _HDR = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 # Raw (bulk) payloads are written in slices with a drain between them:
@@ -536,7 +538,8 @@ class RpcServer:
                     if rule.action == "drop":
                         continue  # frame read, never dispatched
                     await asyncio.sleep(rule.delay_s)
-                asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
+                hold_task(asyncio.get_running_loop().create_task(
+                    self._dispatch(conn, msg)), "rpc-dispatch")
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
@@ -784,7 +787,8 @@ class AsyncRpcClient:
                     try:
                         res = self._push_handler(msg.get("m"), msg.get("p"))
                         if asyncio.iscoroutine(res):
-                            asyncio.get_running_loop().create_task(res)
+                            hold_task(asyncio.get_running_loop()
+                                      .create_task(res), "push-handler")
                     except Exception:
                         import logging
 
@@ -967,7 +971,7 @@ class AsyncRpcClient:
         except RuntimeError:
             self.close()
             return
-        loop.create_task(self.aclose())
+        hold_task(loop.create_task(self.aclose()), "close-soon")
 
     async def aclose(self) -> None:
         """close() that cancels AND AWAITS the read loop — the clean

@@ -29,6 +29,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.async_util import hold_task, spawn_tracked
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _Counter
 from ray_tpu._private.memory_store import MemoryStore
@@ -396,6 +397,7 @@ class Worker:
                 "pid": os.getpid(),
                 "direct_addr": self.direct_addr(),
             },
+            timeout=CONFIG.control_rpc_timeout_s,
         )
         self.node_id = reply["node_id"]
         CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
@@ -407,7 +409,8 @@ class Worker:
         # restart — workers hit the head for actor resolution, pubsub,
         # task events
         self._spawn(self._head_watchdog_loop())
-        info = await self.agent.call("GetNodeInfo", {})
+        info = await self.agent.call("GetNodeInfo", {},
+                                     timeout=CONFIG.control_rpc_timeout_s)
         self.agent_tcp_addr = {"host": node_ip(), "port": info["tcp_port"]}
         self.ready_event.set()
 
@@ -419,21 +422,25 @@ class Worker:
             await self.head.call(
                 "RegisterDriver",
                 {"job_id": self.job_id.hex(), "entrypoint": " ".join(os.sys.argv)},
+                timeout=CONFIG.control_rpc_timeout_s,
             )
             if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
                 # worker stdout/stderr stream here via the agents' log
                 # monitors (log_monitor.py) -> "(worker-x) line" output
                 await self.head.call("Subscribe",
-                                     {"channels": ["logs:all"]})
+                                     {"channels": ["logs:all"]},
+                                     timeout=CONFIG.control_rpc_timeout_s)
         # every process (driver AND executor workers) watches node
         # membership: a `removed` verdict fails pending leases/calls/pulls
         # aimed at that node promptly — under a partition the sockets
         # never RST, so this event is the ONLY fast death signal
-        await self.head.call("Subscribe", {"channels": ["node"]})
+        await self.head.call("Subscribe", {"channels": ["node"]},
+                             timeout=CONFIG.control_rpc_timeout_s)
         # a restarted head has an empty subscriber table: re-subscribe the
         # actor channel so restart/death/address events keep flowing
         if self._actor_sub_started:
-            await self.head.call("Subscribe", {"channels": ["actor"]})
+            await self.head.call("Subscribe", {"channels": ["actor"]},
+                                 timeout=CONFIG.control_rpc_timeout_s)
 
     async def _head_watchdog_loop(self) -> None:
         """Driver survives a head restart (GCS fault tolerance): ping, and
@@ -514,7 +521,7 @@ class Worker:
                         await asyncio.wait(pending, timeout=2)
                     self.loop.stop()
 
-                self.loop.create_task(_drain())
+                hold_task(self.loop.create_task(_drain()), "disconnect-drain")
 
             self.loop.call_soon_threadsafe(_stop)
             thread = getattr(self, "_loop_thread", None)
@@ -932,6 +939,10 @@ class Worker:
             left = self._time_left(deadline)
             timeout_ms = None if left is None else int(left * 1000)
             reply = self._acall(
+                # raylint: disable=R6 -- long-poll by design: get() with no
+                # deadline blocks until the object is produced; the server
+                # bounds its own wait via timeout_ms and orphaned pulls are
+                # reaped by the agent's object_pull_orphan_grace_s sweep
                 self.agent.call(
                     "WaitObjects",
                     {
@@ -1056,10 +1067,14 @@ class Worker:
                 for loc in locations:
                     try:
                         if loc == self.agent_tcp_addr:
-                            await self.agent.call("FreeObjects", {"ids": [hex_id]})
+                            await self.agent.call(
+                                "FreeObjects", {"ids": [hex_id]},
+                                timeout=CONFIG.control_rpc_timeout_s)
                         else:
                             client = await self._owner_client(loc)
-                            await client.call("FreeObjects", {"ids": [hex_id]})
+                            await client.call(
+                                "FreeObjects", {"ids": [hex_id]},
+                                timeout=CONFIG.control_rpc_timeout_s)
                     except Exception:
                         pass
 
@@ -1382,7 +1397,8 @@ class Worker:
             try:
                 await self.head.call(
                     "ReportTaskEvents",
-                    {"events_v2": events, "node_id": self.node_id})
+                    {"events_v2": events, "node_id": self.node_id},
+                    timeout=CONFIG.control_rpc_timeout_s)
             except Exception:
                 pass
 
@@ -1459,6 +1475,7 @@ class Worker:
                     "max_restarts": max_restarts,
                     "get_if_exists": get_if_exists,
                 },
+                timeout=CONFIG.control_rpc_timeout_s,
             )
         )
         if reply.get("existing"):
@@ -1473,7 +1490,8 @@ class Worker:
         if self._actor_sub_started:
             return
         self._actor_sub_started = True
-        self._acall(self.head.call("Subscribe", {"channels": ["actor"]}))
+        self._acall(self.head.call("Subscribe", {"channels": ["actor"]},
+                                   timeout=CONFIG.control_rpc_timeout_s))
 
     def _track_actor(self, actor_id: ActorID, view: Dict) -> "_ActorState":
         st = self._actor_states.get(actor_id.binary())
@@ -1496,7 +1514,9 @@ class Worker:
             self._ensure_actor_subscription()
 
             async def fetch():
-                view = await self.head.call("GetActor", {"actor_id": actor_id.hex()})
+                view = await self.head.call(
+                    "GetActor", {"actor_id": actor_id.hex()},
+                    timeout=CONFIG.control_rpc_timeout_s)
                 if view:
                     st.update(view, self)
 
@@ -1562,13 +1582,15 @@ class Worker:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._acall(self.head.call(
-            "KillActor", {"actor_id": actor_id.hex(), "no_restart": no_restart}
+            "KillActor", {"actor_id": actor_id.hex(), "no_restart": no_restart},
+            timeout=CONFIG.control_rpc_timeout_s,
         ))
 
     # --------------------------------------------------------------- helpers
     def get_named_actor(self, name: str, namespace: str = "default"):
         view = self._acall(self.head.call(
-            "GetNamedActor", {"name": name, "namespace": namespace}
+            "GetNamedActor", {"name": name, "namespace": namespace},
+            timeout=CONFIG.control_rpc_timeout_s,
         ))
         if view is None or view.get("state") == "DEAD":
             raise ValueError(f"Failed to look up actor '{name}' in namespace "
@@ -1621,24 +1643,29 @@ class KvClient:
             namespace: str = "default") -> bool:
         return self._w._acall(self._w.head.call(
             "KvPut", {"key": key, "value": value, "overwrite": overwrite,
-                      "ns": namespace}))
+                      "ns": namespace},
+            timeout=CONFIG.control_rpc_timeout_s))
 
     def get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
         return self._w._acall(self._w.head.call(
-            "KvGet", {"key": key, "ns": namespace}))
+            "KvGet", {"key": key, "ns": namespace},
+            timeout=CONFIG.control_rpc_timeout_s))
 
     def delete(self, key: bytes, prefix: bool = False,
                namespace: str = "default") -> int:
         return self._w._acall(self._w.head.call(
-            "KvDel", {"key": key, "prefix": prefix, "ns": namespace}))
+            "KvDel", {"key": key, "prefix": prefix, "ns": namespace},
+            timeout=CONFIG.control_rpc_timeout_s))
 
     def keys(self, prefix: bytes = b"", namespace: str = "default") -> List[bytes]:
         return self._w._acall(self._w.head.call(
-            "KvKeys", {"prefix": prefix, "ns": namespace}))
+            "KvKeys", {"prefix": prefix, "ns": namespace},
+            timeout=CONFIG.control_rpc_timeout_s))
 
     def exists(self, key: bytes, namespace: str = "default") -> bool:
         return self._w._acall(self._w.head.call(
-            "KvExists", {"key": key, "ns": namespace}))
+            "KvExists", {"key": key, "ns": namespace},
+            timeout=CONFIG.control_rpc_timeout_s))
 
 
 # ---------------------------------------------------------------------------
@@ -1804,7 +1831,7 @@ class _LeasePool:
             and len(self.conns) + self.inflight_leases < self.MAX_WORKERS
         ):
             self.inflight_leases += 1
-            asyncio.get_running_loop().create_task(self._request_lease())
+            spawn_tracked(self._request_lease(), "lease-request")
             want -= 1
 
     async def _resolve_pg_agent(self):
@@ -1813,7 +1840,8 @@ class _LeasePool:
         direct_task_transport lease policy). Waits for a PENDING group."""
         w = self.worker
         while True:
-            info = await w.head.call("GetPlacementGroup", {"pg_id": self.pg[0]})
+            info = await w.head.call("GetPlacementGroup", {"pg_id": self.pg[0]},
+                                     timeout=CONFIG.control_rpc_timeout_s)
             if info is None or info.get("state") == "REMOVED":
                 raise _PlacementGroupGone(
                     f"placement group {self.pg[0]} removed")
@@ -1827,7 +1855,8 @@ class _LeasePool:
                     node_id = placement[self._pg_rr % len(placement)]
                 else:
                     node_id = placement[idx]
-                view = await w.head.call("GetClusterView", {})
+                view = await w.head.call("GetClusterView", {},
+                                         timeout=CONFIG.control_rpc_timeout_s)
                 node = view.get(node_id)
                 if node is None:
                     raise RpcError(f"bundle node {node_id} lost")
@@ -1851,9 +1880,13 @@ class _LeasePool:
             if self.pg:
                 agent_addr = await self._resolve_pg_agent()
                 client = await w._owner_client(agent_addr)
+                # raylint: disable=R6 -- long-poll by design: a lease may
+                # queue for minutes under spawn admission; node death fails
+                # this call fast via the PR 5 node-channel fail-fast path
                 reply = await client.call(
                     "RequestWorkerLease", {**payload, "spilled_once": True})
             else:
+                # raylint: disable=R6 -- long-poll by design (see above)
                 reply = await w.agent.call("RequestWorkerLease", payload)
             hops = 0
             while reply and reply.get("spillback") and \
@@ -1862,6 +1895,7 @@ class _LeasePool:
                 target = reply["spillback"]
                 agent_addr = target["addr"]
                 client = await w._owner_client(agent_addr)
+                # raylint: disable=R6 -- long-poll by design (see above)
                 reply = await client.call(
                     "RequestWorkerLease", {**payload, "spilled_once": True}
                 )
@@ -2085,8 +2119,8 @@ class _LeasePool:
     def _on_batch_failed(self, conn: WorkerConn,
                          records: List[TaskRecord]) -> None:
         conn.dead = True
-        asyncio.get_running_loop().create_task(
-            self._drop_conn(conn, worker_exited=True))
+        spawn_tracked(self._drop_conn(conn, worker_exited=True),
+                      "lease-drop-conn")
         for record in records:
             self.worker._on_task_failure(
                 record, self._push_failure_error(conn, record),
@@ -2096,8 +2130,8 @@ class _LeasePool:
 
     def _on_push_failed(self, conn: WorkerConn, record: TaskRecord) -> None:
         conn.dead = True
-        asyncio.get_running_loop().create_task(
-            self._drop_conn(conn, worker_exited=True))
+        spawn_tracked(self._drop_conn(conn, worker_exited=True),
+                      "lease-drop-conn")
         self.worker._on_task_failure(
             record, self._push_failure_error(conn, record),
             retriable=True,
@@ -2256,7 +2290,8 @@ class _ActorState:
             return
         if self.client is None or not self.client.connected:
             self._connecting = True
-            asyncio.get_running_loop().create_task(self._connect_then_flush(worker))
+            spawn_tracked(self._connect_then_flush(worker),
+                          "actor-connect-flush")
             return
         while self.queue:
             cap = self._batch_cap()

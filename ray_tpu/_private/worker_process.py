@@ -437,6 +437,10 @@ class Executor:
         def report(i: int, ret: Dict) -> None:
             async def call():
                 client = await self.worker._owner_client(owner)
+                # raylint: disable=R6 -- long-poll by design: the per-item
+                # ack IS the backpressure (a slow owner stalls the producer
+                # indefinitely and legitimately); owner death fails this
+                # call fast via the PR 5 node-channel fail-fast path
                 return await client.call(
                     "StreamingReturn",
                     {"task_id": spec.task_id.hex(), "index": i, "ret": ret})
@@ -505,6 +509,7 @@ class Executor:
                     "ActorDied",
                     {"actor_id": payload["actor_id"],
                      "reason": f"creation task failed: {e!r}"},
+                    timeout=CONFIG.control_rpc_timeout_s,
                 )
             finally:
                 os._exit(1)
@@ -527,7 +532,9 @@ class Executor:
         }
         for attempt in range(10):
             try:
-                await self.worker.head.call("ActorReady", ready_payload)
+                await self.worker.head.call(
+                    "ActorReady", ready_payload,
+                    timeout=CONFIG.control_rpc_timeout_s)
                 break
             except Exception:
                 if attempt == 9:

@@ -21,10 +21,12 @@ def request_resources(num_cpus: Optional[int] = None,
         entries.append({"CPU": num_cpus})
     if bundles:
         entries.extend(bundles)
+    from ray_tpu._private.config import CONFIG
     from ray_tpu._private.resources import ResourceSet
 
     wire = [ResourceSet(e).to_wire() for e in entries]
     w = ray_tpu._private.worker.global_worker
     w._acall(w.head.call("KvPut", {
         "ns": "autoscaler", "key": REQUEST_RESOURCES_KEY,
-        "value": json.dumps(wire), "overwrite": True}))
+        "value": json.dumps(wire), "overwrite": True},
+        timeout=CONFIG.control_rpc_timeout_s))

@@ -1,0 +1,129 @@
+"""CLI for the raylint invariant checker.
+
+Usage:
+    python -m ray_tpu.devtools.lint ray_tpu [options]
+
+Exit codes: 0 clean (grandfathered-only is clean), 1 violations or parse
+errors (or stale baseline under --strict-baseline), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .engine import default_baseline_path, run_lint
+from .rules import rule_catalog
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description=("AST/CFG invariant checker for the ray_tpu runtime's "
+                     "concurrency, serialization, and lifecycle "
+                     "contracts."))
+    p.add_argument("paths", nargs="*", default=["ray_tpu"],
+                   help="files/directories to analyze (default: ray_tpu)")
+    p.add_argument("--project-root", default=None,
+                   help="root for relative paths in reports (default: cwd)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset, e.g. R1,R4 (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default: the checked-in "
+                        "devtools/lint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: every violation fails")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to exactly the current "
+                        "unsuppressed violations (review the diff: it "
+                        "must only shrink)")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="also fail on stale baseline entries (used by the "
+                        "tier-1 test so the baseline monotonically "
+                        "shrinks)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in rule_catalog():
+            print(f"{r['id']}: {r['summary']}")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        known = {r["id"] for r in rule_catalog()}
+        bad = [r for r in rules if r.upper() not in known]
+        if bad:
+            print(f"unknown rule(s): {', '.join(bad)} "
+                  f"(valid: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+    if args.update_baseline and rules:
+        # A subset run only produces that subset's violations; rewriting
+        # the baseline from it would silently delete every other rule's
+        # grandfathered entries.
+        print("--update-baseline requires a full-rule run (drop --rules)",
+              file=sys.stderr)
+        return 2
+    baseline_path = None if args.no_baseline else (
+        args.baseline or default_baseline_path())
+
+    result = run_lint(args.paths, project_root=args.project_root,
+                      rules=rules, baseline_path=baseline_path)
+
+    if args.update_baseline:
+        target = args.baseline or default_baseline_path()
+        entries = baseline_mod.counts(result.violations
+                                      + result.grandfathered)
+        old = baseline_mod.load(target)
+        baseline_mod.save(target, entries)
+        grew = sum(entries.values()) > sum(old.values())
+        print(f"baseline written: {target} "
+              f"({sum(entries.values())} entries, was {sum(old.values())})")
+        if grew:
+            print("WARNING: baseline GREW — the tier-1 contract only "
+                  "allows it to shrink; fix or `# raylint: disable=` new "
+                  "violations instead", file=sys.stderr)
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=1))
+    else:
+        for v in result.violations:
+            print(v.format())
+        if result.grandfathered:
+            print(f"-- {len(result.grandfathered)} grandfathered "
+                  f"violation(s) in the baseline "
+                  f"({os.path.basename(baseline_path or '')}); new code "
+                  f"must not add to them")
+        if result.stale_baseline:
+            print(f"-- {len(result.stale_baseline)} stale baseline "
+                  f"entr(y/ies) no longer match — shrink with "
+                  f"--update-baseline:")
+            for k in result.stale_baseline:
+                print(f"   {k}")
+        for e in result.parse_errors:
+            print(f"parse error: {e}", file=sys.stderr)
+        print(f"raylint: {result.files_scanned} files, "
+              f"{len(result.violations)} failing, "
+              f"{len(result.grandfathered)} grandfathered, "
+              f"{result.suppressed_count} inline-disabled "
+              f"({result.elapsed_s:.2f}s)")
+
+    if result.violations or result.parse_errors:
+        return 1
+    if args.strict_baseline and result.stale_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

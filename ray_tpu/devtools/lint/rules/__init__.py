@@ -1,0 +1,41 @@
+"""Rule registry.
+
+Each rule module exposes ``RULE_ID``, ``SUMMARY`` and
+``check(index) -> List[Violation]`` (project-wide rules) or
+``check_module(mod, index) -> List[Violation]`` (per-file rules). The
+engine runs whichever is defined. Every rule encodes an invariant the
+repo has already paid for violating — the docstring of each module names
+the motivating PR.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from . import (
+    r1_gc_reentrancy,
+    r2_blocking_in_async,
+    r3_lock_across_await,
+    r4_task_leak,
+    r5_exception_pickle,
+    r6_unbounded_rpc,
+    r7_untracked_spawn,
+    r8_config_knobs,
+)
+
+ALL_RULES = [
+    r1_gc_reentrancy,
+    r2_blocking_in_async,
+    r3_lock_across_await,
+    r4_task_leak,
+    r5_exception_pickle,
+    r6_unbounded_rpc,
+    r7_untracked_spawn,
+    r8_config_knobs,
+]
+
+RULES_BY_ID: Dict[str, object] = {m.RULE_ID: m for m in ALL_RULES}
+
+
+def rule_catalog() -> List[Dict[str, str]]:
+    return [{"id": m.RULE_ID, "summary": m.SUMMARY} for m in ALL_RULES]

@@ -1,0 +1,84 @@
+"""R8 — every referenced config knob must exist in config.py's defaults.
+
+Invariant: ``CONFIG.<flag>`` reads resolve through ``_Config.__getattr__``
+which raises ``AttributeError: unknown config flag`` for names missing
+from the ``_flag(...)`` table — but only *when the line executes*, which
+for rarely-taken paths (failure handling, chaos branches) is production,
+not tests. A typo'd knob on an error path turns a recoverable failure
+into a crash inside the failure handler.
+
+Detection: the flag table is parsed from ``config.py``'s ``_flag("name",
+default)`` calls; every ``CONFIG.name`` attribute access (and
+``getattr(CONFIG, "name", ...)`` with a literal) elsewhere in the tree
+must name a known flag or a public ``_Config`` method.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..model import ModuleInfo, Violation
+
+RULE_ID = "R8"
+SUMMARY = ("CONFIG.<name> references a flag missing from config.py's "
+           "_flag table — raises AttributeError the first time the "
+           "(often failure-path) line executes")
+
+_CONFIG_METHODS = {"apply_cluster_config", "snapshot", "to_json"}
+_CONFIG_FILE_SUFFIX = "_private/config.py"
+
+
+def _known_flags(index) -> Set[str]:
+    flags: Set[str] = set()
+    for mod in index.modules:
+        if not mod.relpath.replace("\\", "/").endswith(_CONFIG_FILE_SUFFIX):
+            continue
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "_flag" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                flags.add(node.args[0].value)
+    return flags
+
+
+def check(index) -> List[Violation]:
+    flags = _known_flags(index)
+    if not flags:
+        # config.py not in the analyzed set (e.g. linting a fixture dir):
+        # nothing to check against
+        return []
+    out: List[Violation] = []
+    for mod in index.modules:
+        if mod.relpath.replace("\\", "/").endswith(_CONFIG_FILE_SUFFIX):
+            continue
+        for node in ast.walk(mod.tree):
+            name = None
+            target = None
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "CONFIG"):
+                name, target = node.attr, node
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "getattr" and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == "CONFIG"
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                name, target = node.args[1].value, node
+            if name is None:
+                continue
+            if name.startswith("_") or name in _CONFIG_METHODS:
+                continue
+            if name not in flags:
+                out.append(mod.violation(
+                    RULE_ID, target,
+                    f"CONFIG.{name} is not declared in config.py's _flag "
+                    f"table: _Config.__getattr__ will raise "
+                    f"AttributeError the first time this line runs — "
+                    f"declare the flag with a typed default or fix the "
+                    f"name"))
+    return out

@@ -8,6 +8,7 @@ distinct type so user retry logic can discriminate.
 
 from __future__ import annotations
 
+import pickle
 import time
 import traceback
 from typing import Dict, List, Optional, Tuple
@@ -72,6 +73,9 @@ class RayTaskError(RayTpuError):
     cause (reference behavior: python/ray/exceptions.py RayTaskError).
     """
 
+    # memoized "is self.cause picklable" verdict; None = not yet probed
+    _cause_picklable: Optional[bool] = None
+
     def __init__(
         self,
         function_name: str = "",
@@ -87,18 +91,42 @@ class RayTaskError(RayTpuError):
     def from_exception(cls, e: BaseException, function_name: str = "") -> "RayTaskError":
         tb = "".join(traceback.format_exception(type(e), e, e.__traceback__))
         try:
-            import pickle
-
             pickle.dumps(e)
             cause = e
         except Exception:
             cause = None
-        return cls(function_name, tb, cause)
+        err = cls(function_name, tb, cause)
+        err._cause_picklable = cause is not None
+        return err
+
+    def __reduce__(self):
+        # Default Exception pickling would rebuild as cls(traceback_str),
+        # mis-assigning the message to function_name and dropping the
+        # cause (raylint R5). A cause set directly (not via
+        # from_exception's picklability probe) may be unpicklable; drop
+        # it rather than fail the whole dump. The probe verdict is
+        # memoized so repeated dumps don't pickle the cause twice each.
+        cause = self.cause
+        if cause is not None:
+            if self._cause_picklable is None:
+                try:
+                    pickle.dumps(cause)
+                    self._cause_picklable = True
+                except Exception:
+                    self._cause_picklable = False
+            if not self._cause_picklable:
+                cause = None
+        return (_rebuild_task_error,
+                (type(self), self.function_name, self.traceback_str, cause))
 
     def __str__(self):
         return (
             f"Task '{self.function_name}' failed remotely:\n{self.traceback_str}"
         )
+
+
+def _rebuild_task_error(cls, function_name, traceback_str, cause):
+    return cls(function_name, traceback_str, cause)
 
 
 class RayActorError(RayTpuError):
@@ -145,7 +173,20 @@ class ActorUnavailableError(RayActorError):
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id_hex: str = "", reason: str = "lost"):
         self.object_id_hex = object_id_hex
+        self.reason = reason
         super().__init__(f"Object {object_id_hex} {reason}")
+
+    def __reduce__(self):
+        # Rebuild from the real fields, not the formatted message
+        # (raylint R5): default pickling would hand the whole sentence to
+        # object_id_hex. type(self) keeps subclasses
+        # (ObjectFetchTimedOutError) intact; OwnerDiedError overrides.
+        return (_rebuild_object_lost,
+                (type(self), self.object_id_hex, self.reason))
+
+
+def _rebuild_object_lost(cls, object_id_hex, reason):
+    return cls(object_id_hex, reason)
 
 
 class ObjectFetchTimedOutError(ObjectLostError):
@@ -190,7 +231,13 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 class TaskCancelledError(RayTpuError):
     def __init__(self, task_id_hex: str = ""):
+        self.task_id_hex = task_id_hex
         super().__init__(f"Task {task_id_hex} was cancelled")
+
+    def __reduce__(self):
+        # default pickling would double-wrap: cls("Task <id> was
+        # cancelled") re-formats the already-formatted message (raylint R5)
+        return (type(self), (self.task_id_hex,))
 
 
 class WorkerCrashedError(RayTpuError):

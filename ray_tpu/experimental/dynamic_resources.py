@@ -35,13 +35,15 @@ def set_resource(resource_name: str, capacity: float,
     info = view.get(node_id)
     if info is None:
         raise ValueError(f"no alive node {node_id!r}")
+    from ray_tpu._private.config import CONFIG
     from ray_tpu._private.protocol import AsyncRpcClient
 
     async def call_remote():
         client = AsyncRpcClient()
         await client.connect_tcp(info["addr"]["host"], info["addr"]["port"])
         try:
-            return await client.call("SetResource", payload)
+            return await client.call("SetResource", payload,
+                                      timeout=CONFIG.control_rpc_timeout_s)
         finally:
             client.close()
 

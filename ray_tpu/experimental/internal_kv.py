@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from ray_tpu._private.config import CONFIG
+
 
 def _worker():
     from ray_tpu._private import worker as worker_mod
@@ -32,14 +34,14 @@ def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True,
     w = _worker()
     return w._acall(w.head.call("KvPut", {
         "ns": _ns(namespace), "key": key, "value": value,
-        "overwrite": overwrite}))
+        "overwrite": overwrite}, timeout=CONFIG.control_rpc_timeout_s))
 
 
 def _internal_kv_get(key: bytes,
                      namespace: Optional[bytes] = None) -> Optional[bytes]:
     w = _worker()
     out = w._acall(w.head.call("KvGet", {
-        "ns": _ns(namespace), "key": key}))
+        "ns": _ns(namespace), "key": key}, timeout=CONFIG.control_rpc_timeout_s))
     return bytes(out) if out is not None else None
 
 
@@ -47,19 +49,19 @@ def _internal_kv_del(key: bytes,
                      namespace: Optional[bytes] = None) -> int:
     w = _worker()
     return w._acall(w.head.call("KvDel", {
-        "ns": _ns(namespace), "key": key}))
+        "ns": _ns(namespace), "key": key}, timeout=CONFIG.control_rpc_timeout_s))
 
 
 def _internal_kv_exists(key: bytes,
                         namespace: Optional[bytes] = None) -> bool:
     w = _worker()
     return w._acall(w.head.call("KvExists", {
-        "ns": _ns(namespace), "key": key}))
+        "ns": _ns(namespace), "key": key}, timeout=CONFIG.control_rpc_timeout_s))
 
 
 def _internal_kv_list(prefix: bytes,
                       namespace: Optional[bytes] = None) -> List[bytes]:
     w = _worker()
     keys = w._acall(w.head.call("KvKeys", {
-        "ns": _ns(namespace), "prefix": prefix}))
+        "ns": _ns(namespace), "prefix": prefix}, timeout=CONFIG.control_rpc_timeout_s))
     return [bytes(k) for k in keys]

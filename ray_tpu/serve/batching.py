@@ -14,6 +14,8 @@ import functools
 import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
+from ray_tpu._private.async_util import hold_task
+
 # Per-instance batch queues keyed by the OWNER ITSELF, weakly: an id(owner)
 # key is never evicted, and a GC'd instance's id can be reused by a new
 # object — which would silently feed two instances' requests into one stale
@@ -77,7 +79,8 @@ class _BatchQueue:
             self._flush_task = None
         batch = self._take()
         if batch:
-            asyncio.get_running_loop().create_task(self._run(batch))
+            hold_task(asyncio.get_running_loop().create_task(
+                self._run(batch)), "serve-batch-run")
         if self.queue:
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._flush_after_timeout())

@@ -10,6 +10,13 @@ import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+
+
+# Deadline for client data-plane RPCs (put/get/task/actor submissions):
+# one bound to retune, mirrored by _Channel.call's default. Gets/waits
+# with a user timeout get +10s slack so the server-side answer wins.
+_DATA_RPC_TIMEOUT_S = 300.0
 
 
 class _Channel:
@@ -27,7 +34,8 @@ class _Channel:
             self.client.connect_tcp(host, port), self._loop)
         fut.result(30)
 
-    def call(self, method: str, payload: Dict, timeout: float = 300.0):
+    def call(self, method: str, payload: Dict,
+             timeout: float = _DATA_RPC_TIMEOUT_S):
         fut = asyncio.run_coroutine_threadsafe(
             self.client.call(method, payload), self._loop)
         return fut.result(timeout)
@@ -113,9 +121,16 @@ class ClientContext:
                  init_kwargs: Optional[Dict] = None):
         self._chan = _Channel(host, port)
         self._pending_release: List[str] = []
-        self._lock = threading.Lock()
+        # RLock: _release_later runs in GC context (weakref.finalize on
+        # ClientObjectRef) and may fire mid-critical-section on the very
+        # thread holding this lock (raylint R1, the MemoryStore class)
+        self._lock = threading.RLock()
+        # data-plane budget, not control_rpc_timeout_s: the server-side
+        # handler runs a full ray_tpu.init() cluster bring-up (GCS,
+        # agents, prestart workers), not an immediate answer
         self._chan.call("ClientInit", {
-            "init_kwargs": ser.dumps(init_kwargs or {})})
+            "init_kwargs": ser.dumps(init_kwargs or {})},
+            timeout=_DATA_RPC_TIMEOUT_S)
 
     # --------------------------------------------------------------- helpers
     def _wire_args(self, args: tuple, kwargs: dict) -> Tuple[List, Dict]:
@@ -139,7 +154,8 @@ class ClientContext:
             batch, self._pending_release = self._pending_release, []
         if batch:
             try:
-                self._chan.call("ClientRelease", {"ids": batch})
+                self._chan.call("ClientRelease", {"ids": batch},
+                                timeout=CONFIG.control_rpc_timeout_s)
             except Exception:
                 pass
 
@@ -158,7 +174,8 @@ class ClientContext:
 
     def put(self, value: Any) -> ClientObjectRef:
         self._flush_releases()
-        reply = self._chan.call("ClientPut", {"value": ser.dumps(value)})
+        reply = self._chan.call("ClientPut", {"value": ser.dumps(value)},
+                                timeout=_DATA_RPC_TIMEOUT_S)
         return self._refs_from(reply)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -168,7 +185,7 @@ class ClientContext:
             refs = [refs]
         reply = self._chan.call(
             "ClientGet", {"ids": [r.hex() for r in refs], "timeout": timeout},
-            timeout=(timeout or 290) + 10)
+            timeout=(timeout + 10) if timeout else _DATA_RPC_TIMEOUT_S)
         if reply.get("error"):
             raise ser.loads(bytes(reply["error"]))
         values = [ser.loads(bytes(v)) for v in reply["values"]]
@@ -178,7 +195,8 @@ class ClientContext:
              timeout: Optional[float] = None):
         reply = self._chan.call("ClientWait", {
             "ids": [r.hex() for r in refs], "num_returns": num_returns,
-            "timeout": timeout})
+            "timeout": timeout},
+            timeout=(timeout + 10) if timeout else _DATA_RPC_TIMEOUT_S)
         by_hex = {r.hex(): r for r in refs}
         return ([by_hex[h] for h in reply["ready"]],
                 [by_hex[h] for h in reply["not_ready"]])
@@ -188,41 +206,50 @@ class ClientContext:
         wa, wk = self._wire_args(args, kwargs)
         reply = self._chan.call("ClientTask", {
             "fn": ser.dumps(fn), "args": wa, "kwargs": wk,
-            "opts": ser.dumps(opts) if opts else None})
+            "opts": ser.dumps(opts) if opts else None},
+            timeout=_DATA_RPC_TIMEOUT_S)
         return self._refs_from(reply)
 
     def _create_actor(self, cls, args, kwargs, opts) -> ClientActorHandle:
         wa, wk = self._wire_args(args, kwargs)
         reply = self._chan.call("ClientCreateActor", {
             "cls": ser.dumps(cls), "args": wa, "kwargs": wk,
-            "opts": ser.dumps(opts) if opts else None})
+            "opts": ser.dumps(opts) if opts else None},
+            timeout=_DATA_RPC_TIMEOUT_S)
         return ClientActorHandle(self, reply["actor_id"])
 
     def _actor_call(self, actor_id, method, args, kwargs, opts):
         wa, wk = self._wire_args(args, kwargs)
         reply = self._chan.call("ClientActorCall", {
             "actor_id": actor_id, "method": method, "args": wa, "kwargs": wk,
-            "opts": ser.dumps(opts) if opts else None})
+            "opts": ser.dumps(opts) if opts else None},
+            timeout=_DATA_RPC_TIMEOUT_S)
         return self._refs_from(reply)
 
     def get_actor(self, name: str,
                   namespace: Optional[str] = None) -> ClientActorHandle:
         reply = self._chan.call("ClientGetNamedActor",
-                                {"name": name, "namespace": namespace})
+                                {"name": name, "namespace": namespace},
+                                timeout=CONFIG.control_rpc_timeout_s)
         return ClientActorHandle(self, reply["actor_id"])
 
     def kill(self, actor: ClientActorHandle, no_restart: bool = True) -> None:
         self._chan.call("ClientKill", {"actor_id": actor._actor_id,
-                                       "no_restart": no_restart})
+                                       "no_restart": no_restart},
+                        timeout=CONFIG.control_rpc_timeout_s)
 
     def cancel(self, ref: ClientObjectRef, force: bool = False) -> None:
-        self._chan.call("ClientCancel", {"id": ref.hex(), "force": force})
+        self._chan.call("ClientCancel", {"id": ref.hex(), "force": force},
+                        timeout=CONFIG.control_rpc_timeout_s)
 
     def nodes(self) -> List[Dict]:
-        return self._chan.call("ClientClusterInfo", {})["nodes"]
+        return self._chan.call("ClientClusterInfo", {},
+                               timeout=CONFIG.control_rpc_timeout_s)["nodes"]
 
     def cluster_resources(self) -> Dict[str, float]:
-        return self._chan.call("ClientClusterInfo", {})["resources"]
+        reply = self._chan.call("ClientClusterInfo", {},
+                                timeout=CONFIG.control_rpc_timeout_s)
+        return reply["resources"]
 
     def disconnect(self) -> None:
         self._chan.close()

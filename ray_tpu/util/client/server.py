@@ -9,6 +9,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.async_util import hold_task
 from ray_tpu._private.protocol import Connection, RpcServer
 
 
@@ -44,7 +45,7 @@ class ClientServer:
                 self.server.set_disconnect_handler(self._on_disconnect)
                 ready.set()
 
-            loop.create_task(boot())
+            hold_task(loop.create_task(boot()), "client-server-boot")
             loop.run_forever()
 
         self._thread = threading.Thread(

@@ -18,6 +18,9 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private.async_util import hold_task
+from ray_tpu._private.config import CONFIG
+
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 
@@ -68,7 +71,9 @@ class PlacementGroup:
 
     def _table(self) -> Optional[Dict]:
         w = _worker()
-        return w._acall(w.head.call("GetPlacementGroup", {"pg_id": self.id_hex}))
+        return w._acall(w.head.call("GetPlacementGroup",
+                                    {"pg_id": self.id_hex},
+                                    timeout=CONFIG.control_rpc_timeout_s))
 
     def wait(self, timeout_seconds: float = 30) -> bool:
         """Block until all bundles are reserved (reference:
@@ -139,7 +144,7 @@ def placement_group(
         "strategy": strategy,
         "name": name,
         "lifetime": lifetime or "",
-    }))
+    }, timeout=CONFIG.control_rpc_timeout_s))
     pg = PlacementGroup(pg_id, [dict(b) for b in bundles])
     pg._create_state = (reply or {}).get("state")
     return pg
@@ -166,7 +171,8 @@ def remove_placement_group(pg: PlacementGroup) -> None:
         for attempt in range(5):
             try:
                 await w.head.call("RemovePlacementGroup",
-                                  {"pg_id": pg.id_hex})
+                                  {"pg_id": pg.id_hex},
+                                  timeout=CONFIG.control_rpc_timeout_s)
                 return
             except Exception:
                 await asyncio.sleep(0.5 * (attempt + 1))
@@ -185,11 +191,13 @@ def remove_placement_group(pg: PlacementGroup) -> None:
 
             def on_done(f) -> None:
                 if not f.cancelled() and f.exception() is not None:
-                    asyncio.ensure_future(send(), loop=w.loop)
+                    hold_task(asyncio.ensure_future(send(), loop=w.loop),
+                              "pg-remove-retry")
 
             fut.add_done_callback(on_done)
         except Exception:
-            asyncio.ensure_future(send(), loop=w.loop)
+            hold_task(asyncio.ensure_future(send(), loop=w.loop),
+                      "pg-remove-retry")
         finally:
             queued.set()
 
@@ -201,7 +209,7 @@ def get_placement_group(name: str) -> PlacementGroup:
     from ray_tpu._private.resources import ResourceSet
 
     w = _worker()
-    for t in w._acall(w.head.call("ListPlacementGroups", {})):
+    for t in w._acall(w.head.call("ListPlacementGroups", {}, timeout=CONFIG.control_rpc_timeout_s)):
         if t.get("name") == name and t.get("state") != "REMOVED":
             bundles = [ResourceSet.from_wire(b).to_dict()
                        for b in t.get("bundles", [])]
@@ -215,7 +223,8 @@ def placement_group_table(pg: Optional[PlacementGroup] = None) -> Dict:
         t = pg._table()
         return {pg.id_hex: t} if t else {}
     return {t["pg_id"]: t
-            for t in w._acall(w.head.call("ListPlacementGroups", {}))}
+            for t in w._acall(w.head.call("ListPlacementGroups", {},
+                                          timeout=CONFIG.control_rpc_timeout_s))}
 
 
 def get_current_placement_group() -> Optional[PlacementGroup]:

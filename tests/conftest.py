@@ -19,6 +19,21 @@ import os
 # "suite SIGABRTs at the first jax computation".
 import sys
 
+# raylint R4's dynamic complement (ISSUE 7): the whole tier runs with
+# asyncio debug mode on — task creation sites are recorded, cross-thread
+# call_soon misuse raises instead of corrupting, and "coroutine ... was
+# never awaited" warnings carry their origin. Python re-reads this env
+# var at every event-loop creation, and the spawned daemons (gcs, agents,
+# workers) inherit it, so coverage includes the server side. Set it
+# before jax/asyncio load anything. Opt out (e.g. when profiling
+# latency-sensitive benches under pytest) with RAY_TPU_ASYNCIO_DEBUG=0.
+if os.environ.get("RAY_TPU_ASYNCIO_DEBUG", "1") != "0":
+    os.environ["PYTHONASYNCIODEBUG"] = "1"
+    # Marker for async_util's asyncio-logger mute (slow-callback WARNINGs
+    # would corrupt pytest progress output); daemons inherit it. Scoped
+    # to the harness so an app's own PYTHONASYNCIODEBUG stays untouched.
+    os.environ["RAY_TPU_ASYNCIO_DEBUG_QUIET"] = "1"
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ray_tpu._private.xla_flags import (  # noqa: E402
     normalize_xla_flags, supported_xla_flags)
@@ -74,8 +89,29 @@ FAST_FILES = {
     "test_transfer_plane.py",
     "test_partition.py",
     "test_serve_load.py",
+    "test_raylint.py",
 }
 SLOW_TESTS: set = set()
+
+
+def pytest_configure(config):
+    # Promote "coroutine ... was never awaited" to an error (ISSUE 7
+    # conftest hardening). The warning usually fires from the coroutine's
+    # __del__ during GC, where a raised filter lands in the unraisable
+    # hook — pytest's unraisableexception plugin rewraps it as a
+    # PytestUnraisableExceptionWarning at the owning test, so the second
+    # filter (message-scoped: other unraisable classes stay warnings) is
+    # what actually fails the test. The first catches the rare sync-path
+    # emission directly.
+    # (?s): the rewrapped message is MULTI-LINE ("Exception ignored in:
+    # ...\n\nTraceback ..."), and warnings filters re.match without
+    # DOTALL — without the flag the second filter never fires.
+    config.addinivalue_line(
+        "filterwarnings",
+        "error:(?s)coroutine .* was never awaited:RuntimeWarning")
+    config.addinivalue_line(
+        "filterwarnings",
+        "error:(?s).*was never awaited:pytest.PytestUnraisableExceptionWarning")
 
 
 def pytest_collection_modifyitems(config, items):

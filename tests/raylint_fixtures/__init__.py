@@ -1,0 +1,10 @@
+"""Frozen bug-shape fixtures for the raylint regression tests.
+
+Each module reproduces, in miniature, the exact code shape of a bug the
+repo actually shipped (see the module docstrings). tests/test_raylint.py
+runs the analyzer over them and asserts the matching rule trips on the
+lines marked ``# expect-Rn`` — and nowhere else — so a refactor of the
+rule engine can't silently stop catching the original bug class. These
+modules are never imported by the runtime and are outside the lint tree
+gate (which scans ``ray_tpu/`` only).
+"""

@@ -1,0 +1,71 @@
+"""R1 regression fixture: the MemoryStore GC-reentrancy deadlock (PR 5).
+
+The shipped bug: ``ObjectRef.__del__`` (fired by a GC pass, on whatever
+thread happened to allocate) called ``ReferenceCounter.remove_local_ref``
+which called ``MemoryStore.delete`` — which took the store's plain
+``threading.Lock``. When the GC pass started while the *same* thread was
+already inside another ``MemoryStore`` critical section, the non-reentrant
+acquire deadlocked the whole driver. Three classes between the destructor
+and the lock; no single-file review saw it.
+
+The three classes below are that chain, minimized. R1 must flag the
+``with self._lock:`` in ``MemoryStoreShape.delete`` (reachable from
+``ObjectRefShape.__del__``) and must NOT flag the ``SafeStoreShape`` twin,
+which uses the RLock fix that shipped.
+"""
+
+import threading
+
+
+class MemoryStoreShape:
+    """The store: plain Lock guarding its table (the bug)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def delete(self, key):
+        with self._lock:  # expect-R1
+            self._table.pop(key, None)
+
+
+class ReferenceCounterShape:
+    """The middle hop: no locks of its own, just the call edge."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def remove_local_ref(self, key):
+        self._store.delete(key)
+
+
+class ObjectRefShape:
+    """The GC root: a destructor that walks into the store."""
+
+    def __init__(self, rc, key):
+        self._rc = rc
+        self._key = key
+
+    def __del__(self):
+        self._rc.remove_local_ref(self._key)
+
+
+class SafeStoreShape:
+    """The shipped fix: RLock — same reachability, reentrant, no flag."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._table = {}
+
+    def drop(self, key):
+        with self._lock:
+            self._table.pop(key, None)
+
+
+class SafeRefShape:
+    def __init__(self, store, key):
+        self._safe_store = store
+        self._key = key
+
+    def __del__(self):
+        self._safe_store.drop(self._key)

@@ -1,0 +1,49 @@
+"""R4 regression fixture: the leaked read-loop task (PRs 1/3).
+
+The shipped bug: ``AsyncRpcClient`` connect paths did a bare
+``loop.create_task(self._read_loop())`` and kept no reference. The event
+loop holds tasks only weakly, so when a concurrent-spillback race
+overwrote the client object, its read task was garbage-collected
+mid-flight — the bench-tail "Task was destroyed but it is pending!" spam
+— and any exception the loop raised was never observed.
+
+R4 must flag the two discarded spawns below (bare statement and
+assign-to-underscore) and must NOT flag the retained/tracked twins,
+which are the shipped ``async_util.spawn_tracked`` discipline.
+"""
+
+import asyncio
+
+
+class ReadLoopOwnerShape:
+    """The bug: spawn the read loop, keep nothing."""
+
+    def start(self, loop):
+        loop.create_task(self._read_loop())  # expect-R4
+
+    async def _read_loop(self):
+        while True:
+            await asyncio.sleep(1)
+
+
+def spawn_and_forget(coro):
+    _ = asyncio.ensure_future(coro)  # expect-R4
+
+
+class TrackedOwnerShape:
+    """The fix: the handle is retained (attribute / tracked set)."""
+
+    def __init__(self):
+        self._tasks = set()
+        self._read_task = None
+
+    def start(self, loop):
+        self._read_task = loop.create_task(self._read_loop())
+        self._tasks.add(loop.create_task(self._read_loop()))
+
+    async def _read_loop(self):
+        await asyncio.sleep(1)
+
+
+async def awaited_inline():
+    await asyncio.ensure_future(asyncio.sleep(0))
