@@ -25,7 +25,8 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import lifecycle
-from ray_tpu._private.async_util import spawn_tracked
+from ray_tpu._private.async_util import (
+    DecorrelatedJitterBackoff, spawn_tracked)
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import StoreDirectory
@@ -355,6 +356,12 @@ class NodeAgent:
                 "incarnation": self.incarnation,
                 "addr": {"host": "127.0.0.1", "port": self.tcp_port},
                 "resources": self.resources.to_wire(),
+                # the actors this node ACTUALLY still hosts: a restarted
+                # head reconciles its restored (RECOVERING) actor table
+                # against this list — present means claimed-alive, absent
+                # means the worker died during the outage
+                "actors": [w.actor_id for w in self.workers.values()
+                           if w.is_actor and w.actor_id and w.alive],
             },
             timeout=max(CONFIG.head_ping_timeout_s * 2, 5.0),
         )
@@ -410,7 +417,10 @@ class NodeAgent:
                 continue
             except Exception:
                 pass
-            delay = 0.2
+            # decorrelated jitter: after a head bounce every agent's
+            # retries spread across the interval instead of arriving in
+            # synchronized waves at the recovering head
+            backoff = DecorrelatedJitterBackoff(base_s=0.2, cap_s=2.0)
             down_since = time.monotonic()
             while True:
                 try:
@@ -430,8 +440,7 @@ class NodeAgent:
                     if time.monotonic() - down_since > give_up_s:
                         self.teardown_processes()
                         os._exit(1)
-                    await asyncio.sleep(delay)
-                    delay = min(delay * 2, 2.0)
+                    await asyncio.sleep(backoff.next_delay())
 
     async def _on_head_push(self, method: str, payload: Any) -> None:
         if method == "ClusterView":

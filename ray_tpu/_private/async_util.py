@@ -117,3 +117,39 @@ def spawn_tracked(coro: Coroutine, tag: str = "",
 def tracked_count() -> int:
     """Currently-live tracked tasks (leak-gate introspection)."""
     return len(_TRACKED)
+
+
+class DecorrelatedJitterBackoff:
+    """Decorrelated-jitter reconnect pacing (AWS architecture-blog
+    "exponential backoff and jitter", the ``decorrelated`` variant):
+    ``sleep = min(cap, uniform(base, prev * 3))``.
+
+    The head watchdogs previously used a FIXED doubling schedule — after
+    a head bounce, every agent and driver in the cluster woke on the
+    same 0.2/0.4/0.8… grid and re-registered in synchronized waves (a
+    thundering herd exactly when the freshly restarted head is busiest
+    replaying its WAL). Decorrelation spreads each client's retries
+    across the whole interval while keeping the expected pace
+    exponential.
+    """
+
+    def __init__(self, base_s: float = 0.2, cap_s: float = 2.0, rng=None):
+        import random
+
+        if base_s <= 0:
+            raise ValueError("base_s must be positive")
+        self.base_s = float(base_s)
+        self.cap_s = max(float(cap_s), self.base_s)
+        self._rng = rng if rng is not None else random.Random()
+        self._prev = self.base_s
+
+    def next_delay(self) -> float:
+        """The next sleep; grows (on average) until capped, never below
+        base, never above cap, and never the same sequence twice."""
+        self._prev = min(self.cap_s,
+                         self._rng.uniform(self.base_s, self._prev * 3))
+        return self._prev
+
+    def reset(self) -> None:
+        """Back to base pacing after a successful (re)connect."""
+        self._prev = self.base_s

@@ -177,6 +177,28 @@ _flag("object_pull_round_s", 0.2)  # pull-plane round pacing
 # while cutting that 5x (Redis-backed HA is the real durability path).
 _flag("head_save_debounce_s", 0.25)
 _flag("pg_prepare_timeout_s", 10.0)  # 2PC bundle-prepare RPC deadline
+
+# --- head-plane durability (ISSUE 8) ----------------------------------------
+# WAL rides next to a file-backed RAY_TPU_GCS_PERSIST store: every
+# authoritative mutation is appended + fsynced BEFORE its RPC is acked,
+# so kill -9 at any point loses nothing acknowledged. Disable to fall
+# back to the debounced-snapshot-only behavior.
+_flag("gcs_wal_enabled", True)
+# Group-commit window: appends buffer up to this long so one fsync
+# covers a whole mutation burst. 0 = fsync every batch immediately.
+_flag("gcs_wal_fsync_interval_ms", 2.0)
+# Snapshot-and-truncate compaction threshold for the WAL file.
+_flag("gcs_wal_compact_bytes", 8 * 1024 * 1024)
+# Recovery claim window: entities restored from the durable store stay
+# RECOVERING this long for their agent/driver to re-register and claim
+# them; anything unclaimed is then declared dead with reason
+# "lost_during_head_outage". Keep comfortably above
+# head_watchdog_period_s so healthy agents always make the window.
+_flag("gcs_recovery_grace_s", 10.0)
+# How long head-bound control calls queue (retrying while the watchdog
+# reconnects) during a head outage before failing fast with a typed
+# HeadUnavailableError. 0 = fail on first connection loss.
+_flag("gcs_outage_queue_s", 30.0)
 _flag("pg_retry_place_period_s", 0.5)  # pending-PG placement retry cadence
 _flag("pg_resolve_poll_s", 0.1)  # lease pool waiting for PG placement
 _flag("wait_poll_interval_s", 0.002)  # ray.wait readiness re-check

@@ -9,14 +9,31 @@ Parity with the reference's GCS server (reference:
 (GcsResourceManager) that is gossiped back to node agents for spillback
 decisions (ray_syncer analog).
 
-One asyncio process, TCP. State is in-memory; a periodic JSON snapshot to
-disk provides warm-restart durability (the RedisStoreClient analog).
+One asyncio process, TCP. State is in-memory; durability is layered
+(reference: gcs_server.cc storage-backend selection):
+
+* **File-backed (default when ``RAY_TPU_GCS_PERSIST`` is a path):** every
+  authoritative mutation is write-ahead logged (``wal.py``) and the
+  mutating RPC replies only after the record is fsynced — a ``kill -9``
+  at ANY point loses nothing that was acked. Snapshot-and-truncate
+  compaction bounds the log; recovery replays snapshot + log suffix.
+* **Redis-backed:** the debounced full-snapshot save (the external store
+  outlives the head; per-mutation round trips would serialize the loop).
+
+Recovery does not trust the restored tables blindly: restored nodes and
+actors enter a ``RECOVERING`` state with a claim window
+(``gcs_recovery_grace_s``). Agents re-register into their existing
+incarnations — reporting which actors they still actually host — to
+claim them; drivers re-register to claim their jobs. Anything unclaimed
+at window close is declared dead through the normal death machinery with
+reason ``lost_during_head_outage``: no ghost actors, no zombie nodes.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import time
 from collections import deque
@@ -31,6 +48,33 @@ ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
 ACTOR_DEAD = "DEAD"
+# restored from the durable store after a head restart; waiting for its
+# node's agent to re-register and claim it within the recovery window
+ACTOR_RECOVERING = "RECOVERING"
+
+# reason string for entities reconciled dead at recovery-window close;
+# tests and operators match on it EXACTLY (DeathContext.reason)
+LOST_DURING_HEAD_OUTAGE = "lost_during_head_outage"
+
+
+class _RestoredConn:
+    """Placeholder connection for entities restored from the durable
+    store: permanently closed, so every push/broadcast no-ops until the
+    real agent/driver re-registers and swaps in a live connection."""
+
+    closed = True
+
+    def __init__(self):
+        self.meta: Dict = {}
+
+    async def push(self, method: str, payload: Any) -> None:
+        pass
+
+    async def send(self, msg: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
 
 
 class NodeInfo:
@@ -49,6 +93,10 @@ class NodeInfo:
         # set while the agent's connection is down but the reconnect
         # grace window is still open
         self.disconnected_at: Optional[float] = None
+        # restored from the durable store after a head restart; cleared
+        # when the agent re-registers (claims it) within the recovery
+        # window, else the node is reconciled dead
+        self.recovering = False
         self.labels = resources.labels
         self.pending_demand: List[Dict] = []  # unfulfilled lease requests
 
@@ -77,6 +125,9 @@ class ActorInfo:
         self.detached = bool(spec_wire.get("detached"))
         self.class_name = spec_wire.get("class_name", "")
         self.pid: int = 0
+        # True between restore-from-durable-store and the hosting agent's
+        # claiming re-register (recovery reconciliation)
+        self.recovering = False
 
     def note(self, event: str) -> None:
         self.timeline.append((time.time(), event))
@@ -141,10 +192,31 @@ class HeadServer:
         # (NodeManagerService.NotifyGCSRestart analog).
         self.persist_path = persist_path
         self.store = None
+        self.wal = None
+        self.started_at = time.time()
+        # per-boot head generation: restored+1 on every recovery, so
+        # operators (CLI status) can see how many lives this head has had
+        self.head_incarnation = 1
+        # recovery reconciliation bookkeeping (claim window)
+        self.recovering_nodes: set = set()
+        self.recovering_actors: set = set()
+        self.recovering_jobs: set = set()
+        self.last_recovery: Dict[str, Any] = {}
+        self._compacting = False
         if persist_path:
             from ray_tpu._private.store_client import create_store_client
 
             self.store = create_store_client(persist_path)
+            # WAL rides next to a file-backed snapshot: per-mutation
+            # durability with group-commit fsync. Redis mode keeps the
+            # debounced snapshot (the external store outlives the head).
+            if not persist_path.startswith(("redis://", "rediss://")) \
+                    and CONFIG.gcs_wal_enabled:
+                from ray_tpu._private.wal import WriteAheadLog
+
+                self.wal = WriteAheadLog(
+                    persist_path + ".wal",
+                    fsync_interval_ms=CONFIG.gcs_wal_fsync_interval_ms)
         self._save_pending = False
         self._save_lock = asyncio.Lock()
         self._driver_conns: Dict[Optional[str], Connection] = {}
@@ -160,9 +232,9 @@ class HeadServer:
         import pickle
 
         # A load failure must be FATAL, not "start empty": the next
-        # debounced save would overwrite the durable store with an empty
-        # snapshot, destroying exactly the state HA exists to protect
-        # (e.g. a transient redis outage during head restart).
+        # durable write would overwrite the store with an empty snapshot,
+        # destroying exactly the state HA exists to protect (e.g. a
+        # transient redis outage during head restart).
         tables = self.store.load()
         if tables and all(isinstance(v, bytes) for v in tables.values()):
             state = {name: pickle.loads(blob)
@@ -170,24 +242,304 @@ class HeadServer:
         else:
             # legacy file snapshot: one pickle of the state dict itself
             state = tables
-        if not state:
+        snapshot_seq = int(state.get("seq", 0)) if state else 0
+        if state:
+            self._apply_snapshot(state)
+        wal_records = 0
+        if self.wal is not None:
+            # crash-consistent replay off the WAL's open-time scan (one
+            # read of the file, torn tail already truncated, stopped at
+            # the first bad CRC — a head killed mid-write must never
+            # crash-loop on its own log)
+            records = [r for r in self.wal.take_boot_records()
+                       if r[0] > snapshot_seq]
+            for _seq, op, data in records:
+                try:
+                    self._apply_wal_op(op, data)
+                except Exception:
+                    logging.getLogger("ray_tpu").exception(
+                        "skipping unreplayable WAL op %r", op)
+            wal_records = len(records)
+            self.wal.reset_seq(snapshot_seq)
+        if not state and not wal_records:
             return
+        self.head_incarnation += 1
+        self._begin_recovery(wal_records)
+
+    def _apply_snapshot(self, state: Dict) -> None:
         self.kv = state.get("kv", {})
         self.jobs = state.get("jobs", {})
         self.named_actors = {tuple(k): v for k, v in
                              state.get("named_actors", [])}
         self.placement_groups = state.get("placement_groups", {})
         self._pg_counter = state.get("pg_counter", 0)
+        self.fenced_incarnations = {
+            k: int(v) for k, v in
+            (state.get("fenced_incarnations") or {}).items()}
+        self.head_incarnation = int(state.get("head_incarnation", 1))
         for rec in state.get("actors", []):
-            info = ActorInfo(rec["actor_id"], rec["spec_wire"],
-                             rec["name"], rec["namespace"],
-                             rec["max_restarts"], None)
-            info.state = rec["state"]
-            info.addr = rec["addr"]
-            info.node_id = rec["node_id"]
-            info.num_restarts = rec["num_restarts"]
-            info.owner_job = rec.get("owner_job")
-            self.actors[rec["actor_id"]] = info
+            self._restore_actor(rec)
+        for rec in state.get("nodes", []):
+            self._restore_node(rec)
+
+    def _restore_actor(self, rec: Dict) -> None:
+        info = ActorInfo(rec["actor_id"], rec["spec_wire"],
+                         rec["name"], rec["namespace"],
+                         rec["max_restarts"], None)
+        info.state = rec["state"]
+        info.addr = rec["addr"]
+        info.node_id = rec["node_id"]
+        info.num_restarts = rec["num_restarts"]
+        info.owner_job = rec.get("owner_job")
+        info.death_cause = rec.get("death_cause", "")
+        info.pid = rec.get("pid", 0)
+        self.actors[rec["actor_id"]] = info
+
+    def _restore_node(self, rec: Dict) -> None:
+        info = NodeInfo(rec["node_id"], rec["addr"],
+                        NodeResources.from_wire(rec["resources"]),
+                        _RestoredConn(),
+                        incarnation=int(rec.get("incarnation", 0)))
+        info.alive = bool(rec.get("alive", True))
+        self.nodes[rec["node_id"]] = info
+
+    def _apply_wal_op(self, op: str, data: Dict) -> None:
+        """Replay one logged mutation. Must stay a pure, deterministic
+        state transform: compaction correctness is literally
+        ``replay(snapshot + suffix) == replay(full log)``."""
+        if op == "kv_put":
+            ns = self.kv.setdefault(data.get("ns", "default"), {})
+            if data.get("overwrite", True) or data["key"] not in ns:
+                ns[data["key"]] = data["value"]
+        elif op == "kv_del":
+            ns = self.kv.get(data.get("ns", "default"), {})
+            if data.get("prefix"):
+                for k in [k for k in ns if k.startswith(data["key"])]:
+                    del ns[k]
+            else:
+                ns.pop(data["key"], None)
+        elif op == "job":
+            self.jobs[data["key"]] = data["job"]
+        elif op == "actor_create":
+            self._restore_actor(data)
+            if data.get("name"):
+                self.named_actors[(data["namespace"], data["name"])] = \
+                    data["actor_id"]
+        elif op == "actor_update":
+            info = self.actors.get(data["actor_id"])
+            if info is None:
+                return
+            for field in ("state", "addr", "node_id", "num_restarts",
+                          "death_cause", "pid", "max_restarts"):
+                if field in data:
+                    setattr(info, field, data[field])
+            if data.get("drop_name") and self.named_actors.get(
+                    (info.namespace, info.name)) == info.actor_id:
+                del self.named_actors[(info.namespace, info.name)]
+        elif op == "node_register":
+            self._restore_node(data)
+        elif op == "node_dead":
+            node = self.nodes.get(data["node_id"])
+            if node is not None:
+                node.alive = False
+                node.recovering = False
+            if CONFIG.node_fence_enabled:
+                self.fenced_incarnations[data["node_id"]] = max(
+                    self.fenced_incarnations.get(data["node_id"], -1),
+                    int(data.get("incarnation", 0)))
+        elif op == "pg":
+            self.placement_groups[data["pg"]["pg_id"]] = data["pg"]
+        elif op == "pg_remove":
+            pg = self.placement_groups.get(data["pg_id"])
+            if pg is not None:
+                pg["state"] = "REMOVED"
+        elif op == "head_boot":
+            self.head_incarnation = max(self.head_incarnation,
+                                        int(data.get("incarnation", 1)))
+
+    def _begin_recovery(self, wal_records: int) -> None:
+        """Mark restored entities RECOVERING: nothing restored from disk
+        is trusted as alive until its agent/driver re-registers and
+        claims it inside the ``gcs_recovery_grace_s`` window."""
+        restored_nodes = restored_actors = 0
+        for node in self.nodes.values():
+            if node.alive:
+                node.recovering = True
+                self.recovering_nodes.add(node.node_id)
+                restored_nodes += 1
+        for info in self.actors.values():
+            if info.state == ACTOR_ALIVE:
+                # claimable: its worker may still be running; the hosting
+                # agent's re-register reports whether it actually is
+                info.state = ACTOR_RECOVERING
+                info.recovering = True
+                info.note("restored; awaiting agent claim")
+                self.recovering_actors.add(info.actor_id)
+                restored_actors += 1
+            elif info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                # never acked running: rescheduled from scratch once the
+                # claim window lets agents re-register (start() re-arms
+                # the retry loop snapshots cannot persist)
+                info.note("restored mid-scheduling")
+        for job_id, job in self.jobs.items():
+            if job.get("state") == "RUNNING":
+                self.recovering_jobs.add(job_id)
+        self.last_recovery = {
+            "at": time.time(),
+            "wal_records_replayed": wal_records,
+            "restored_nodes": restored_nodes,
+            "restored_actors": restored_actors,
+            "restored_jobs": len(self.recovering_jobs),
+            "reconciled_dead": 0,
+            "completed": False,
+        }
+
+    async def _recovery_reconcile(self) -> None:
+        """Close the claim window: anything restored but unclaimed is
+        declared dead through the normal death machinery with reason
+        ``lost_during_head_outage`` — no ghost actors, no zombie nodes,
+        no immortal jobs."""
+        await asyncio.sleep(float(CONFIG.gcs_recovery_grace_s))
+        reconciled = 0
+        # actors first so each carries the EXACT outage reason instead of
+        # the node-death cascade's prefixed one
+        for actor_id in list(self.recovering_actors):
+            info = self.actors.get(actor_id)
+            self.recovering_actors.discard(actor_id)
+            if info is None or not info.recovering:
+                continue
+            info.recovering = False
+            if info.state != ACTOR_RECOVERING:
+                continue
+            info.death_node_id = info.node_id or ""
+            info.note("unclaimed at recovery-window close")
+            await self._handle_actor_failure(info, LOST_DURING_HEAD_OUTAGE)
+            reconciled += 1
+        for node_id in list(self.recovering_nodes):
+            node = self.nodes.get(node_id)
+            self.recovering_nodes.discard(node_id)
+            if node is None or not node.recovering or not node.alive:
+                continue
+            await self._mark_node_dead(node, LOST_DURING_HEAD_OUTAGE)
+            reconciled += 1
+        for job_id in list(self.recovering_jobs):
+            self.recovering_jobs.discard(job_id)
+            if self._driver_conns.get(job_id) is not None:
+                continue  # driver re-registered (claimed) meanwhile
+            job = self.jobs.get(job_id)
+            if job is not None and job.get("state") == "RUNNING":
+                job["state"] = "FINISHED"
+                await self._durable("job", {"key": job_id, "job": dict(job)})
+                reconciled += 1
+            # its non-detached actors die with the lost driver
+            for actor in list(self.actors.values()):
+                if actor.owner_job == job_id and not actor.detached \
+                        and actor.owner_conn is None \
+                        and actor.state != ACTOR_DEAD:
+                    await self._kill_actor_internal(
+                        actor, LOST_DURING_HEAD_OUTAGE)
+                    reconciled += 1
+        self.last_recovery["reconciled_dead"] = reconciled
+        self.last_recovery["completed"] = True
+        self.last_recovery["window_closed_at"] = time.time()
+        if reconciled:
+            from ray_tpu._private.event import report_event
+
+            report_event(
+                "WARNING", "RECOVERY_RECONCILED",
+                f"declared {reconciled} unclaimed entities dead "
+                f"({LOST_DURING_HEAD_OUTAGE})", reconciled=reconciled)
+
+    async def _claim_node(self, node: NodeInfo, reported_actors) -> None:
+        """An agent re-registered into its restored incarnation: the node
+        is claimed, and its RECOVERING actors reconcile against the list
+        the agent ACTUALLY still hosts — present means alive, absent
+        means the worker died during the head outage."""
+        node.recovering = False
+        self.recovering_nodes.discard(node.node_id)
+        reported = set(reported_actors or [])
+        claimed: List[ActorInfo] = []
+        lost: List[ActorInfo] = []
+        for actor in list(self.actors.values()):
+            if actor.node_id != node.node_id or not actor.recovering:
+                continue
+            actor.recovering = False
+            self.recovering_actors.discard(actor.actor_id)
+            if actor.state != ACTOR_RECOVERING:
+                continue
+            if actor.actor_id in reported:
+                actor.state = ACTOR_ALIVE
+                actor.note("claimed by re-registered agent")
+                claimed.append(actor)
+            else:
+                actor.death_node_id = node.node_id
+                actor.death_incarnation = node.incarnation
+                actor.note("not in re-registering agent's live set")
+                lost.append(actor)
+        # one group commit for the whole claimed set: a 1000-actor node's
+        # re-register must not pay 1000 serial fsync windows inside its
+        # RegisterNode deadline
+        await self._durable_batch([
+            ("actor_update", {"actor_id": a.actor_id, "state": ACTOR_ALIVE})
+            for a in claimed])
+        for actor in claimed:
+            await self._publish_event("actor", actor.public_view())
+        for actor in lost:
+            await self._handle_actor_failure(actor, LOST_DURING_HEAD_OUTAGE)
+
+    # --------------------------------------------------- durable mutations
+    async def _durable(self, op: str, data: Dict) -> None:
+        """Make one mutation durable BEFORE the caller acks it.
+
+        WAL mode: group-commit append — resolves after the record is
+        fsynced (many concurrent mutations share one fsync). Snapshot
+        mode (redis backend): the debounced full-state save, whose
+        durability window the external store's own persistence covers.
+        No store: no-op (pure in-memory head).
+        """
+        if self.wal is not None:
+            _seq, fut = self.wal.append_nowait(op, data)
+            self._maybe_compact()
+            await fut
+        elif self.store is not None:
+            self._schedule_save()
+
+    async def _durable_batch(self, ops: List[Tuple[str, Dict]]) -> None:
+        """`_durable` for many mutations at once: append every record
+        BEFORE the first await so the whole batch resolves on one
+        group-commit fsync instead of paying N serial commit windows."""
+        if not ops:
+            return
+        if self.wal is not None:
+            futs = [self.wal.append_nowait(op, data)[1] for op, data in ops]
+            self._maybe_compact()
+            await asyncio.gather(*futs)
+        elif self.store is not None:
+            self._schedule_save()
+
+    def _maybe_compact(self) -> None:
+        if self._compacting or self.wal is None or self.store is None:
+            return
+        if self.wal.size_bytes < int(CONFIG.gcs_wal_compact_bytes):
+            return
+        self._compacting = True
+        self._hold_task(asyncio.get_running_loop().create_task(
+            self._compact()))
+
+    async def _compact(self) -> None:
+        """Snapshot-and-truncate: save a full snapshot stamped with the
+        latest WAL seq, then rotate the log keeping only records newer
+        than the snapshot. A crash between the two steps is safe — replay
+        skips records at or below the snapshot's seq."""
+        try:
+            async with self._save_lock:
+                state = self._snapshot()
+                await asyncio.to_thread(self._write_snapshot, state)
+                await self.wal.rotate(int(state.get("seq", 0)))
+        except Exception:
+            logging.getLogger("ray_tpu").exception("WAL compaction failed")
+        finally:
+            self._compacting = False
 
     def _schedule_save(self) -> None:
         if self.store is None or self._save_pending:
@@ -204,6 +556,8 @@ class HeadServer:
         (possibly large) pickle+write can run off-loop without racing
         concurrent mutation."""
         return {
+            "seq": self.wal.seq if self.wal is not None else 0,
+            "head_incarnation": self.head_incarnation,
             "kv": {ns: dict(table) for ns, table in self.kv.items()},
             "jobs": {k: dict(v) for k, v in self.jobs.items()},
             "named_actors": [[list(k), v]
@@ -211,15 +565,26 @@ class HeadServer:
             "placement_groups": {k: dict(v)
                                  for k, v in self.placement_groups.items()},
             "pg_counter": self._pg_counter,
-            "actors": [
-                {"actor_id": a.actor_id, "spec_wire": a.spec_wire,
-                 "name": a.name, "namespace": a.namespace,
-                 "max_restarts": a.max_restarts,
-                 "state": a.state, "addr": a.addr, "node_id": a.node_id,
-                 "num_restarts": a.num_restarts, "owner_job": a.owner_job}
-                for a in self.actors.values()
+            "fenced_incarnations": dict(self.fenced_incarnations),
+            "actors": [self._actor_record(a) for a in self.actors.values()],
+            "nodes": [
+                {"node_id": n.node_id, "incarnation": n.incarnation,
+                 "addr": n.addr, "resources": n.resources.to_wire(),
+                 "alive": True}
+                for n in self.nodes.values() if n.alive
             ],
         }
+
+    @staticmethod
+    def _actor_record(a: ActorInfo) -> Dict:
+        """Durable actor row — shared by snapshots and ``actor_create``
+        WAL records so both restore through ``_restore_actor``."""
+        return {"actor_id": a.actor_id, "spec_wire": a.spec_wire,
+                "name": a.name, "namespace": a.namespace,
+                "max_restarts": a.max_restarts,
+                "state": a.state, "addr": a.addr, "node_id": a.node_id,
+                "num_restarts": a.num_restarts, "owner_job": a.owner_job,
+                "death_cause": a.death_cause, "pid": a.pid}
 
     async def _save_state_async(self) -> None:
         self._save_pending = False
@@ -252,6 +617,25 @@ class HeadServer:
         self.port = await self.server.start_tcp("0.0.0.0", self.port)
         self.server.set_disconnect_handler(self._on_disconnect)
         loop = asyncio.get_running_loop()
+        if self.wal is not None:
+            self.wal.start()
+            # durable boot marker: a double restart with no snapshot in
+            # between must still advance the head incarnation
+            self._hold_task(loop.create_task(self.wal.append(
+                "head_boot", {"incarnation": self.head_incarnation})))
+        if self.recovering_nodes or self.recovering_actors \
+                or self.recovering_jobs:
+            self._hold_task(loop.create_task(self._recovery_reconcile()))
+        for info in self.actors.values():
+            # restored mid-scheduling: snapshots can't persist the retry
+            # task, so re-arm it (agents re-register within the window)
+            if info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                self._hold_task(loop.create_task(self._retry_schedule(info)))
+        for pg_id, pg in list(self.placement_groups.items()):
+            # same story for placement groups restored mid-placement: the
+            # retry task is in-process state a snapshot can't persist
+            if pg.get("state") == "PENDING":
+                self._hold_task(loop.create_task(self._retry_place_pg(pg_id)))
         for name, factory in (
                 ("health_check", self._health_check_loop),
                 ("broadcast", self._broadcast_loop),
@@ -324,10 +708,28 @@ class HeadServer:
         r("RegisterJob", self._register_job)
         r("ListJobs", self._list_jobs)
         r("DrainNode", self._drain_node)
+        r("GetHeadStatus", self._get_head_status)
         r("Ping", self._ping)
 
     async def _ping(self, conn, p) -> Dict:
         return {"ok": True}
+
+    async def _get_head_status(self, conn, p) -> Dict:
+        """Operator view of the head plane (CLI ``status``): incarnation,
+        uptime, WAL health, and the last recovery's reconciliation."""
+        return {
+            "incarnation": self.head_incarnation,
+            "started_at": self.started_at,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "persist": self.persist_path or "",
+            "wal": self.wal.stats() if self.wal is not None else None,
+            "last_recovery": dict(self.last_recovery),
+            "recovering": {
+                "nodes": len(self.recovering_nodes),
+                "actors": len(self.recovering_actors),
+                "jobs": len(self.recovering_jobs),
+            },
+        }
 
     # ------------------------------------------------------ node membership
     async def _register_node(self, conn: Connection, p: Dict) -> Dict:
@@ -363,6 +765,14 @@ class HeadServer:
                 existing.disconnected_at = None
                 conn.meta["node_id"] = node_id
                 conn.meta["role"] = "agent"
+                if existing.recovering:
+                    # restored-from-durable-store node claimed: reconcile
+                    # its actors against the agent's ACTUAL live set
+                    await self._claim_node(existing, p.get("actors"))
+                await self._durable("node_register", {
+                    "node_id": node_id, "incarnation": incarnation,
+                    "addr": p["addr"], "resources": p["resources"],
+                    "alive": True})
                 return {"cluster_config": self.cluster_config,
                         "cluster_view": self._cluster_view()}
             # a NEWER boot superseding a still-"alive" record (the old
@@ -377,6 +787,10 @@ class HeadServer:
         self.nodes[node_id] = info
         conn.meta["node_id"] = node_id
         conn.meta["role"] = "agent"
+        # durable BEFORE the ack: an acked membership must survive kill -9
+        await self._durable("node_register", {
+            "node_id": node_id, "incarnation": incarnation,
+            "addr": p["addr"], "resources": p["resources"], "alive": True})
         await self._publish_event("node", {"event": "added", "node_id": node_id,
                                            "addr": p["addr"],
                                            "incarnation": incarnation})
@@ -401,6 +815,10 @@ class HeadServer:
                 # cleanup reaches these actors again
                 actor.owner_conn = conn
         self._driver_conns[job_id] = conn
+        # a re-registering driver claims its restored job: the recovery
+        # window must not declare it lost and reap its actors (jobs are
+        # keyed `job_id or ""`, so normalize the same way)
+        self.recovering_jobs.discard(job_id or "")
         existing = self.jobs.get(job_id or "")
         if existing is not None and existing.get("state") == "RUNNING":
             pass  # keep original start_time on re-register
@@ -409,7 +827,8 @@ class HeadServer:
                 "job_id": job_id, "start_time": time.time(),
                 "state": "RUNNING", "entrypoint": p.get("entrypoint", ""),
             }
-        self._schedule_save()
+        await self._durable("job", {"key": job_id or "",
+                                    "job": dict(self.jobs[job_id or ""])})
         return {"cluster_config": self.cluster_config,
                 "cluster_view": self._cluster_view()}
 
@@ -464,6 +883,8 @@ class HeadServer:
             await asyncio.sleep(period)
             now = time.monotonic()
             for node in list(self.nodes.values()):
+                if node.recovering:
+                    continue  # the recovery claim window owns its verdict
                 if node.alive and now - node.last_heartbeat > period * threshold:
                     await self._mark_node_dead(node, "health check timeout")
 
@@ -471,6 +892,8 @@ class HeadServer:
         if not node.alive:
             return
         node.alive = False
+        node.recovering = False
+        self.recovering_nodes.discard(node.node_id)
         if CONFIG.node_fence_enabled:
             # fence THIS incarnation: a later re-register from it (the
             # partition healed) is rejected; a fresh boot (higher
@@ -483,6 +906,12 @@ class HeadServer:
         report_event("ERROR", "NODE_DEAD",
                      f"node {node.node_id[:12]} marked dead: {reason}",
                      node_id=node.node_id, reason=reason)
+        # the death verdict (and its fence) must survive a head restart:
+        # a fenced incarnation resurrecting through a stale snapshot would
+        # be exactly the zombie state fencing exists to prevent
+        await self._durable("node_dead", {
+            "node_id": node.node_id, "incarnation": node.incarnation,
+            "reason": reason})
         # drop the node's published system metrics: a dead node's last
         # cpu/mem/TPU gauges must not keep exporting as current
         metrics_ns = self.kv.get("_metrics")
@@ -504,11 +933,16 @@ class HeadServer:
                     await other.conn.push("NodeRemoved", removed_msg)
                 except Exception:
                     pass
-        # Every actor on that node dies with it.
+        # Every actor on that node dies with it — including RECOVERING
+        # ones: once the node's death is known there is nothing left to
+        # claim them, so failing over NOW beats waiting out the window.
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state in (
                 ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
+                ACTOR_RECOVERING,
             ):
+                actor.recovering = False
+                self.recovering_actors.discard(actor.actor_id)
                 actor.death_node_id = node.node_id
                 actor.death_incarnation = node.incarnation
                 actor.note(f"node {node.node_id[:12]} died: {reason}")
@@ -623,6 +1057,8 @@ class HeadServer:
                 self._driver_conns.pop(job_id, None)
                 if job_id in self.jobs:
                     self.jobs[job_id]["state"] = "FINISHED"
+                    await self._durable("job", {
+                        "key": job_id, "job": dict(self.jobs[job_id])})
                 # Non-detached actors owned by this driver die with it.
                 for actor in list(self.actors.values()):
                     if actor.owner_conn is conn and not actor.detached \
@@ -646,11 +1082,18 @@ class HeadServer:
 
     # ------------------------------------------------------------------- kv
     async def _kv_put(self, conn, p) -> bool:
-        ns = self.kv.setdefault(p.get("ns", "default"), {})
+        ns_name = p.get("ns", "default")
+        ns = self.kv.setdefault(ns_name, {})
         key = p["key"]
         if p.get("overwrite", True) or key not in ns:
             ns[key] = p["value"]
-            self._schedule_save()
+            # "_metrics" churns every few seconds per process and is
+            # rebuilt live after a restart — logging it would be pure WAL
+            # noise between compactions
+            if ns_name != "_metrics":
+                await self._durable("kv_put", {
+                    "ns": ns_name, "key": key, "value": p["value"],
+                    "overwrite": True})
             return True
         return False
 
@@ -658,16 +1101,19 @@ class HeadServer:
         return self.kv.get(p.get("ns", "default"), {}).get(p["key"])
 
     async def _kv_del(self, conn, p) -> int:
-        ns = self.kv.get(p.get("ns", "default"), {})
+        ns_name = p.get("ns", "default")
+        ns = self.kv.get(ns_name, {})
         if p.get("prefix"):
             keys = [k for k in ns if k.startswith(p["key"])]
             for k in keys:
                 del ns[k]
-            self._schedule_save()
+            if keys and ns_name != "_metrics":
+                await self._durable("kv_del", {
+                    "ns": ns_name, "key": p["key"], "prefix": True})
             return len(keys)
         n = 1 if ns.pop(p["key"], None) is not None else 0
-        if n:
-            self._schedule_save()
+        if n and ns_name != "_metrics":
+            await self._durable("kv_del", {"ns": ns_name, "key": p["key"]})
         return n
 
     async def _kv_keys(self, conn, p) -> List[bytes]:
@@ -684,6 +1130,16 @@ class HeadServer:
         actor_id = p["actor_id"]
         name = p.get("name", "")
         namespace = p.get("namespace", "default")
+        dup = self.actors.get(actor_id)
+        if dup is not None:
+            # duplicate delivery: the original ack died with the head and
+            # the driver's outage-queued head_call retried a create the
+            # WAL already made durable (actor ids are client-generated,
+            # so same id == same logical create) — adopt, never
+            # double-create or fail a create that actually succeeded
+            if dup.owner_conn is None or dup.owner_conn.closed:
+                dup.owner_conn = conn
+            return {"actor_id": actor_id, "state": dup.state}
         if name:
             existing_id = self.named_actors.get((namespace, name))
             if existing_id:
@@ -698,7 +1154,9 @@ class HeadServer:
         self.actors[actor_id] = info
         if name:
             self.named_actors[(namespace, name)] = actor_id
-        self._schedule_save()
+        # durable before scheduling (and before the ack): a kill -9 right
+        # after this reply restores the actor PENDING and reschedules it
+        await self._durable("actor_create", self._actor_record(info))
         ok = await self._schedule_actor(info)
         if not ok:
             # No feasible node right now; keep PENDING and retry when nodes join
@@ -732,6 +1190,8 @@ class HeadServer:
         for node in self.nodes.values():
             if not node.alive:
                 continue
+            if node.recovering:
+                continue  # not claimed yet: placement frames would be lost
             if pg_node is not None and node.node_id != pg_node:
                 continue
             if strategy and strategy.get("type") == "node_affinity":
@@ -832,10 +1292,16 @@ class HeadServer:
         info.addr = p["addr"]
         info.pid = p.get("pid", 0)
         info.node_id = conn.meta.get("node_id", info.node_id)
+        # a worker's ready report also claims a RECOVERING actor (e.g.
+        # the ready raced the head's death and is being re-delivered)
+        info.recovering = False
+        self.recovering_actors.discard(info.actor_id)
         # ActorReady arrives on the WORKER's head connection (no node_id
         # in conn.meta) — note after the node_id fallback above resolves
         info.note(f"alive on {(info.node_id or '?')[:12]}")
-        self._schedule_save()
+        await self._durable("actor_update", {
+            "actor_id": info.actor_id, "state": ACTOR_ALIVE,
+            "addr": info.addr, "pid": info.pid, "node_id": info.node_id})
         await self._publish_event("actor", info.public_view())
 
     async def _actor_died(self, conn: Connection, p: Dict) -> None:
@@ -857,6 +1323,9 @@ class HeadServer:
             info.state = ACTOR_RESTARTING
             info.note(f"restarting (#{info.num_restarts}): {reason}")
             info.addr = None
+            await self._durable("actor_update", {
+                "actor_id": info.actor_id, "state": ACTOR_RESTARTING,
+                "num_restarts": info.num_restarts, "addr": None})
             await self._publish_event("actor", info.public_view())
             if not await self._schedule_actor(info):
                 self._hold_task(asyncio.get_running_loop().create_task(
@@ -869,10 +1338,18 @@ class HeadServer:
         info.death_cause = reason
         info.note(f"dead: {reason}")
         info.addr = None
+        info.recovering = False
+        self.recovering_actors.discard(info.actor_id)
+        dropped_name = False
         if (info.namespace, info.name) in self.named_actors:
             if self.named_actors[(info.namespace, info.name)] == info.actor_id:
                 del self.named_actors[(info.namespace, info.name)]
-        self._schedule_save()
+                dropped_name = True
+        await self._durable("actor_update", {
+            "actor_id": info.actor_id, "state": ACTOR_DEAD,
+            "death_cause": reason, "addr": None,
+            "max_restarts": info.max_restarts,
+            "drop_name": dropped_name})
         await self._publish_event("actor", info.public_view())
 
     async def _get_actor(self, conn, p) -> Optional[Dict]:
@@ -938,6 +1415,7 @@ class HeadServer:
             "strategy": p.get("strategy", "PACK"), "placement": None,
             "name": p.get("name", ""),
         }
+        await self._durable("pg", {"pg": dict(self.placement_groups[pg_id])})
         if await self._try_place_pg(pg_id):
             return {"state": "CREATED",
                     "placement": self.placement_groups[pg_id]["placement"]}
@@ -986,8 +1464,8 @@ class HeadServer:
                                      {"pg_id": pg_id, "bundle_index": idx})
             return False
         pg["state"] = "CREATED"
-        self._schedule_save()
         pg["placement"] = placement
+        await self._durable("pg", {"pg": dict(pg)})
         return True
 
     async def _retry_place_pg(self, pg_id: str) -> None:
@@ -1007,7 +1485,8 @@ class HeadServer:
 
     def _place_bundles(self, bundles: List[ResourceSet], strategy: str
                        ) -> Optional[List[str]]:
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and not n.recovering]
         if not alive:
             return None
         placement: List[str] = []
@@ -1085,7 +1564,7 @@ class HeadServer:
                 if node and node.alive:
                     await node.conn.push("ReturnPGBundle",
                                          {"pg_id": p["pg_id"], "bundle_index": idx})
-        self._schedule_save()
+        await self._durable("pg_remove", {"pg_id": p["pg_id"]})
         return {"ok": True}
 
     async def _get_placement_group(self, conn, p) -> Optional[Dict]:
@@ -1145,7 +1624,7 @@ class HeadServer:
     # ----------------------------------------------------------------- jobs
     async def _register_job(self, conn, p) -> None:
         self.jobs[p["job_id"]] = p
-        self._schedule_save()
+        await self._durable("job", {"key": p["job_id"], "job": dict(p)})
 
     async def _list_jobs(self, conn, p) -> List[Dict]:
         return list(self.jobs.values())
@@ -1188,8 +1667,11 @@ def main() -> None:
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
-        # flush the last debounce window so a clean stop loses nothing
+        # flush the last debounce window so a clean stop loses nothing;
+        # the snapshot's seq stamp lets the next boot skip the WAL prefix
         head._save_state()
+        if head.wal is not None:
+            head.wal.close_sync()
         proc_profile.dump(prof, "head")
         lifecycle.unregister_process(args.session_dir, os.getpid())
 

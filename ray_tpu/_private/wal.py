@@ -1,0 +1,388 @@
+"""Write-ahead log for the head control plane (GCS durability).
+
+The debounced-snapshot persistence this replaces (``head_save_debounce_s``)
+silently lost every mutation inside the debounce window on a head
+``kill -9``. Here every authoritative GCS mutation appends one record to
+an append-only log and the mutating RPC replies only after the record is
+durable, so acknowledged state survives any head death (reference: Ray's
+Redis-backed GCS fault tolerance, ``src/ray/gcs/gcs_server/`` — the log
+plays the role of the external store's operation stream).
+
+On-disk format (little-endian)::
+
+    magic:  b"RTPUWAL1"                      (8 bytes, once per file)
+    record: u32 length | u32 crc32(payload) | payload
+    payload = pickle((seq, op, data))
+
+Durability model:
+
+* **Group commit** — appends buffer in memory; a flusher task writes and
+  ``fsync``\\ s the batch at most ``gcs_wal_fsync_interval_ms`` later and
+  resolves every batched append's future at once. One fsync amortizes
+  across an entire mutation burst (a 1,000-actor creation storm pays
+  ~interval, not 1,000 fsyncs).
+* **Torn-tail tolerance** — recovery replays records until the first
+  short/oversized/bad-CRC record, truncates the file there, and carries
+  on. A head killed mid-write (or mid-``fsync``) never crash-loops on its
+  own log.
+* **Snapshot-and-truncate compaction** — when the log outgrows
+  ``gcs_wal_compact_bytes`` the head saves a full snapshot stamped with
+  the latest sequence number, then ``rotate()``\\ s the log. Replay skips
+  records with ``seq <= snapshot_seq``, so a crash *between* snapshot
+  save and rotate is harmless (the stale prefix is simply ignored).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+MAGIC = b"RTPUWAL1"
+_HDR = struct.Struct("<II")  # length, crc32(payload)
+# A length prefix beyond this is garbage from a torn write, not a real
+# record (the largest legitimate record is one KV value plus envelope).
+MAX_RECORD_BYTES = 256 * 1024 * 1024
+
+
+def _encode(seq: int, op: str, data: Any) -> bytes:
+    payload = pickle.dumps((seq, op, data), protocol=pickle.HIGHEST_PROTOCOL)
+    return _HDR.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the parent directory so a freshly created/replaced log file
+    survives a machine crash, not just a process kill."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # e.g. directories that don't support fsync
+
+
+def scan(path: str, repair: bool = True
+         ) -> Tuple[List[Tuple[int, str, Any]], int]:
+    """Read every intact record; return ``(records, valid_end_offset)``.
+
+    Stops at the first torn or corrupt record (short header, impossible
+    length, CRC mismatch, unpicklable payload) — everything after a bad
+    record is untrusted, because record boundaries can no longer be
+    located. With ``repair`` the file is truncated to the last valid
+    offset so subsequent appends extend a clean log.
+    """
+    if not os.path.exists(path):
+        return [], 0
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    if data[:len(MAGIC)] == MAGIC:
+        off = len(MAGIC)
+    elif data:
+        # unrecognized preamble: nothing in this file can be trusted
+        if repair:
+            with open(path, "wb") as f:
+                f.write(MAGIC)
+                f.flush()
+                os.fsync(f.fileno())
+        return [], len(MAGIC)
+    records: List[Tuple[int, str, Any]] = []
+    valid_end = off
+    while True:
+        if off + _HDR.size > len(data):
+            break  # torn header
+        length, crc = _HDR.unpack_from(data, off)
+        if length > MAX_RECORD_BYTES or off + _HDR.size + length > len(data):
+            break  # impossible/torn body
+        payload = data[off + _HDR.size:off + _HDR.size + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            break  # corrupt record: stop, trust nothing past it
+        try:
+            rec = pickle.loads(payload)
+        except Exception:
+            break
+        if not (isinstance(rec, tuple) and len(rec) == 3):
+            break
+        records.append(rec)
+        off += _HDR.size + length
+        valid_end = off
+    if repair and valid_end < len(data):
+        with open(path, "r+b") as f:
+            f.truncate(valid_end)
+            f.flush()
+            os.fsync(f.fileno())
+    return records, valid_end
+
+
+def replay(path: str, snapshot_seq: int = 0, repair: bool = True
+           ) -> List[Tuple[int, str, Any]]:
+    """Records to apply on top of a snapshot stamped ``snapshot_seq``."""
+    records, _ = scan(path, repair=repair)
+    return [r for r in records if r[0] > snapshot_seq]
+
+
+class WriteAheadLog:
+    """Append-only, CRC-checksummed, group-committed operation log.
+
+    Construct (sync — opens/repairs the file), then ``start()`` on the
+    serving event loop. ``append()`` resolves once the record is fsynced.
+    """
+
+    def __init__(self, path: str, fsync_interval_ms: float = 2.0):
+        self.path = path
+        self.fsync_interval_s = max(0.0, float(fsync_interval_ms)) / 1000.0
+        existing, valid_end = scan(path, repair=True)
+        #: last sequence number present in the log (callers bump past the
+        #: snapshot's seq via ``reset_seq`` after recovery merges both)
+        self.seq = existing[-1][0] if existing else 0
+        # the open-time scan already read and CRC-checked every record;
+        # hand it to recovery via take_boot_records() instead of making
+        # _load_state re-read the whole file
+        self._boot_records: List[Tuple[int, str, Any]] = existing
+        fresh = not os.path.exists(path) or valid_end == 0
+        self._f = open(path, "ab")
+        if fresh and self._f.tell() == 0:
+            self._f.write(MAGIC)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            _fsync_dir(path)
+        self.size_bytes = self._f.tell()
+        self.records_appended = 0
+        self.fsyncs = 0
+        self.last_fsync_at = time.monotonic()
+        self._pending: List[Tuple[bytes, "asyncio.Future"]] = []
+        self._wake: Optional[asyncio.Event] = None
+        self._io_lock: Optional[asyncio.Lock] = None
+        self._flusher: Optional["asyncio.Task"] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        """Arm the group-commit flusher (running-loop context)."""
+        from ray_tpu._private.async_util import spawn_tracked
+
+        if self._flusher is not None:
+            return
+        self._wake = asyncio.Event()
+        self._io_lock = asyncio.Lock()
+        self._flusher = spawn_tracked(self._flush_loop(), "wal-flusher")
+
+    def reset_seq(self, seq: int) -> None:
+        self.seq = max(self.seq, int(seq))
+
+    def take_boot_records(self) -> List[Tuple[int, str, Any]]:
+        """The records found (and repaired past) when the log was opened
+        — the boot-time replay source. Cleared on first call so a large
+        log's decoded records aren't pinned for the process lifetime."""
+        recs, self._boot_records = self._boot_records, []
+        return recs
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._flusher is not None:
+            if self._wake is not None:
+                self._wake.set()
+            try:
+                await self._flusher
+            except Exception:
+                pass
+            self._flusher = None
+        self._drain_pending_sync()
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    def close_sync(self) -> None:
+        """Shutdown-path close: flush whatever is buffered, no loop."""
+        self._closed = True
+        self._drain_pending_sync()
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    def _drain_pending_sync(self) -> None:
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        err = None
+        try:
+            self._write_and_sync(b"".join(body for body, _ in pending))
+        except OSError as e:
+            err = e
+        for _, fut in pending:
+            try:
+                if fut.done():
+                    continue
+                if err is None:
+                    fut.set_result(None)
+                else:  # never falsely ack a write that failed
+                    fut.set_exception(
+                        RuntimeError(f"WAL write failed: {err!r}"))
+            except Exception:
+                pass  # future's loop may already be closed at shutdown
+
+    # -------------------------------------------------------------- appends
+    def append_nowait(self, op: str, data: Any
+                      ) -> Tuple[int, "asyncio.Future"]:
+        """Buffer one record; the future resolves when it is durable."""
+        if self._closed:
+            raise RuntimeError("WAL is closed")
+        self.seq += 1
+        seq = self.seq
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((_encode(seq, op, data), fut))
+        self.records_appended += 1
+        if self._wake is not None:
+            self._wake.set()
+        return seq, fut
+
+    async def append(self, op: str, data: Any) -> int:
+        """Append and wait until the record is fsynced (group commit)."""
+        seq, fut = self.append_nowait(op, data)
+        await fut
+        return seq
+
+    async def flush(self) -> None:
+        """Force everything buffered to disk now (bypasses the window)."""
+        if not self._pending:
+            return
+        await self._commit_batch()
+
+    # ----------------------------------------------------------- compaction
+    async def rotate(self, snapshot_seq: int) -> None:
+        """Truncate after a durably saved snapshot stamped
+        ``snapshot_seq``: replace the log with a fresh file keeping only
+        records *newer* than the snapshot — both those flushed to the old
+        file while the snapshot was being written and everything still
+        pending. Records at or below the snapshot's seq are covered by
+        the snapshot and dropped.
+        """
+        async with self._io_lock:
+            pending, self._pending = self._pending, []
+            tmp = f"{self.path}.rotate.tmp"
+            old = self._f
+            old.flush()  # make the old tail scannable below
+
+            def _swap() -> int:
+                keep, _ = scan(self.path, repair=False)
+                with open(tmp, "wb") as nf:
+                    nf.write(MAGIC)
+                    for rec in keep:
+                        if rec[0] > snapshot_seq:
+                            nf.write(_encode(*rec))
+                    for body, _ in pending:
+                        nf.write(body)
+                    nf.flush()
+                    os.fsync(nf.fileno())
+                os.replace(tmp, self.path)
+                _fsync_dir(self.path)
+                return os.path.getsize(self.path)
+
+            try:
+                size = await asyncio.to_thread(_swap)
+            except Exception:
+                # rotation failed before the replace took effect: hand the
+                # stolen appends back to the flusher (old file is intact)
+                # instead of leaving their futures unresolved forever
+                self._pending = pending + self._pending
+                if self._wake is not None:
+                    self._wake.set()
+                raise
+            self._f = open(self.path, "ab")
+            try:
+                old.close()
+            except OSError:
+                pass
+            self.size_bytes = size
+            self.fsyncs += 1
+            self.last_fsync_at = time.monotonic()
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_result(None)
+
+    # ------------------------------------------------------------- internals
+    async def _flush_loop(self) -> None:
+        while not self._closed:
+            await self._wake.wait()
+            self._wake.clear()
+            if self._closed:
+                break
+            if self.fsync_interval_s > 0:
+                # group-commit window: let a mutation burst pile on so the
+                # whole batch shares one write+fsync
+                await asyncio.sleep(self.fsync_interval_s)
+            await self._commit_batch()
+
+    async def _commit_batch(self) -> None:
+        async with self._io_lock:
+            pending, self._pending = self._pending, []
+            if not pending:
+                return
+            buf = b"".join(body for body, _ in pending)
+            try:
+                await asyncio.to_thread(self._write_and_sync, buf)
+            except Exception as e:  # disk full / EIO: fail the acks, keep
+                # roll the file back to the last offset known durable: a
+                # torn record left mid-file would make recovery's scan
+                # stop THERE and silently discard every LATER acked batch
+                # ("kill -9 loses nothing acked" would quietly break)
+                try:
+                    await asyncio.to_thread(self._rollback_to_last_sync)
+                except Exception:
+                    # can't restore a clean tail: poison the log so no
+                    # future append can be falsely acked past the garbage
+                    self._closed = True
+                for _, fut in pending:  # serving reads — callers see the
+                    if not fut.done():  # error instead of a false ack
+                        fut.set_exception(
+                            RuntimeError(f"WAL write failed: {e!r}"))
+                return
+            self.fsyncs += 1
+            self.last_fsync_at = time.monotonic()
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_result(None)
+
+    def _write_and_sync(self, buf: bytes) -> None:
+        self._f.write(buf)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        # only advanced after a SUCCESSFUL fsync: on a failed write this
+        # is the rollback point (_rollback_to_last_sync)
+        self.size_bytes = self._f.tell()
+
+    def _rollback_to_last_sync(self) -> None:
+        """Drop a torn record a failed write may have left: reopen the
+        file truncated at the last fsynced offset so later appends extend
+        a clean log (O_APPEND ignores seeks — reopen, don't rewind)."""
+        try:
+            self._f.close()  # discards any half-buffered garbage
+        except OSError:
+            pass
+        with open(self.path, "r+b") as f:
+            f.truncate(self.size_bytes)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f = open(self.path, "ab")
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "size_bytes": self.size_bytes,
+            "seq": self.seq,
+            "records_appended": self.records_appended,
+            "fsyncs": self.fsyncs,
+            "last_fsync_age_s": round(
+                time.monotonic() - self.last_fsync_at, 3),
+            "pending": len(self._pending),
+        }

@@ -29,7 +29,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization as ser
-from ray_tpu._private.async_util import hold_task, spawn_tracked
+from ray_tpu._private.async_util import (
+    DecorrelatedJitterBackoff, hold_task, spawn_tracked)
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _Counter
 from ray_tpu._private.memory_store import MemoryStore
@@ -404,6 +405,10 @@ class Worker:
         self.store = make_store_client(reply["store_dir"])
         self._head_addr = reply["head_addr"]
         self.head = AsyncRpcClient()
+        # set while the head link is believed up; cleared by the watchdog
+        # during an outage so queued control calls (head_call) know to
+        # wait for the reconnect instead of spinning
+        self._head_reconnected = asyncio.Event()
         await self._connect_head()
         # every process (driver AND executor workers) must survive a head
         # restart — workers hit the head for actor resolution, pubsub,
@@ -441,6 +446,7 @@ class Worker:
         if self._actor_sub_started:
             await self.head.call("Subscribe", {"channels": ["actor"]},
                                  timeout=CONFIG.control_rpc_timeout_s)
+        self._head_reconnected.set()  # wake outage-queued control calls
 
     async def _head_watchdog_loop(self) -> None:
         """Driver survives a head restart (GCS fault tolerance): ping, and
@@ -465,11 +471,18 @@ class Worker:
             try:
                 await asyncio.wait_for(self.head.call("Ping", {}),
                                        timeout=CONFIG.head_ping_timeout_s)
+                # a queued head_call may have cleared the flag on a
+                # transient error the link already recovered from
+                self._head_reconnected.set()
                 continue
             except Exception:
                 if not self.connected:
                     return
-            delay = 0.2
+            # outage begins: queued control calls park until reconnect
+            self._head_reconnected.clear()
+            # decorrelated jitter so a cluster's worth of drivers/workers
+            # doesn't stampede the freshly restarted head in lockstep
+            backoff = DecorrelatedJitterBackoff(base_s=0.2, cap_s=2.0)
             while self.connected:
                 try:
                     await self.head.aclose()
@@ -479,8 +492,7 @@ class Worker:
                     await self._connect_head()
                     break
                 except Exception:
-                    await asyncio.sleep(delay)
-                    delay = min(delay * 2, 2.0)
+                    await asyncio.sleep(backoff.next_delay())
 
     def disconnect(self) -> None:
         if not self.connected:
@@ -543,6 +555,61 @@ class Worker:
     def _acall(self, coro, timeout: Optional[float] = None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
+
+    async def _head_call_async(self, method: str, payload: Dict,
+                               timeout: Optional[float] = None):
+        """Outage-tolerant head-bound control call: on a lost head
+        connection the call queues behind the watchdog's reconnect for up
+        to ``gcs_outage_queue_s`` (instead of failing instantly on a head
+        bounce), then fails fast with a typed
+        :class:`~ray_tpu.exceptions.HeadUnavailableError`. Server-side
+        errors and slow-reply timeouts propagate unchanged — only a DOWN
+        head queues. An explicit ``timeout`` bounds BOTH each RPC attempt
+        and the total time queued.
+
+        Delivery is at-least-once: when the head dies AFTER applying a
+        mutation but before the reply, the retry re-executes it against
+        the recovered head. Creates are deduped server-side by
+        client-generated actor id; idempotent ops (KvPut/KvGet/KillActor)
+        are safe by shape; but non-idempotent RESULTS (e.g. KvDel's
+        deleted-key count) may reflect the retry, not the first
+        delivery."""
+        from ray_tpu._private.protocol import ConnectionLost
+        from ray_tpu.exceptions import HeadUnavailableError
+
+        budget = float(CONFIG.gcs_outage_queue_s)
+        if timeout is not None:
+            # an explicit per-call timeout also caps the total queueing:
+            # `status` against a down head must answer in seconds, not
+            # ride out the full outage budget
+            budget = min(budget, float(timeout))
+        deadline = time.monotonic() + budget
+        rpc_timeout = timeout if timeout is not None \
+            else CONFIG.control_rpc_timeout_s
+        while True:
+            try:
+                return await self.head.call(method, payload,
+                                            timeout=rpc_timeout)
+            except (ConnectionLost, ConnectionError, OSError) as e:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self.connected:
+                    raise HeadUnavailableError(
+                        method=method, outage_s=budget) from e
+                # the watchdog may not have noticed yet: mark the link
+                # down ourselves, then wait for its reconnect signal
+                self._head_reconnected.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._head_reconnected.wait(),
+                        timeout=min(0.5, max(remaining, 0.05)))
+                except asyncio.TimeoutError:
+                    pass
+
+    def head_call(self, method: str, payload: Dict,
+                  timeout: Optional[float] = None):
+        """Sync facade of :meth:`_head_call_async` (main-thread callers)."""
+        return self._acall(self._head_call_async(method, payload,
+                                                 timeout=timeout))
 
     def _loop_call(self, fn, *args):
         self.loop.call_soon_threadsafe(fn, *args)
@@ -1464,19 +1531,16 @@ class Worker:
         # Track before the CreateActor RPC so a fast ActorReady event can't
         # race past the state registration.
         self._track_actor(actor_id, {"state": "PENDING_CREATION"})
-        reply = self._acall(
-            self.head.call(
-                "CreateActor",
-                {
-                    "actor_id": actor_id.hex(),
-                    "spec": spec_wire,
-                    "name": name,
-                    "namespace": namespace,
-                    "max_restarts": max_restarts,
-                    "get_if_exists": get_if_exists,
-                },
-                timeout=CONFIG.control_rpc_timeout_s,
-            )
+        reply = self.head_call(
+            "CreateActor",
+            {
+                "actor_id": actor_id.hex(),
+                "spec": spec_wire,
+                "name": name,
+                "namespace": namespace,
+                "max_restarts": max_restarts,
+                "get_if_exists": get_if_exists,
+            },
         )
         if reply.get("existing"):
             view = reply["existing"]
@@ -1581,17 +1645,14 @@ class Worker:
         return refs
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
-        self._acall(self.head.call(
-            "KillActor", {"actor_id": actor_id.hex(), "no_restart": no_restart},
-            timeout=CONFIG.control_rpc_timeout_s,
-        ))
+        self.head_call(
+            "KillActor",
+            {"actor_id": actor_id.hex(), "no_restart": no_restart})
 
     # --------------------------------------------------------------- helpers
     def get_named_actor(self, name: str, namespace: str = "default"):
-        view = self._acall(self.head.call(
-            "GetNamedActor", {"name": name, "namespace": namespace},
-            timeout=CONFIG.control_rpc_timeout_s,
-        ))
+        view = self.head_call(
+            "GetNamedActor", {"name": name, "namespace": namespace})
         if view is None or view.get("state") == "DEAD":
             raise ValueError(f"Failed to look up actor '{name}' in namespace "
                              f"'{namespace}'")

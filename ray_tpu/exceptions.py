@@ -277,6 +277,42 @@ def _rebuild_node_error(message, ctx_dict):
                          timeline=ctx.timeline)
 
 
+class HeadUnavailableError(RayTpuError):
+    """The head (GCS) stayed unreachable past the outage-queue budget.
+
+    Head-bound control calls (KV, actor resolution, job registration)
+    queue behind the watchdog's reconnect for up to
+    ``gcs_outage_queue_s`` during a head outage instead of failing on
+    the first lost connection; when the budget runs out they fail fast
+    with this typed error so callers can tell "the head is down" from a
+    task/actor failure and apply their own retry policy.
+    """
+
+    def __init__(self, message: str = "", method: str = "",
+                 outage_s: float = 0.0):
+        self.method = method
+        self.outage_s = float(outage_s)
+        if not message:
+            message = "head unreachable"
+            if method:
+                message += f" for control call {method!r}"
+            if self.outage_s:
+                message += f" after queueing {self.outage_s:.1f}s"
+        super().__init__(message)
+        self.message = message
+
+    def __reduce__(self):
+        # rebuild from the real fields (raylint R5): default pickling
+        # would hand the formatted message to `message` AND lose
+        # method/outage_s
+        return (_rebuild_head_unavailable,
+                (self.message, self.method, self.outage_s))
+
+
+def _rebuild_head_unavailable(message, method, outage_s):
+    return HeadUnavailableError(message, method, outage_s)
+
+
 class BackPressureError(RayTpuError):
     """The serving plane shed this request: every candidate replica's
     admission queue was full (``max_queued_requests``), or a batching

@@ -5,8 +5,6 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ray_tpu._private.config import CONFIG
-
 
 def _worker():
     from ray_tpu._private import worker as worker_mod
@@ -31,37 +29,36 @@ def _ns(namespace: Optional[bytes]) -> str:
 
 def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True,
                      namespace: Optional[bytes] = None) -> bool:
+    # head_call: outage-tolerant — queues behind the head watchdog's
+    # reconnect for up to gcs_outage_queue_s during a head bounce, then
+    # raises a typed HeadUnavailableError (same for every KV op below)
     w = _worker()
-    return w._acall(w.head.call("KvPut", {
+    return w.head_call("KvPut", {
         "ns": _ns(namespace), "key": key, "value": value,
-        "overwrite": overwrite}, timeout=CONFIG.control_rpc_timeout_s))
+        "overwrite": overwrite})
 
 
 def _internal_kv_get(key: bytes,
                      namespace: Optional[bytes] = None) -> Optional[bytes]:
     w = _worker()
-    out = w._acall(w.head.call("KvGet", {
-        "ns": _ns(namespace), "key": key}, timeout=CONFIG.control_rpc_timeout_s))
+    out = w.head_call("KvGet", {"ns": _ns(namespace), "key": key})
     return bytes(out) if out is not None else None
 
 
 def _internal_kv_del(key: bytes,
                      namespace: Optional[bytes] = None) -> int:
     w = _worker()
-    return w._acall(w.head.call("KvDel", {
-        "ns": _ns(namespace), "key": key}, timeout=CONFIG.control_rpc_timeout_s))
+    return w.head_call("KvDel", {"ns": _ns(namespace), "key": key})
 
 
 def _internal_kv_exists(key: bytes,
                         namespace: Optional[bytes] = None) -> bool:
     w = _worker()
-    return w._acall(w.head.call("KvExists", {
-        "ns": _ns(namespace), "key": key}, timeout=CONFIG.control_rpc_timeout_s))
+    return w.head_call("KvExists", {"ns": _ns(namespace), "key": key})
 
 
 def _internal_kv_list(prefix: bytes,
                       namespace: Optional[bytes] = None) -> List[bytes]:
     w = _worker()
-    keys = w._acall(w.head.call("KvKeys", {
-        "ns": _ns(namespace), "prefix": prefix}, timeout=CONFIG.control_rpc_timeout_s))
+    keys = w.head_call("KvKeys", {"ns": _ns(namespace), "prefix": prefix})
     return [bytes(k) for k in keys]

@@ -199,7 +199,45 @@ def cmd_status(args) -> int:
     for k in sorted(total):
         used = total[k] - avail.get(k, 0.0)
         print(f"  {used:g}/{total[k]:g} {k}")
+    _print_head_status()
     return 0
+
+
+def _print_head_status() -> None:
+    """Head-plane durability view: incarnation, uptime, WAL health, and
+    what the last recovery reconciled dead (ISSUE 8)."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        # explicit timeout caps the outage-queue budget too: status
+        # against a down head answers in ~3s, not gcs_outage_queue_s
+        st = w.head_call("GetHeadStatus", {}, timeout=3)
+    except Exception:
+        return  # older head without the RPC, or a head mid-bounce
+    print("\nHead plane")
+    print("-" * 40)
+    print(f"  incarnation {st.get('incarnation', 1)}   "
+          f"uptime {st.get('uptime_s', 0):.0f}s   "
+          f"persist {st.get('persist') or '(memory only)'}")
+    wal = st.get("wal")
+    if wal:
+        print(f"  WAL {wal['size_bytes']} B, seq {wal['seq']}, "
+              f"last fsync {wal['last_fsync_age_s']:.1f}s ago, "
+              f"{wal['fsyncs']} fsyncs")
+    rec = st.get("last_recovery") or {}
+    if rec:
+        status = "closed" if rec.get("completed") else "open"
+        print(f"  last recovery: restored {rec.get('restored_nodes', 0)} "
+              f"nodes / {rec.get('restored_actors', 0)} actors / "
+              f"{rec.get('restored_jobs', 0)} jobs; "
+              f"reconciled dead {rec.get('reconciled_dead', 0)} "
+              f"(window {status})")
+    recv = st.get("recovering") or {}
+    if any(recv.values()):
+        print(f"  still recovering: {recv.get('nodes', 0)} nodes, "
+              f"{recv.get('actors', 0)} actors, "
+              f"{recv.get('jobs', 0)} jobs")
 
 
 def cmd_list(args) -> int:

@@ -368,6 +368,224 @@ def bench_chaos(quick: bool = False) -> dict:
     return out
 
 
+def bench_head_chaos(quick: bool = False) -> dict:
+    """Durable-head-plane chaos (ISSUE 8; ROADMAP item 2): kill -9 the
+    GCS at random points while an actor workload, a KV write stream and a
+    serve deployment run. Asserts the WAL + recovery-reconciliation
+    contract: zero loss of live actors, every ACKED kv put readable
+    after the last restart, actor-table fidelity (all workers ALIVE, no
+    ghosts, nothing reconciled dead), and recovery time under budget."""
+    import os
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import HeadUnavailableError, RayTpuError
+    from ray_tpu.experimental import internal_kv
+    from ray_tpu.util.chaos import HeadKiller
+
+    persist = os.path.join(tempfile.mkdtemp(prefix="head_chaos_"),
+                           "head_state.bin")
+    env = {"RAY_TPU_GCS_PERSIST": persist,
+           "RAY_TPU_HEAD_WATCHDOG_PERIOD_S": "0.5",
+           "RAY_TPU_HEAD_PING_TIMEOUT_S": "2.0",
+           "RAY_TPU_GCS_RECOVERY_GRACE_S": "5.0",
+           "RAY_TPU_GCS_OUTAGE_QUEUE_S": "20.0"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    recovery_budget_s = 15.0
+    n_actors = 4 if quick else 8
+    kills = 2 if quick else 3
+    out = {"kills": kills, "actors": n_actors,
+           "recovery_budget_s": recovery_budget_s}
+    cluster = killer = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 4})
+        ray_tpu.init(_node=cluster.head_node)
+
+        @ray_tpu.remote(num_cpus=0.01)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def value(self):
+                return self.n
+
+        actors = [Counter.options(name=f"hc-{i}",
+                                  lifetime="detached").remote()
+                  for i in range(n_actors)]
+        ray_tpu.get([a.bump.remote() for a in actors], timeout=120)
+
+        @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+        class Echo:
+            def __call__(self, x):
+                return x
+
+        handle = serve.run(Echo.bind(), name="headchaos",
+                           route_prefix="/headchaos")
+        assert handle.remote(1).result(timeout_s=60) == 1
+
+        stop = threading.Event()
+        stats = {"bumps": [0] * n_actors, "kv_acked": 0,
+                 "serve_ok": 0, "serve_err": 0,
+                 "head_unavailable": 0, "workload_err": 0}
+        lock = threading.Lock()
+
+        def actor_client(i):
+            # direct worker connections: actor calls must keep completing
+            # THROUGH head outages, not merely recover afterwards
+            while not stop.is_set():
+                try:
+                    ray_tpu.get(actors[i].bump.remote(), timeout=60)
+                    with lock:
+                        stats["bumps"][i] += 1
+                except Exception:
+                    with lock:
+                        stats["workload_err"] += 1
+                stop.wait(0.05)
+
+        def kv_client():
+            k = 0
+            while not stop.is_set():
+                try:
+                    internal_kv._internal_kv_put(
+                        b"hc-%d" % k, b"v-%d" % k)
+                    with lock:
+                        stats["kv_acked"] += 1  # acked => must survive
+                    k += 1
+                except HeadUnavailableError:
+                    with lock:
+                        stats["head_unavailable"] += 1
+                except (RayTpuError, ConnectionError, TimeoutError):
+                    with lock:
+                        stats["workload_err"] += 1
+                stop.wait(0.05)
+
+        def serve_client():
+            j = 0
+            while not stop.is_set():
+                try:
+                    assert handle.remote(j).result(timeout_s=60) == j
+                    with lock:
+                        stats["serve_ok"] += 1
+                except Exception:
+                    with lock:
+                        stats["serve_err"] += 1
+                j += 1
+                stop.wait(0.05)
+
+        threads = [threading.Thread(target=actor_client, args=(i,))
+                   for i in range(n_actors)]
+        threads += [threading.Thread(target=kv_client),
+                    threading.Thread(target=serve_client)]
+        for t in threads:
+            t.start()
+
+        killer = HeadKiller(cluster, downtime_s=0.75, interval_s=4.0,
+                            max_kills=kills, seed=7, persist=persist)
+        killer.run()
+        deadline = time.perf_counter() + 120
+        while len(killer.kills) < kills and time.perf_counter() < deadline:
+            time.sleep(0.25)
+        kill_records = killer.stop()
+        t_rec0 = time.perf_counter()
+        stop.set()
+        for t in threads:
+            t.join(timeout=90)
+
+        # ---- recovery: every actor answers + KV serves reads again ----
+        recovered = None
+        while time.perf_counter() - t_rec0 < 120:
+            try:
+                vals = ray_tpu.get([a.value.remote() for a in actors],
+                                   timeout=30)
+                internal_kv._internal_kv_get(b"hc-0")
+                recovered = time.perf_counter() - t_rec0
+                break
+            except Exception:
+                time.sleep(0.25)
+        out["head_kills"] = kill_records
+        out["recovery_s"] = (round(recovered, 3)
+                             if recovered is not None else None)
+        out["recovery_under_budget"] = (recovered is not None
+                                        and recovered < recovery_budget_s)
+
+        # ---- zero actor loss + counter fidelity -----------------------
+        vals = ray_tpu.get([a.value.remote() for a in actors], timeout=60)
+        expected = [stats["bumps"][i] + 1 for i in range(n_actors)]
+        # an unacked bump may still have landed (kill between execute and
+        # reply): counters may exceed acked, never trail them
+        out["actor_counters_intact"] = all(
+            v >= e for v, e in zip(vals, expected))
+        out["actors_lost"] = sum(
+            1 for v, e in zip(vals, expected) if v < e)
+
+        # ---- KV fidelity: every ACKED put is readable ------------------
+        missing = 0
+        for k in range(stats["kv_acked"]):
+            if internal_kv._internal_kv_get(b"hc-%d" % k) != b"v-%d" % k:
+                missing += 1
+        out["kv_acked"] = stats["kv_acked"]
+        out["kv_lost"] = missing
+
+        # ---- actor-table fidelity + reconciliation verdict -------------
+        # the table re-converges when the agent's re-register claims the
+        # RECOVERING actors — time that as its own recovery metric
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        t_claim0 = time.perf_counter()
+        alive = 0
+        while time.perf_counter() - t_claim0 < 60:
+            views = {v["name"]: v for v in w.head_call("ListActors", {})}
+            alive = sum(1 for i in range(n_actors)
+                        if views.get(f"hc-{i}", {}).get("state") == "ALIVE")
+            if alive == n_actors:
+                break
+            time.sleep(0.25)
+        out["actor_table_alive"] = alive
+        out["table_reclaim_s"] = round(time.perf_counter() - t_rec0, 3)
+        status = w.head_call("GetHeadStatus", {})
+        out["head_incarnation"] = status["incarnation"]
+        out["wal"] = status["wal"]
+        out["reconciled_dead"] = (status.get("last_recovery") or {}).get(
+            "reconciled_dead", 0)
+        out["serve"] = {"ok": stats["serve_ok"], "err": stats["serve_err"]}
+        out["head_unavailable_typed"] = stats["head_unavailable"]
+        out["workload_err"] = stats["workload_err"]
+        out["pass"] = bool(
+            out["recovery_under_budget"]
+            and out["actors_lost"] == 0
+            and out["kv_lost"] == 0
+            and out["actor_table_alive"] == n_actors)
+        serve.delete("headchaos")
+        serve.shutdown()
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    return out
+
+
 def bench_serve_load(quick: bool = False) -> dict:
     """Serving-plane load phase (ISSUE 6; ROADMAP item 1): sustained
     multi-client RPS against a deployed app, tracked across rounds like
@@ -684,6 +902,22 @@ def main(quick: bool = False) -> dict:
         results["chaos"] = bench_chaos(quick)
     except Exception as e:  # noqa: BLE001
         results["chaos"] = {"error": f"{type(e).__name__}: {e}"}
+    # head-plane chaos phase (ISSUE 8): kill -9 the GCS mid-workload;
+    # written standalone too so the durability trajectory diffs across
+    # rounds like RAYPERF_rNN
+    try:
+        results["head_chaos"] = bench_head_chaos(quick)
+    except Exception as e:  # noqa: BLE001
+        results["head_chaos"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        import os
+
+        art = os.environ.get("RAY_TPU_HEADCHAOS_OUT",
+                             "HEAD_CHAOS_latest.json")
+        with open(art, "w") as f:
+            json.dump(results["head_chaos"], f, indent=2, sort_keys=True)
+    except Exception:
+        pass
     # serving-plane phase (own cluster + serve control plane, same
     # flake-isolation story); its result is ALSO written standalone so the
     # serving trajectory is diffable across rounds like RAYPERF_rNN

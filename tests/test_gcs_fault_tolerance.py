@@ -1,9 +1,16 @@
 """GCS (head) fault tolerance (reference:
 python/ray/tests/test_gcs_fault_tolerance.py — GCS restart with
-redis-backed state; here a file snapshot is the durable store and agents/
-drivers re-register through their watchdogs)."""
+redis-backed state; here a write-ahead-logged file store is the durable
+backend and agents/drivers re-register through their watchdogs).
+
+The WAL makes durability per-mutation: a mutating RPC is acked only
+after its record is fsynced, so these tests ``kill -9`` the head
+IMMEDIATELY after an acked put/actor-create — no "let the debounced
+snapshot flush" sleep (the pre-WAL race these tests used to paper over).
+"""
 
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -11,12 +18,18 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu.exceptions import HeadUnavailableError
 
 
 @pytest.fixture()
 def persistent_cluster(tmp_path, monkeypatch):
     persist = str(tmp_path / "head_state.bin")
     monkeypatch.setenv("RAY_TPU_GCS_PERSIST", persist)
+    # fast reconnects + a short claim window keep the recovery phases of
+    # these tests in seconds (daemons inherit the env via Cluster())
+    monkeypatch.setenv("RAY_TPU_HEAD_WATCHDOG_PERIOD_S", "0.5")
+    monkeypatch.setenv("RAY_TPU_HEAD_PING_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("RAY_TPU_GCS_RECOVERY_GRACE_S", "6.0")
     from ray_tpu.cluster_utils import Cluster
 
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
@@ -27,7 +40,9 @@ def persistent_cluster(tmp_path, monkeypatch):
 
 
 def _restart_head(node, persist: str) -> None:
-    node.head_proc.kill()
+    from ray_tpu._private import lifecycle
+
+    node.head_proc.kill()  # SIGKILL: no flush, no atexit, no mercy
     node.head_proc.wait()
     log = open(os.path.join(node.session_dir, "logs", "head2.log"), "ab")
     env = dict(os.environ, RAY_TPU_GCS_PERSIST=persist)
@@ -37,13 +52,29 @@ def _restart_head(node, persist: str) -> None:
          "--port", str(node.head_port)],
         stdout=log, stderr=log, env=env,
         start_new_session=True)  # node.stop() killpg must not hit us
+    # spawner-side pid-registry entry: node.stop()'s sweep must reap the
+    # replacement head even if it dies before its own register_self runs
+    # (intermittent leaked-session teardown ERROR otherwise)
+    lifecycle.register_process(node.session_dir, "gcs", node.head_proc.pid)
+
+
+def _await_kv(key: bytes, value: bytes, timeout: float = 30) -> bool:
+    from ray_tpu.experimental import internal_kv
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if internal_kv._internal_kv_get(key) == value:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.5)
+    return False
 
 
 def test_head_restart_preserves_state_and_recovers(persistent_cluster):
     cluster, persist = persistent_cluster
     from ray_tpu.experimental import internal_kv
-
-    internal_kv._internal_kv_put(b"durable_key", b"durable_value")
 
     @ray_tpu.remote
     class Keeper:
@@ -56,25 +87,16 @@ def test_head_restart_preserves_state_and_recovers(persistent_cluster):
 
     keeper = Keeper.options(name="keeper", lifetime="detached").remote()
     assert ray_tpu.get(keeper.bump.remote(), timeout=60) == 1
-    time.sleep(0.3)  # let the debounced snapshot flush
 
+    # kill -9 IMMEDIATELY after the acked put: the WAL ack contract means
+    # an acknowledged mutation is already durable — no flush sleep
+    internal_kv._internal_kv_put(b"durable_key", b"durable_value")
     _restart_head(cluster.head_node, persist)
-    # wait for agent + driver watchdogs to reconnect to the new head
-    deadline = time.monotonic() + 30
-    recovered = False
-    while time.monotonic() < deadline:
-        try:
-            if internal_kv._internal_kv_get(b"durable_key") == \
-                    b"durable_value":
-                recovered = True
-                break
-        except Exception:
-            pass
-        time.sleep(0.5)
-    assert recovered, "KV not readable after head restart"
+    assert _await_kv(b"durable_key", b"durable_value"), \
+        "KV not readable after head restart"
 
     # named detached actor survives: the restored actor table still routes
-    # to the live actor process
+    # to the live actor process once the agent's re-register claims it
     handle = None
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
@@ -100,3 +122,180 @@ def test_head_restart_preserves_state_and_recovers(persistent_cluster):
             if time.monotonic() > deadline:
                 raise
             time.sleep(1.0)
+
+    # SECOND immediate kill: an acked actor-create with no snapshot flush
+    # in between must survive through the WAL alone
+    @ray_tpu.remote
+    class Second:
+        def ping(self):
+            return "pong"
+
+    second = Second.options(name="second", lifetime="detached").remote()
+    assert ray_tpu.get(second.ping.remote(), timeout=60) == "pong"
+    internal_kv._internal_kv_put(b"second_key", b"second_value")
+    _restart_head(cluster.head_node, persist)
+    assert _await_kv(b"second_key", b"second_value")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            h2 = ray_tpu.get_actor("second")
+            assert ray_tpu.get(h2.ping.remote(), timeout=30) == "pong"
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("actor created pre-kill lost by restart")
+
+    # operator view: the head knows how many lives it has had and that
+    # its WAL is alive (CLI `status` surfaces exactly this)
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    status = w.head_call("GetHeadStatus", {})
+    assert status["incarnation"] == 3  # boot + two recoveries
+    assert status["wal"] is not None and status["wal"]["seq"] > 0
+    assert status["last_recovery"]["restored_actors"] >= 1
+
+
+def test_unclaimed_actor_reconciled_dead(persistent_cluster):
+    """An actor whose worker dies DURING the head outage: the restored
+    table says ALIVE, the re-registering agent's live set says otherwise
+    — reconciliation must declare it dead with the exact outage reason,
+    not leave a ghost routing to a dead pid."""
+    cluster, persist = persistent_cluster
+
+    @ray_tpu.remote
+    class Doomed:
+        def pid(self):
+            return os.getpid()
+
+    doomed = Doomed.options(name="doomed", lifetime="detached").remote()
+    victim_pid = ray_tpu.get(doomed.pid.remote(), timeout=60)
+
+    # head dies first (so it can never observe the worker death), then
+    # the worker: the ONLY way the cluster can learn the truth is the
+    # recovery reconciliation against the agent's reported live set
+    cluster.head_node.head_proc.kill()
+    cluster.head_node.head_proc.wait()
+    os.kill(victim_pid, signal.SIGKILL)
+    time.sleep(0.5)  # let the agent reap the worker before it re-registers
+    _restart_head(cluster.head_node, persist)
+
+    deadline = time.monotonic() + 60
+    view = None
+    while time.monotonic() < deadline:
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            views = w.head_call("ListActors", {})
+            view = next(v for v in views
+                        if v["name"] == "doomed")
+            if view["state"] == "DEAD":
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert view is not None and view["state"] == "DEAD", view
+    assert view["death_context"]["reason"] == "lost_during_head_outage", view
+    with pytest.raises(Exception):
+        ray_tpu.get_actor("doomed")  # the name is released, no ghost
+
+
+def test_outage_queue_then_typed_error(persistent_cluster, monkeypatch):
+    """Head-bound control calls during an outage: queue briefly (a head
+    bounce is survivable), then fail FAST with the typed error — not a
+    generic ConnectionLost, not a 60 s RPC deadline."""
+    cluster, persist = persistent_cluster
+    from ray_tpu.experimental import internal_kv
+
+    internal_kv._internal_kv_put(b"pre", b"1")  # link warm + durable
+    monkeypatch.setenv("RAY_TPU_GCS_OUTAGE_QUEUE_S", "2.0")
+    cluster.head_node.head_proc.kill()
+    cluster.head_node.head_proc.wait()
+    t0 = time.monotonic()
+    with pytest.raises(HeadUnavailableError) as err:
+        # retried internally against the dead head until the 2 s budget
+        # lapses; worst case adds one in-flight RPC timeout on top
+        internal_kv._internal_kv_put(b"during_outage", b"x")
+    took = time.monotonic() - t0
+    assert took < 30, f"typed failure took {took:.1f}s"
+    assert err.value.method == "KvPut"
+    # the head comes back: the SAME call path works again, and nothing
+    # acked before the outage was lost
+    _restart_head(cluster.head_node, persist)
+    assert _await_kv(b"pre", b"1")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            internal_kv._internal_kv_put(b"after", b"2")
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert _await_kv(b"after", b"2", timeout=10)
+
+
+def test_duplicate_create_actor_is_idempotent(tmp_path):
+    """An ambiguous CreateActor (mutation durable, reply lost to a head
+    kill) is retried by the outage-tolerant head_call: the head must
+    adopt the duplicate (actor ids are client-generated, same id ==
+    same logical create) — not raise 'name already taken' for a create
+    that succeeded, and not schedule a second copy."""
+    import asyncio
+
+    from ray_tpu._private.gcs import HeadServer
+
+    class _Conn:
+        closed = False
+
+        def __init__(self):
+            self.meta = {"job_id": "j"}
+
+    async def main():
+        head = HeadServer(str(tmp_path), 0, persist_path=None)
+        conn = _Conn()
+        p = {"actor_id": "abc", "spec": {"class_name": "C"},
+             "name": "dupname", "namespace": "default", "max_restarts": 0}
+        r1 = await head._create_actor(conn, p)
+        r2 = await head._create_actor(conn, p)  # retry after lost ack
+        assert r2["actor_id"] == r1["actor_id"] == "abc"
+        assert len(head.actors) == 1
+        assert head.named_actors[("default", "dupname")] == "abc"
+        assert head.actors["abc"].owner_conn is conn
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# decorrelated-jitter backoff (unit): the reconnect pacing the agent and
+# driver watchdogs use after a head bounce
+# ---------------------------------------------------------------------------
+def test_decorrelated_jitter_backoff_sequence():
+    import random
+
+    from ray_tpu._private.async_util import DecorrelatedJitterBackoff
+
+    b = DecorrelatedJitterBackoff(base_s=0.2, cap_s=2.0,
+                                  rng=random.Random(42))
+    prev = 0.2
+    seq = []
+    for _ in range(50):
+        d = b.next_delay()
+        seq.append(d)
+        assert 0.2 <= d <= 2.0
+        assert d <= max(prev * 3, 0.2 * 3) + 1e-9  # decorrelated bound
+        prev = d
+    # jittered: not a fixed doubling grid, and not constant
+    assert len({round(d, 6) for d in seq}) > 10
+    assert max(seq) == 2.0  # reaches the cap under sustained outage
+    b.reset()
+    assert b.next_delay() <= 0.6  # back to base pacing after reconnect
+
+
+def test_decorrelated_jitter_distinct_across_instances():
+    """Two clients must not share a schedule — that IS the herd."""
+    from ray_tpu._private.async_util import DecorrelatedJitterBackoff
+
+    a = [DecorrelatedJitterBackoff(0.2, 2.0).next_delay() for _ in range(8)]
+    b = [DecorrelatedJitterBackoff(0.2, 2.0).next_delay() for _ in range(8)]
+    assert a != b

@@ -137,15 +137,24 @@ class TestFileStore:
 
         head = HeadServer.__new__(HeadServer)
         head.store = FileStoreClient(str(path))
+        head.wal = None  # legacy snapshots predate the WAL
         head.kv = {}
         head.jobs = {}
         head.named_actors = {}
         head.placement_groups = {}
         head._pg_counter = 0
         head.actors = {}
+        head.nodes = {}
+        head.fenced_incarnations = {}
+        head.head_incarnation = 1
+        head.recovering_nodes = set()
+        head.recovering_actors = set()
+        head.recovering_jobs = set()
+        head.last_recovery = {}
         head._load_state()
         assert head.kv == {"ns": {b"k": b"v"}}
         assert head._pg_counter == 3
+        assert head.head_incarnation == 2  # restored state counts a life
 
 
 class TestUriSelection:
