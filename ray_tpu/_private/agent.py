@@ -200,6 +200,8 @@ class NodeAgent:
         # serve-side view cache: see _fetch_object_chunk
         self._serve_view_cache: "OrderedDict[str, list]" = OrderedDict()
         self.pulls = PullManager(self)
+        # zero-copy array puts sealed on this node (device object plane)
+        self._zero_copy_puts = 0
 
         # placement groups: (pg_id, bundle_index) -> reserved ResourceSet
         self._pg_bundles: Dict[Tuple[str, int], ResourceSet] = {}
@@ -479,6 +481,8 @@ class NodeAgent:
         addr = payload.get("addr") or {}
         if addr.get("host") is not None and addr.get("port") is not None:
             self.pulls.on_peer_removed(addr)  # drops ctrl+data channels
+            # a dead peer is no longer a remote-tier restore source
+            self.store.forget_remote_source(addr)
 
     async def _resource_report_loop(self) -> None:
         """Versioned delta gossip (reference: ray_syncer.h:88 — versioned
@@ -1390,6 +1394,8 @@ class NodeAgent:
     async def _object_sealed(self, conn: Connection, p: Dict) -> None:
         hex_id = p["object_id"]
         self.store.on_sealed(hex_id, p["size"])
+        if p.get("zero_copy"):
+            self._zero_copy_puts += 1
         for fut in self._object_waits.pop(hex_id, []):
             if not fut.done():
                 fut.set_result(True)
@@ -1558,9 +1564,14 @@ class NodeAgent:
                     a for a in loc.get("locations", [])
                     if not (a.get("host") == "127.0.0.1"
                             and a.get("port") == self.tcp_port)]
+                if not remote_locs:
+                    # remote-tier spill: this node dropped its local copy
+                    # against recorded remote holders — those are a valid
+                    # restore source even when the owner only lists us
+                    remote_locs = self.store.remote_sources_for(hex_id)
                 st = "absent"
                 if remote_locs:
-                    st = await self.pulls.fetch(hex_id, remote_locs)
+                    st = await self._fetch_routed(hex_id, remote_locs)
                 if st == "ok":
                     self._notify_sealed(hex_id)
                     # Tell the owner we now hold a copy.
@@ -1607,12 +1618,45 @@ class NodeAgent:
             if not fut.done():
                 fut.set_result(True)
 
+    async def _fetch_routed(self, hex_id: str, holders: List[Dict]) -> str:
+        """Route one pull: the spanning broadcast tree for large objects
+        (K consumers of the same object share O(log N) distribution via
+        chunk-level relay) with transparent degradation to the plain
+        multi-holder striped pull — the tree is an optimization layer,
+        never a new failure mode."""
+        from ray_tpu._private import broadcast
+
+        size, alive, any_absent = await self.pulls._probe_meta(
+            hex_id, holders)
+        if size is None:
+            return "absent" if any_absent else "conn"
+        meta = (size, alive, any_absent)
+        if not (CONFIG.bcast_enabled and size >= CONFIG.bcast_min_bytes):
+            return await self.pulls.fetch(hex_id, alive, meta=meta)
+        progress = self.pulls.register_progress(hex_id, size)
+        try:
+            st = await broadcast.bcast_fetch(self, hex_id, size, alive,
+                                             progress)
+            if st == "fallback":
+                # keep the SAME progress registered: children this node
+                # was assigned relay off the striped pull just the same
+                st = await self.pulls.fetch(hex_id, alive, meta=meta,
+                                            progress=progress)
+            return st
+        finally:
+            self.pulls.unregister_progress(hex_id, progress)
+
     async def _fetch_object_meta(self, conn: Connection, p: Dict) -> Dict:
         hex_id = p["object_id"]
         view = self.store.read_maybe_spilled(hex_id)
-        if view is None:
-            return {"exists": False}
-        return {"exists": True, "size": len(view)}
+        if view is not None:
+            return {"exists": True, "size": len(view)}
+        # mid-pull relay source: a broadcast child probing its assigned
+        # parent must see the advertised size, not an absent verdict
+        prog = self.pulls.active.get(hex_id)
+        if prog is not None and not prog.failed:
+            return {"exists": True, "size": prog.size, "partial": True}
+        return {"exists": False}
 
     async def _fetch_object_chunk(self, conn: Connection, p: Dict):
         hex_id = p["object_id"]
@@ -1628,7 +1672,10 @@ class NodeAgent:
         if entry is None:
             view = self.store.read_maybe_spilled(hex_id)
             if view is None:
-                return None
+                # broadcast relay: the object may be mid-pull on this
+                # node — serve ranges that have already arrived
+                return await self._serve_relay_chunk(
+                    hex_id, p["offset"], p["length"])
             cache[hex_id] = [view, time.monotonic()]
             # cap must exceed the batched-get fan-in (8 concurrent
             # transfers from one holder is the common burst) or the LRU
@@ -1641,13 +1688,72 @@ class NodeAgent:
             cache.move_to_end(hex_id)
         off, length = p["offset"], p["length"]
         self._chunks_served = getattr(self, "_chunks_served", 0) + 1
+        await self._serve_throttle(length)
         # RawData: header + raw writer.write of the store view slice — no
-        # bytes() materialization, no msgpack re-pack of the payload
+        # bytes() materialization, no msgpack re-pack of the payload.
+        # raylint: disable=R9 -- the serve-view cache entry above IS the
+        # pin: it holds the (natively pinned) view until the TTL purge,
+        # which outlives the reply write by construction
         return RawData(view[off : off + length])
+
+    async def _serve_throttle(self, length: int) -> None:
+        """Per-node upload-bandwidth cap for bulk chunk serving
+        (``object_serve_bandwidth_bytes_ps``): a virtual-clock token
+        bucket — each served byte advances the node's serve clock, and a
+        request sleeps until its slot. Serialized per node (not per
+        connection), so a broadcast root's fanout shares one simulated
+        uplink the way a real NIC would."""
+        bw = CONFIG.object_serve_bandwidth_bytes_ps
+        if not bw or length <= 0:
+            return
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        clock = max(getattr(self, "_serve_clock", now), now)
+        self._serve_clock = clock + length / bw
+        if clock > now:
+            await asyncio.sleep(clock - now)
+
+    async def _serve_relay_chunk(self, hex_id: str, off: int, length: int):
+        """Serve a chunk out of an in-flight pull's unsealed view — the
+        broadcast-tree relay: interior nodes forward ranges while still
+        receiving the rest. Waits (bounded) for the range to arrive,
+        which also carries a child across this node's own admission
+        delay. The bytes are copied out of the unsealed view (chunk-
+        sized, one memcpy): its mmap's lifetime belongs to the transfer,
+        and an abort must never invalidate a reply mid-write."""
+        prog = self.pulls.active.get(hex_id)
+        if prog is not None:
+            ok = await prog.wait_covered(
+                off, length, CONFIG.bcast_chunk_wait_s)
+            if ok and prog.view is not None:
+                self.pulls.bcast_relay_chunks += 1
+                self.pulls.bcast_relay_bytes += length
+                # copy BEFORE the bandwidth throttle sleeps: an abort
+                # during the sleep nulls prog.view
+                payload = bytes(prog.view[off : off + length])
+                await self._serve_throttle(length)
+                return RawData(payload)
+        # the transfer may have sealed-and-unregistered while we waited:
+        # the store is now the source of truth. The cache entry is the
+        # escaping view's pin (same contract as the main serve path,
+        # including its size cap and the bandwidth throttle).
+        view = self.store.read_maybe_spilled(hex_id)
+        if view is not None:
+            cache = self._serve_view_cache
+            cache[hex_id] = [view, time.monotonic()]
+            while len(cache) > 16:
+                cache.popitem(last=False)
+            await self._serve_throttle(length)
+            # raylint: disable=R9 -- pinned by the cache entry just
+            # inserted (same contract as _fetch_object_chunk)
+            return RawData(view[off : off + length])
+        return None
 
     async def _get_pull_stats(self, conn: Connection, p) -> Dict:
         stats = self.pulls.stats()
         stats["chunks_served"] = getattr(self, "_chunks_served", 0)
+        stats["zero_copy_puts"] = self._zero_copy_puts
+        stats["spill"] = self.store.tier_stats()
         return stats
 
     async def _free_objects(self, conn: Connection, p: Dict) -> None:
@@ -1861,6 +1967,28 @@ class NodeAgent:
                     counter("ray_tpu_object_pull_stripe_failovers_total",
                             "Chunk stripes failed over to another holder.",
                             self.pulls.stripe_failovers),
+                    # device object plane (ISSUE 9): zero-copy puts,
+                    # broadcast-tree shape + relay volume, spill tiers
+                    counter("ray_tpu_store_zero_copy_puts",
+                            "Typed array objects sealed without a "
+                            "pickle pass.",
+                            self._zero_copy_puts),
+                    gauge("ray_tpu_bcast_tree_depth",
+                          "Depth of this node's latest broadcast-tree "
+                          "slot.",
+                          self.pulls.bcast_last_depth),
+                    counter("ray_tpu_bcast_relay_bytes",
+                            "Bytes relayed to children from unsealed "
+                            "in-flight views.",
+                            self.pulls.bcast_relay_bytes),
+                    counter("ray_tpu_bcast_reparents_total",
+                            "Dead broadcast parents this node reported.",
+                            self.pulls.bcast_reparents_client),
+                    counter("ray_tpu_object_spill_remote_total",
+                            "Objects demoted to the remote-holder spill "
+                            "tier.",
+                            getattr(self.store, "num_remote_demotions",
+                                    0)),
                     gauge("ray_tpu_object_waits_pending",
                           "Local seal-wait futures outstanding.",
                           sum(len(v) for v in self._object_waits.values())),

@@ -1,0 +1,455 @@
+"""Spanning broadcast trees over the per-peer data channels (ISSUE 9).
+
+When K consumers pull the same large object, N serial point-to-point
+pulls cost the root N full uploads. This module arranges the consumers
+into a spanning tree (reference: the object manager's push path,
+``object_manager.h`` — here coordinated by the head instead of gossip):
+
+- ``BcastTreeRegistry`` (head-side, in-memory): assigns each joining
+  consumer a parent — the shallowest live node with spare fanout — so
+  tree depth is O(log_fanout N) and no node uploads more than ``fanout``
+  copies. On a node-death verdict (the PR 5 machinery) or a consumer-
+  reported dead parent, a dead interior node's children re-parent to its
+  closest live ancestor (ultimately a root holder).
+- ``TransferProgress`` (agent-side): byte-interval tracking of an
+  in-flight pull so an interior node can RELAY chunks it has already
+  received while still receiving the rest — children stream behind their
+  parent at chunk granularity instead of waiting for the full object.
+- ``bcast_fetch`` (agent-side): the consumer loop — join, pull from the
+  assigned parent, re-parent on failure, fall back to the plain striped
+  pull if the head or the tree is unavailable. Broadcast is an
+  optimization layer: every failure mode degrades to the PR 3 pull
+  plane, never to a hang.
+
+Registry state is deliberately not WAL-durable: it describes transfers
+in flight, and a head restart simply starts fresh trees (consumers fall
+back to direct pulls mid-outage).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+
+
+def addr_key(addr: Dict) -> str:
+    return f"{addr.get('host')}:{addr.get('port')}"
+
+
+# ---------------------------------------------------------------------------
+# agent-side: in-flight transfer progress (chunk-level relay)
+# ---------------------------------------------------------------------------
+class TransferProgress:
+    """Byte intervals of one in-flight pull, awaitable by relay serves.
+
+    Registered in ``PullManager.active`` the moment a node decides to
+    pull (before the transfer is admitted), so a child assigned to this
+    node parks on ``wait_covered`` through the parent's own admission
+    delay. ``reset`` re-arms it when a retry allocates a fresh store
+    view (marks from an aborted attempt describe memory that no longer
+    exists).
+    """
+
+    def __init__(self, hex_id: str, size: int):
+        self.hex_id = hex_id
+        self.size = size
+        self.view: Optional[memoryview] = None
+        self.failed = False
+        self._intervals: List[List[int]] = []  # merged, sorted [start, end)
+        self._waiters: List[Tuple[int, int, asyncio.Future]] = []
+
+    # -- write side (the pulling stripes) -----------------------------------
+    def reset(self, view: memoryview) -> None:
+        self.view = view
+        self.failed = False
+        self._intervals = []
+
+    def mark(self, off: int, length: int) -> None:
+        if length <= 0:
+            return
+        start, end = off, off + length
+        iv = self._intervals
+        i = 0
+        while i < len(iv) and iv[i][1] < start:
+            i += 1
+        j = i
+        while j < len(iv) and iv[j][0] <= end:
+            start = min(start, iv[j][0])
+            end = max(end, iv[j][1])
+            j += 1
+        iv[i:j] = [[start, end]]
+        self._wake()
+
+    def fail(self) -> None:
+        """Transfer over (aborted, or sealed-and-unregistered): wake every
+        waiter; each re-checks the store before giving up."""
+        self.failed = True
+        self.view = None
+        self._wake()
+
+    # -- read side (relay serves) -------------------------------------------
+    def covered(self, off: int, length: int) -> bool:
+        end = min(off + length, self.size)
+        if end <= off:
+            return True
+        for start, stop in self._intervals:  # merged + sorted: the only
+            if start > off:                  # candidate is the one
+                return False                 # containing `off`
+            if stop >= end:
+                return True
+        return False
+
+    async def wait_covered(self, off: int, length: int,
+                           timeout: float) -> bool:
+        if self.covered(off, length) and self.view is not None:
+            return True
+        if self.failed:
+            return False
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((off, min(off + length, self.size), fut))
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            self._waiters = [w for w in self._waiters if w[2] is not fut]
+        return self.covered(off, length) and self.view is not None
+
+    def _wake(self) -> None:
+        for off, end, fut in self._waiters:
+            if fut.done():
+                continue
+            if self.failed or (self.covered(off, end - off)
+                               and self.view is not None):
+                fut.set_result(True)
+
+    def stats(self) -> Dict:
+        done = sum(e - s for s, e in self._intervals)
+        return {"size": self.size, "bytes_done": done,
+                "waiters": len(self._waiters), "failed": self.failed}
+
+
+# ---------------------------------------------------------------------------
+# head-side: tree registry
+# ---------------------------------------------------------------------------
+class _TreeNode:
+    __slots__ = ("key", "addr", "parent", "children", "state", "depth",
+                 "seq")
+
+    def __init__(self, key: str, addr: Dict, parent: Optional[str],
+                 state: str, depth: int, seq: int):
+        self.key = key
+        self.addr = dict(addr)
+        self.parent = parent          # parent key, None for roots
+        self.children: set = set()    # child keys
+        self.state = state            # 'root' | 'joining' | 'ready' | 'dead'
+        self.depth = depth
+        self.seq = seq
+
+
+class _Tree:
+    __slots__ = ("object_id", "size", "nodes", "last_touch", "joins",
+                 "reparents", "seq")
+
+    def __init__(self, object_id: str, size: int):
+        self.object_id = object_id
+        self.size = size
+        self.nodes: Dict[str, _TreeNode] = {}
+        self.last_touch = time.monotonic()
+        self.joins = 0
+        self.reparents = 0
+        self.seq = 0
+
+
+class BcastTreeRegistry:
+    """Head-owned assignment of consumers into per-object spanning trees.
+
+    Pure in-memory bookkeeping on the head loop (single-threaded); every
+    reply is advisory — a consumer that cannot reach its parent comes
+    back with ``reparent`` and the registry converges around the death.
+    """
+
+    def __init__(self):
+        self.trees: Dict[str, _Tree] = {}
+        self.joins_total = 0
+        self.reparents_total = 0
+
+    # -- public API (one RPC handler each) ----------------------------------
+    def join(self, object_id: str, size: int, addr: Dict,
+             roots: List[Dict]) -> Dict:
+        self._gc()
+        tree = self.trees.get(object_id)
+        if tree is None:
+            tree = self.trees[object_id] = _Tree(object_id, size)
+        tree.last_touch = time.monotonic()
+        for root in roots or []:
+            rk = addr_key(root)
+            node = tree.nodes.get(rk)
+            if node is None:
+                tree.seq += 1
+                tree.nodes[rk] = _TreeNode(rk, root, None, "root", 0,
+                                           tree.seq)
+            elif node.state == "dead":
+                pass  # a dead root stays dead until re-advertised alive
+        key = addr_key(addr)
+        existing = tree.nodes.get(key)
+        if existing is not None and existing.state != "dead":
+            # idempotent re-join (retried RPC): same slot, parent healed
+            # if necessary
+            if existing.parent is not None:
+                parent = tree.nodes.get(existing.parent)
+                if parent is None or parent.state == "dead":
+                    return self._reattach(tree, existing)
+            return self._slot_reply(tree, existing)
+        parent = self._pick_parent(tree, exclude=key)
+        if parent is None:
+            return {"fallback": "no live holder in tree"}
+        tree.seq += 1
+        tree.joins += 1
+        self.joins_total += 1
+        node = _TreeNode(key, addr, parent.key, "joining",
+                         parent.depth + 1, tree.seq)
+        if existing is not None:      # dead slot being re-taken
+            tree.nodes.pop(key, None)
+        tree.nodes[key] = node
+        parent.children.add(key)
+        return self._slot_reply(tree, node)
+
+    def ready(self, object_id: str, addr: Dict) -> Dict:
+        tree = self.trees.get(object_id)
+        if tree is None:
+            return {"ok": False}
+        tree.last_touch = time.monotonic()
+        node = tree.nodes.get(addr_key(addr))
+        if node is not None and node.state == "joining":
+            node.state = "ready"
+        return {"ok": True}
+
+    def reparent(self, object_id: str, addr: Dict,
+                 dead_addr: Dict) -> Dict:
+        """Consumer ``addr`` observed its parent ``dead_addr`` failing:
+        mark it dead, hoist its children to the closest live ancestor,
+        and hand the caller its new slot."""
+        tree = self.trees.get(object_id)
+        if tree is None:
+            return {"fallback": "tree expired"}
+        tree.last_touch = time.monotonic()
+        self._mark_dead(tree, addr_key(dead_addr))
+        node = tree.nodes.get(addr_key(addr))
+        if node is None or node.state == "dead":
+            return self.join(object_id, tree.size, addr, [])
+        tree.reparents += 1
+        self.reparents_total += 1
+        return self._reattach(tree, node)
+
+    def on_node_removed(self, addr: Dict) -> None:
+        """Cluster-level death verdict: fail the node out of every tree
+        NOW so joiners stop being routed to it (its children re-parent
+        proactively instead of waiting out chunk timeouts)."""
+        key = addr_key(addr)
+        for tree in self.trees.values():
+            if key in tree.nodes:
+                self._mark_dead(tree, key)
+
+    def stats(self, object_id: Optional[str] = None) -> Dict:
+        def one(tree: _Tree) -> Dict:
+            states: Dict[str, int] = {}
+            for n in tree.nodes.values():
+                states[n.state] = states.get(n.state, 0) + 1
+            return {
+                "size": tree.size,
+                "nodes": len(tree.nodes),
+                "states": states,
+                "depth_max": max(
+                    (n.depth for n in tree.nodes.values()
+                     if n.state != "dead"), default=0),
+                "joins": tree.joins,
+                "reparents": tree.reparents,
+                "edges": {k: sorted(n.children)
+                          for k, n in tree.nodes.items() if n.children},
+            }
+
+        if object_id is not None:
+            tree = self.trees.get(object_id)
+            return one(tree) if tree else {}
+        return {
+            "trees": len(self.trees),
+            "joins_total": self.joins_total,
+            "reparents_total": self.reparents_total,
+            "objects": {oid: one(t) for oid, t in self.trees.items()},
+        }
+
+    # -- internals -----------------------------------------------------------
+    def _slot_reply(self, tree: _Tree, node: _TreeNode) -> Dict:
+        parent = tree.nodes.get(node.parent) if node.parent else None
+        if parent is None:
+            return {"fallback": "no live holder in tree"}
+        return {"parent": dict(parent.addr), "depth": node.depth,
+                "parent_state": parent.state}
+
+    def _pick_parent(self, tree: _Tree,
+                     exclude: Optional[str] = None) -> Optional[_TreeNode]:
+        """Shallowest live node with spare fanout; FIFO (seq) among
+        equals so early joiners fill before late ones."""
+        fanout = max(1, CONFIG.bcast_fanout)
+        best = None
+        for n in tree.nodes.values():
+            if n.state == "dead" or n.key == exclude:
+                continue
+            if len(n.children) >= fanout:
+                continue
+            if best is None or (n.depth, len(n.children), n.seq) < (
+                    best.depth, len(best.children), best.seq):
+                best = n
+        return best
+
+    def _live_ancestor(self, tree: _Tree,
+                       node: _TreeNode) -> Optional[_TreeNode]:
+        seen = set()
+        cur = node.parent
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            anc = tree.nodes.get(cur)
+            if anc is None:
+                return None
+            if anc.state != "dead":
+                return anc
+            cur = anc.parent
+        return None
+
+    def _mark_dead(self, tree: _Tree, key: str) -> None:
+        node = tree.nodes.get(key)
+        if node is None or node.state == "dead":
+            return
+        node.state = "dead"
+        parent = tree.nodes.get(node.parent) if node.parent else None
+        if parent is not None:
+            parent.children.discard(key)
+        # hoist the orphaned subtree roots to their closest live
+        # ancestor (may exceed fanout transiently — bounded by deaths,
+        # and a better slot is found at the next natural re-join)
+        for child_key in sorted(node.children):
+            child = tree.nodes.get(child_key)
+            if child is None or child.state == "dead":
+                continue
+            anc = self._live_ancestor(tree, child)
+            if anc is None:
+                child.parent = None  # next touch falls back / re-joins
+                continue
+            child.parent = anc.key
+            anc.children.add(child_key)
+            self._redepth(tree, child, anc.depth + 1)
+        node.children = set()
+
+    def _redepth(self, tree: _Tree, node: _TreeNode, depth: int) -> None:
+        node.depth = depth
+        stack = [node]
+        seen = {node.key}
+        while stack:
+            cur = stack.pop()
+            for ck in cur.children:
+                child = tree.nodes.get(ck)
+                if child is None or ck in seen:
+                    continue
+                seen.add(ck)
+                child.depth = cur.depth + 1
+                stack.append(child)
+
+    def _reattach(self, tree: _Tree, node: _TreeNode) -> Dict:
+        parent = None
+        if node.parent is not None:
+            anc = tree.nodes.get(node.parent)
+            if anc is not None and anc.state != "dead":
+                parent = anc
+        if parent is None:
+            parent = self._pick_parent(tree, exclude=node.key)
+        if parent is None:
+            return {"fallback": "no live holder in tree"}
+        # guard: never attach under our own subtree (possible when the
+        # picker chose a descendant after heavy churn)
+        probe, seen = parent, set()
+        while probe is not None and probe.key not in seen:
+            if probe.key == node.key:
+                return {"fallback": "no acyclic slot"}
+            seen.add(probe.key)
+            probe = tree.nodes.get(probe.parent) if probe.parent else None
+        old = tree.nodes.get(node.parent) if node.parent else None
+        if old is not None:
+            old.children.discard(node.key)
+        node.parent = parent.key
+        parent.children.add(node.key)
+        self._redepth(tree, node, parent.depth + 1)
+        return self._slot_reply(tree, node)
+
+    def _gc(self) -> None:
+        ttl = CONFIG.bcast_tree_ttl_s
+        now = time.monotonic()
+        for oid in [oid for oid, t in self.trees.items()
+                    if now - t.last_touch > ttl]:
+            self.trees.pop(oid, None)
+
+
+# ---------------------------------------------------------------------------
+# agent-side: consumer loop
+# ---------------------------------------------------------------------------
+async def bcast_fetch(agent, hex_id: str, size: int, holders: List[Dict],
+                      progress: TransferProgress) -> str:
+    """Tree-coordinated pull of one object into the local store.
+
+    Returns 'ok' (sealed locally) or 'fallback' (head unreachable, tree
+    drained, or re-parent budget exhausted — the caller runs the plain
+    striped pull, keeping ``progress`` registered so children of this
+    node keep relaying either way).
+    """
+    pulls = agent.pulls
+    my_addr = {"host": "127.0.0.1", "port": agent.tcp_port}
+    timeout = CONFIG.control_rpc_timeout_s
+    dead_parent: Optional[Dict] = None
+    for _ in range(max(1, CONFIG.bcast_max_reparents) + 1):
+        try:
+            if dead_parent is None:
+                reply = await agent.head.call(
+                    "BcastJoin",
+                    {"object_id": hex_id, "size": size, "addr": my_addr,
+                     "roots": holders}, timeout=timeout)
+            else:
+                reply = await agent.head.call(
+                    "BcastReparent",
+                    {"object_id": hex_id, "addr": my_addr,
+                     "dead": dead_parent}, timeout=timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pulls.bcast_fallbacks += 1
+            return "fallback"
+        if not reply or reply.get("fallback"):
+            pulls.bcast_fallbacks += 1
+            return "fallback"
+        parent = reply["parent"]
+        pulls.bcast_joins += 1
+        pulls.bcast_last_depth = int(reply.get("depth", 0))
+        status = await pulls.fetch(
+            hex_id, [parent], meta=(size, [parent], False),
+            progress=progress)
+        if status == "ok":
+            pulls.bcast_tree_pulls += 1
+            # (the parent is already recorded as a remote-tier restore
+            # source by PullManager.fetch's ok path)
+            try:
+                await agent.head.call(
+                    "BcastReady", {"object_id": hex_id, "addr": my_addr},
+                    timeout=timeout)
+            except Exception:
+                pass  # advisory; the tree converges without it
+            return "ok"
+        if status == "local":
+            return "fallback"
+        # 'conn' (parent dead / unreachable) or 'absent' (parent gave up
+        # or evicted mid-relay): report it dead and take a new slot
+        pulls.bcast_reparents_client += 1
+        dead_parent = parent
+    pulls.bcast_fallbacks += 1
+    return "fallback"

@@ -80,6 +80,38 @@ _flag("object_pull_max_inflight_bytes", 0)
 # the 600 s pull deadline.
 _flag("object_pull_orphan_grace_s", 20.0)
 
+# --- device object plane (ISSUE 9) ------------------------------------------
+# Spanning broadcast trees: K consumers pulling the same large object are
+# arranged into a tree over the per-peer data channels (interior nodes
+# relay chunks while still receiving), so distribution costs O(log N)
+# instead of N serial root pulls. Objects below bcast_min_bytes keep the
+# plain multi-holder striped pull (tree bookkeeping costs more than it
+# saves on small objects).
+_flag("bcast_enabled", True)
+_flag("bcast_min_bytes", 8 * 1024 * 1024)
+# Children per tree node. 2 keeps every node's upload ≤ 2x the object
+# size; raise on networks where serving fan-out is cheap.
+_flag("bcast_fanout", 2)
+# Serve-side wait for a chunk a relay has not received yet: covers the
+# parent's own admission-queue + transfer time. On expiry the child gets
+# an absent verdict and re-parents through the head registry.
+_flag("bcast_chunk_wait_s", 30.0)
+# Parent failures one consumer tolerates (each triggers a head
+# re-parent) before falling back to the plain striped pull.
+_flag("bcast_max_reparents", 8)
+# Idle tree state on the head is garbage-collected after this.
+_flag("bcast_tree_ttl_s", 120.0)
+# Tiered spill: bytes of disk the spill directory may hold before the
+# oldest disk-tier objects WITH a known remote holder are demoted to the
+# remote tier (local copy dropped; restore re-pulls it). 0 = unlimited.
+_flag("object_spill_disk_max_bytes", 0)
+# Per-node cap on object-chunk SERVING bandwidth (bytes/s, 0 =
+# unlimited): a virtual-clock token bucket on FetchObjectChunk so bulk
+# distribution cannot starve a node's control RPCs — and the knob that
+# lets the broadcast bench model per-node upload capacity on loopback
+# (where the real NIC constraint does not exist).
+_flag("object_serve_bandwidth_bytes_ps", 0)
+
 # --- workers ----------------------------------------------------------------
 _flag("num_workers_soft_limit", 0)  # 0 = num_cpus
 _flag("worker_forkserver", True)  # fork plain workers from a warm template

@@ -180,6 +180,13 @@ class HeadServer:
         # of every actor in the cluster (O(N^2) across a creation burst)
         self._recent_placements: deque = deque()
         self.subscribers: Dict[str, set] = {}  # channel -> set[Connection]
+        # broadcast-tree coordination (device object plane, ISSUE 9):
+        # transient transfer topology, deliberately NOT WAL-durable — a
+        # restarted head starts fresh trees and mid-flight consumers
+        # degrade to plain pulls
+        from ray_tpu._private.broadcast import BcastTreeRegistry
+
+        self.bcast = BcastTreeRegistry()
         self.task_events: List[Dict] = []  # ring buffer of task state transitions
         self.cluster_config = CONFIG.snapshot()
         self._pg_counter = 0
@@ -709,6 +716,10 @@ class HeadServer:
         r("ListJobs", self._list_jobs)
         r("DrainNode", self._drain_node)
         r("GetHeadStatus", self._get_head_status)
+        r("BcastJoin", self._bcast_join)
+        r("BcastReady", self._bcast_ready)
+        r("BcastReparent", self._bcast_reparent)
+        r("BcastStats", self._bcast_stats)
         r("Ping", self._ping)
 
     async def _ping(self, conn, p) -> Dict:
@@ -876,6 +887,20 @@ class HeadServer:
             await node.conn.push("Drain", {})
         return {"ok": True}
 
+    # ------------------------------------------- broadcast trees (ISSUE 9)
+    async def _bcast_join(self, conn: Connection, p: Dict) -> Dict:
+        return self.bcast.join(p["object_id"], p.get("size", 0),
+                               p["addr"], p.get("roots") or [])
+
+    async def _bcast_ready(self, conn: Connection, p: Dict) -> Dict:
+        return self.bcast.ready(p["object_id"], p["addr"])
+
+    async def _bcast_reparent(self, conn: Connection, p: Dict) -> Dict:
+        return self.bcast.reparent(p["object_id"], p["addr"], p["dead"])
+
+    async def _bcast_stats(self, conn: Connection, p) -> Dict:
+        return self.bcast.stats((p or {}).get("object_id"))
+
     async def _health_check_loop(self) -> None:
         period = CONFIG.health_check_period_ms / 1000
         threshold = CONFIG.health_check_failure_threshold
@@ -919,6 +944,13 @@ class HeadServer:
             prefix = f"metrics::{node.node_id}".encode()
             for key in [k for k in metrics_ns if bytes(k).startswith(prefix)]:
                 metrics_ns.pop(key, None)
+        # drop the node out of every broadcast tree NOW: joiners stop
+        # being routed to it and its children re-parent to a live
+        # ancestor instead of waiting out relay-chunk timeouts
+        try:
+            self.bcast.on_node_removed(node.addr)
+        except Exception:
+            pass
         removed_msg = {"event": "removed", "node_id": node.node_id,
                        "reason": reason, "incarnation": node.incarnation,
                        "addr": node.addr, "time": time.time()}

@@ -214,10 +214,20 @@ def make_store_client(store_dir: str, capacity: Optional[int] = None):
 
 
 class StoreDirectory:
-    """Authoritative per-node accounting: sizes, pins, LRU, spilling.
+    """Authoritative per-node accounting: sizes, pins, LRU, tiered spill.
 
     Runs inside the node agent (the raylet analog). Thread-safe; called from
     the agent event loop and RPC handlers.
+
+    Spill is tiered (device object plane, ISSUE 9): shm → disk →
+    remote-holder. The disk tier is the classic spill file; the remote
+    tier drops the local copy entirely against a RECORDED remote holder
+    (``note_remote_source``) — restoring it is a plain pull-plane fetch,
+    so broadcast-tree reads can source an object from whichever tier a
+    holder currently keeps it in. Demotion to the remote tier happens
+    when the disk tier is unavailable (write failure) or over its
+    ``object_spill_disk_max_bytes`` budget, and only ever for objects
+    with a known live source elsewhere.
     """
 
     def __init__(self, store_dir: str, capacity: Optional[int] = None,
@@ -235,19 +245,56 @@ class StoreDirectory:
         self._objects: "OrderedDict[str, int]" = OrderedDict()
         self._pins: Dict[str, int] = {}
         self._native_pins: Dict[str, Optional[memoryview]] = {}
-        self._spilled: Dict[str, int] = {}  # hex -> size on disk
+        self._spilled: "OrderedDict[str, int]" = OrderedDict()  # disk tier
+        self._remote: "OrderedDict[str, int]" = OrderedDict()   # remote tier
+        # hex -> [addr]: holders known to keep a sealed copy (recorded by
+        # the pull plane; survives local eviction so the remote tier can
+        # point a restore pull at them)
+        self._remote_sources: Dict[str, List[Dict]] = {}
         self.num_evictions = 0
         self.num_spills = 0
+        self.num_restores = 0
+        self.num_remote_demotions = 0
 
     # -- bookkeeping ---------------------------------------------------------
     def on_sealed(self, object_id_hex: str, size: int) -> None:
         with self._lock:
+            self._remote.pop(object_id_hex, None)  # restored locally
             if object_id_hex in self._objects:
                 return
             if not self.native:
                 self._ensure_space(size)
             self._objects[object_id_hex] = size
             self.used += size
+
+    def note_remote_source(self, object_id_hex: str,
+                           addrs: List[Dict]) -> None:
+        """Record holders known to keep a sealed copy (the nodes a pull
+        fetched from). These make the object eligible for remote-tier
+        demotion and seed the restore pull's holder list."""
+        if not addrs:
+            return
+        with self._lock:
+            known = self._remote_sources.setdefault(object_id_hex, [])
+            for addr in addrs:
+                entry = {"host": addr.get("host"), "port": addr.get("port")}
+                if entry not in known:
+                    known.append(entry)
+
+    def remote_sources_for(self, object_id_hex: str) -> List[Dict]:
+        with self._lock:
+            return list(self._remote_sources.get(object_id_hex, []))
+
+    def forget_remote_source(self, addr: Dict) -> None:
+        """A holder died: stop offering it as a restore source."""
+        entry = {"host": addr.get("host"), "port": addr.get("port")}
+        with self._lock:
+            for hex_id in list(self._remote_sources):
+                lst = self._remote_sources[hex_id]
+                if entry in lst:
+                    lst.remove(entry)
+                    if not lst:
+                        self._remote_sources.pop(hex_id)
 
     def touch(self, object_id_hex: str) -> None:
         with self._lock:
@@ -285,17 +332,26 @@ class StoreDirectory:
             pins = set(self._pins)
         rows = [
             {"object_id": h, "size_bytes": size, "pinned": h in pins,
-             "spilled": False}
+             "spilled": False, "tier": "shm"}
             for h, size in resident if self.contains(h)
         ]
         rows += [
             {"object_id": h, "size_bytes": size, "pinned": False,
-             "spilled": True}
+             "spilled": True, "tier": "disk"}
             for h, size in spilled
+        ]
+        with self._lock:
+            remote = list(self._remote.items())[:max(0, limit - len(rows))]
+        rows += [
+            {"object_id": h, "size_bytes": size, "pinned": False,
+             "spilled": True, "tier": "remote"}
+            for h, size in remote
         ]
         return rows
 
     def contains(self, object_id_hex: str) -> bool:
+        # remote-tier objects are NOT local: a False here is what routes
+        # waiters back into the pull plane (the remote tier's restore)
         if self.native:
             # the C++ arena is authoritative — it may have LRU-evicted the
             # object without telling us, and a stale True here would make
@@ -307,6 +363,16 @@ class StoreDirectory:
     def is_spilled(self, object_id_hex: str) -> bool:
         with self._lock:
             return object_id_hex in self._spilled
+
+    def spill_tier(self, object_id_hex: str) -> Optional[str]:
+        with self._lock:
+            if object_id_hex in self._objects:
+                return "shm"
+            if object_id_hex in self._spilled:
+                return "disk"
+            if object_id_hex in self._remote:
+                return "remote"
+            return None
 
     def delete(self, object_id_hex: str) -> None:
         with self._lock:
@@ -320,6 +386,8 @@ class StoreDirectory:
                     os.unlink(os.path.join(self.spill_dir, object_id_hex))
                 except OSError:
                     pass
+            self._remote.pop(object_id_hex, None)
+            self._remote_sources.pop(object_id_hex, None)
             self._pins.pop(object_id_hex, None)
             if self.native and self._native_pins.pop(
                     object_id_hex, None) is not None:
@@ -343,10 +411,28 @@ class StoreDirectory:
                 "num_spills": self.num_spills,
             }
 
-    # -- eviction / spilling -------------------------------------------------
+    def tier_stats(self) -> Dict:
+        """Spill-tier breakdown (GetPullStats / CLI status / bench)."""
+        with self._lock:
+            return {
+                "shm_objects": len(self._objects),
+                "disk_objects": len(self._spilled),
+                "disk_bytes": sum(self._spilled.values()),
+                "remote_objects": len(self._remote),
+                "remote_bytes": sum(self._remote.values()),
+                "objects_with_remote_sources": len(self._remote_sources),
+                "num_spills": self.num_spills,
+                "num_restores": self.num_restores,
+                "num_remote_demotions": self.num_remote_demotions,
+                "num_evictions": self.num_evictions,
+            }
+
+    # -- eviction / tiered spilling ------------------------------------------
     def _ensure_space(self, size: int) -> None:
-        """Evict (owner-recoverable) or spill (pinned primaries) until `size`
-        fits. Caller holds the lock."""
+        """Make `size` fit, walking the tiers: evict unpinned (owner-
+        recoverable) → spill pinned primaries to disk → demote objects
+        with a recorded remote holder to the remote tier. Caller holds
+        the lock."""
         if self.native:
             return  # C++ arena evicts internally
         if size > self.capacity:
@@ -366,16 +452,18 @@ class StoreDirectory:
                 self.num_evictions += 1
                 continue
             # Everything is pinned: spill the oldest pinned object to disk.
-            spilled_one = False
-            for hex_id in list(self._objects):
-                if self._spill(hex_id):
-                    spilled_one = True
-                    break
-            if not spilled_one:
-                raise ObjectStoreFullError(
-                    f"store full ({self.used}/{self.capacity}) and nothing can "
-                    "be evicted or spilled"
-                )
+            if any(self._spill(hex_id) for hex_id in list(self._objects)):
+                continue
+            # Disk tier unavailable (write failure / dir gone): drop the
+            # oldest object with a known remote holder — the pull plane
+            # restores it on demand.
+            if any(self._demote_remote(hex_id)
+                   for hex_id in list(self._objects)):
+                continue
+            raise ObjectStoreFullError(
+                f"store full ({self.used}/{self.capacity}) and nothing can "
+                "be evicted, spilled, or demoted to a remote holder"
+            )
 
     def _spill(self, object_id_hex: str) -> bool:
         if self.native:
@@ -384,16 +472,60 @@ class StoreDirectory:
         if view is None:
             self.used -= self._objects.pop(object_id_hex, 0)
             return False
-        os.makedirs(self.spill_dir, exist_ok=True)
-        path = os.path.join(self.spill_dir, object_id_hex)
-        with open(path, "wb") as f:
-            f.write(view)
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, object_id_hex)
+            with open(path, "wb") as f:
+                f.write(view)
+        except OSError:
+            # disk tier unavailable: the caller's next tier (remote
+            # demotion) may still make room
+            return False
         size = self._objects.pop(object_id_hex)
         self.client.delete(ObjectID.from_hex(object_id_hex))
         self.used -= size
         self._spilled[object_id_hex] = size
         self.num_spills += 1
+        self._enforce_disk_cap()
         return True
+
+    def _demote_remote(self, object_id_hex: str) -> bool:
+        """Drop the local (shm) copy against a recorded remote holder.
+        Memory-safe even for pinned objects on the tmpfs backend (live
+        mmaps outlive the unlink); only taken when the disk tier cannot."""
+        if self.native or not self._remote_sources.get(object_id_hex):
+            return False
+        size = self._objects.pop(object_id_hex, None)
+        if size is None:
+            return False
+        self.client.delete(ObjectID.from_hex(object_id_hex))
+        self.used -= size
+        self._remote[object_id_hex] = size
+        self.num_remote_demotions += 1
+        return True
+
+    def _enforce_disk_cap(self) -> None:
+        """Keep the disk tier under ``object_spill_disk_max_bytes`` by
+        demoting its OLDEST entries with a known remote holder (drop the
+        file, keep the record). Entries without a source stay — they are
+        the only copy."""
+        cap = CONFIG.object_spill_disk_max_bytes
+        if not cap:
+            return
+        disk_bytes = sum(self._spilled.values())
+        for hex_id in list(self._spilled):
+            if disk_bytes <= cap:
+                break
+            if not self._remote_sources.get(hex_id):
+                continue
+            size = self._spilled.pop(hex_id)
+            try:
+                os.unlink(os.path.join(self.spill_dir, hex_id))
+            except OSError:
+                pass
+            self._remote[hex_id] = size
+            self.num_remote_demotions += 1
+            disk_bytes -= size
 
     def restore(self, object_id_hex: str) -> bool:
         """Bring a spilled object back into shm, streaming the file through
@@ -429,6 +561,7 @@ class StoreDirectory:
             self._objects[object_id_hex] = size
             self.used += size
             self._spilled.pop(object_id_hex)
+            self.num_restores += 1
             os.unlink(path)
             return True
 

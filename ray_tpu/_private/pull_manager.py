@@ -27,6 +27,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private.broadcast import TransferProgress
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
 
@@ -98,8 +99,15 @@ class PullManager:
             cap = max(agent.store.capacity // 4,
                       CONFIG.object_chunk_size_bytes)
         self.budget = PullBudget(cap)
+        # in-flight transfer progress, keyed by object hex: the relay
+        # source for broadcast-tree children (agent._fetch_object_chunk
+        # serves partially-received ranges straight out of these)
+        self.active: Dict[str, TransferProgress] = {}
         # hot-path counters, exported via GetPullStats + node gauges
         self.window_occupancy = 0  # chunk RPCs in flight right now
+        self.window_occupancy_peak = 0
+        self.transfers_concurrent = 0   # transfers inside _transfer now
+        self.transfers_concurrent_peak = 0
         self.chunks_fetched = 0
         self.bytes_fetched = 0
         self.transfers_ok = 0
@@ -108,6 +116,14 @@ class PullManager:
         self.pulls_cancelled = 0
         self.peer_removed_failfasts = 0  # node-death verdicts applied
         self.transfer_seconds = 0.0  # time inside _transfer (ok ones)
+        # broadcast-tree counters (device object plane, ISSUE 9)
+        self.bcast_joins = 0            # tree slots taken (incl. re-joins)
+        self.bcast_tree_pulls = 0       # objects sealed via a tree parent
+        self.bcast_reparents_client = 0  # dead parents this node reported
+        self.bcast_fallbacks = 0        # tree pulls degraded to striped
+        self.bcast_last_depth = 0       # depth of the latest tree slot
+        self.bcast_relay_chunks = 0     # chunks served from unsealed views
+        self.bcast_relay_bytes = 0
 
     def on_peer_removed(self, addr: Dict) -> None:
         """A cluster-level death verdict for a holder peer: drop BOTH its
@@ -122,6 +138,9 @@ class PullManager:
     def stats(self) -> Dict:
         return {
             "window_occupancy": self.window_occupancy,
+            "window_occupancy_peak": self.window_occupancy_peak,
+            "transfers_concurrent": self.transfers_concurrent,
+            "transfers_concurrent_peak": self.transfers_concurrent_peak,
             "chunks_fetched": self.chunks_fetched,
             "bytes_fetched": self.bytes_fetched,
             "transfers_ok": self.transfers_ok,
@@ -134,28 +153,71 @@ class PullManager:
             "pulls_queued": self.budget.queued,
             "pulls_queued_total": self.budget.queued_total,
             "transfer_seconds": round(self.transfer_seconds, 4),
+            "bcast_joins": self.bcast_joins,
+            "bcast_tree_pulls": self.bcast_tree_pulls,
+            "bcast_reparents": self.bcast_reparents_client,
+            "bcast_fallbacks": self.bcast_fallbacks,
+            "bcast_tree_depth": self.bcast_last_depth,
+            "bcast_relay_chunks": self.bcast_relay_chunks,
+            "bcast_relay_bytes": self.bcast_relay_bytes,
+            "transfers_active": len(self.active),
         }
 
+    # ------------------------------------------- relay progress registry
+    def register_progress(self, hex_id: str, size: int) -> TransferProgress:
+        """Announce an upcoming pull so broadcast children assigned to
+        this node park on its progress (through admission delay and
+        retries) instead of bouncing off an absent verdict."""
+        prog = TransferProgress(hex_id, size)
+        self.active[hex_id] = prog
+        return prog
+
+    def unregister_progress(self, hex_id: str,
+                            prog: TransferProgress) -> None:
+        if self.active.get(hex_id) is prog:
+            self.active.pop(hex_id, None)
+        # wake parked relay serves; each re-checks the (possibly just
+        # sealed) store before answering absent
+        prog.fail()
+
     # ------------------------------------------------------------- transfer
-    async def fetch(self, hex_id: str, holders: List[Dict]) -> str:
+    async def fetch(self, hex_id: str, holders: List[Dict], *,
+                    meta: Optional[Tuple] = None,
+                    progress: Optional[TransferProgress] = None) -> str:
         """Pull one object from `holders` into the local store.
 
         Returns 'ok' | 'absent' (some holder alive, object not there) |
         'conn' (every holder unreachable) | 'local' (local store error).
         Only 'conn' feeds the agent's dead-holder fast-fail.
+
+        ``meta=(size, alive_holders, saw_absent)`` skips the probe round
+        (broadcast pulls already know the size and their single parent —
+        probing a mid-relay parent would misread its unsealed state).
+        ``progress`` tracks received byte ranges for chunk-level relay.
         """
-        size, alive, any_absent = await self._probe_meta(hex_id, holders)
+        if meta is not None:
+            size, alive, any_absent = meta
+        else:
+            size, alive, any_absent = await self._probe_meta(hex_id, holders)
         if size is None:
             return "absent" if any_absent else "conn"
         await self.budget.acquire(size)
         t0 = time.monotonic()
+        self.transfers_concurrent += 1
+        self.transfers_concurrent_peak = max(
+            self.transfers_concurrent_peak, self.transfers_concurrent)
         try:
-            status = await self._transfer(hex_id, size, alive)
+            status = await self._transfer(hex_id, size, alive,
+                                          progress=progress)
         finally:
+            self.transfers_concurrent -= 1
             self.budget.release(size)
         if status == "ok":
             self.transfers_ok += 1
             self.transfer_seconds += time.monotonic() - t0
+            # the holders we fetched from keep sealed copies: record them
+            # as remote-tier restore sources for this object
+            self.agent.store.note_remote_source(hex_id, alive)
         else:
             self.transfers_failed += 1
         return status
@@ -192,6 +254,13 @@ class PullManager:
         any_absent = False
         for addr, meta in zip(holders, metas):
             if meta and meta.get("exists"):
+                if meta.get("partial"):
+                    # mid-pull relay source: not stripe-able by the plain
+                    # path (its unsealed ranges arrive on ITS schedule);
+                    # count as absent-this-round so the locate loop
+                    # retries after the holder seals
+                    any_absent = True
+                    continue
                 alive.append(addr)
                 if size is None:
                     size = meta["size"]
@@ -200,12 +269,17 @@ class PullManager:
         return size, alive, any_absent
 
     async def _transfer(self, hex_id: str, size: int,
-                        holders: List[Dict]) -> str:
+                        holders: List[Dict],
+                        progress: Optional[TransferProgress] = None) -> str:
         oid = ObjectID.from_hex(hex_id)
         try:
             view, handle = self.agent.store.client.create(oid, size)
         except Exception:
             return "local"
+        if progress is not None:
+            # re-arm (retries allocate a fresh view; marks from an
+            # aborted attempt describe freed memory)
+            progress.reset(view)
         chunk = max(1, CONFIG.object_chunk_size_bytes)
         todo: deque = deque(range(0, size, chunk))
         total_chunks = len(todo) or 1
@@ -232,6 +306,8 @@ class PullManager:
                     # next chunk's range (double write + double count)
                     n = min(chunk - off % chunk, size - off)
                     self.window_occupancy += 1
+                    self.window_occupancy_peak = max(
+                        self.window_occupancy_peak, self.window_occupancy)
                     try:
                         # raw reply streams straight into the store view at
                         # this chunk's offset; out-of-order completion is
@@ -262,6 +338,9 @@ class PullManager:
                     bytes_done[0] += got
                     self.chunks_fetched += 1
                     self.bytes_fetched += got
+                    if progress is not None and got > 0:
+                        progress.mark(off, got)  # relay children may now
+                        # stream this range while the rest arrives
                     if got < n:  # truncated reply: refetch the rest
                         todo.append(off + got)
 
@@ -301,15 +380,22 @@ class PullManager:
             for s in stripes:
                 s.cancel()
             await asyncio.gather(*stripes, return_exceptions=True)
+            if progress is not None:
+                progress.fail()  # before abort: relay serves must never
+                # slice a closed mmap
             self.agent.store.client.abort(handle)
             raise
         if bytes_done[0] >= size and not todo:
             try:
                 self.agent.store.client.seal(oid, handle)
             except Exception:
+                if progress is not None:
+                    progress.fail()
                 self.agent.store.client.abort(handle)
                 return "local"
             self.agent.store.on_sealed(hex_id, size)
             return "ok"
+        if progress is not None:
+            progress.fail()
         self.agent.store.client.abort(handle)
         return "absent" if saw_absent else "conn"

@@ -25,9 +25,24 @@ import cloudpickle
 #   header = <u32 meta_len><u32 num_buffers>
 #   meta   = pickled (protocol 5) bytes with out-of-band buffer placeholders
 #   then for each buffer: <u64 length><raw bytes, 64-byte aligned>
+#
+# Typed zero-copy array objects (the device object plane, ISSUE 9) reuse
+# the same 8-byte header with num_buffers == ZC_SENTINEL: meta is then a
+# fixed struct descriptor (dtype tag, order, shape — never pickle) and
+# exactly one raw buffer follows, written straight from the array's
+# memory into the store view and read back as a numpy view aliasing the
+# store mmap. No pickle pass in either direction.
 import struct
 
 _ALIGN = 64
+
+# num_buffers value that can never occur on the pickle path (buffers are
+# appended one at a time; 2**32-1 of them is unreachable).
+ZC_SENTINEL = 0xFFFFFFFF
+_ZC_VERSION = 1
+# descriptor prefix: version, order flag ('C'/'F'), ndim, dtype-tag len,
+# payload nbytes; then tag bytes, then ndim u64 dims
+_ZC_PREFIX = "<BBBBQ"
 
 
 def _align(n: int) -> int:
@@ -65,6 +80,165 @@ class SerializedObject:
         buf = bytearray(self.total_size())
         used = self.write_into(memoryview(buf))
         return bytes(buf[:used])
+
+
+class ZeroCopyArray:
+    """Serialized form of one contiguous ndarray: header + raw buffer.
+
+    Duck-compatible with SerializedObject (total_size / write_into /
+    to_bytes) so every put path — put(), task returns, inline values —
+    takes the fast path without call-site changes. ``write_into`` is a
+    single memcpy from the array's memory into the store view; there is
+    no pickle pass and no intermediate bytes object.
+    """
+
+    __slots__ = ("descriptor", "raw", "nbytes")
+
+    def __init__(self, descriptor: bytes, raw, nbytes: int):
+        self.descriptor = descriptor
+        self.raw = raw  # 1-D uint8 ndarray view of the source array
+        self.nbytes = nbytes
+
+    def total_size(self) -> int:
+        return 8 + _align(len(self.descriptor)) + _align(self.nbytes)
+
+    def write_into(self, view: memoryview) -> int:
+        struct.pack_into("<II", view, 0, len(self.descriptor), ZC_SENTINEL)
+        off = 8
+        view[off : off + len(self.descriptor)] = self.descriptor
+        off += _align(len(self.descriptor))
+        view[off : off + self.nbytes] = self.raw
+        return off + self.nbytes
+
+    def to_bytes(self) -> bytes:
+        buf = bytearray(self.total_size())
+        used = self.write_into(memoryview(buf))
+        return bytes(buf[:used])
+
+
+def _dtype_tag(dtype) -> Optional[str]:
+    """Stable round-trippable tag for a dtype. ``dtype.str`` for the
+    standard kinds; extension dtypes (ml_dtypes bfloat16 & friends
+    report an opaque '<V2') fall back to ``dtype.name``, which
+    ``np.dtype(name)`` resolves once ml_dtypes is imported."""
+    import numpy as np
+
+    if dtype.hasobject:
+        return None
+    tag = dtype.str
+    try:
+        if np.dtype(tag) == dtype:
+            return tag
+    except TypeError:
+        pass
+    tag = dtype.name
+    try:
+        if np.dtype(tag) == dtype:
+            return tag
+    except TypeError:
+        pass
+    return None
+
+
+def _resolve_dtype(tag: str):
+    import numpy as np
+
+    try:
+        return np.dtype(tag)
+    except TypeError:
+        # extension dtypes register with numpy on import (bfloat16 etc.)
+        import ml_dtypes  # noqa: F401
+
+        return np.dtype(tag)
+
+
+def try_serialize_array(value) -> Optional[ZeroCopyArray]:
+    """The typed fast path: a single contiguous numpy/JAX array object.
+
+    Returns None — caller falls back to the pickle path — for anything
+    else: non-arrays, object dtypes, and non-contiguous layouts (a
+    sliced array's strides cannot be represented as one raw segment
+    without a gather; refusing keeps the fast path a pure memcpy).
+    """
+    import numpy as np
+
+    tname = type(value).__module__
+    if tname.startswith("jax") or tname.startswith("jaxlib"):
+        try:
+            import jax
+
+            if isinstance(value, jax.Array):
+                # device buffers cannot cross processes; this is the one
+                # host materialization (zero-copy on the CPU backend)
+                value = np.asarray(value)
+        except ImportError:
+            return None
+    if type(value) is not np.ndarray:
+        return None  # subclasses may carry state the header cannot
+    if value.ndim > 255:
+        return None
+    if value.flags["C_CONTIGUOUS"]:
+        order = 0
+        base = value
+    elif value.flags["F_CONTIGUOUS"]:
+        order = 1
+        base = value.T  # C-contiguous view over the same memory
+    else:
+        return None
+    tag = _dtype_tag(value.dtype)
+    if tag is None:
+        return None
+    tag_b = tag.encode()
+    if len(tag_b) > 255:
+        return None
+    descriptor = struct.pack(_ZC_PREFIX, _ZC_VERSION, order, value.ndim,
+                             len(tag_b), value.nbytes) + tag_b + \
+        struct.pack(f"<{value.ndim}Q", *value.shape)
+    # raw uint8 view (not memoryview: extension dtypes like bfloat16
+    # refuse the buffer protocol, but .view(uint8) on a contiguous
+    # array is always a free reinterpretation)
+    raw = base.reshape(-1).view(np.uint8) if value.nbytes else \
+        np.empty(0, np.uint8)
+    return ZeroCopyArray(descriptor, raw, value.nbytes)
+
+
+def is_zero_copy(data: memoryview) -> bool:
+    """Header peek: does this wire object use the typed array format?"""
+    if len(data) < 8:
+        return False
+    _, num_buffers = struct.unpack_from("<II", data, 0)
+    return num_buffers == ZC_SENTINEL
+
+
+def _deserialize_zero_copy(data: memoryview):
+    """Rebuild the array as a read-only view aliasing ``data`` (the
+    store mmap) — jax.device_put streams from it with no host copy. The
+    caller owns pin semantics: the view must not outlive the store
+    segment (see Worker._pin_escaping_view / raylint R9)."""
+    import numpy as np
+
+    meta_len, _ = struct.unpack_from("<II", data, 0)
+    off = 8
+    version, order, ndim, tag_len, nbytes = struct.unpack_from(
+        _ZC_PREFIX, data, off)
+    if version != _ZC_VERSION:
+        raise ValueError(f"unknown zero-copy array version {version}")
+    pos = off + struct.calcsize(_ZC_PREFIX)
+    tag = bytes(data[pos : pos + tag_len]).decode()
+    pos += tag_len
+    shape = struct.unpack_from(f"<{ndim}Q", data, pos)
+    off += _align(meta_len)
+    dtype = _resolve_dtype(tag)
+    arr = np.frombuffer(data[off : off + nbytes], dtype=dtype)
+    out = np.reshape(arr, shape, order="F" if order else "C")
+    try:
+        # sealed objects are immutable: a writable alias (the native
+        # arena hands out writable buffers) would let user code corrupt
+        # a segment other processes share
+        out.flags.writeable = False
+    except ValueError:
+        pass
+    return out
 
 
 def _jax_array_reducer(arr):
@@ -110,7 +284,12 @@ class SerializationContext:
     def set_actor_handle_reducer(self, reducer: Callable) -> None:
         self._actor_handle_reducer = reducer
 
-    def serialize(self, value: Any) -> SerializedObject:
+    def serialize(self, value: Any):
+        # typed fast path first: a bare contiguous array skips the whole
+        # pickle machinery (ZeroCopyArray is duck-compatible downstream)
+        zc = try_serialize_array(value)
+        if zc is not None:
+            return zc
         buffers: List[pickle.PickleBuffer] = []
 
         def buffer_cb(pb: pickle.PickleBuffer) -> bool:
@@ -133,6 +312,8 @@ class SerializationContext:
 
     def deserialize(self, data: memoryview) -> Any:
         meta_len, num_buffers = struct.unpack_from("<II", data, 0)
+        if num_buffers == ZC_SENTINEL:
+            return _deserialize_zero_copy(data)
         off = 8
         meta = data[off : off + meta_len]
         off += _align(meta_len)

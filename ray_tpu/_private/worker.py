@@ -261,6 +261,11 @@ class WorkerConn:
         # executes and replies in push order). Used to detect a long-running
         # head-of-line task so new work is not pipelined behind it.
         self.dispatch_times: deque = deque()
+        # Function names of the same in-flight tasks (parallel deque):
+        # pipelining behind a head-of-line function the pool has never
+        # observed completing would strand the queued task for an
+        # unbounded time (a committed task cannot be stolen back).
+        self.dispatch_fns: deque = deque()
 
 
 class Worker:
@@ -296,6 +301,11 @@ class Worker:
         # the GCS node channel; work targeting these nodes fails fast with
         # NodeDiedError instead of waiting out network deadlines
         self._dead_nodes: Dict[str, Dict] = {}
+        # unpins queued by zero-copy-view finalizers: a GC-context
+        # callback must never take _inbox_mu (the R1 destructor-deadlock
+        # shape), so it only appends here (deque: lock-free under the
+        # GIL) and the loop flushes
+        self._pending_unpins: deque = deque()
         self._owner_conn_pool = ConnectionPool()
         self.current_task_info = threading.local()
         self.task_events: List[Dict] = []
@@ -417,6 +427,13 @@ class Worker:
         info = await self.agent.call("GetNodeInfo", {},
                                      timeout=CONFIG.control_rpc_timeout_s)
         self.agent_tcp_addr = {"host": node_ip(), "port": info["tcp_port"]}
+        # flip BEFORE ready_event releases the executor: the first pushed
+        # task may call user-facing API (ray_tpu.get of a task arg ref)
+        # immediately, and _require_worker checks this flag — setting it
+        # on the main thread after _acall returned left a window where a
+        # cold worker's first task failed with "init() must be called
+        # first" (caught by the ISSUE 9 broadcast consumers)
+        self.connected = True
         self.ready_event.set()
 
     async def _connect_head(self) -> None:
@@ -843,6 +860,7 @@ class Worker:
             self.memory_store.put(object_id.binary(), sobj.to_bytes(), False)
             self.reference_counter.set_resolved(object_id.binary(), "inline")
         else:
+            zero_copy = isinstance(sobj, ser.ZeroCopyArray)
             view, handle = self.store.create(object_id, size)
             used = sobj.write_into(view)
             self.store.seal(object_id, handle)
@@ -851,13 +869,17 @@ class Worker:
             # connection preserves happens-before), so the blocking round
             # trip the old path paid per put is unnecessary.
             self._post(self.agent.push_nowait,
-                       "ObjectSealed", {"object_id": object_id.hex(), "size": used})
+                       "ObjectSealed", {"object_id": object_id.hex(),
+                                        "size": used,
+                                        "zero_copy": zero_copy})
             self.memory_store.put(object_id.binary(), b"", IN_PLASMA)
             self.reference_counter.set_resolved(
                 object_id.binary(), "plasma", [self.agent_tcp_addr]
             )
 
-    def _serialize_value(self, value: Any) -> ser.SerializedObject:
+    def _serialize_value(self, value: Any):
+        """Returns a SerializedObject, or a ZeroCopyArray for bare
+        contiguous arrays (duck-compatible; no pickle pass)."""
         ctx = ser.get_reducer_context()
         ctx.collected_refs = []
         try:
@@ -1028,7 +1050,57 @@ class Worker:
             view = self.store.get_view(ref.id())
             if view is None:
                 return _LOST
-        return self.serialization_context.deserialize(view)
+        result = self.serialization_context.deserialize(view)
+        if ser.is_zero_copy(view):
+            self._pin_escaping_view(hex_id, result)
+        return result
+
+    def _pin_escaping_view(self, hex_id: str, result) -> None:
+        """A zero-copy array aliasing the store mmap is escaping to user
+        code: pin the backing object for exactly the array's lifetime so
+        eviction/spill can never reclaim a segment a live view still
+        reads (the explicit-pin half of the R9 view-lifetime contract).
+        Fire-and-forget pushes — frame order on the agent socket keeps
+        pin-before-unpin, and a lost pin only weakens eviction ordering,
+        never correctness (the mmap itself outlives the unlink).
+
+        The finalizer runs in GC context, where taking _inbox_mu could
+        deadlock its own thread (raylint R1, the MemoryStore shape): it
+        only appends to a deque and pokes the loop directly."""
+        import weakref
+
+        try:
+            self._post(self.agent.push_nowait,
+                       "PinObject", {"object_id": hex_id})
+        except Exception:
+            return
+
+        def _unpin(worker=self, hex_id=hex_id):
+            worker._pending_unpins.append(hex_id)
+            try:
+                # call_soon_threadsafe takes no project lock — safe from
+                # a destructor; if the loop is gone the pin dies with it
+                worker.loop.call_soon_threadsafe(worker._flush_unpins)
+            except Exception:
+                pass
+
+        try:
+            weakref.finalize(result, _unpin)
+        except TypeError:
+            pass  # non-weakrefable result: the pin rides out the process
+
+    def _flush_unpins(self) -> None:
+        """Loop-thread drain of finalizer-queued unpins."""
+        while self._pending_unpins:
+            try:
+                hex_id = self._pending_unpins.popleft()
+            except IndexError:
+                return
+            try:
+                self.agent.push_nowait("UnpinObject",
+                                       {"object_id": hex_id})
+            except Exception:
+                pass
 
     def _try_recover(self, ref: ObjectRef, attempt: int) -> bool:
         """Lineage reconstruction: resubmit the task that created this object
@@ -1807,8 +1879,19 @@ class _LeasePool:
         self.idle: List[WorkerConn] = []
         self.inflight_leases = 0
         self._exec_ms_ema: Optional[float] = None
+        # per-function exec EMAs: the pool-wide EMA sizes the pipeline,
+        # but whether it is safe to stack behind a specific head-of-line
+        # task depends on THAT function's history (see _conn_depth)
+        self._fn_ema: Dict[str, float] = {}
         self._reaper: Optional[asyncio.Task] = None
         self._pump_scheduled = False
+
+    def _note_exec_ms(self, fn_name: str, ms: float) -> None:
+        prev = self._exec_ms_ema
+        self._exec_ms_ema = ms if prev is None else 0.8 * prev + 0.2 * ms
+        prev_fn = self._fn_ema.get(fn_name)
+        self._fn_ema[fn_name] = ms if prev_fn is None \
+            else 0.8 * prev_fn + 0.2 * ms
 
     def _depth(self) -> int:
         """Adaptive pipelining: short tasks go deep so one worker wakeup
@@ -1829,11 +1912,19 @@ class _LeasePool:
         return self.PIPELINE_DEPTH
 
     def _conn_depth(self, conn: WorkerConn, now: float, depth: int) -> int:
-        """A task committed to a busy worker cannot be stolen back. If this
-        conn's head-of-line task has already run well past the pool's typical
-        duration (a surprise straggler — e.g. an abandoned get-timeout task),
-        stop stacking work behind it and let _pump lease fresh workers."""
+        """A task committed to a busy worker cannot be stolen back. Two
+        guards against stranding queued work behind its head-of-line
+        task: (a) if that task's FUNCTION has never been observed
+        completing in this pool, its duration is unbounded as far as we
+        know (the abandoned get-timeout sleeper shape — a fast task
+        stacked behind it waits the sleeper out), so no stacking until a
+        first completion lands; (b) if the head-of-line has already run
+        well past the pool's typical duration (a surprise straggler),
+        stop stacking and let _pump lease fresh workers."""
         if conn.dispatch_times:
+            if conn.dispatch_fns and \
+                    conn.dispatch_fns[0] not in self._fn_ema:
+                return 0 if conn.inflight else 1
             limit = max(0.05, ((self._exec_ms_ema or 0.0)
                               * CONFIG.straggler_limit_multiplier) / 1000.0)
             if now - conn.dispatch_times[0] > limit:
@@ -2030,6 +2121,7 @@ class _LeasePool:
             self._on_push_failed(conn, record)
             return
         conn.dispatch_times.append(time.monotonic())
+        conn.dispatch_fns.append(record.spec.function_name)
         fut.add_done_callback(
             lambda f: self._on_push_done(conn, record, f))
 
@@ -2037,14 +2129,15 @@ class _LeasePool:
                       fut: "asyncio.Future") -> None:
         if conn.dispatch_times:
             conn.dispatch_times.popleft()
+        if conn.dispatch_fns:
+            conn.dispatch_fns.popleft()
         if fut.cancelled() or fut.exception() is not None:
             self._on_push_failed(conn, record)
             return
         reply = fut.result()
         ms = reply.get("exec_ms") if isinstance(reply, dict) else None
         if ms is not None:
-            prev = self._exec_ms_ema
-            self._exec_ms_ema = ms if prev is None else 0.8 * prev + 0.2 * ms
+            self._note_exec_ms(record.spec.function_name, ms)
         try:
             self.worker._on_task_reply(record, reply)
         except Exception as e:  # a reply-processing bug must not leak
@@ -2090,12 +2183,12 @@ class _LeasePool:
             resolved[i] = True
             if conn.dispatch_times:
                 conn.dispatch_times.popleft()
+            if conn.dispatch_fns:
+                conn.dispatch_fns.popleft()
             record = live[i]
             ms = reply.get("exec_ms") if isinstance(reply, dict) else None
             if ms is not None:
-                prev = self._exec_ms_ema
-                self._exec_ms_ema = ms if prev is None \
-                    else 0.8 * prev + 0.2 * ms
+                self._note_exec_ms(record.spec.function_name, ms)
             try:
                 if isinstance(reply, dict) and "batch_item_error" in reply:
                     self.worker._on_task_failure(
@@ -2124,6 +2217,7 @@ class _LeasePool:
             return
         now = time.monotonic()
         conn.dispatch_times.extend([now] * len(live))
+        conn.dispatch_fns.extend(r.spec.function_name for r in live)
 
         def on_final(f):
             batches.pop(bid, None)
@@ -2133,6 +2227,8 @@ class _LeasePool:
             for _ in stragglers:
                 if conn.dispatch_times:
                     conn.dispatch_times.popleft()
+                if conn.dispatch_fns:
+                    conn.dispatch_fns.popleft()
             self._on_batch_failed(conn, stragglers)
 
         fut.add_done_callback(on_final)

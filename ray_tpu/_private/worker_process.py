@@ -379,8 +379,12 @@ class Executor:
         # Fire-and-forget (ordering rides the agent socket); the reply to the
         # owner races the seal notification only through the agent, and reads
         # hit tmpfs directly, so the blocking round trip is unnecessary.
+        from ray_tpu._private import serialization as _ser
+
         self.worker._post(self.worker.agent.push_nowait,
-                          "ObjectSealed", {"object_id": oid.hex(), "size": used})
+                          "ObjectSealed",
+                          {"object_id": oid.hex(), "size": used,
+                           "zero_copy": isinstance(sobj, _ser.ZeroCopyArray)})
         return {"plasma": True, "size": used,
                 "node_addr": self.worker.agent_tcp_addr}
 

@@ -21,6 +21,7 @@ from . import (
     r6_unbounded_rpc,
     r7_untracked_spawn,
     r8_config_knobs,
+    r9_view_escape,
 )
 
 ALL_RULES = [
@@ -32,6 +33,7 @@ ALL_RULES = [
     r6_unbounded_rpc,
     r7_untracked_spawn,
     r8_config_knobs,
+    r9_view_escape,
 ]
 
 RULES_BY_ID: Dict[str, object] = {m.RULE_ID: m for m in ALL_RULES}

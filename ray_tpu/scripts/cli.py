@@ -200,6 +200,7 @@ def cmd_status(args) -> int:
         used = total[k] - avail.get(k, 0.0)
         print(f"  {used:g}/{total[k]:g} {k}")
     _print_head_status()
+    _print_data_plane()
     return 0
 
 
@@ -238,6 +239,46 @@ def _print_head_status() -> None:
         print(f"  still recovering: {recv.get('nodes', 0)} nodes, "
               f"{recv.get('actors', 0)} actors, "
               f"{recv.get('jobs', 0)} jobs")
+
+
+def _print_data_plane() -> None:
+    """Device object plane view (ISSUE 9): this node's zero-copy puts,
+    pull/relay counters and spill tiers, plus the head's broadcast-tree
+    registry."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        stats = w._acall(w.agent.call("GetPullStats", {}, timeout=3),
+                         timeout=5)
+    except Exception:
+        return  # older agent without the RPC, or headless
+    print("\nData plane (this node)")
+    print("-" * 40)
+    print(f"  zero-copy puts {stats.get('zero_copy_puts', 0)}   "
+          f"pulls ok {stats.get('transfers_ok', 0)}   "
+          f"chunks served {stats.get('chunks_served', 0)}")
+    print(f"  bcast: depth {stats.get('bcast_tree_depth', 0)}, "
+          f"tree pulls {stats.get('bcast_tree_pulls', 0)}, "
+          f"relayed {stats.get('bcast_relay_bytes', 0)} B, "
+          f"reparents {stats.get('bcast_reparents', 0)}, "
+          f"fallbacks {stats.get('bcast_fallbacks', 0)}")
+    spill = stats.get("spill") or {}
+    if spill:
+        print(f"  spill tiers: shm {spill.get('shm_objects', 0)} / "
+              f"disk {spill.get('disk_objects', 0)} "
+              f"({spill.get('disk_bytes', 0)} B) / "
+              f"remote {spill.get('remote_objects', 0)}"
+              f"  [restores {spill.get('num_restores', 0)}, "
+              f"demotions {spill.get('num_remote_demotions', 0)}]")
+    try:
+        bs = w.head_call("BcastStats", {}, timeout=3)
+        if bs and bs.get("trees"):
+            print(f"  head trees: {bs['trees']} active, "
+                  f"{bs.get('joins_total', 0)} joins, "
+                  f"{bs.get('reparents_total', 0)} reparents")
+    except Exception:
+        pass
 
 
 def cmd_list(args) -> int:

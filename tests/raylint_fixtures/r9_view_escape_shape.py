@@ -1,0 +1,74 @@
+"""R9 regression fixture: store views escaping without a pin (ISSUE 9).
+
+The bug class the device object plane's zero-copy path makes possible:
+``get_view`` / ``read_maybe_spilled`` hand out memoryviews aliasing
+store memory. Local use inside one call is safe (the view dies before
+the store can move the object); an ESCAPING view — returned to a
+caller, parked on ``self``, or captured by a closure handed to the
+event loop — outlives the frame and can alias an evicted or spilled
+segment unless the object is pinned for the view's lifetime.
+
+R9 must flag the three escape shapes below and must NOT flag the
+pinned twins (the shipped ``Worker._pin_escaping_view`` discipline) or
+the local-use-only reader.
+"""
+
+import asyncio
+
+
+class UnpinnedEscapes:
+    """The bug: views leave the function, nothing pins the object."""
+
+    def __init__(self, store, loop):
+        self.store = store
+        self.loop = loop
+        self._cached = None
+
+    def read(self, oid):
+        view = self.store.get_view(oid)
+        return view  # expect-R9
+
+    def cache(self, oid):
+        self._cached = self.store.read_maybe_spilled(oid)  # expect-R9
+
+    def serve_later(self, oid):
+        view = self.store.get_view(oid)
+
+        async def reply():  # expect-R9
+            await asyncio.sleep(0)
+            return bytes(view)
+
+        self._task = self.loop.create_task(reply())
+
+
+class PinnedEscapes:
+    """The fix: a pin in scope covers the view's lifetime."""
+
+    def __init__(self, store):
+        self.store = store
+        self._cached = None
+
+    def read(self, oid):
+        self.store.pin(oid.hex())
+        view = self.store.get_view(oid)
+        return view
+
+    def cache(self, oid):
+        self._pin_for_cache(oid)
+        self._cached = self.store.get_view(oid)
+
+    def _pin_for_cache(self, oid):
+        self.store.pin(oid.hex())
+
+
+class LocalUseOnly:
+    """No escape: the view dies inside the call — no pin needed."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def size_of(self, oid):
+        view = self.store.get_view(oid)
+        if view is None:
+            return 0
+        return len(view)

@@ -1,0 +1,530 @@
+"""Device object plane (ISSUE 9): zero-copy array objects, spanning
+broadcast trees, and tiered spill.
+
+Unit layers (no cluster): the typed zero-copy wire format round-trips
+dtype/shape/strides and refuses non-contiguous arrays gracefully; the
+transfer-progress interval tracker and the head's broadcast-tree
+registry keep their invariants (O(log N) depth, re-parent on death);
+the store directory walks the spill tiers shm → disk → remote.
+
+Integration: a 64 MB array broadcast to 4 consumer agents lands
+byte-identical through the tree (depth ≥ 2, every consumer pulled via
+its assigned parent); SIGKILL of an interior tree node mid-broadcast
+re-parents its subtree and every surviving consumer still gets correct
+bytes — never a hang.
+"""
+
+import hashlib
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.broadcast import BcastTreeRegistry, TransferProgress
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import StoreDirectory
+from ray_tpu.cluster_utils import Cluster
+
+MB = 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# zero-copy wire format
+# ---------------------------------------------------------------------------
+class TestZeroCopyFormat:
+    @pytest.mark.parametrize("dtype,order", [
+        ("float32", "C"), ("float32", "F"), ("int8", "C"),
+        ("bfloat16", "C"), ("bfloat16", "F"),
+    ])
+    def test_round_trips_dtype_shape_strides(self, dtype, order):
+        if dtype == "bfloat16":
+            ml_dtypes = pytest.importorskip("ml_dtypes")
+            dt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dt = np.dtype(dtype)
+        arr = np.arange(6 * 8, dtype=np.float64).astype(dt).reshape(6, 8)
+        if order == "F":
+            arr = np.asfortranarray(arr)
+        sobj = ser.try_serialize_array(arr)
+        assert sobj is not None, "contiguous array must take the fast path"
+        wire = memoryview(sobj.to_bytes())
+        assert ser.is_zero_copy(wire)
+        out = ser.SerializationContext().deserialize(wire)
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        assert out.strides == arr.strides
+        assert np.array_equal(out, arr)
+        # the view aliases the wire buffer (no copy) and is read-only
+        assert not out.flags.writeable
+
+    def test_zero_d_and_empty(self):
+        for arr in (np.array(3.25), np.empty((0, 5), np.float32)):
+            out = ser.SerializationContext().deserialize(
+                memoryview(ser.try_serialize_array(arr).to_bytes()))
+            assert out.shape == arr.shape and out.dtype == arr.dtype
+            assert np.array_equal(out, arr)
+
+    def test_refuses_non_contiguous_gracefully(self):
+        sliced = np.arange(100, dtype=np.float32)[::2]
+        assert ser.try_serialize_array(sliced) is None
+        # the context falls back to the pickle path, value intact
+        ctx = ser.SerializationContext()
+        sobj = ctx.serialize(sliced)
+        assert isinstance(sobj, ser.SerializedObject)
+        assert not ser.is_zero_copy(memoryview(sobj.to_bytes()))
+        assert np.array_equal(
+            ctx.deserialize(memoryview(sobj.to_bytes())), sliced)
+
+    def test_refuses_object_dtype_and_scalars(self):
+        assert ser.try_serialize_array(
+            np.array([object(), object()])) is None
+        assert ser.try_serialize_array(np.float64(1.5)) is None  # scalar
+        assert ser.try_serialize_array([1, 2, 3]) is None
+
+    def test_nested_arrays_still_pickle(self):
+        ctx = ser.SerializationContext()
+        value = {"w": np.ones((4, 4), np.float32), "step": 7}
+        sobj = ctx.serialize(value)
+        assert isinstance(sobj, ser.SerializedObject)
+        out = ctx.deserialize(memoryview(sobj.to_bytes()))
+        assert out["step"] == 7 and np.array_equal(out["w"], value["w"])
+
+    def test_jax_array_takes_fast_path(self):
+        jnp = pytest.importorskip("jax.numpy")
+        arr = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        sobj = ser.try_serialize_array(arr)
+        assert sobj is not None
+        out = ser.SerializationContext().deserialize(
+            memoryview(sobj.to_bytes()))
+        assert np.array_equal(out, np.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# transfer progress (relay source)
+# ---------------------------------------------------------------------------
+class TestTransferProgress:
+    def test_interval_merge_and_coverage(self):
+        p = TransferProgress("ab", 100)
+        p.reset(memoryview(bytearray(100)))
+        p.mark(0, 10)
+        p.mark(20, 10)
+        assert p.covered(0, 10) and not p.covered(0, 30)
+        p.mark(10, 10)  # bridges the gap
+        assert p.covered(0, 30)
+        assert p.stats()["bytes_done"] == 30
+        # length clamps to the object size
+        p.mark(30, 70)
+        assert p.covered(90, 10) and p.covered(90, 10_000)
+
+    def test_wait_covered_wakes_on_mark_and_fail(self):
+        import asyncio
+
+        async def scenario():
+            p = TransferProgress("ab", 100)
+            p.reset(memoryview(bytearray(100)))
+            waiter = asyncio.ensure_future(p.wait_covered(40, 20, 5))
+            await asyncio.sleep(0)
+            p.mark(40, 20)
+            assert await waiter
+            # timeout expires for a range that never arrives
+            assert not await p.wait_covered(90, 10, 0.05)
+            # fail() wakes parked waiters with a False verdict
+            late = asyncio.ensure_future(p.wait_covered(90, 10, 5))
+            await asyncio.sleep(0)
+            p.fail()
+            assert not await late
+            assert p.view is None
+
+        asyncio.run(scenario())
+
+    def test_reset_discards_stale_marks(self):
+        p = TransferProgress("ab", 100)
+        p.reset(memoryview(bytearray(100)))
+        p.mark(0, 100)
+        p.reset(memoryview(bytearray(100)))  # retry, fresh view
+        assert not p.covered(0, 1)
+
+
+# ---------------------------------------------------------------------------
+# head-side tree registry
+# ---------------------------------------------------------------------------
+def _addr(i):
+    return {"host": "10.0.0.1", "port": i}
+
+
+class TestBcastTreeRegistry:
+    def test_log_n_depth_and_fanout(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_BCAST_FANOUT", "2")
+        r = BcastTreeRegistry()
+        for i in range(1, 16):
+            reply = r.join("obj", 1000, _addr(100 + i), [_addr(1)])
+            assert "parent" in reply, reply
+        st = r.stats("obj")
+        assert st["nodes"] == 16  # root + 15 consumers
+        # fanout-2 tree of 16 nodes: depth exactly ceil(log2) shaped
+        assert st["depth_max"] <= 4
+        assert all(len(c) <= 2 for c in st["edges"].values())
+
+    def test_join_is_idempotent(self):
+        r = BcastTreeRegistry()
+        a = r.join("obj", 10, _addr(5), [_addr(1)])
+        b = r.join("obj", 10, _addr(5), [_addr(1)])
+        assert a == b
+        assert r.stats("obj")["nodes"] == 2
+
+    def test_interior_death_reparents_subtree(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_BCAST_FANOUT", "1")
+        r = BcastTreeRegistry()
+        # chain: root <- 2 <- 3 <- 4 (fanout 1 forces a line)
+        for i in (2, 3, 4):
+            reply = r.join("obj", 10, _addr(i), [_addr(1)])
+            assert reply["depth"] == i - 1
+        # node 3 reports node 2 dead: it must land on a LIVE ancestor
+        reply = r.reparent("obj", _addr(3), _addr(2))
+        assert reply["parent"]["port"] == 1
+        assert reply["depth"] == 1
+        st = r.stats("obj")
+        assert st["states"]["dead"] == 1
+        # node 4 (child of 3) had its depth recomputed through the hoist
+        reply4 = r.join("obj", 10, _addr(4), [])
+        assert reply4["depth"] == 2
+        # new joiners are never routed to the dead node
+        for i in (5, 6, 7):
+            reply = r.join("obj", 10, _addr(i), [])
+            assert reply["parent"]["port"] != 2
+
+    def test_cluster_death_verdict_fails_node_everywhere(self):
+        r = BcastTreeRegistry()
+        r.join("a", 10, _addr(2), [_addr(1)])
+        r.join("b", 10, _addr(2), [_addr(1)])
+        r.on_node_removed(_addr(2))
+        assert r.stats("a")["states"]["dead"] == 1
+        assert r.stats("b")["states"]["dead"] == 1
+        # a retried join from a fresh boot of the same addr re-enters
+        reply = r.join("a", 10, _addr(2), [])
+        assert "parent" in reply
+
+    def test_all_roots_dead_falls_back(self):
+        r = BcastTreeRegistry()
+        r.join("obj", 10, _addr(2), [_addr(1)])
+        r.on_node_removed(_addr(1))
+        r.on_node_removed(_addr(2))
+        assert "fallback" in r.join("obj", 10, _addr(3), [])
+
+    def test_idle_trees_gc(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_BCAST_TREE_TTL_S", "0.05")
+        r = BcastTreeRegistry()
+        r.join("obj", 10, _addr(2), [_addr(1)])
+        time.sleep(0.1)
+        r.join("other", 10, _addr(2), [_addr(1)])  # any mutation GCs
+        assert "obj" not in r.trees
+
+
+# ---------------------------------------------------------------------------
+# tiered spill: shm -> disk -> remote holder
+# ---------------------------------------------------------------------------
+class TestTieredSpill:
+    def _mk(self, tmp_path, name, spill_dir=None, capacity=5 * MB):
+        return StoreDirectory(str(tmp_path / name), capacity=capacity,
+                              spill_dir=spill_dir)
+
+    def _seal(self, store, data, pin=True):
+        oid = ObjectID(os.urandom(20))
+        store.client.put_bytes(oid, data)
+        store.on_sealed(oid.hex(), len(data))
+        if pin:
+            store.pin(oid.hex())
+        return oid.hex()
+
+    def test_pinned_overflow_spills_to_disk(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
+        store = self._mk(tmp_path, "s1")
+        first = self._seal(store, os.urandom(2 * MB))
+        self._seal(store, os.urandom(2 * MB))
+        self._seal(store, os.urandom(2 * MB))  # overflow: oldest -> disk
+        assert store.spill_tier(first) == "disk"
+        assert store.contains(first)  # disk tier is still local
+        view = store.read_maybe_spilled(first)
+        assert view is not None and len(view) >= 2 * MB
+        assert store.tier_stats()["num_restores"] == 1
+
+    def test_disk_unavailable_demotes_to_remote_tier(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
+        blocked = tmp_path / "blocked"
+        blocked.write_bytes(b"not a directory")
+        store = self._mk(tmp_path, "s2", spill_dir=str(blocked))
+        first = self._seal(store, os.urandom(2 * MB))
+        second = self._seal(store, os.urandom(2 * MB))
+        store.note_remote_source(first, [{"host": "10.0.0.9", "port": 1}])
+        # overflow: disk spill fails (spill dir is a file), so the sourced
+        # object drops to the remote tier
+        self._seal(store, os.urandom(2 * MB))
+        assert store.spill_tier(first) == "remote"
+        assert not store.contains(first)  # restore goes via the pull plane
+        assert store.remote_sources_for(first) == [
+            {"host": "10.0.0.9", "port": 1}]
+        st = store.tier_stats()
+        assert st["num_remote_demotions"] == 1 and st["remote_objects"] == 1
+        # nothing else has a source: the next overflow is a hard error
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        with pytest.raises(ObjectStoreFullError):
+            store.on_sealed("ff" * 20, 2 * MB)
+        assert store.spill_tier(second) == "shm"
+
+    def test_remote_restore_reseals_locally(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
+        blocked = tmp_path / "blocked2"
+        blocked.write_bytes(b"x")
+        store = self._mk(tmp_path, "s3", spill_dir=str(blocked))
+        data = os.urandom(2 * MB)
+        first = self._seal(store, data)
+        store.note_remote_source(first, [{"host": "10.0.0.9", "port": 1}])
+        second = self._seal(store, os.urandom(2 * MB))
+        third = self._seal(store, os.urandom(2 * MB))
+        assert store.spill_tier(first) == "remote"
+        # consumers moved on: the fillers unpin, making room for the
+        # restore to evict them
+        store.unpin(second)
+        store.unpin(third)
+        # the pull plane re-fetches and seals: the record clears
+        store.client.put_bytes(ObjectID.from_hex(first), data)
+        store.on_sealed(first, len(data))
+        assert store.spill_tier(first) == "shm"
+        assert store.tier_stats()["remote_objects"] == 0
+
+    def test_disk_cap_demotes_sourced_entries(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
+        monkeypatch.setenv("RAY_TPU_OBJECT_SPILL_DISK_MAX_BYTES",
+                           str(2 * MB + 1))
+        store = self._mk(tmp_path, "s4")
+        first = self._seal(store, os.urandom(2 * MB))
+        store.note_remote_source(first, [{"host": "10.0.0.9", "port": 1}])
+        self._seal(store, os.urandom(2 * MB))
+        self._seal(store, os.urandom(2 * MB))  # spills `first` to disk
+        assert store.spill_tier(first) == "disk"
+        self._seal(store, os.urandom(2 * MB))  # spills #2; cap demotes first
+        assert store.spill_tier(first) == "remote"
+        assert store.tier_stats()["disk_bytes"] <= 2 * MB + 1
+
+    def test_dead_source_forgotten(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_STORE_BACKEND", "tmpfs")
+        store = self._mk(tmp_path, "s5")
+        first = self._seal(store, os.urandom(MB))
+        store.note_remote_source(first, [{"host": "10.0.0.9", "port": 1}])
+        store.forget_remote_source({"host": "10.0.0.9", "port": 1})
+        assert store.remote_sources_for(first) == []
+
+
+# ---------------------------------------------------------------------------
+# integration: broadcast to 4 consumers (+ chaos)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def bcast_cluster(monkeypatch):
+    """Factory: env -> (cluster, consumer_nodes). Head node hosts the
+    producer (resource `src`); each consumer node gets `far{i}`."""
+    made = []
+
+    def boot(n_consumers=4, env=None):
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        cluster = Cluster(
+            initialize_head=True,
+            head_node_args={"num_cpus": 2, "resources": {"src": 4}})
+        made.append(cluster)
+        ray_tpu.init(_node=cluster.head_node)
+        nodes = [cluster.add_node(num_cpus=1, resources={f"far{i}": 1})
+                 for i in range(n_consumers)]
+        cluster.wait_for_nodes()
+        return cluster, nodes
+
+    yield boot
+    try:
+        ray_tpu.shutdown()
+    finally:
+        for cluster in made:
+            cluster.shutdown()
+
+
+def _consumer(i):
+    @ray_tpu.remote(resources={f"far{i}": 1}, max_retries=0)
+    def consume(wrapped):
+        import hashlib as _h
+
+        import ray_tpu as _rt
+        from ray_tpu._private import worker as worker_mod
+
+        arr = _rt.get(wrapped[0], timeout=240)
+        w = worker_mod.global_worker
+        stats = w._acall(w.agent.call("GetPullStats", {}))
+        return {
+            "sha": _h.sha256(arr).hexdigest(),
+            "nbytes": arr.nbytes,
+            "depth": stats["bcast_tree_depth"],
+            "tree_pulls": stats["bcast_tree_pulls"],
+            "relay_bytes": stats["bcast_relay_bytes"],
+            "fallbacks": stats["bcast_fallbacks"],
+        }
+
+    return consume
+
+
+def _head_bcast_stats(object_id=None):
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    return w.head_call("BcastStats", {"object_id": object_id}, timeout=10)
+
+
+def test_broadcast_64mb_to_4_consumers(bcast_cluster):
+    """64 MB produced once, consumed on 4 agents through the spanning
+    tree: byte-identical everywhere, tree depth >= 2 (so at least one
+    consumer was served by a non-root relay), zero-copy put counted."""
+    bcast_cluster()
+
+    @ray_tpu.remote(resources={"src": 1})
+    def produce():
+        rng = np.random.default_rng(2026)
+        return rng.integers(0, 255, 64 * MB, dtype=np.uint8)
+
+    expected = np.random.default_rng(2026).integers(
+        0, 255, 64 * MB, dtype=np.uint8)
+    expected_sha = hashlib.sha256(expected).hexdigest()
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=120)
+    assert ready, "produce() did not finish"
+
+    results = ray_tpu.get(
+        [_consumer(i).remote([ref]) for i in range(4)], timeout=300)
+    for res in results:
+        assert res["nbytes"] == 64 * MB
+        assert res["sha"] == expected_sha, "broadcast corrupted bytes"
+        assert res["tree_pulls"] >= 1, f"consumer fell back: {res}"
+        assert res["depth"] >= 1
+    # fanout-2 tree with 4 consumers: someone sat at depth 2 — served by
+    # an interior relay, not the root
+    assert max(res["depth"] for res in results) >= 2, results
+
+    tree = _head_bcast_stats(ref.hex())
+    assert tree and tree["joins"] >= 4, tree
+    assert tree["depth_max"] >= 2
+    assert all(len(c) <= 2 for c in tree["edges"].values())
+
+    # the producer's put took the typed fast path: no pickle pass
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    stats = w._acall(w.agent.call("GetPullStats", {}))
+    assert stats["zero_copy_puts"] >= 1
+
+
+def test_zero_copy_get_returns_store_backed_view(bcast_cluster):
+    """A put/get round trip of a large array goes through the typed path
+    end to end: the counter increments and the value is intact (and the
+    returned array is a read-only view, not a pickle rebuild)."""
+    bcast_cluster(n_consumers=0)
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    before = w._acall(w.agent.call("GetPullStats", {}))["zero_copy_puts"]
+
+    arr = np.arange(8 * MB, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=60)
+    assert np.array_equal(out, arr)
+    assert not out.flags.writeable  # mmap-backed view, not a copy
+    after = w._acall(w.agent.call("GetPullStats", {}))["zero_copy_puts"]
+    assert after >= before + 1
+
+    # non-contiguous values fall back without incident (and without
+    # counting)
+    ref2 = ray_tpu.put(np.arange(4 * MB, dtype=np.float32)[::2])
+    assert ray_tpu.get(ref2, timeout=60)[1] == 2.0
+    final = w._acall(w.agent.call("GetPullStats", {}))["zero_copy_puts"]
+    assert final == after
+
+
+def test_interior_node_kill_mid_broadcast(bcast_cluster):
+    """kill -9 an interior tree node's agent while chunks stream (small
+    chunks + narrow window stretch the transfer): its subtree re-parents
+    through the registry and every surviving consumer lands
+    byte-identical results — no hang, no corruption."""
+    from ray_tpu.util.chaos import DaemonKiller
+
+    cluster, nodes = bcast_cluster(env={
+        "RAY_TPU_OBJECT_CHUNK_SIZE_BYTES": str(256 * 1024),
+        "RAY_TPU_OBJECT_PULL_WINDOW": "2",
+        "RAY_TPU_BCAST_MIN_BYTES": str(MB),
+        "RAY_TPU_PULL_DEAD_HOLDER_ROUNDS": "3",
+        "RAY_TPU_OBJECT_PULL_DEADLINE_S": "120",
+    })
+
+    @ray_tpu.remote(resources={"src": 1})
+    def produce():
+        rng = np.random.default_rng(7)
+        return rng.integers(0, 255, 32 * MB, dtype=np.uint8)
+
+    expected = np.random.default_rng(7).integers(
+        0, 255, 32 * MB, dtype=np.uint8)
+    expected_sha = hashlib.sha256(expected).hexdigest()
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=120)
+    assert ready
+
+    result_refs = [_consumer(i).remote([ref]) for i in range(4)]
+
+    # wait until the tree has an interior consumer (a non-root node with
+    # children), then SIGKILL its agent
+    root_key = None
+    victim_port = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and victim_port is None:
+        tree = _head_bcast_stats(ref.hex()) or {}
+        edges = tree.get("edges") or {}
+        for key, children in edges.items():
+            port = int(key.rsplit(":", 1)[1])
+            is_root = port == cluster.head_node.agent_tcp_port
+            if is_root:
+                root_key = key
+                continue
+            if children:
+                victim_port = port
+                break
+        if victim_port is None:
+            time.sleep(0.05)
+    assert root_key is not None, f"tree never formed: {tree}"
+
+    killed_idx = None
+    if victim_port is not None:
+        victim = next(n for n in nodes
+                      if n.agent_tcp_port == victim_port)
+        killed_idx = nodes.index(victim)
+        killer = DaemonKiller(cluster.session_dir, roles=("agent",),
+                              max_kills=1)
+        record = killer.kill_target(
+            {"role": "agent", "pid": victim.agent_proc.pid})
+        assert record is not None, "interior agent was not killed"
+
+    survivors = 0
+    for i, rref in enumerate(result_refs):
+        try:
+            res = ray_tpu.get(rref, timeout=240)
+        except Exception:
+            # only the killed node's own consumer may fail
+            assert i == killed_idx, (
+                f"consumer {i} failed but node {killed_idx} was killed")
+            continue
+        assert res["sha"] == expected_sha, (
+            f"consumer {i} got corrupted bytes after the failover")
+        survivors += 1
+    assert survivors >= 3, "the subtree did not recover"
+
+    if killed_idx is not None:
+        tree = _head_bcast_stats(ref.hex())
+        assert tree.get("states", {}).get("dead", 0) >= 1, tree

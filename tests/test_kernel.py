@@ -112,9 +112,17 @@ class TestSerialization:
         ctx = ser.SerializationContext()
         arr = np.arange(100_000, dtype=np.float64)
         sobj = ctx.serialize(arr)
-        assert len(sobj.buffers) >= 1  # big array went out-of-band
+        # bare contiguous arrays take the typed zero-copy path (ISSUE 9:
+        # header + raw buffer, no pickle at all)
+        assert isinstance(sobj, ser.ZeroCopyArray)
         out = ctx.deserialize(memoryview(sobj.to_bytes()))
         np.testing.assert_array_equal(arr, out)
+        # arrays nested in containers still ride pickle-5 out-of-band
+        # buffers (no inline copy into the pickle stream)
+        sobj = ctx.serialize({"w": arr})
+        assert len(sobj.buffers) >= 1
+        out = ctx.deserialize(memoryview(sobj.to_bytes()))
+        np.testing.assert_array_equal(arr, out["w"])
 
     def test_closure(self):
         ctx = ser.SerializationContext()

@@ -96,6 +96,7 @@ def _expect_lines(fixture, rule):
 @pytest.mark.parametrize("fixture,rule", [
     ("r1_memorystore_shape.py", "R1"),
     ("r4_leaked_task_shape.py", "R4"),
+    ("r9_view_escape_shape.py", "R9"),
 ])
 def test_fixture_trips_exactly_on_marked_lines(fixture, rule):
     path, expected = _expect_lines(fixture, rule)
@@ -128,6 +129,20 @@ def test_r4_flags_both_discard_shapes():
                    baseline_path=None)
     assert {v.symbol for v in res.violations} == {
         "ReadLoopOwnerShape.start", "spawn_and_forget"}
+
+
+def test_r9_flags_all_three_escape_shapes():
+    """Return, self-attribute, and closure-capture escapes each trip;
+    the pinned twins and the local-use-only reader do not (ISSUE 9's
+    view-lifetime contract)."""
+    path, _ = _expect_lines("r9_view_escape_shape.py", "R9")
+    res = run_lint([path], project_root=FIXTURES, rules=["R9"],
+                   baseline_path=None)
+    assert {v.symbol for v in res.violations} == {
+        "UnpinnedEscapes.read", "UnpinnedEscapes.cache",
+        "UnpinnedEscapes.serve_later.reply"}
+    # every message names the contract's remedy
+    assert all("pin" in v.message for v in res.violations)
 
 
 # ---------------------------------------------------------------------------

@@ -89,8 +89,11 @@ def test_large_pull_byte_identical(two_node):
 
 def test_batched_get_pulls_concurrently(two_node):
     """One `get` of 8 cross-node refs issues ONE WaitObjects frame, so the
-    agent overlaps all 8 transfers: wall time must look like ~1 pull, not
-    ~8 sequential pulls."""
+    agent overlaps the transfers. Asserted on the pull manager's
+    occupancy counters — `transfers_concurrent_peak` can only exceed 1
+    if two transfers were genuinely inside `_transfer` at once — instead
+    of wall-clock overlap, which flaked on slow boxes where scheduler
+    jitter dwarfed the transfer time."""
     two_node()
 
     @ray_tpu.remote(resources={"far": 0.25})
@@ -101,24 +104,28 @@ def test_batched_get_pulls_concurrently(two_node):
     ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
     assert len(ready) == len(refs)
 
-    t0 = time.perf_counter()
     one = ray_tpu.get(refs[0], timeout=120)
-    t_one = time.perf_counter() - t0
+    assert one[0] == 0
+    base = _pull_stats()
+    assert base["transfers_ok"] >= 1
 
     refs2 = [produce.remote(i) for i in range(8)]  # fresh object ids
     ready, _ = ray_tpu.wait(refs2, num_returns=len(refs2), timeout=120)
     assert len(ready) == len(refs2)
-    t0 = time.perf_counter()
     values = ray_tpu.get(refs2, timeout=120)
-    t_all = time.perf_counter() - t0
-
-    assert one[0] == 0
     for i, v in enumerate(values):
         assert v[0] == i and v.nbytes == 4 * MB
-    # generous bound (CI boxes jitter): 8 concurrent pulls must not cost
-    # anywhere near 8 sequential ones
-    assert t_all < max(8 * t_one * 0.75, t_one + 2.0), (
-        f"batched get looks sequential: one={t_one:.3f}s all={t_all:.3f}s")
+
+    stats = _pull_stats()
+    assert stats["transfers_ok"] >= base["transfers_ok"] + 8
+    # the batched get overlapped transfers (a sequential agent would
+    # never have two pulls inside _transfer simultaneously)
+    assert stats["transfers_concurrent_peak"] >= 2, stats
+    # and the per-holder chunk window pipelined within a transfer
+    assert stats["window_occupancy_peak"] >= 2, stats
+    # everything retired cleanly
+    assert stats["transfers_concurrent"] == 0
+    assert stats["inflight_bytes"] == 0
 
 
 def test_holder_killed_mid_transfer_no_hang(two_node):
