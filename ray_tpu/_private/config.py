@@ -122,6 +122,41 @@ _flag("worker_register_timeout_s", 60)
 _flag("idle_worker_killing_time_ms", 600_000)
 _flag("prestart_workers", True)
 
+# --- warm worker pool (ISSUE 10) ---------------------------------------------
+# Pre-warmed pool target: the agent keeps this many forked-but-idle
+# workers (booted through socket handshake + store attach, parked before
+# any actor-class unpickle) leasable for instant actor/task starts,
+# refilling in the background (reference: worker_pool.h prestart pools).
+# 0 = auto (max(2, num_cpus)); negative disables warm leasing entirely.
+_flag("worker_pool_warm_target", 0)
+# Background refill pacing: at most one warm fork per interval, so a
+# drained pool refills without starving the workload that drained it.
+_flag("worker_pool_refill_interval_ms", 50)
+# Warm workers BEYOND the target that stay idle past this are reaped
+# (returned leases accumulate after a burst; the target-sized core pool
+# is kept warm indefinitely).
+_flag("worker_pool_idle_ttl_s", 30.0)
+# Worker processes defer their head TCP connection off the boot critical
+# path (background connect): time-to-leasable drops by one TCP setup +
+# two subscribe round trips per worker. Head-bound calls queue behind
+# the pending connect via the outage machinery (head_call).
+_flag("worker_lazy_head_connect", True)
+
+# --- batched control RPCs (ISSUE 10) -----------------------------------------
+# Driver-side CreateActor coalescing: anonymous (unnamed, not
+# get_if_exists) creates enqueue for up to this window and ride ONE
+# CreateActorBatch RPC + one WAL group-commit instead of N serial round
+# trips. 0 disables (every create is a blocking RPC again).
+_flag("actor_create_batch_window_ms", 4.0)
+_flag("actor_create_batch_max", 256)  # flush immediately at this size
+# Agent-side ActorReady relay coalescing: workers report readiness to
+# their node agent (unix socket); the agent flushes one ActorReadyBatch
+# head RPC per window, acking workers only after the head acked.
+_flag("actor_ready_batch_window_ms", 5.0)
+# Lease-request batching: a pool wanting k leases in one pump sends one
+# RequestWorkerLeaseBatch frame; grants stream back per entry.
+_flag("lease_batch_enabled", True)
+
 # --- fault tolerance --------------------------------------------------------
 _flag("task_max_retries_default", 3)
 _flag("actor_max_restarts_default", 0)
@@ -177,7 +212,13 @@ _flag("task_event_flush_batch", 5000)  # size backstop between periodic
 # flushes on a 1s timer, task_events_report_interval_ms; a small size
 # trigger made every 50th task in a burst pay a head round-trip)
 _flag("rpc_drain_threshold_bytes", 64 * 1024)  # write-combining flush point
-_flag("head_watchdog_period_s", 2.0)  # driver/worker head-liveness probes
+_flag("head_watchdog_period_s", 2.0)  # driver head-liveness probes
+# Executor workers probe the head far less often (ISSUE 10): their head
+# link only serves actor resolution / task events — reconnect-after-
+# restart can lag — while at 1,000 workers a 2s ping each means 500
+# head RPCs/s of pure liveness noise. Node liveness stays the agent's
+# 2s watchdog; connection loss still fails fast via the read loop.
+_flag("worker_head_watchdog_period_s", 15.0)
 _flag("agent_head_gone_exit_s", 120.0)  # agent suicide after head unreachable
 _flag("autoscaler_boot_timeout_s", 120.0)  # launched-node registration window
 
@@ -235,7 +276,10 @@ _flag("pg_retry_place_period_s", 0.5)  # pending-PG placement retry cadence
 _flag("pg_resolve_poll_s", 0.1)  # lease pool waiting for PG placement
 _flag("wait_poll_interval_s", 0.002)  # ray.wait readiness re-check
 _flag("node_boot_poll_s", 0.02)  # head/agent subprocess startup polling
-_flag("worker_park_poll_s", 0.5)  # worker main-thread liveness park
+_flag("worker_park_poll_s", 2.0)  # worker main-thread liveness park
+# (2s: the park check is a fallback — PDEATHSIG + the agent connection
+# drop are the fast death paths; at 1,000 workers a 0.5s poll was 2,000
+# wakeup syscalls/s of background burn)
 _flag("conda_failure_cache_s", 60.0)  # failed-env fast-fail window
 
 # --- TPU --------------------------------------------------------------------

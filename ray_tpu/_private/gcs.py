@@ -36,8 +36,8 @@ import json
 import logging
 import os
 import time
-from collections import deque
-from typing import Any, Dict, List, Optional
+import bisect
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.protocol import Connection, RpcServer
@@ -155,6 +155,44 @@ class ActorInfo:
         }
 
 
+class _NodeRank:
+    """Utilization-ordered index of schedulable nodes (ISSUE 10).
+
+    Maintained incrementally on node deltas (register / resource report /
+    death / recovery), so a placement walks candidates in
+    least-utilized-first order and stops at the first fit — per-placement
+    cost no longer pays a full sort of every alive node. Updates are
+    O(log n) to locate + O(n) list splice, paid per *node event*; the
+    hot path (a 1,000-actor creation burst) is placements, not node
+    events."""
+
+    def __init__(self):
+        self._keys: List[Tuple[float, str]] = []  # sorted (util, node_id)
+        self._cur: Dict[str, Tuple[float, str]] = {}
+
+    def update(self, node_id: str, util: float) -> None:
+        self.remove(node_id)
+        key = (util, node_id)
+        bisect.insort(self._keys, key)
+        self._cur[node_id] = key
+
+    def remove(self, node_id: str) -> None:
+        key = self._cur.pop(node_id, None)
+        if key is not None:
+            i = bisect.bisect_left(self._keys, key)
+            if i < len(self._keys) and self._keys[i] == key:
+                self._keys.pop(i)
+
+    def __contains__(self, node_id: str) -> bool:
+        return node_id in self._cur
+
+    def __len__(self) -> int:
+        return len(self._cur)
+
+    def ordered_ids(self) -> List[str]:
+        return [node_id for _util, node_id in self._keys]
+
+
 class HeadServer:
     """The cluster brain. All state lives here; agents and drivers connect in."""
 
@@ -175,10 +213,26 @@ class HeadServer:
         self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> key -> value
         self.jobs: Dict[str, Dict] = {}
         self.placement_groups: Dict[str, Dict] = {}
-        # (placed_at, ActorInfo) of in-flight placements younger than the
-        # gossip window — the anti-double-booking scan reads this instead
-        # of every actor in the cluster (O(N^2) across a creation burst)
-        self._recent_placements: deque = deque()
+        # ---- O(1) incremental scheduler state (ISSUE 10) ----
+        # Per-node committed-resources ledger: in-flight placements
+        # (StartActor pushed, not yet ready) counted against a candidate's
+        # advertised availability. Insertion-ordered per node so age-out
+        # prunes from the front; entries leave on ready/death. Replaces
+        # both the full-cluster actor scan (pre-round-5) and the
+        # _recent_placements deque with its per-placement dedupe pass.
+        self._committed_nodes: Dict[str, Dict[str, Tuple[float, ResourceSet]]] = {}
+        self._committed_agg: Dict[str, ResourceSet] = {}
+        self._committed_node_of: Dict[str, str] = {}  # actor_id -> node_id
+        # actor indexes maintained on every state/node transition, so node
+        # death/claim/driver-exit cascades and the metrics loop stop
+        # scanning the whole actor table per event
+        self._actors_by_node: Dict[str, Set[str]] = {}
+        self._actors_by_job: Dict[Optional[str], Set[str]] = {}
+        self._actor_state_counts: Dict[str, int] = {}
+        # schedulable nodes (alive, claimed) ranked by utilization:
+        # candidate selection walks this in order and stops at the first
+        # fit instead of re-sorting every alive node per placement
+        self._node_rank = _NodeRank()
         self.subscribers: Dict[str, set] = {}  # channel -> set[Connection]
         # broadcast-tree coordination (device object plane, ISSUE 9):
         # transient transfer topology, deliberately NOT WAL-durable — a
@@ -272,6 +326,11 @@ class HeadServer:
             return
         self.head_incarnation += 1
         self._begin_recovery(wal_records)
+        # snapshot restore + WAL replay mutate ActorInfo/NodeInfo fields
+        # directly; derive the incremental scheduler indexes once here
+        self._rebuild_actor_indexes()
+        for node in self.nodes.values():
+            self._rank_update(node)
 
     def _apply_snapshot(self, state: Dict) -> None:
         self.kv = state.get("kv", {})
@@ -439,8 +498,9 @@ class HeadServer:
                 await self._durable("job", {"key": job_id, "job": dict(job)})
                 reconciled += 1
             # its non-detached actors die with the lost driver
-            for actor in list(self.actors.values()):
-                if actor.owner_job == job_id and not actor.detached \
+            for actor_id in list(self._actors_by_job.get(job_id, ())):
+                actor = self.actors.get(actor_id)
+                if actor is not None and not actor.detached \
                         and actor.owner_conn is None \
                         and actor.state != ACTOR_DEAD:
                     await self._kill_actor_internal(
@@ -464,18 +524,20 @@ class HeadServer:
         means the worker died during the head outage."""
         node.recovering = False
         self.recovering_nodes.discard(node.node_id)
+        self._rank_update(node)
         reported = set(reported_actors or [])
         claimed: List[ActorInfo] = []
         lost: List[ActorInfo] = []
-        for actor in list(self.actors.values()):
-            if actor.node_id != node.node_id or not actor.recovering:
+        for actor_id in list(self._actors_by_node.get(node.node_id, ())):
+            actor = self.actors.get(actor_id)
+            if actor is None or not actor.recovering:
                 continue
             actor.recovering = False
             self.recovering_actors.discard(actor.actor_id)
             if actor.state != ACTOR_RECOVERING:
                 continue
             if actor.actor_id in reported:
-                actor.state = ACTOR_ALIVE
+                self._actor_set_state(actor, ACTOR_ALIVE)
                 actor.note("claimed by re-registered agent")
                 claimed.append(actor)
             else:
@@ -619,6 +681,130 @@ class HeadServer:
         task.add_done_callback(self._bg_tasks.discard)
         return task
 
+    # ------------------------------------- O(1) scheduler state (ISSUE 10)
+    def _index_new_actor(self, info: ActorInfo) -> None:
+        self._actor_state_counts[info.state] = \
+            self._actor_state_counts.get(info.state, 0) + 1
+        self._actors_by_job.setdefault(info.owner_job, set()).add(
+            info.actor_id)
+        if info.node_id and info.state != ACTOR_DEAD:
+            self._actors_by_node.setdefault(info.node_id, set()).add(
+                info.actor_id)
+
+    def _actor_set_state(self, info: ActorInfo, state: str) -> None:
+        """Single choke point for actor state transitions: keeps the
+        per-state counts (metrics loop) and the node index exact without
+        any table scan."""
+        if state == info.state:
+            return
+        prev = self._actor_state_counts.get(info.state, 0) - 1
+        if prev > 0:
+            self._actor_state_counts[info.state] = prev
+        else:
+            self._actor_state_counts.pop(info.state, None)
+        info.state = state
+        self._actor_state_counts[state] = \
+            self._actor_state_counts.get(state, 0) + 1
+        if state == ACTOR_DEAD:
+            self._uncommit_placement(info.actor_id)
+            if info.node_id:
+                bucket = self._actors_by_node.get(info.node_id)
+                if bucket is not None:
+                    bucket.discard(info.actor_id)
+                    if not bucket:
+                        self._actors_by_node.pop(info.node_id, None)
+
+    def _actor_set_node(self, info: ActorInfo, node_id: Optional[str]) -> None:
+        if node_id == info.node_id:
+            return
+        if info.node_id:
+            bucket = self._actors_by_node.get(info.node_id)
+            if bucket is not None:
+                bucket.discard(info.actor_id)
+                if not bucket:
+                    self._actors_by_node.pop(info.node_id, None)
+        info.node_id = node_id
+        if node_id and info.state != ACTOR_DEAD:
+            self._actors_by_node.setdefault(node_id, set()).add(
+                info.actor_id)
+
+    def _rebuild_actor_indexes(self) -> None:
+        """Recompute the derived actor indexes from the actor table —
+        load-time only (snapshot restore + WAL replay mutate ActorInfo
+        fields directly); every runtime transition goes through the
+        incremental helpers."""
+        self._actors_by_node = {}
+        self._actors_by_job = {}
+        self._actor_state_counts = {}
+        for info in self.actors.values():
+            self._index_new_actor(info)
+
+    @property
+    def COMMIT_WINDOW_S(self) -> float:
+        # once the target agent's next resource report lands (~one gossip
+        # period) its advertised availability already reflects the
+        # placement; only younger commitments must be double-counted
+        return max(1.5, 3 * CONFIG.gossip_period_ms / 1000.0)
+
+    def _commit_placement(self, info: ActorInfo, request: ResourceSet,
+                          node_id: str) -> None:
+        self._uncommit_placement(info.actor_id)
+        entries = self._committed_nodes.setdefault(node_id, {})
+        entries[info.actor_id] = (time.monotonic(), request)
+        agg = self._committed_agg.get(node_id)
+        if agg is None:
+            agg = self._committed_agg[node_id] = ResourceSet({})
+        agg.add(request)
+        self._committed_node_of[info.actor_id] = node_id
+
+    def _uncommit_placement(self, actor_id: str) -> None:
+        node_id = self._committed_node_of.pop(actor_id, None)
+        if node_id is None:
+            return
+        entries = self._committed_nodes.get(node_id)
+        if entries is None:
+            return
+        entry = entries.pop(actor_id, None)
+        if entry is not None:
+            if entries:
+                self._committed_agg[node_id].subtract(
+                    entry[1], allow_negative=True)
+            else:
+                # empty ledger: drop the aggregate instead of subtracting
+                # down — float drift from add/subtract churn self-heals
+                self._committed_nodes.pop(node_id, None)
+                self._committed_agg.pop(node_id, None)
+
+    def _prune_committed(self, node_id: str) -> None:
+        """Age out commitments older than the gossip window. Entries are
+        insertion-ordered (placements happen in time order), so this pops
+        from the front — amortized O(1) per placement."""
+        entries = self._committed_nodes.get(node_id)
+        if not entries:
+            return
+        horizon = time.monotonic() - self.COMMIT_WINDOW_S
+        for actor_id in list(entries):
+            if entries[actor_id][0] >= horizon:
+                break
+            self._uncommit_placement(actor_id)
+
+    def _effective_available(self, node: NodeInfo) -> ResourceSet:
+        self._prune_committed(node.node_id)
+        avail = node.resources.available.copy()
+        pending = self._committed_agg.get(node.node_id)
+        if pending is not None:
+            avail.subtract(pending, allow_negative=True)
+        return avail
+
+    def _rank_update(self, node: NodeInfo) -> None:
+        """Re-rank one node after a delta (register, resource report,
+        death, recovery transition)."""
+        if node.alive and not node.recovering:
+            self._node_rank.update(node.node_id,
+                                   node.resources.utilization())
+        else:
+            self._node_rank.remove(node.node_id)
+
     # ------------------------------------------------------------------ boot
     async def start(self) -> int:
         self.port = await self.server.start_tcp("0.0.0.0", self.port)
@@ -697,7 +883,9 @@ class HeadServer:
         r("KvKeys", self._kv_keys)
         r("KvExists", self._kv_exists)
         r("CreateActor", self._create_actor)
+        r("CreateActorBatch", self._create_actor_batch)
         r("ActorReady", self._actor_ready)
+        r("ActorReadyBatch", self._actor_ready_batch)
         r("ActorDied", self._actor_died)
         r("GetActor", self._get_actor)
         r("GetNamedActor", self._get_named_actor)
@@ -776,6 +964,7 @@ class HeadServer:
                 existing.disconnected_at = None
                 conn.meta["node_id"] = node_id
                 conn.meta["role"] = "agent"
+                self._rank_update(existing)
                 if existing.recovering:
                     # restored-from-durable-store node claimed: reconcile
                     # its actors against the agent's ACTUAL live set
@@ -798,6 +987,7 @@ class HeadServer:
         self.nodes[node_id] = info
         conn.meta["node_id"] = node_id
         conn.meta["role"] = "agent"
+        self._rank_update(info)
         # durable BEFORE the ack: an acked membership must survive kill -9
         await self._durable("node_register", {
             "node_id": node_id, "incarnation": incarnation,
@@ -816,7 +1006,10 @@ class HeadServer:
         # blip): move actor ownership onto the new connection so the old
         # connection's disconnect can't reap them
         old_conn = self._driver_conns.get(job_id)
-        for actor in self.actors.values():
+        for actor_id in self._actors_by_job.get(job_id, ()):
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                continue
             if actor.owner_conn is old_conn and old_conn is not None \
                     and old_conn is not conn:
                 actor.owner_conn = conn
@@ -858,6 +1051,7 @@ class HeadServer:
             self.report_stats.get("full_reports", 0) + 1
         node.resources = NodeResources.from_wire(p["resources"])
         node.pending_demand = p.get("pending", [])
+        self._rank_update(node)
 
     async def _get_report_stats(self, conn: Connection, p) -> Dict:
         return dict(self.report_stats)
@@ -919,6 +1113,10 @@ class HeadServer:
         node.alive = False
         node.recovering = False
         self.recovering_nodes.discard(node.node_id)
+        self._rank_update(node)
+        # in-flight placement commitments to a dead node are moot
+        for actor_id in list(self._committed_nodes.get(node.node_id, ())):
+            self._uncommit_placement(actor_id)
         if CONFIG.node_fence_enabled:
             # fence THIS incarnation: a later re-register from it (the
             # partition healed) is rejected; a fresh boot (higher
@@ -968,8 +1166,11 @@ class HeadServer:
         # Every actor on that node dies with it — including RECOVERING
         # ones: once the node's death is known there is nothing left to
         # claim them, so failing over NOW beats waiting out the window.
-        for actor in list(self.actors.values()):
-            if actor.node_id == node.node_id and actor.state in (
+        # Indexed by node: the cascade reads only the dead node's actors,
+        # not the whole cluster's table.
+        for actor_id in list(self._actors_by_node.get(node.node_id, ())):
+            actor = self.actors.get(actor_id)
+            if actor is not None and actor.state in (
                 ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING,
                 ACTOR_RECOVERING,
             ):
@@ -993,9 +1194,9 @@ class HeadServer:
         while True:
             await asyncio.sleep(period)
             try:
-                actor_states: Dict[str, int] = {}
-                for a in self.actors.values():
-                    actor_states[a.state] = actor_states.get(a.state, 0) + 1
+                # maintained incrementally on every transition — no
+                # per-tick scan of a 5,000-actor table
+                actor_states = dict(self._actor_state_counts)
                 snaps = [
                     g("ray_tpu_gcs_nodes_alive", "Registered alive nodes.",
                       sum(1 for n in self.nodes.values() if n.alive)),
@@ -1092,8 +1293,10 @@ class HeadServer:
                     await self._durable("job", {
                         "key": job_id, "job": dict(self.jobs[job_id])})
                 # Non-detached actors owned by this driver die with it.
-                for actor in list(self.actors.values()):
-                    if actor.owner_conn is conn and not actor.detached \
+                for actor_id in list(self._actors_by_job.get(job_id, ())):
+                    actor = self.actors.get(actor_id)
+                    if actor is not None and actor.owner_conn is conn \
+                            and not actor.detached \
                             and actor.state != ACTOR_DEAD:
                         await self._kill_actor_internal(
                             actor, "owner driver exited")
@@ -1157,7 +1360,12 @@ class HeadServer:
         return p["key"] in self.kv.get(p.get("ns", "default"), {})
 
     # --------------------------------------------------------------- actors
-    async def _create_actor(self, conn: Connection, p: Dict) -> Dict:
+    def _admit_actor(self, conn: Connection, p: Dict
+                     ) -> Tuple[Optional[Dict], Optional[ActorInfo],
+                                Optional[Tuple[str, Dict]]]:
+        """Registry admission shared by single and batched creates:
+        returns (terminal_reply, new_info, durable_op). Exactly one of
+        terminal_reply / new_info is set; raises for a taken name."""
         spec = p["spec"]
         actor_id = p["actor_id"]
         name = p.get("name", "")
@@ -1171,33 +1379,104 @@ class HeadServer:
             # double-create or fail a create that actually succeeded
             if dup.owner_conn is None or dup.owner_conn.closed:
                 dup.owner_conn = conn
-            return {"actor_id": actor_id, "state": dup.state}
+            return {"actor_id": actor_id, "state": dup.state}, None, None
         if name:
             existing_id = self.named_actors.get((namespace, name))
             if existing_id:
                 existing = self.actors.get(existing_id)
                 if existing and existing.state != ACTOR_DEAD:
                     if p.get("get_if_exists"):
-                        return {"existing": existing.public_view()}
+                        return {"existing": existing.public_view()}, \
+                            None, None
                     raise ValueError(f"actor name '{name}' already taken")
         info = ActorInfo(actor_id, spec, name, namespace,
                          p.get("max_restarts", 0), conn)
         info.owner_job = conn.meta.get("job_id")
         self.actors[actor_id] = info
+        self._index_new_actor(info)
         if name:
             self.named_actors[(namespace, name)] = actor_id
+        return None, info, ("actor_create", self._actor_record(info))
+
+    async def _create_actor(self, conn: Connection, p: Dict) -> Dict:
+        reply, info, op = self._admit_actor(conn, p)
+        if reply is not None:
+            return reply
         # durable before scheduling (and before the ack): a kill -9 right
         # after this reply restores the actor PENDING and reschedules it
-        await self._durable("actor_create", self._actor_record(info))
+        await self._durable(*op)
         ok = await self._schedule_actor(info)
         if not ok:
             # No feasible node right now; keep PENDING and retry when nodes join
             self._hold_task(asyncio.get_running_loop().create_task(
                 self._retry_schedule(info)))
-        return {"actor_id": actor_id, "state": info.state}
+        return {"actor_id": info.actor_id, "state": info.state}
 
-    async def _schedule_actor(self, info: ActorInfo) -> bool:
-        """Pick the least-utilized feasible node (GcsActorScheduler analog)."""
+    async def _create_actor_batch(self, conn: Connection, p: Dict) -> Dict:
+        """Coalesced driver-side creates (ISSUE 10): one frame, one WAL
+        group commit, and StartActor pushes grouped into ONE
+        StartActorBatch frame per target node. Entries keep per-entry
+        semantics — a taken name (or any admission error) fails only its
+        entry, and the at-least-once dedupe-by-actor-id contract of the
+        single path is identical."""
+        results: List[Dict] = []
+        admitted: List[ActorInfo] = []
+        ops: List[Tuple[str, Dict]] = []
+        for entry in p.get("items", ()):
+            try:
+                reply, info, op = self._admit_actor(conn, entry)
+            except ValueError as e:
+                results.append({"actor_id": entry.get("actor_id"),
+                                "error": str(e)})
+                continue
+            if reply is not None:
+                results.append(reply)
+                continue
+            admitted.append(info)
+            ops.append(op)
+            results.append({"actor_id": info.actor_id, "state": info.state})
+        # one fsync window for the whole burst, before any entry is acked
+        await self._durable_batch(ops)
+        sink: List[Tuple[NodeInfo, ActorInfo, Dict]] = []
+        for info in admitted:
+            if not await self._schedule_actor(info, push_sink=sink):
+                self._hold_task(asyncio.get_running_loop().create_task(
+                    self._retry_schedule(info)))
+        by_node: Dict[str, Tuple[NodeInfo, List[ActorInfo], List[Dict]]] = {}
+        for node, info, payload in sink:
+            entry = by_node.setdefault(node.node_id, (node, [], []))
+            entry[1].append(info)
+            entry[2].append(payload)
+        for node, infos, payloads in by_node.values():
+            try:
+                if len(payloads) == 1:
+                    await node.conn.push("StartActor", payloads[0])
+                else:
+                    await node.conn.push("StartActorBatch",
+                                         {"items": payloads})
+            except Exception:
+                # lost frame: re-arm the normal retry machinery per actor
+                for info in infos:
+                    self._hold_task(asyncio.get_running_loop().create_task(
+                        self._retry_schedule(info)))
+        return {"results": results}
+
+    async def _schedule_actor(self, info: ActorInfo,
+                              push_sink: Optional[List] = None) -> bool:
+        """Pick the least-utilized feasible node (GcsActorScheduler analog).
+
+        O(1)-per-placement in the common case (ISSUE 10): candidates come
+        from the utilization-ranked schedulable-node index — the walk
+        stops at the first node whose committed-adjusted availability
+        fits — and the anti-double-booking accounting reads the
+        incrementally-maintained per-node committed ledger instead of
+        scanning actors (reference: GcsActorScheduler tracks leased
+        resources per node). Constrained placements (PG / affinity /
+        labels) filter the same ranked order.
+
+        With ``push_sink``, the chosen (node, info, payload) is appended
+        instead of pushed — the batched create path groups one
+        StartActorBatch frame per node."""
         request = ResourceSet.from_wire(info.spec_wire.get("resources", {}))
         strategy = info.spec_wire.get("scheduling_strategy")
         pg = info.spec_wire.get("pg")  # [pg_id, bundle_index] or None
@@ -1218,76 +1497,61 @@ class HeadServer:
                 pg_node = group["placement"][rr % len(group["placement"])]
             else:
                 pg_node = group["placement"][pg[1]]
-        candidates = []
-        for node in self.nodes.values():
-            if not node.alive:
-                continue
-            if node.recovering:
-                continue  # not claimed yet: placement frames would be lost
-            if pg_node is not None and node.node_id != pg_node:
-                continue
-            if strategy and strategy.get("type") == "node_affinity":
-                if node.node_id != strategy.get("node_id"):
+        node: Optional[NodeInfo] = None
+        if pg_node is not None or strategy:
+            # constrained path: filter the ranked order (already ascending
+            # by utilization, alive + claimed only)
+            candidates = []
+            for node_id in self._node_rank.ordered_ids():
+                n = self.nodes.get(node_id)
+                if n is None:
                     continue
+                if pg_node is not None and n.node_id != pg_node:
+                    continue
+                if strategy and strategy.get("type") == "node_affinity":
+                    if n.node_id != strategy.get("node_id"):
+                        continue
+                if strategy and strategy.get("type") == "node_label":
+                    if not label_constraints_match(
+                            n.labels, strategy.get("hard") or {}):
+                        continue
+                if pg_node is None and \
+                        not request.feasible_on(n.resources.total):
+                    continue
+                candidates.append(n)
+            if not candidates:
+                return False
+            fits = [n for n in candidates
+                    if request.fits(self._effective_available(n))]
+            pool = fits or candidates
             if strategy and strategy.get("type") == "node_label":
-                if not label_constraints_match(
-                        node.labels, strategy.get("hard") or {}):
-                    continue
-            if pg_node is None and not request.feasible_on(node.resources.total):
-                continue
-            candidates.append(node)
-        if not candidates:
-            return False
-        # count resources already committed to in-flight actor placements
-        # against each candidate: a burst of actor creations scheduled off
-        # the same gossip snapshot must not all pick the same node
-        # (reference: GcsActorScheduler tracks leased resources per node).
-        # Only RECENT placements count — once the target agent's next
-        # resource report lands (~one gossip period), its advertised
-        # availability already reflects the allocation. The recency window
-        # is tracked in a deque so a 1,000-actor burst scans a handful of
-        # entries per placement instead of every actor in the cluster
-        # (that full scan was O(N^2) across the burst).
-        committed: Dict[str, ResourceSet] = {}
-        now = time.monotonic()
-        window = max(1.5, 3 * CONFIG.gossip_period_ms / 1000.0)
-        recent = self._recent_placements
-        while recent and now - recent[0][0] > window:
-            recent.popleft()
-        # dedupe by actor: a retried placement appends a second entry for
-        # the same (mutated) ActorInfo — counting both would double-book
-        # its request against its current node
-        latest = {}
-        for placed_at, other in recent:
-            latest[id(other)] = other
-        for other in latest.values():
-            if other is info or other.node_id is None:
-                continue
-            if other.state not in (ACTOR_PENDING, ACTOR_RESTARTING):
-                continue
-            req = ResourceSet.from_wire(
-                other.spec_wire.get("resources", {}))
-            agg = committed.setdefault(other.node_id, ResourceSet({}))
-            agg.add(req)
-
-        def effective_available(n):
-            avail = n.resources.available.copy()
-            pending = committed.get(n.node_id)
-            if pending is not None:
-                avail.subtract(pending, allow_negative=True)
-            return avail
-
-        fits = [n for n in candidates
-                if request.fits(effective_available(n))]
-        pool = fits or candidates
-        if strategy and strategy.get("type") == "node_label":
-            soft = strategy.get("soft") or {}
-            pool.sort(key=lambda n: (
-                not label_constraints_match(n.labels, soft),
-                n.resources.utilization()))
+                soft = strategy.get("soft") or {}
+                # stable sort: utilization rank order is preserved within
+                # each soft-match group
+                pool.sort(key=lambda n: not label_constraints_match(
+                    n.labels, soft))
+            node = pool[0]
         else:
-            pool.sort(key=lambda n: n.resources.utilization())
-        node = pool[0]
+            # default path: walk ascending utilization, first fit wins;
+            # fall back to the least-utilized feasible node when nothing
+            # fits right now (the agent queues the start until capacity
+            # frees, exactly like the old sorted-pool pick)
+            first_feasible: Optional[NodeInfo] = None
+            for node_id in self._node_rank.ordered_ids():
+                n = self.nodes.get(node_id)
+                if n is None:
+                    continue
+                if not request.feasible_on(n.resources.total):
+                    continue
+                if request.fits(self._effective_available(n)):
+                    node = n
+                    break
+                if first_feasible is None:
+                    first_feasible = n
+            if node is None:
+                node = first_feasible
+            if node is None:
+                return False
         if node.conn.closed:
             # mid-grace-window: the agent's connection is down and push()
             # would silently no-op — the StartActor frame would be LOST
@@ -1295,12 +1559,15 @@ class HeadServer:
             # failure so _retry_schedule keeps polling until the agent
             # re-registers (or the grace expires and the node dies).
             return False
-        info.node_id = node.node_id
+        self._actor_set_node(info, node.node_id)
         info.placed_at = time.monotonic()
-        self._recent_placements.append((info.placed_at, info))
+        self._commit_placement(info, request, node.node_id)
+        payload = {"spec": info.spec_wire, "actor_id": info.actor_id}
+        if push_sink is not None:
+            push_sink.append((node, info, payload))
+            return True
         try:
-            await node.conn.push("StartActor", {"spec": info.spec_wire,
-                                                "actor_id": info.actor_id})
+            await node.conn.push("StartActor", payload)
         except Exception:
             return False
         return True
@@ -1316,25 +1583,54 @@ class HeadServer:
         if info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
             await self._handle_actor_death(info, "no feasible node for actor resources")
 
-    async def _actor_ready(self, conn: Connection, p: Dict) -> None:
-        info = self.actors.get(p["actor_id"])
-        if not info:
-            return
-        info.state = ACTOR_ALIVE
+    def _apply_actor_ready(self, info: ActorInfo, p: Dict,
+                           conn_node: Optional[str]) -> Dict:
+        """Shared readiness transition; returns the durable op payload so
+        a batch commits every entry in ONE WAL group-commit window."""
+        self._actor_set_state(info, ACTOR_ALIVE)
+        self._uncommit_placement(info.actor_id)
         info.addr = p["addr"]
         info.pid = p.get("pid", 0)
-        info.node_id = conn.meta.get("node_id", info.node_id)
+        # legacy direct reports arrive on the WORKER's head connection (no
+        # node_id in conn.meta); relayed batches carry the agent's node
+        self._actor_set_node(
+            info, conn_node or p.get("node_id") or info.node_id)
         # a worker's ready report also claims a RECOVERING actor (e.g.
         # the ready raced the head's death and is being re-delivered)
         info.recovering = False
         self.recovering_actors.discard(info.actor_id)
-        # ActorReady arrives on the WORKER's head connection (no node_id
-        # in conn.meta) — note after the node_id fallback above resolves
         info.note(f"alive on {(info.node_id or '?')[:12]}")
-        await self._durable("actor_update", {
-            "actor_id": info.actor_id, "state": ACTOR_ALIVE,
-            "addr": info.addr, "pid": info.pid, "node_id": info.node_id})
+        return {"actor_id": info.actor_id, "state": ACTOR_ALIVE,
+                "addr": info.addr, "pid": info.pid,
+                "node_id": info.node_id}
+
+    async def _actor_ready(self, conn: Connection, p: Dict) -> None:
+        info = self.actors.get(p["actor_id"])
+        if not info:
+            return
+        op = self._apply_actor_ready(info, p, conn.meta.get("node_id"))
+        await self._durable("actor_update", op)
         await self._publish_event("actor", info.public_view())
+
+    async def _actor_ready_batch(self, conn: Connection, p: Dict) -> Dict:
+        """A node agent's coalesced worker readiness reports (ISSUE 10):
+        every entry commits in one WAL group-commit window and the agent
+        acks its workers only after this reply — per-entry at-least-once
+        semantics are preserved through the relay."""
+        conn_node = conn.meta.get("node_id") or p.get("node_id")
+        ops = []
+        ready: List[ActorInfo] = []
+        for entry in p.get("items", ()):
+            info = self.actors.get(entry["actor_id"])
+            if not info:
+                continue
+            ops.append(("actor_update",
+                        self._apply_actor_ready(info, entry, conn_node)))
+            ready.append(info)
+        await self._durable_batch(ops)
+        for info in ready:
+            await self._publish_event("actor", info.public_view())
+        return {"n": len(ready)}
 
     async def _actor_died(self, conn: Connection, p: Dict) -> None:
         info = self.actors.get(p["actor_id"])
@@ -1352,7 +1648,8 @@ class HeadServer:
                      restarts=info.num_restarts)
         if info.num_restarts < info.max_restarts or info.max_restarts == -1:
             info.num_restarts += 1
-            info.state = ACTOR_RESTARTING
+            self._actor_set_state(info, ACTOR_RESTARTING)
+            self._uncommit_placement(info.actor_id)
             info.note(f"restarting (#{info.num_restarts}): {reason}")
             info.addr = None
             await self._durable("actor_update", {
@@ -1366,7 +1663,7 @@ class HeadServer:
             await self._handle_actor_death(info, reason)
 
     async def _handle_actor_death(self, info: ActorInfo, reason: str) -> None:
-        info.state = ACTOR_DEAD
+        self._actor_set_state(info, ACTOR_DEAD)
         info.death_cause = reason
         info.note(f"dead: {reason}")
         info.addr = None

@@ -307,6 +307,14 @@ class Worker:
         # GIL) and the loop flushes
         self._pending_unpins: deque = deque()
         self._owner_conn_pool = ConnectionPool()
+        # batched control RPCs (ISSUE 10): queued anonymous CreateActor
+        # payloads (one CreateActorBatch frame per flush window) and the
+        # LeaseItem routers for in-flight RequestWorkerLeaseBatch calls
+        self._pending_creates: List[Dict] = []
+        self._create_flush_armed = False
+        self._create_inflight = 0
+        self._lease_batches: Dict[Any, Any] = {}
+        self._lease_batch_seq = 0
         self.current_task_info = threading.local()
         self.task_events: List[Dict] = []
         self.actor_instance = None  # set in actor workers
@@ -394,12 +402,22 @@ class Worker:
             pass  # metrics are best-effort
 
     async def _async_connect(self, agent_unix_path: str) -> None:
+        trace = {} if os.environ.get("RAY_TPU_BOOT_TRACE") else None
+        t0 = time.monotonic()
+
+        def mark(name):
+            if trace is not None:
+                trace[name] = round((time.monotonic() - t0) * 1000, 1)
+                self._boot_trace = trace
+
         self.ready_event = asyncio.Event()
         self._register_direct_routes()
         self.direct_port = await self.direct_server.start_tcp("0.0.0.0", 0)
+        mark("direct_tcp")
         self.agent = AsyncRpcClient()
         await self.agent.connect_unix(agent_unix_path)
-        self.agent.set_push_handler(self._on_agent_push)
+        self.agent.set_push_handler(self._on_agent_push_sync)
+        mark("agent_conn")
         reply = await self.agent.call(
             "RegisterClient",
             {
@@ -410,23 +428,38 @@ class Worker:
             },
             timeout=CONFIG.control_rpc_timeout_s,
         )
+        mark("register")
         self.node_id = reply["node_id"]
         CONFIG.apply_cluster_config(reply.get("cluster_config", {}))
         self.store = make_store_client(reply["store_dir"])
+        mark("store")
         self._head_addr = reply["head_addr"]
         self.head = AsyncRpcClient()
         # set while the head link is believed up; cleared by the watchdog
         # during an outage so queued control calls (head_call) know to
         # wait for the reconnect instead of spinning
         self._head_reconnected = asyncio.Event()
-        await self._connect_head()
+        self._head_boot_done = False
+        if self.mode == self.MODE_WORKER and CONFIG.worker_lazy_head_connect:
+            # boot-path trim (ISSUE 10): the head TCP setup + subscribe
+            # round trips move OFF the time-to-leasable critical path —
+            # most executor workers touch the head rarely (readiness now
+            # rides the agent relay). Head-bound calls issued before the
+            # background connect lands queue behind it via the outage
+            # machinery (ConnectionLost -> wait _head_reconnected).
+            self._spawn(self._connect_head_bg())
+        else:
+            await self._connect_head()
         # every process (driver AND executor workers) must survive a head
         # restart — workers hit the head for actor resolution, pubsub,
         # task events
         self._spawn(self._head_watchdog_loop())
-        info = await self.agent.call("GetNodeInfo", {},
-                                     timeout=CONFIG.control_rpc_timeout_s)
-        self.agent_tcp_addr = {"host": node_ip(), "port": info["tcp_port"]}
+        tcp_port = reply.get("tcp_port")
+        if not tcp_port:
+            info = await self.agent.call("GetNodeInfo", {},
+                                         timeout=CONFIG.control_rpc_timeout_s)
+            tcp_port = info["tcp_port"]
+        self.agent_tcp_addr = {"host": node_ip(), "port": tcp_port}
         # flip BEFORE ready_event releases the executor: the first pushed
         # task may call user-facing API (ray_tpu.get of a task arg ref)
         # immediately, and _require_worker checks this flag — setting it
@@ -434,6 +467,7 @@ class Worker:
         # cold worker's first task failed with "init() must be called
         # first" (caught by the ISSUE 9 broadcast consumers)
         self.connected = True
+        mark("ready")
         self.ready_event.set()
 
     async def _connect_head(self) -> None:
@@ -463,7 +497,25 @@ class Worker:
         if self._actor_sub_started:
             await self.head.call("Subscribe", {"channels": ["actor"]},
                                  timeout=CONFIG.control_rpc_timeout_s)
+        self._head_boot_done = True
         self._head_reconnected.set()  # wake outage-queued control calls
+
+    async def _connect_head_bg(self) -> None:
+        """Deferred worker-mode head connect (worker_lazy_head_connect):
+        retries until it lands; the watchdog takes over reconnects only
+        after the first successful connect (``_head_boot_done``), so the
+        two never race a double connect_tcp onto one client."""
+        backoff = DecorrelatedJitterBackoff(base_s=0.1, cap_s=1.0)
+        while True:
+            try:
+                await self._connect_head()
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                if self.ready_event.is_set() and not self.connected:
+                    return  # disconnected while still booting the link
+                await asyncio.sleep(backoff.next_delay())
 
     async def _head_watchdog_loop(self) -> None:
         """Driver survives a head restart (GCS fault tolerance): ping, and
@@ -476,7 +528,10 @@ class Worker:
                 break
             await asyncio.sleep(0.1)
         while self.connected:
-            await asyncio.sleep(CONFIG.head_watchdog_period_s)
+            period = (CONFIG.worker_head_watchdog_period_s
+                      if self.mode == self.MODE_WORKER
+                      else CONFIG.head_watchdog_period_s)
+            await asyncio.sleep(period)
             # periodic task-event flush: observers (state API, dashboard)
             # must see this process's transitions without it having to
             # query (reference: TaskEventBuffer's periodic GCS flush,
@@ -495,6 +550,11 @@ class Worker:
             except Exception:
                 if not self.connected:
                     return
+            if not self._head_boot_done:
+                # the deferred boot connect (_connect_head_bg) still owns
+                # the link — a concurrent reconnect here would stack a
+                # second read loop onto the same client
+                continue
             # outage begins: queued control calls park until reconnect
             self._head_reconnected.clear()
             # decorrelated jitter so a cluster's worth of drivers/workers
@@ -514,6 +574,12 @@ class Worker:
     def disconnect(self) -> None:
         if not self.connected:
             return
+        try:
+            # queued batched creates must reach the head before the link
+            # drops (a lost create would strand its handle PENDING)
+            self._acall(self._drain_actor_creates(), timeout=5)
+        except Exception:
+            pass
         self.connected = False
 
         async def _close():
@@ -760,6 +826,20 @@ class Worker:
 
     async def _handle_location_added(self, conn, p):
         self.reference_counter.add_location(bytes.fromhex(p["object_id"]), p["addr"])
+
+    def _on_agent_push_sync(self, method: str, payload):
+        """Agent-connection push dispatch. LeaseItem routes INLINE in the
+        read loop (set_push_handler contract): the per-entry grants of a
+        RequestWorkerLeaseBatch stream on the same connection as the
+        batch's closing reply, and an inline route guarantees every item
+        is claimed before the awaiting batch call resumes and tears down
+        its router. Everything else keeps the per-push task."""
+        if method == "LeaseItem":
+            cb = self._lease_batches.get((payload or {}).get("b"))
+            if cb is not None:
+                cb(payload)
+            return None
+        return self._on_agent_push(method, payload)
 
     async def _on_agent_push(self, method: str, payload):
         pass
@@ -1603,17 +1683,26 @@ class Worker:
         # Track before the CreateActor RPC so a fast ActorReady event can't
         # race past the state registration.
         self._track_actor(actor_id, {"state": "PENDING_CREATION"})
-        reply = self.head_call(
-            "CreateActor",
-            {
-                "actor_id": actor_id.hex(),
-                "spec": spec_wire,
-                "name": name,
-                "namespace": namespace,
-                "max_restarts": max_restarts,
-                "get_if_exists": get_if_exists,
-            },
-        )
+        payload = {
+            "actor_id": actor_id.hex(),
+            "spec": spec_wire,
+            "name": name,
+            "namespace": namespace,
+            "max_restarts": max_restarts,
+            "get_if_exists": get_if_exists,
+        }
+        # Anonymous creates coalesce (ISSUE 10): the actor id is client-
+        # generated and the only RPC-surfaced error (name taken) cannot
+        # apply, so the create can ride the next CreateActorBatch frame —
+        # a 1,000-actor burst pays ~4 head round trips instead of 1,000
+        # serial ones. Named / get_if_exists creates keep the blocking
+        # path: their reply (existing view, ValueError) is load-bearing.
+        if not name and not get_if_exists \
+                and CONFIG.actor_create_batch_window_ms > 0:
+            self._acall(self._enqueue_create(payload))
+            return actor_id, {"actor_id": actor_id.hex(),
+                              "state": "PENDING_CREATION"}
+        reply = self.head_call("CreateActor", payload)
         if reply.get("existing"):
             view = reply["existing"]
             existing_id = ActorID.from_hex(view["actor_id"])
@@ -1622,12 +1711,76 @@ class Worker:
         self._track_actor(actor_id, {"state": "PENDING_CREATION"})
         return actor_id, reply
 
+    # ------------------------------------- batched actor creation (ISSUE 10)
+    async def _enqueue_create(self, payload: Dict) -> None:
+        """Loop-side: queue one anonymous create; arm (or ride) the flush
+        window. Never awaits the RPC — create_actor returns immediately
+        and failures surface through the tracked actor state (DEAD with a
+        death_cause), exactly like any other post-ack actor failure."""
+        self._pending_creates.append(payload)
+        if len(self._pending_creates) >= CONFIG.actor_create_batch_max:
+            self._create_flush_now()
+        elif not self._create_flush_armed:
+            self._create_flush_armed = True
+            self.loop.call_later(
+                max(CONFIG.actor_create_batch_window_ms, 0) / 1000.0,
+                self._create_flush_now)
+
+    def _create_flush_now(self) -> None:
+        self._create_flush_armed = False
+        if not self._pending_creates:
+            return
+        batch, self._pending_creates = self._pending_creates, []
+        self._create_inflight += 1
+        self._spawn(self._send_create_batch(batch))
+
+    async def _send_create_batch(self, batch: List[Dict]) -> None:
+        try:
+            reply = await self._head_call_async(
+                "CreateActorBatch", {"items": batch})
+            by_id = {r.get("actor_id"): r
+                     for r in (reply or {}).get("results", []) if r}
+            for item in batch:
+                r = by_id.get(item["actor_id"])
+                if r is None or r.get("error"):
+                    self._fail_create(
+                        item, r.get("error") if r else "create lost")
+        except Exception as e:
+            for item in batch:
+                self._fail_create(item, repr(e))
+        finally:
+            self._create_inflight -= 1
+
+    def _fail_create(self, item: Dict, msg: str) -> None:
+        self._track_actor(
+            ActorID.from_hex(item["actor_id"]),
+            {"actor_id": item["actor_id"], "state": "DEAD",
+             "death_cause": f"actor creation failed: {msg}"})
+
+    async def _drain_actor_creates(self) -> None:
+        """Flush + await every queued/in-flight batched create. Ordering
+        barrier for head calls that must observe prior creates (KillActor,
+        GetActor, shutdown)."""
+        while self._pending_creates or self._create_inflight:
+            self._create_flush_now()
+            await asyncio.sleep(0.002)
+
     def _ensure_actor_subscription(self):
         if self._actor_sub_started:
             return
         self._actor_sub_started = True
-        self._acall(self.head.call("Subscribe", {"channels": ["actor"]},
-                                   timeout=CONFIG.control_rpc_timeout_s))
+
+        async def sub():
+            try:
+                await self.head.call("Subscribe", {"channels": ["actor"]},
+                                     timeout=CONFIG.control_rpc_timeout_s)
+            except Exception:
+                # head link not up yet (lazy worker-mode connect) or mid-
+                # outage: _connect_head re-subscribes off the already-set
+                # _actor_sub_started flag when the link lands
+                pass
+
+        self._acall(sub())
 
     def _track_actor(self, actor_id: ActorID, view: Dict) -> "_ActorState":
         st = self._actor_states.get(actor_id.binary())
@@ -1650,7 +1803,12 @@ class Worker:
             self._ensure_actor_subscription()
 
             async def fetch():
-                view = await self.head.call(
+                # a batched anonymous create may still be queued locally:
+                # flush it first so the head can answer; outage-queued
+                # (_head_call_async) so a worker's lazy head connect or a
+                # head bounce delays rather than loses the fetch
+                await self._drain_actor_creates()
+                view = await self._head_call_async(
                     "GetActor", {"actor_id": actor_id.hex()},
                     timeout=CONFIG.control_rpc_timeout_s)
                 if view:
@@ -1717,6 +1875,10 @@ class Worker:
         return refs
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        # order after any queued batched create: the head must know the
+        # actor before it can kill it (a reordered kill would no-op and
+        # the later create would leak a live actor)
+        self._acall(self._drain_actor_creates())
         self.head_call(
             "KillActor",
             {"actor_id": actor_id.hex(), "no_restart": no_restart})
@@ -1977,14 +2139,25 @@ class _LeasePool:
                     break
         want = len(self.pending)
         cap = CONFIG.max_pending_lease_requests_per_scheduling_category
+        n = 0
         while (
             want > 0
             and self.inflight_leases < min(cap, want)
             and len(self.conns) + self.inflight_leases < self.MAX_WORKERS
         ):
             self.inflight_leases += 1
-            spawn_tracked(self._request_lease(), "lease-request")
+            n += 1
             want -= 1
+        if n == 0:
+            return
+        # k leases wanted in one pump ride ONE RequestWorkerLeaseBatch
+        # frame (grants stream back per entry); PG leases keep the single
+        # path — they resolve their target agent per request
+        if n > 1 and not self.pg and CONFIG.lease_batch_enabled:
+            spawn_tracked(self._request_lease_batch(n), "lease-request")
+        else:
+            for _ in range(n):
+                spawn_tracked(self._request_lease(), "lease-request")
 
     async def _resolve_pg_agent(self):
         """Target the agent of the node holding our PG bundle (the reference
@@ -2015,19 +2188,23 @@ class _LeasePool:
                 return node["addr"]
             await asyncio.sleep(CONFIG.pg_resolve_poll_s)
 
+    def _lease_payload(self) -> Dict:
+        w = self.worker
+        return {
+            "resources": self.resources,
+            "scheduling_strategy": self.strategy,
+            "pg": self.pg,
+            "owner": w.worker_id.hex(),
+            "env_key": self.env_key,
+            "container": self.container,
+            "conda": self.conda,
+            "retriable": self.retriable,
+        }
+
     async def _request_lease(self) -> None:
         w = self.worker
+        payload = self._lease_payload()
         try:
-            payload = {
-                "resources": self.resources,
-                "scheduling_strategy": self.strategy,
-                "pg": self.pg,
-                "owner": w.worker_id.hex(),
-                "env_key": self.env_key,
-                "container": self.container,
-                "conda": self.conda,
-                "retriable": self.retriable,
-            }
             agent_addr = None
             if self.pg:
                 agent_addr = await self._resolve_pg_agent()
@@ -2040,71 +2217,127 @@ class _LeasePool:
             else:
                 # raylint: disable=R6 -- long-poll by design (see above)
                 reply = await w.agent.call("RequestWorkerLease", payload)
-            hops = 0
-            while reply and reply.get("spillback") and \
-                    hops < CONFIG.lease_spillback_max_hops:
-                hops += 1
-                target = reply["spillback"]
-                agent_addr = target["addr"]
-                client = await w._owner_client(agent_addr)
-                # raylint: disable=R6 -- long-poll by design (see above)
-                reply = await client.call(
-                    "RequestWorkerLease", {**payload, "spilled_once": True}
-                )
-            if reply and reply.get("error") == "pg_removed":
-                raise _PlacementGroupGone(
-                    f"placement group {self.pg[0] if self.pg else ''} removed")
-            if reply and reply.get("error") == "runtime_env":
-                raise _RuntimeEnvFailed(
-                    reply.get("message", "runtime_env setup failed"))
-            grant = (reply or {}).get("grant")
-            if not grant:
-                raise RpcError("lease request failed")
-            conn = WorkerConn(
-                grant["lease_id"],
-                grant["worker_id"],
-                grant["addr"],
-                grant["node_id"],
-                agent_addr,
-            )
-            if grant["node_id"] in w._dead_nodes:
-                # the node died between grant and now (partition verdict
-                # raced the lease reply); don't connect into a zombie
-                raise w.node_death_error(grant["node_id"],
-                                         "lease granted by dead node")
-            conn.assigned_instances = grant.get("assigned_instances", {})
-            client = AsyncRpcClient()
-            await client.connect_tcp(conn.addr["host"], conn.addr["port"])
-            client.start_idle_monitor(CONFIG.client_idle_deadline_s)
-            conn.client = client
-            self.conns.append(conn)
-            self.inflight_leases -= 1
-            conn.idle_since = time.monotonic()
-            self.idle.append(conn)
-            # A grant can arrive after the queue drained; make sure an unused
-            # lease is returned rather than pinning resources forever.
-            self._ensure_reaper()
-            self._pump()
+            await self._finish_lease(reply, payload, agent_addr)
         except (_PlacementGroupGone, _RuntimeEnvFailed) as e:
-            # Unschedulable forever: fail every queued task, don't retry.
-            from ray_tpu.runtime_env.runtime_env import RuntimeEnvSetupError
-
-            exc = (RuntimeEnvSetupError(str(e))
-                   if isinstance(e, _RuntimeEnvFailed)
-                   else RuntimeError(str(e)))
-            self.inflight_leases -= 1
-            while self.pending:
-                record = self.pending.popleft()
-                self.worker._on_task_failure(record, exc, retriable=False)
+            self._lease_unschedulable(e)
         except Exception:
-            if os.environ.get("RAY_TPU_DEBUG"):
-                import traceback
+            await self._lease_failed()
 
-                traceback.print_exc()
-            self.inflight_leases -= 1
-            if self.pending:
-                await asyncio.sleep(CONFIG.lease_retry_backoff_s)
-                self._pump()
+    async def _request_lease_batch(self, n: int) -> None:
+        """One RequestWorkerLeaseBatch frame for n leases (ISSUE 10): the
+        agent streams per-entry grants back as LeaseItem pushes (routed
+        inline by _on_agent_push_sync) so fast grants wire up while slow
+        entries still queue; the closing reply settles stragglers."""
+        w = self.worker
+        payload = self._lease_payload()
+        w._lease_batch_seq += 1
+        bid = w._lease_batch_seq
+        seen: set = set()
+
+        async def finish_item(reply) -> None:
+            try:
+                await self._finish_lease(reply, payload, None)
+            except (_PlacementGroupGone, _RuntimeEnvFailed) as e:
+                self._lease_unschedulable(e)
+            except Exception:
+                await self._lease_failed()
+
+        def on_item(p: Dict) -> None:
+            i = p.get("i")
+            if i in seen:
+                return
+            seen.add(i)
+            spawn_tracked(finish_item(p.get("r")), "lease-batch-item")
+
+        w._lease_batches[bid] = on_item
+        try:
+            # raylint: disable=R6 -- long-poll by design (entries may
+            # legitimately queue behind capacity for minutes)
+            await w.agent.call("RequestWorkerLeaseBatch",
+                               {**payload, "n": n, "b": bid})
+        except Exception:
+            missing = n - len(seen)
+            if missing > 0:
+                self.inflight_leases -= missing
+                if self.pending:
+                    await asyncio.sleep(CONFIG.lease_retry_backoff_s)
+                    self._pump()
+        finally:
+            w._lease_batches.pop(bid, None)
+
+    async def _finish_lease(self, reply, payload: Dict,
+                            agent_addr: Optional[Dict]) -> None:
+        """Spillback-follow + grant wiring shared by the single and
+        batched lease paths. Settles exactly one inflight_leases slot on
+        success; raises for the caller's failure accounting."""
+        w = self.worker
+        hops = 0
+        while reply and reply.get("spillback") and \
+                hops < CONFIG.lease_spillback_max_hops:
+            hops += 1
+            target = reply["spillback"]
+            agent_addr = target["addr"]
+            client = await w._owner_client(agent_addr)
+            # raylint: disable=R6 -- long-poll by design (see above)
+            reply = await client.call(
+                "RequestWorkerLease", {**payload, "spilled_once": True}
+            )
+        if reply and reply.get("error") == "pg_removed":
+            raise _PlacementGroupGone(
+                f"placement group {self.pg[0] if self.pg else ''} removed")
+        if reply and reply.get("error") == "runtime_env":
+            raise _RuntimeEnvFailed(
+                reply.get("message", "runtime_env setup failed"))
+        grant = (reply or {}).get("grant")
+        if not grant:
+            raise RpcError("lease request failed")
+        conn = WorkerConn(
+            grant["lease_id"],
+            grant["worker_id"],
+            grant["addr"],
+            grant["node_id"],
+            agent_addr,
+        )
+        if grant["node_id"] in w._dead_nodes:
+            # the node died between grant and now (partition verdict
+            # raced the lease reply); don't connect into a zombie
+            raise w.node_death_error(grant["node_id"],
+                                     "lease granted by dead node")
+        conn.assigned_instances = grant.get("assigned_instances", {})
+        client = AsyncRpcClient()
+        await client.connect_tcp(conn.addr["host"], conn.addr["port"])
+        client.start_idle_monitor(CONFIG.client_idle_deadline_s)
+        conn.client = client
+        self.conns.append(conn)
+        self.inflight_leases -= 1
+        conn.idle_since = time.monotonic()
+        self.idle.append(conn)
+        # A grant can arrive after the queue drained; make sure an unused
+        # lease is returned rather than pinning resources forever.
+        self._ensure_reaper()
+        self._pump()
+
+    def _lease_unschedulable(self, e: Exception) -> None:
+        # Unschedulable forever: fail every queued task, don't retry.
+        from ray_tpu.runtime_env.runtime_env import RuntimeEnvSetupError
+
+        exc = (RuntimeEnvSetupError(str(e))
+               if isinstance(e, _RuntimeEnvFailed)
+               else RuntimeError(str(e)))
+        self.inflight_leases -= 1
+        while self.pending:
+            record = self.pending.popleft()
+            self.worker._on_task_failure(record, exc, retriable=False)
+
+    async def _lease_failed(self) -> None:
+        if os.environ.get("RAY_TPU_DEBUG"):
+            import traceback
+
+            traceback.print_exc()
+        self.inflight_leases -= 1
+        if self.pending:
+            await asyncio.sleep(CONFIG.lease_retry_backoff_s)
+            self._pump()
 
     def _dispatch(self, conn: WorkerConn, record: TaskRecord) -> None:
         """Send PushTask via the client's write-combined frame queue and

@@ -32,6 +32,38 @@ import sys
 # whole point of the template). Must not start loops or sockets.
 import ray_tpu._private.worker_process  # noqa: F401  (warm import)
 
+# numpy too: the serialization fast path imports it lazily on the FIRST
+# task result, which charged every fresh worker a ~250ms import on its
+# first reply (measured dominating warm-pool actor starts, ISSUE 10).
+# numpy touches no device state — jax stays deliberately unimported
+# (workers must not pre-touch TPU runtime; the MULTICHIP dryrun gate
+# asserts a parked warm worker has no `jax` in sys.modules).
+import numpy  # noqa: F401  (warm import)
+
+# Store-attach warmup: psutil (default_store_capacity) and the native
+# arena's ctypes .so — dlopen'd ONCE here and inherited by every fork —
+# were the next-largest slices of a worker's measured time-to-leasable
+# (boot trace: the `store` phase). Best-effort: a missing toolchain just
+# means children fall back exactly as they would have cold.
+import psutil  # noqa: F401  (warm import)
+
+try:
+    from ray_tpu import _native as _native_warm
+
+    _native_warm.get_native_lib()
+except Exception:
+    pass
+
+
+# Death ledger: pids reaped by the SIGCHLD handler are appended here (one
+# decimal pid per line) for the agent to consume. The agent cannot see
+# these deaths itself: forked workers are children of THIS process, so
+# after the zombie is reaped the pid may be recycled and the agent's
+# kill(pid, 0) liveness probe would call a dead (or foreign!) process
+# alive — a warm worker that died between fork and first lease could be
+# leased. The ledger is the authoritative death signal for that window.
+_death_ledger_path: str = ""
+
 
 def _reap(_sig, _frm):
     try:
@@ -39,6 +71,15 @@ def _reap(_sig, _frm):
             pid, _ = os.waitpid(-1, os.WNOHANG)
             if pid == 0:
                 break
+            if _death_ledger_path:
+                # Python signal handlers run between bytecodes (not in
+                # async-signal context), so buffered file I/O is safe;
+                # O_APPEND keeps concurrent lines intact.
+                try:
+                    with open(_death_ledger_path, "a") as f:
+                        f.write(f"{pid}\n")
+                except OSError:
+                    pass
     except ChildProcessError:
         pass
 
@@ -81,9 +122,15 @@ def _spawn(req: dict, server: socket.socket, conn: socket.socket) -> int:
 
 
 def main() -> None:
+    global _death_ledger_path
     sock_path = sys.argv[1]
     try:
         os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    _death_ledger_path = sock_path + ".deaths"
+    try:
+        os.unlink(_death_ledger_path)
     except FileNotFoundError:
         pass
     signal.signal(signal.SIGCHLD, _reap)

@@ -509,7 +509,10 @@ class Executor:
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             try:
-                await self.worker.head.call(
+                # outage-queued (head_call machinery): under lazy worker
+                # head connect the link may still be coming up — the
+                # precise failure reason should survive that window
+                await self.worker._head_call_async(
                     "ActorDied",
                     {"actor_id": payload["actor_id"],
                      "reason": f"creation task failed: {e!r}"},
@@ -523,10 +526,13 @@ class Executor:
         if pg:
             self.worker.current_placement_group_id = pg[0]
         # The readiness report MUST land or this process must die: a
-        # dropped head connection here (seen under 1,000-actor bursts)
-        # would otherwise leave a zombie — alive, never ALIVE in the head,
-        # its callers hanging forever. The head watchdog reconnects
-        # between attempts; persistent failure exits so the agent reports
+        # dropped report (seen under 1,000-actor bursts) would otherwise
+        # leave a zombie — alive, never ALIVE in the head, its callers
+        # hanging forever. It rides the AGENT relay (unix socket →
+        # coalesced ActorReadyBatch, ISSUE 10): the agent acks only after
+        # the head acked, so the at-least-once contract is end-to-end and
+        # a creation burst costs one head RPC per flush window instead of
+        # one per worker. Persistent failure exits so the agent reports
         # ActorDied and callers fail fast.
         ready_payload = {
             "actor_id": payload["actor_id"],
@@ -536,8 +542,8 @@ class Executor:
         }
         for attempt in range(10):
             try:
-                await self.worker.head.call(
-                    "ActorReady", ready_payload,
+                await self.worker.agent.call(
+                    "ReportActorReady", ready_payload,
                     timeout=CONFIG.control_rpc_timeout_s)
                 break
             except Exception:
@@ -636,6 +642,7 @@ async def _handle_capture_jax_trace(conn, p) -> Dict:
 
 
 def main() -> None:
+    boot_t0 = time.monotonic()
     agent_sock = os.environ["RAY_TPU_AGENT_SOCK"]
     from ray_tpu._private import lifecycle
     from ray_tpu._private.ids import WorkerID
@@ -644,8 +651,11 @@ def main() -> None:
     # below exits when the agent CONNECTION drops, but a worker stuck in
     # user code / a jitted computation never reaches that check — the
     # PDEATHSIG + supervisor-poll watchdog covers it (escalates to
-    # os._exit if SIGTERM is swallowed)
-    lifecycle.fate_share_with_parent()
+    # os._exit if SIGTERM is swallowed). Workers poll SLOWLY: PDEATHSIG
+    # chains cover the common death paths, and a 1s poll across 1,000
+    # workers is thousands of liveness syscalls/s (ISSUE 10); the
+    # registry sweep bounds the rare orphan window regardless.
+    lifecycle.fate_share_with_parent(poll_s=5.0)
 
     worker = Worker()
     worker.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
@@ -661,13 +671,26 @@ def main() -> None:
     worker.direct_server.add_handler("CaptureJaxTrace",
                                      _handle_capture_jax_trace)
 
+    base_push = worker._on_agent_push
+
     async def on_agent_push(method: str, payload):
         if method == "BecomeActor":
             await worker.ready_event.wait()
             await executor.become_actor(payload)
+        else:
+            # keep the base dispatch: executor workers submitting nested
+            # work use the same lease plane as drivers
+            await base_push(method, payload)
 
     worker._on_agent_push = on_agent_push  # type: ignore[method-assign]
     worker.connect(agent_sock, mode=Worker.MODE_WORKER)
+    if os.environ.get("RAY_TPU_BOOT_TRACE"):
+        # time-to-leasable per worker (stderr -> worker .err log): the
+        # number the warm pool exists to amortize
+        print(f"BOOT_TRACE pid={os.getpid()} "
+              f"ready_ms={(time.monotonic() - boot_t0) * 1000:.1f} "
+              f"phases={getattr(worker, '_boot_trace', {})}",
+              file=sys.stderr, flush=True)
 
     # Park the main thread; all work happens on the IO loop + executors.
     try:

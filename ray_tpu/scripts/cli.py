@@ -201,6 +201,7 @@ def cmd_status(args) -> int:
         print(f"  {used:g}/{total[k]:g} {k}")
     _print_head_status()
     _print_data_plane()
+    _print_worker_pool()
     return 0
 
 
@@ -279,6 +280,40 @@ def _print_data_plane() -> None:
                   f"{bs.get('reparents_total', 0)} reparents")
     except Exception:
         pass
+
+
+def _fmt_hist(hist) -> str:
+    if not hist:
+        return "-"
+    def key(k):
+        return int(str(k).rstrip("+"))
+    return " ".join(f"{k}:{hist[k]}" for k in sorted(hist, key=key))
+
+
+def _print_worker_pool() -> None:
+    """Warm worker pool + batched control-RPC view (ISSUE 10): pool
+    level vs target, hit ratio of actor starts served warm, and the
+    lease/registration batch-size histograms."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        st = w._acall(w.agent.call("GetWorkerPoolStats", {}, timeout=3),
+                      timeout=5)
+    except Exception:
+        return  # older agent without the RPC, or headless
+    print("\nWorker pool (this node)")
+    print("-" * 40)
+    hits, misses = st.get("hits", 0), st.get("misses", 0)
+    ratio = hits / (hits + misses) if hits + misses else 0.0
+    print(f"  warm {st.get('warm', 0)}/{st.get('warm_target', 0)}   "
+          f"idle {st.get('idle', 0)}   workers {st.get('workers', 0)}   "
+          f"starting {st.get('starting', 0)}")
+    print(f"  actor starts: {hits} warm hits / {misses} cold forks "
+          f"(hit ratio {ratio:.0%})   refills {st.get('refills', 0)}   "
+          f"ttl-reaped {st.get('reaped', 0)}")
+    print(f"  lease batch sizes: {_fmt_hist(st.get('lease_batch_hist'))}")
+    print(f"  ready batch sizes: {_fmt_hist(st.get('ready_batch_hist'))}")
 
 
 def cmd_list(args) -> int:

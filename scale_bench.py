@@ -57,6 +57,108 @@ def bench_many_actors(ray, n: int) -> dict:
             "actors_per_s": round(n / ready, 1)}
 
 
+def bench_actor_scale(quick: bool) -> dict:
+    """First-class actor scale-out phase (ISSUE 10): burst and
+    incremental-batch creation rates, tail rate over the last 10%,
+    straggler count, and the warm-pool hit ratio — run in its OWN
+    cluster so the warm pool can be sized for the phase and a flake
+    can't poison the shared-cluster phases."""
+    import os
+    import ray_tpu
+
+    os.environ.setdefault("RAY_TPU_WORKER_POOL_WARM_TARGET",
+                          "16" if quick else "32")
+    # a 1,000-worker boot storm on a 2-core box can starve the agent's
+    # heartbeats past the default 15s budget — the node is busy, not
+    # dead; the chaos phases keep the tight threshold
+    os.environ.setdefault("RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD", "40")
+    ray_tpu.init(num_cpus=4)
+    out: dict = {}
+    try:
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        def pool_stats():
+            from ray_tpu._private import worker as wm
+
+            w = wm.global_worker
+            return w._acall(
+                w.agent.call("GetWorkerPoolStats", {}, timeout=10),
+                timeout=15)
+
+        def run_round(n: int, straggler_timeout: float) -> dict:
+            t0 = time.perf_counter()
+            actors = [A.options(num_cpus=0.001).remote() for _ in range(n)]
+            submit_s = time.perf_counter() - t0
+            refs = [a.ping.remote() for a in actors]
+            t90 = t100 = None
+            deadline = time.perf_counter() + straggler_timeout
+            pending = list(refs)
+            ready_n = 0
+            while pending and time.perf_counter() < deadline:
+                done, pending = ray_tpu.wait(
+                    pending, num_returns=len(pending), timeout=0.25)
+                ready_n += len(done)
+                now = time.perf_counter()
+                if t90 is None and ready_n >= 0.9 * n:
+                    t90 = now - t0
+                if ready_n >= n:
+                    t100 = now - t0
+            stragglers = len(pending)
+            total = t100 if t100 is not None else straggler_timeout
+            res = {
+                "n": n, "submit_s": round(submit_s, 3),
+                "ready_s": round(total, 3),
+                "actors_per_s": round(n / total, 1),
+                "stragglers": stragglers,
+            }
+            if t90 is not None and t100 is not None and t100 > t90:
+                res["tail_rate_90_100_per_s"] = round(
+                    (n - int(0.9 * n)) / (t100 - t90), 1)
+            for a in actors:
+                ray_tpu.kill(a)
+            return res
+
+        before = pool_stats()
+        # burst: everything at once (the many_actors shape)
+        out["burst"] = run_round(200 if quick else 1000,
+                                 straggler_timeout=300 if quick else 900)
+        # incremental: batches of 50 against the refilling pool — the
+        # sustained-rate shape serve autoscaling produces
+        batches = []
+        for _ in range(4 if quick else 8):
+            batches.append(run_round(50, straggler_timeout=120))
+            time.sleep(1.0)  # refill window between batches
+        out["incremental"] = {
+            "batch_n": 50,
+            "rates_per_s": [b["actors_per_s"] for b in batches],
+            "stragglers": sum(b["stragglers"] for b in batches),
+        }
+        if not quick:
+            # scale envelope: 5,000 actors created and answering
+            out["envelope"] = run_round(5000, straggler_timeout=1800)
+        after = pool_stats()
+        hits = after["hits"] - before["hits"]
+        misses = after["misses"] - before["misses"]
+        out["pool"] = {
+            "warm_target": after["warm_target"],
+            "hits": hits, "misses": misses,
+            "hit_ratio": round(hits / (hits + misses), 3)
+            if hits + misses else 0.0,
+            "refills": after["refills"] - before["refills"],
+            "ready_batch_hist": after["ready_batch_hist"],
+            "lease_batch_hist": after["lease_batch_hist"],
+        }
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    return out
+
+
 def bench_pg_churn(ray, n: int) -> dict:
     """create -> ready -> remove cycles (reference: placement group
     create/removal 899/s on m4.16xlarge). Warmed: the first ~50 cycles
@@ -1049,6 +1151,24 @@ def main(quick: bool = False) -> dict:
         from ray_tpu._private import lifecycle
 
         lifecycle.gc_stale_sessions()
+    # actor scale-out phase (ISSUE 10): own cluster (warm pool sized for
+    # the phase), standalone artifact so the actor-creation trajectory
+    # diffs across rounds like the other *_latest.json files
+    try:
+        results["actor_scale"] = bench_actor_scale(quick)
+    except Exception as e:  # noqa: BLE001
+        results["actor_scale"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        import os
+
+        if "error" not in results["actor_scale"]:
+            art = os.environ.get("RAY_TPU_ACTORSCALE_OUT",
+                                 "ACTORS_latest.json")
+            with open(art, "w") as f:
+                json.dump(results["actor_scale"], f, indent=2,
+                          sort_keys=True)
+    except Exception:
+        pass
     # two-node phase builds (and tears down) its own localhost clusters; a
     # flake here must not discard the JSON of every completed phase above
     try:

@@ -88,6 +88,7 @@ FAST_FILES = {
     "test_lifecycle.py",
     "test_transfer_plane.py",
     "test_partition.py",
+    "test_actor_scale.py",
     "test_serve_load.py",
     "test_raylint.py",
 }
