@@ -196,6 +196,14 @@ class NodeAgent:
         self._pool_misses = 0
         self._pool_refills = 0
         self._pool_reaped = 0
+        # predictive demand-paged refill (ISSUE 11): actor starts that
+        # miss the warm pool park here for the next pool registration
+        # (instead of each cold-forking), and the refill burst is sized
+        # from the StartActor(Batch) demand seen inside the window —
+        # not one fork per tick
+        self._pool_waiters: deque = deque()
+        self._demand_hits = 0
+        self._demand_events: deque = deque()  # (monotonic, n)
         self._pid_handles: Dict[int, WorkerHandle] = {}
         self._death_ledger_pos = 0
         # batched control-RPC state: queued worker ActorReady reports
@@ -433,15 +441,21 @@ class NodeAgent:
                 self._consume_death_ledger()
             except Exception:
                 pass
+            if CONFIG.worker_pool_demand_paging:
+                # predictive refill (ISSUE 11): deficit = live waiters +
+                # warm floor − parked − mid-boot; the burst events from
+                # _note_actor_demand already pre-forked toward the batch
+                # window, so this tick only covers the floor and
+                # stragglers (a fork that died, an expired waiter)
+                self._refill_to_demand(include_floor=True)
+                continue
             deficit = self.WARM_TARGET - self._warm_idle_count() \
                 - self._spawning_plain
             if deficit <= 0:
                 continue
-            # pace by demand: while a burst is actively draining the pool
-            # (a warm lease in the last second) refill one fork per tick —
-            # the CPU belongs to the actors being constructed, not to
-            # refills racing them. Once the burst passes, refill a whole
-            # admission window per tick to restore the target quickly.
+            # legacy pacing (demand paging disabled): while a burst is
+            # actively draining the pool refill one fork per tick; once
+            # the burst passes, a whole admission window per tick.
             now = time.monotonic()
             busy = (now - getattr(self, "_last_warm_lease", 0.0) < 1.0
                     or now - getattr(self, "_last_ready_report", 0.0) < 1.0
@@ -480,6 +494,117 @@ class NodeAgent:
                 self._handle_worker_exit(
                     handle, "reaped by forkserver (death ledger)"),
                 "agent-ledger-exit")
+
+    def _note_actor_demand(self, n: int) -> None:
+        """A StartActor(Batch) frame just landed: record the demand and
+        pre-fork toward it NOW — by the time the entries clear resource
+        admission, their workers are already booting through the
+        admission queue (the 1-fork/tick pacing this replaces left
+        hit_ratio at 0.17 under a burst of 200, ACTORS_latest r10)."""
+        if n > 0:
+            self._demand_events.append((time.monotonic(), n))
+            # prune HERE, not just in the stats read (the only other
+            # caller): a long-lived agent serving millions of creates
+            # with nobody polling stats must not grow this unbounded
+            self._recent_demand()
+        self._refill_to_demand(extra_demand=n)
+
+    def _recent_demand(self) -> int:
+        window = float(CONFIG.worker_pool_demand_window_s)
+        now = time.monotonic()
+        while self._demand_events and \
+                now - self._demand_events[0][0] > window:
+            self._demand_events.popleft()
+        return sum(n for _t, n in self._demand_events)
+
+    def _refill_to_demand(self, extra_demand: int = 0,
+                          include_floor: bool = False) -> None:
+        """Fork pool-fill workers up to the observed shortfall: live
+        waiters + fresh batch demand, minus what is already parked or
+        mid-boot. The warm FLOOR is included only from the periodic
+        loop tick — adding it per StartActorBatch would re-fork the
+        floor once per frame of a burst (measured: 546 forks for 400
+        actors, every extra fork stealing boot CPU from the burst on a
+        2-core box). The spawn admission queue still bounds concurrent
+        boots; this only sizes the pipeline."""
+        if not self.warm_lease_enabled or self._closing or \
+                not CONFIG.worker_pool_demand_paging:
+            return
+        # shed settled waiters (timed-out futures from re-arm windows):
+        # without this the deque only drains when a registration pops
+        # through it, which is exactly what ISN'T happening when
+        # waiters time out
+        while self._pool_waiters and self._pool_waiters[0].done():
+            self._pool_waiters.popleft()
+        deficit = (extra_demand
+                   + sum(1 for f in self._pool_waiters if not f.done())
+                   + (self.WARM_TARGET if include_floor else 0)
+                   - self._warm_idle_count()
+                   - self._spawning_plain)
+        cap = int(CONFIG.worker_pool_refill_burst_max)
+        if cap > 0:
+            deficit = min(deficit, cap)
+        for _ in range(max(0, deficit)):
+            self._pool_refills += 1
+            self._spawn_worker(pool_fill=True)
+
+    def _offer_pool_worker(self, handle: WorkerHandle) -> bool:
+        """Hand a just-available pristine worker to the oldest live
+        pool waiter (a missed actor start parked by demand paging).
+        True = consumed; False = caller parks it idle as before."""
+        if handle.is_actor or handle.leased_to is not None or \
+                handle.env_key is not None:
+            return False
+        while self._pool_waiters:
+            fut = self._pool_waiters.popleft()
+            if fut.done():
+                continue  # waiter timed out and cold-forked meanwhile
+            fut.set_result(handle)
+            return True
+        return False
+
+    async def _wait_pool_worker(self) -> Optional[WorkerHandle]:
+        """Demand-paged miss path: park for the next pool registration
+        instead of cold-forking a dedicated process. The wait window
+        EXTENDS while pool-fill spawns are still in flight — under a
+        saturated burst the pre-forked worker for the queue tail
+        legitimately arrives after a flat window, and a timeout there
+        cold-forks a DUPLICATE that steals boot CPU from the very
+        pipeline the waiter depends on (measured: a 20 s cliff turned
+        92/400 starts into duplicate forks and halved the burst rate).
+        Hard-capped regardless, so a wedged forkserver still degrades
+        to the cold fork (never a failure mode)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pool_waiters.append(fut)
+        self._refill_to_demand()
+        window = float(CONFIG.worker_pool_wait_s)
+        deadline = time.monotonic() + 10 * window
+        remaining = window
+        while True:
+            try:
+                handle = await asyncio.wait_for(
+                    fut, timeout=max(0.05, remaining))
+                break
+            except asyncio.TimeoutError:
+                if self._spawning_plain <= 0 or \
+                        time.monotonic() > deadline or self._closing:
+                    return None
+                # workers are still owed to the pool: re-arm a fresh
+                # future (the timed-out one is poisoned for set_result
+                # — and removed so it cannot accumulate as deque junk)
+                try:
+                    self._pool_waiters.remove(fut)
+                except ValueError:
+                    pass
+                fut = asyncio.get_running_loop().create_future()
+                self._pool_waiters.append(fut)
+                remaining = window
+        # paranoia at handout: the ledger may have caught its death
+        # between registration and this wakeup
+        if not handle.alive or (handle.conn is not None
+                                and handle.conn.closed):
+            return None
+        return handle
 
     def _lease_warm_worker(self) -> Optional[WorkerHandle]:
         """Pop a live pristine warm worker for an actor start, with a
@@ -602,11 +727,15 @@ class NodeAgent:
             self.cluster_view = payload
             await self._drain_pending_leases()
         elif method == "StartActor":
+            self._note_actor_demand(1)
             await self._start_actor(payload)
         elif method == "StartActorBatch":
             # one frame per node per CreateActorBatch: each entry gets its
             # own task — _start_actor can legitimately await resource
-            # capacity, and one starved entry must not wedge its siblings
+            # capacity, and one starved entry must not wedge its siblings.
+            # The batch size IS the demand window: pre-fork toward it now
+            # so workers boot while entries clear admission (ISSUE 11).
+            self._note_actor_demand(len(payload["items"]))
             for item in payload["items"]:
                 spawn_tracked(self._start_actor(item), "agent-start-actor")
         elif method == "KillActorWorker":
@@ -1032,13 +1161,20 @@ class NodeAgent:
                 # language:cpp so only matching leases land on them)
                 handle.env_key = p["env_key"]
             handle.conn = conn
-            handle.direct_addr = p["direct_addr"]
+            # stamp the node onto the advertised addr: lease grants carry
+            # it so a same-node owner can pick the shm lane (ISSUE 11) —
+            # the worker registers before it learns its own node_id
+            handle.direct_addr = dict(p["direct_addr"])
+            handle.direct_addr.setdefault("node_id", self.node_id)
             handle.registered.set()
             conn.meta["worker_id"] = worker_id
             if not handle.is_actor and handle.leased_to is None:
-                handle.idle_since = time.monotonic()
-                self.idle_workers.append(handle)
-                await self._drain_pending_leases()
+                # demand paging: a parked waiter (missed actor start)
+                # beats the idle pool — the worker goes straight to work
+                if not self._offer_pool_worker(handle):
+                    handle.idle_since = time.monotonic()
+                    self.idle_workers.append(handle)
+                    await self._drain_pending_leases()
         return {
             "node_id": self.node_id,
             "head_addr": {"host": self.head_host, "port": self.head_port},
@@ -1249,6 +1385,12 @@ class NodeAgent:
             "spawning_plain": self._spawning_plain,
             "hits": self._pool_hits,
             "misses": self._pool_misses,
+            # demand-paged handouts (ISSUE 11): missed-then-served by a
+            # pre-forked pool worker instead of a dedicated cold fork
+            "demand_hits": self._demand_hits,
+            "waiters": sum(1 for f in self._pool_waiters
+                           if not f.done()),
+            "recent_demand": self._recent_demand(),
             "refills": self._pool_refills,
             "reaped": self._pool_reaped,
             "spawned_total": getattr(self, "_workers_spawned", 0),
@@ -1510,6 +1652,8 @@ class NodeAgent:
         self._release_lease(lease_id, worker)
         if p.get("worker_exiting") or not worker.alive:
             return True
+        if self._offer_pool_worker(worker):
+            return True  # returned lease feeds a parked actor start
         worker.idle_since = time.monotonic()
         self.idle_workers.append(worker)
         await self._drain_pending_leases()
@@ -1579,8 +1723,17 @@ class NodeAgent:
         if handle is not None:
             self._pool_hits += 1
         else:
-            self._pool_misses += 1
-            handle = self._spawn_worker()
+            if self.warm_lease_enabled and CONFIG.worker_pool_demand_paging:
+                # demand paging (ISSUE 11): park for the next pool
+                # registration — the pre-forked pipeline from
+                # _note_actor_demand is already booting toward us
+                handle = await self._wait_pool_worker()
+            if handle is not None:
+                self._demand_hits += 1
+                self._last_warm_lease = time.monotonic()
+            else:
+                self._pool_misses += 1
+                handle = self._spawn_worker()
         handle.is_actor = True
         handle.actor_id = p["actor_id"]
         handle.assigned_resources = None  # released via actor-death path below
@@ -2328,6 +2481,10 @@ class NodeAgent:
                     counter("ray_tpu_worker_pool_misses_total",
                             "Actor starts that fell back to a cold fork.",
                             self._pool_misses),
+                    counter("ray_tpu_worker_pool_demand_hits_total",
+                            "Missed actor starts served by a demand-"
+                            "paged pool worker (ISSUE 11).",
+                            self._demand_hits),
                     counter("ray_tpu_worker_pool_reaped_total",
                             "Warm workers reaped on the idle TTL.",
                             self._pool_reaped),

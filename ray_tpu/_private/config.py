@@ -136,11 +136,57 @@ _flag("worker_pool_refill_interval_ms", 50)
 # (returned leases accumulate after a burst; the target-sized core pool
 # is kept warm indefinitely).
 _flag("worker_pool_idle_ttl_s", 30.0)
+# Predictive demand-paged refill (ISSUE 11): an actor start that misses
+# the warm pool WAITS for the next pool registration (instead of always
+# cold-forking), and the refill loop sizes its fork burst from the
+# observed CreateActorBatch window + the live waiter queue — not one
+# fork per tick — so hit_ratio approaches 1 under creation bursts.
+_flag("worker_pool_demand_paging", True)
+# How long a missed actor start waits for a demand-paged pool worker
+# before falling back to a dedicated cold fork (never a failure mode).
+_flag("worker_pool_wait_s", 20.0)
+# How long StartActor(Batch) demand is remembered: the instantaneous
+# batch size + live waiter queue drive the pre-fork burst; this window
+# only scopes the `recent_demand` observability field (pool stats, CLI)
+# and bounds the demand ledger's size.
+_flag("worker_pool_demand_window_s", 5.0)
+# Cap on pool-fill forks enqueued per refill decision; 0 = uncapped
+# (the spawn admission queue still bounds concurrent boots).
+_flag("worker_pool_refill_burst_max", 0)
 # Worker processes defer their head TCP connection off the boot critical
 # path (background connect): time-to-leasable drops by one TCP setup +
 # two subscribe round trips per worker. Head-bound calls queue behind
 # the pending connect via the outage machinery (head_call).
 _flag("worker_lazy_head_connect", True)
+
+# --- multiplexed direct-call plane (ISSUE 11) --------------------------------
+# One ctrl connection per peer PROCESS carrying every actor/lease/owner
+# channel as a stream (per-call stream ids in the PR 3 framing) instead
+# of one TCP connection per driver→actor pair. Per-stream close fails
+# only that stream's in-flight calls; the session survives for its
+# siblings. Disable to fall back to dedicated per-channel clients.
+_flag("direct_call_mux_enabled", True)
+# Fair interleaving quantum: frames one stream may place in the shared
+# session's outbound buffer per round-robin turn, so one chatty actor
+# cannot head-of-line-block its session siblings' dispatch order.
+_flag("direct_call_fair_frames_per_round", 16)
+
+# --- shared-memory local RPC (ISSUE 11) ---------------------------------------
+# Same-node sessions attach a shm doorbell lane riding the store arena
+# mount: an SPSC ring per direction + a FIFO doorbell, selected
+# automatically when caller and callee share a node_id. Frames above
+# shm_rpc_max_frame_bytes (or with the ring full) transparently fall
+# back to the session's TCP lane; a session-seq reorder stage on the
+# receiver keeps cross-lane dispatch order identical to a single TCP
+# stream. Cross-node peers and arena-less processes never attach.
+_flag("shm_rpc_enabled", True)
+_flag("shm_rpc_ring_bytes", 4 * 1024 * 1024)  # per direction
+_flag("shm_rpc_max_frame_bytes", 256 * 1024)  # larger frames ride TCP
+_flag("shm_rpc_attach_timeout_s", 5.0)  # ShmAttach handshake budget
+# Reorder-stage gap deadline: a cross-lane frame missing this long (a
+# fault-injected drop on one lane) is given up on — later frames
+# dispatch out of order instead of stalling the session forever.
+_flag("shm_rpc_order_gap_s", 10.0)
 
 # --- batched control RPCs (ISSUE 10) -----------------------------------------
 # Driver-side CreateActor coalescing: anonymous (unnamed, not

@@ -1,0 +1,775 @@
+"""Multiplexed direct-call plane (ISSUE 11).
+
+One data/control session per peer PROCESS, carrying every actor channel,
+lease-pool connection and owner callback channel as a logical STREAM
+over the shared connection — the role gRPC's HTTP/2 streams play for the
+reference's core_worker↔core_worker direct calls (reference:
+``src/ray/rpc/worker/core_worker_client_pool.h`` caches ONE client per
+worker address; ``direct_actor_task_submitter.h`` rides it per actor).
+
+Pieces:
+
+- :class:`MuxSession` — owns the underlying :class:`AsyncRpcClient`
+  (ctrl socket), a fair round-robin outbound scheduler across streams,
+  the session-scoped BatchItems router, and (same-node peers) the shm
+  doorbell lane from :mod:`shm_rpc`.
+- :class:`MuxStream` — the per-channel facade handed to callers. API
+  mirrors the AsyncRpcClient subset the submitters use (``call`` /
+  ``call_future`` / ``push`` / ``push_nowait`` / ``close`` …), so the
+  actor and lease pipelines did not have to change shape. Closing a
+  stream fails only ITS in-flight calls with a typed
+  :class:`StreamClosedError`; the session and its sibling streams
+  survive (the old per-actor ``client.close()`` tore down the whole
+  socket).
+- :class:`MuxPool` — sessions keyed ``(host, port)`` with the same
+  race-guarded connect discipline as ``protocol.ConnectionPool``.
+- ``_FrameOrderer`` + ``ShmServerDemux`` / ``ShmConnection`` — when a
+  shm lane is attached, every frame of the session (BOTH lanes) carries
+  a per-direction session seq ``q``; the receiving edge dispatches in
+  ``q`` order, so a frame that fell back to TCP (oversized / ring full)
+  can never be overtaken by a later shm frame. A seq missing past
+  ``shm_rpc_order_gap_s`` (a fault-injected drop on one lane) is given
+  up on instead of stalling the session forever.
+
+Fairness: a chatty stream queueing thousands of frames shares the wire
+in ``direct_call_fair_frames_per_round`` quanta, so a sibling's single
+call dispatches within one quantum instead of behind the whole backlog.
+
+MUST NOT import jax (driver AND parked workers import this module).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+from ray_tpu._private import shm_rpc
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.protocol import (
+    AsyncRpcClient, ConnectionLost, pack)
+from ray_tpu._private.async_util import hold_task, spawn_tracked
+
+
+class StreamClosedError(ConnectionLost):
+    """This stream was closed (actor died/restarting, lease dropped)
+    while the call was in flight; the session itself is still up."""
+
+
+# Mux-plane counters (ray_tpu_mux_* gauges + CLI "Direct-call plane").
+MUX_STATS: Dict[str, int] = {
+    "sessions_opened": 0,
+    "streams_opened": 0,
+    "streams_closed": 0,
+    "fair_rounds": 0,      # flush rounds that had >1 stream queued
+}
+
+
+def route_batch_items(batches: Dict[int, Callable], payload: Any) -> None:
+    """Dispatch one BatchItems push to its batch's per-item callback —
+    THE one implementation of the wire contract, shared by mux sessions
+    and plain per-channel clients (attach_batch_router)."""
+    cb = batches.get((payload or {}).get("b"))
+    if cb is not None:
+        for i, reply in payload.get("xs", ()):
+            cb(i, reply)
+
+
+def attach_batch_router(client) -> Dict[int, Callable]:
+    """Route streamed BatchItem pushes on a PLAIN client to their
+    batch's per-item callback (mux streams get the session router at
+    creation instead). One sync push handler per connection; batches
+    register/unregister by id from ``client.next_batch_id()``."""
+    batches: Dict[int, Callable] = {}
+
+    def on_push(method, payload):
+        if method == "BatchItems":
+            route_batch_items(batches, payload)
+
+    client.set_push_handler(on_push)
+    client._stream_batches = batches
+    return batches
+
+
+class _FrameOrderer:
+    """Per-direction dispatch orderer for a dual-lane session: frames
+    carry a contiguous seq ``q``; out-of-order arrivals (one lane raced
+    the other) are held until the gap fills, bounded by ``gap_s``."""
+
+    __slots__ = ("_loop", "_deliver", "_gap_s", "expected", "_held",
+                 "_timer", "closed")
+
+    def __init__(self, loop, deliver: Callable[[Dict], None],
+                 gap_s: float):
+        self._loop = loop
+        self._deliver = deliver
+        self._gap_s = max(gap_s, 0.05)
+        self.expected = 1
+        self._held: Dict[int, Dict] = {}
+        self._timer = None
+        self.closed = False
+
+    def feed(self, msg: Dict) -> None:
+        if self.closed:
+            return
+        q = msg.get("q")
+        if q is None or q < self.expected:
+            # unstamped (pre-attach) or already-given-up-on: dispatch now
+            self._deliver(msg)
+            return
+        if q == self.expected:
+            self.expected += 1
+            self._deliver(msg)
+            while self.expected in self._held:
+                _t, nxt = self._held.pop(self.expected)
+                self.expected += 1
+                self._deliver(nxt)
+            if not self._held and self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            return
+        self._held[q] = (time.monotonic(), msg)
+        if self._timer is None:
+            self._timer = self._loop.call_later(self._gap_s,
+                                                self._gap_flush)
+
+    def _gap_flush(self) -> None:
+        """A seq never arrived (a fault rule ate one lane's frame).
+        Dispatching the held tail out of order beats a wedged session —
+        the missing frame's caller still gets its own timeout. The
+        give-up clock runs from when the CURRENT oldest hold appeared:
+        a timer armed for an earlier, since-filled gap must re-arm, not
+        flush fresher holds after only a fraction of the window."""
+        self._timer = None
+        if self.closed or not self._held:
+            return
+        now = time.monotonic()
+        oldest = min(t for t, _m in self._held.values())
+        remaining = self._gap_s - (now - oldest)
+        if remaining > 0.001:
+            self._timer = self._loop.call_later(remaining,
+                                                self._gap_flush)
+            return
+        shm_rpc.SHM_STATS["order_gap_flushes"] += 1
+        for q in sorted(self._held):
+            _t, msg = self._held.pop(q)
+            if q >= self.expected:
+                self.expected = q + 1
+            self._deliver(msg)
+
+    def close(self) -> None:
+        self.closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._held.clear()
+
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+
+class MuxStream:
+    """One logical channel over a shared session. API-compatible with the
+    AsyncRpcClient subset the task/actor submitters use."""
+
+    __slots__ = ("session", "sid", "label", "closed", "_outq", "_queued",
+                 "_pending", "_stream_batches")
+
+    def __init__(self, session: "MuxSession", sid: int, label: str):
+        self.session = session
+        self.sid = sid
+        self.label = label
+        self.closed = False
+        self._outq: deque = deque()
+        self._queued = False  # present in the session's fair rotation?
+        self._pending: set = set()
+        # session-scoped BatchItems router: sibling streams share the
+        # dict, ids come from next_batch_id() so they can never collide
+        self._stream_batches = session._batches
+
+    # ------------------------------------------------------------ client API
+    @property
+    def connected(self) -> bool:
+        return (not self.closed and self.session.client is not None
+                and self.session.client.connected)
+
+    def next_batch_id(self) -> int:
+        return self.session.next_batch_id()
+
+    def call_future(self, method: str, payload: Any) -> asyncio.Future:
+        """Loop-thread only (same contract as AsyncRpcClient)."""
+        session = self.session
+        client = session.client
+        if self.closed:
+            fut = session.loop.create_future()
+            fut.set_exception(StreamClosedError(
+                f"stream {self.label or self.sid} closed"))
+            return fut
+        if client is None or not client.connected:
+            fut = session.loop.create_future()
+            fut.set_exception(ConnectionLost("not connected"))
+            return fut
+        req_id, fut = client.register_call()
+        self._track(fut)
+        session.enqueue(self, {"m": method, "i": req_id, "p": payload,
+                               "s": self.sid})
+        return fut
+
+    async def call(self, method: str, payload: Any,
+                   timeout: Optional[float] = None) -> Any:
+        fut = self.call_future(method, payload)
+        if timeout:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    def push_nowait(self, method: str, payload: Any) -> None:
+        if self.closed or self.session.client is None:
+            return
+        self.session.enqueue(self, {"m": method, "i": 0, "p": payload,
+                                    "s": self.sid})
+
+    async def push(self, method: str, payload: Any) -> None:
+        self.push_nowait(method, payload)
+
+    def start_idle_monitor(self, idle_s: float,
+                           ping_method: str = "Ping") -> None:
+        """Session-level: first stream to ask arms it for everyone."""
+        if self.session.client is not None:
+            self.session.client.start_idle_monitor(idle_s, ping_method)
+
+    # ------------------------------------------------------------- lifecycle
+    def _track(self, fut: asyncio.Future) -> None:
+        self._pending.add(fut)
+        fut.add_done_callback(self._pending.discard)
+
+    def close(self) -> None:
+        """Per-stream close: fail THIS stream's in-flight calls, drop its
+        queued frames — the session and sibling streams stay up."""
+        self.session.close_stream(self)
+
+    def close_soon(self) -> None:
+        self.close()
+
+    async def aclose(self) -> None:
+        self.close()
+
+
+class MuxSession:
+    """One peer process: the shared ctrl client + stream bookkeeping +
+    (same-node) the shm doorbell lane."""
+
+    def __init__(self, pool: "MuxPool", host: str, port: int):
+        self.pool = pool
+        self.host = host
+        self.port = port
+        self.client: Optional[AsyncRpcClient] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.streams: Dict[int, MuxStream] = {}
+        self._shared: Dict[str, MuxStream] = {}
+        self.peer_node_id: Optional[str] = None
+        self._next_sid = 0
+        self._batches: Dict[int, Callable] = {}
+        self._batch_seq = 0
+        # fair outbound scheduler
+        self._active: deque = deque()
+        self._flush_armed = False
+        # shm lane state
+        self.lane: Optional[shm_rpc.ShmLane] = None
+        self._orderer: Optional[_FrameOrderer] = None
+        self._out_seq = 0
+        self.closed = False
+
+    def next_batch_id(self) -> int:
+        self._batch_seq += 1
+        return self._batch_seq
+
+    # ---------------------------------------------------------------- open
+    async def open_session(self, shm_node_id: Optional[str],
+                   shm_store_dir: Optional[str]) -> None:
+        self.loop = asyncio.get_running_loop()
+        client = AsyncRpcClient()
+        await client.connect_tcp(self.host, self.port)
+        client.set_push_handler(self._on_push)
+        client.start_idle_monitor(CONFIG.client_idle_deadline_s)
+        self.client = client
+        MUX_STATS["sessions_opened"] += 1
+        # reap-on-death: a session whose peer exited must release its
+        # lane fds/mmaps promptly (a churny 400-actor run would pin ~4
+        # fds per dead peer until a lazy prune otherwise)
+        spawn_tracked(self._watch_client(), "mux-session-watch")
+        if shm_node_id and shm_store_dir and CONFIG.shm_rpc_enabled:
+            try:
+                await self._attach_shm(shm_node_id, shm_store_dir)
+            except Exception:
+                shm_rpc.SHM_STATS["attach_declined"] += 1
+                # clean TCP fallback: the session works without the lane
+                self.client._mux_feed = None
+                if self._orderer is not None:
+                    self._orderer.close()
+                    self._orderer = None
+                # the failure may be a TIMEOUT after the server already
+                # committed its half — it would then sink every small
+                # reply into a ring nobody reads. ShmDetach tears that
+                # down; TCP FIFO guarantees it lands before any stream
+                # call this session will ever make.
+                try:
+                    self.client.push_nowait("ShmDetach", {})
+                except Exception:
+                    pass
+
+    async def _attach_shm(self, node_id: str, store_dir: str) -> None:
+        """Rendezvous: WE create the rings + doorbell FIFOs under the
+        store arena, the server maps them during the ShmAttach RPC, and
+        the names are unlinked once both sides hold fds. Any failure
+        leaves the session on pure TCP."""
+        token = os.urandom(8).hex()
+        paths = shm_rpc.lane_paths(store_dir, token)
+        cap = int(CONFIG.shm_rpc_ring_bytes)
+        tx = rx = None
+        rx_bell_fd = tx_bell_fd = None
+        try:
+            tx = shm_rpc.ShmRing(paths["ring_c2s"], cap, create=True)
+            rx = shm_rpc.ShmRing(paths["ring_s2c"], cap, create=True)
+            shm_rpc.make_fifo(paths["bell_c2s"])
+            shm_rpc.make_fifo(paths["bell_s2c"])
+            # our read end must exist before the server opens its write
+            # end (O_WRONLY|O_NONBLOCK is ENXIO without a reader)
+            rx_bell_fd = shm_rpc.open_bell_read(paths["bell_s2c"])
+            # the reorder stage must be live BEFORE any stamped frame can
+            # arrive (the server stamps from its first post-attach reply)
+            self._orderer = _FrameOrderer(
+                self.loop, self._deliver_inbound,
+                float(CONFIG.shm_rpc_order_gap_s))
+            self.client._mux_feed = self._orderer.feed
+            reply = await self.client.call(
+                "ShmAttach",
+                {"paths": paths, "node_id": node_id, "ring_bytes": cap},
+                timeout=CONFIG.shm_rpc_attach_timeout_s)
+            if not (reply or {}).get("ok"):
+                raise ConnectionLost(
+                    f"shm attach declined: {(reply or {}).get('reason')}")
+            tx_bell_fd = shm_rpc.open_bell_write(paths["bell_c2s"])
+            self.lane = shm_rpc.ShmLane(
+                self.loop, tx=tx, rx=rx, tx_bell_fd=tx_bell_fd,
+                rx_bell_fd=rx_bell_fd, on_frame=self._on_shm_frame)
+            shm_rpc.SHM_STATS["attach_ok"] += 1
+        except BaseException:
+            for ring in (tx, rx):
+                if ring is not None:
+                    ring.close()
+            for fd in (rx_bell_fd, tx_bell_fd):
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+            raise
+        finally:
+            shm_rpc.unlink_lane_paths(paths)
+
+    async def _watch_client(self) -> None:
+        task = getattr(self.client, "_read_task", None)
+        if task is None:
+            return
+        await asyncio.wait({task})
+        if not self.closed:
+            self.close()
+            pool = self.pool
+            if pool is not None and \
+                    pool._sessions.get((self.host, self.port)) is self:
+                pool._sessions.pop((self.host, self.port), None)
+
+    # ------------------------------------------------------------- inbound
+    def _deliver_inbound(self, msg: Dict) -> None:
+        client = self.client
+        if client is None:
+            return
+        client.last_recv = time.monotonic()
+        client._deliver_msg(msg)
+
+    def _on_shm_frame(self, frame: bytes) -> None:
+        msg = msgpack.unpackb(frame, raw=False, strict_map_key=False)
+        orderer = self._orderer
+        if orderer is not None and "q" in msg:
+            orderer.feed(msg)
+        else:
+            self._deliver_inbound(msg)
+
+    def _on_push(self, method: str, payload: Any):
+        if method == "BatchItems":
+            route_batch_items(self._batches, payload)
+
+    # ------------------------------------------------------------ outbound
+    def enqueue(self, stream: MuxStream, msg: Dict) -> None:
+        stream._outq.append(msg)
+        if not stream._queued:
+            stream._queued = True
+            self._active.append(stream)
+        if not self._flush_armed:
+            self._flush_armed = True
+            self.loop.call_soon(self._flush)
+
+    def _flush(self) -> None:
+        """Fair drain: round-robin over queued streams, up to the quantum
+        per turn, so a chatty stream's backlog interleaves with (instead
+        of preceding) its siblings' frames in the combined write — the
+        receiver dispatches in arrival order, so interleaving here bounds
+        a sibling's dispatch delay to one quantum."""
+        self._flush_armed = False
+        quantum = max(1, int(CONFIG.direct_call_fair_frames_per_round))
+        if len(self._active) > 1:
+            MUX_STATS["fair_rounds"] += 1
+        active = self._active
+        while active:
+            stream = active.popleft()
+            outq = stream._outq
+            n = 0
+            while outq and n < quantum:
+                self._send_now(outq.popleft())
+                n += 1
+            if outq:
+                active.append(stream)
+            else:
+                stream._queued = False
+
+    def _send_now(self, msg: Dict) -> None:
+        client = self.client
+        if client is None:
+            return
+        lane = self.lane
+        if lane is not None and not lane.closed:
+            # lane attached: EVERY frame (both lanes) is seq-stamped so
+            # the server's reorder stage restores single-stream order
+            self._out_seq += 1
+            msg["q"] = self._out_seq
+            body = pack(msg)
+            if len(body) - 4 <= int(CONFIG.shm_rpc_max_frame_bytes):
+                if lane.try_send(body[4:]):
+                    return
+            else:
+                shm_rpc.SHM_STATS["fallback_oversize"] += 1
+            client._send_frame(body, msg.get("m"))
+            return
+        client.send_msg_nowait(msg)
+
+    # ------------------------------------------------------------ lifecycle
+    def open_stream(self, label: str = "") -> MuxStream:
+        self._next_sid += 1
+        stream = MuxStream(self, self._next_sid, label)
+        self.streams[stream.sid] = stream
+        MUX_STATS["streams_opened"] += 1
+        return stream
+
+    def shared_stream(self, label: str = "owner") -> MuxStream:
+        """Long-lived singleton channel per purpose (the owner-callback
+        channel every worker keeps to each peer): callers share one
+        stream instead of opening one per RPC."""
+        stream = self._shared.get(label)
+        if stream is None or stream.closed:
+            stream = self.open_stream(label)
+            self._shared[label] = stream
+        return stream
+
+    def close_stream(self, stream: MuxStream) -> None:
+        if stream.closed:
+            return
+        stream.closed = True
+        self.streams.pop(stream.sid, None)
+        stream._outq.clear()
+        MUX_STATS["streams_closed"] += 1
+        err = StreamClosedError(
+            f"stream {stream.label or stream.sid} closed")
+        for fut in list(stream._pending):
+            if not fut.done():
+                fut.set_exception(err)
+        stream._pending.clear()
+
+    def close(self) -> None:
+        """Session teardown (peer death verdict / pool drop): the
+        client's close fails every stream's pending future with
+        ConnectionLost — no per-stream hang."""
+        if self.closed:
+            return
+        self.closed = True
+        if self.lane is not None:
+            self.lane.close()
+            self.lane = None
+        if self._orderer is not None:
+            self._orderer.close()
+            self._orderer = None
+        for stream in list(self.streams.values()):
+            stream.closed = True
+            stream._outq.clear()
+        self.streams.clear()
+        if self.client is not None:
+            self.client.close_soon()
+
+    async def aclose(self) -> None:
+        client = self.client
+        self.closed = True
+        if self.lane is not None:
+            self.lane.close()
+            self.lane = None
+        if self._orderer is not None:
+            self._orderer.close()
+            self._orderer = None
+        self.streams.clear()
+        if client is not None:
+            await client.aclose()
+
+
+class MuxPool:
+    """Sessions keyed (host, port) with race-guarded opens (the
+    ConnectionPool discipline — a lost connect race must not leak the
+    loser's read loop). ``node_id_fn``/``store_dir_fn`` supply the local
+    identity lazily (the worker learns both at registration)."""
+
+    def __init__(self, node_id_fn: Callable[[], Optional[str]] = None,
+                 store_dir_fn: Callable[[], Optional[str]] = None):
+        self._sessions: Dict[Tuple[str, int], MuxSession] = {}
+        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+        self._node_id_fn = node_id_fn or (lambda: None)
+        self._store_dir_fn = store_dir_fn or (lambda: None)
+
+    async def session(self, host: str, port: int,
+                      peer_node_id: Optional[str] = None) -> MuxSession:
+        key = (host, port)
+        sess = self._sessions.get(key)
+        if sess and not sess.closed and sess.client and \
+                sess.client.connected:
+            return sess
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            sess = self._sessions.get(key)
+            if sess and not sess.closed and sess.client and \
+                    sess.client.connected:
+                return sess
+            if sess is not None:
+                sess.close()
+            sess = MuxSession(self, host, port)
+            sess.peer_node_id = peer_node_id
+            local_node = self._node_id_fn()
+            shm_node = None
+            shm_dir = None
+            # attach ONLY on a positive node match: worker/owner addrs
+            # all carry node_id; a "looks local" heuristic would pay
+            # ring setup + a guaranteed decline against every same-host
+            # AGENT session (agents serve no ShmAttach by design)
+            if local_node and peer_node_id == local_node:
+                shm_node = local_node
+                shm_dir = self._store_dir_fn()
+            await sess.open_session(shm_node, shm_dir)
+            self._sessions[key] = sess
+            return sess
+
+    async def stream(self, host: str, port: int, label: str = "",
+                     peer_node_id: Optional[str] = None) -> MuxStream:
+        sess = await self.session(host, port, peer_node_id=peer_node_id)
+        return sess.open_stream(label)
+
+    def drop(self, host: str, port: int) -> None:
+        sess = self._sessions.pop((host, port), None)
+        if sess is not None:
+            sess.close()
+
+    def drop_node(self, node_id: str) -> None:
+        """Cluster death verdict: close every session to the node NOW so
+        pending calls fail fast instead of riding a partitioned socket
+        (the PR 5 fail-fast contract, session-granular)."""
+        for key, sess in list(self._sessions.items()):
+            if sess.peer_node_id == node_id:
+                self._sessions.pop(key, None)
+                sess.close()
+
+    def total_streams(self) -> int:
+        return sum(len(s.streams) for s in self._sessions.values())
+
+    def shm_sessions(self) -> int:
+        return sum(1 for s in self._sessions.values()
+                   if s.lane is not None and not s.lane.closed)
+
+    async def aclose_all(self) -> None:
+        sessions, self._sessions = list(self._sessions.values()), {}
+        for sess in sessions:
+            await sess.aclose()
+
+
+# ---------------------------------------------------------------------------
+# Server side
+# ---------------------------------------------------------------------------
+
+
+class ShmConnection:
+    """Lane-aware reply connection handed to handlers once a session
+    attached its shm lane: small replies/pushes ride the ring, oversized
+    ones fall back to the TCP conn — every outbound frame seq-stamped so
+    the client's reorder stage restores order. Shares ``meta`` with the
+    TCP conn (handler bookkeeping keys on it)."""
+
+    kind = "shm"
+
+    def __init__(self, tcp_conn, demux: "ShmServerDemux"):
+        self.tcp = tcp_conn
+        self.meta = tcp_conn.meta
+        self._demux = demux
+        self._out_seq = 0
+
+    @property
+    def closed(self) -> bool:
+        return self.tcp.closed
+
+    def _try_lane(self, msg: Dict) -> bool:
+        self._out_seq += 1
+        msg["q"] = self._out_seq
+        lane = self._demux.lane
+        if lane is None or lane.closed:
+            return False
+        body = pack(msg)
+        if len(body) - 4 > int(CONFIG.shm_rpc_max_frame_bytes):
+            shm_rpc.SHM_STATS["fallback_oversize"] += 1
+            return False
+        return lane.try_send(body[4:])
+
+    async def send(self, msg: Dict) -> None:
+        if not self._try_lane(msg):
+            await self.tcp.send(msg)
+
+    def send_nowait(self, msg: Dict) -> None:
+        if not self._try_lane(msg):
+            self.tcp.send_nowait(msg)
+
+    async def send_raw(self, req_id: int, raw) -> None:
+        # bulk bodies keep the TCP data path (sliced writes + drain);
+        # unstamped — raw replies resolve by req id, no ordering needs
+        await self.tcp.send_raw(req_id, raw)
+
+    async def push(self, method: str, payload: Any) -> None:
+        await self.send({"m": method, "i": 0, "p": payload})
+
+    def push_nowait(self, method: str, payload: Any) -> None:
+        self.send_nowait({"m": method, "i": 0, "p": payload})
+
+    def close(self) -> None:
+        self.tcp.close()
+
+
+class ShmServerDemux:
+    """Installed as ``conn.mux_demux`` on the accepted TCP connection:
+    funnels BOTH lanes' inbound frames through one reorder stage and
+    dispatches with the lane-aware :class:`ShmConnection`, so per-caller
+    execution chains (keyed on the conn object) stay coherent across
+    lanes."""
+
+    def __init__(self, server, tcp_conn, loop, tx: shm_rpc.ShmRing,
+                 rx: shm_rpc.ShmRing, tx_bell_fd: int, rx_bell_fd: int):
+        self._server = server
+        self._loop = loop
+        self.conn = ShmConnection(tcp_conn, self)
+        self.lane = shm_rpc.ShmLane(
+            loop, tx=tx, rx=rx, tx_bell_fd=tx_bell_fd,
+            rx_bell_fd=rx_bell_fd, on_frame=self._on_shm_frame)
+        self._orderer = _FrameOrderer(
+            loop, self._dispatch, float(CONFIG.shm_rpc_order_gap_s))
+
+    def feed_tcp(self, msg: Dict) -> None:
+        if "q" in msg:
+            self._orderer.feed(msg)
+        else:
+            self._dispatch(msg)
+
+    def _on_shm_frame(self, frame: bytes) -> None:
+        msg = msgpack.unpackb(frame, raw=False, strict_map_key=False)
+        self.feed_tcp(msg)
+
+    def _dispatch(self, msg: Dict) -> None:
+        hold_task(self._loop.create_task(
+            self._server._dispatch(self.conn, msg)), "rpc-dispatch")
+
+    def close(self) -> None:
+        self.lane.close()
+        self._orderer.close()
+
+
+async def handle_shm_attach(server, conn, payload: Dict,
+                            node_id: str, store_dir: Optional[str]
+                            ) -> Dict:
+    """ShmAttach handler body (registered on every direct server): map
+    the client-created rings/FIFOs and switch the connection onto the
+    lane-aware demux. Any refusal is a plain ``ok=False`` — the client
+    then runs the session on pure TCP."""
+    def decline(reason: str) -> Dict:
+        shm_rpc.SHM_STATS["attach_declined"] += 1
+        return {"ok": False, "reason": reason}
+
+    # post-attach dispatches hand handlers the lane-aware wrapper; the
+    # demux hook and detach mark live on the underlying TCP conn
+    conn = getattr(conn, "tcp", conn)
+    if not CONFIG.shm_rpc_enabled:
+        return decline("disabled")
+    if conn.mux_demux is not None:
+        return decline("already attached")
+    if conn.meta.get("shm_detached"):
+        # the client's ShmDetach overtook this attach's dispatch (its
+        # attach timer expired while we were queued): committing now
+        # would sink replies into a ring the client already abandoned
+        return decline("client detached")
+    if not node_id or (payload or {}).get("node_id") != node_id:
+        return decline("cross-node")
+    if not store_dir or not os.path.isdir(store_dir):
+        return decline("no store arena")
+    paths = (payload or {}).get("paths") or {}
+    for key in ("ring_c2s", "ring_s2c", "bell_c2s", "bell_s2c"):
+        p = paths.get(key)
+        if not p or not shm_rpc.path_in_dir(p, store_dir):
+            return decline(f"bad path for {key}")
+    rx = tx = None
+    rx_bell_fd = tx_bell_fd = None
+    try:
+        # client→server ring: we consume; server→client: we produce
+        rx = shm_rpc.ShmRing(paths["ring_c2s"])
+        tx = shm_rpc.ShmRing(paths["ring_s2c"])
+        rx_bell_fd = shm_rpc.open_bell_read(paths["bell_c2s"])
+        # the client's read end is already open (protocol order)
+        tx_bell_fd = shm_rpc.open_bell_write(paths["bell_s2c"])
+    except Exception as e:
+        for ring in (rx, tx):
+            if ring is not None:
+                ring.close()
+        if rx_bell_fd is not None:
+            try:
+                os.close(rx_bell_fd)
+            except OSError:
+                pass
+        return decline(f"map failed: {e!r}")
+    demux = ShmServerDemux(server, conn, asyncio.get_running_loop(),
+                           tx=tx, rx=rx, tx_bell_fd=tx_bell_fd,
+                           rx_bell_fd=rx_bell_fd)
+    if conn.meta.get("shm_detached"):
+        # detach raced in while the rings were being mapped
+        demux.close()
+        return decline("client detached")
+    conn.mux_demux = demux
+    shm_rpc.SHM_STATS["attach_served"] = \
+        shm_rpc.SHM_STATS.get("attach_served", 0) + 1
+    return {"ok": True, "ring_bytes": rx.capacity}
+
+
+async def handle_shm_detach(conn, payload: Dict) -> Dict:
+    """Client gave up on the lane (attach timeout after this side may
+    have committed): drop back to plain TCP dispatch and release the
+    rings. Idempotent; also marks the conn so a still-queued attach
+    cannot commit afterwards. ``conn`` may be the lane-aware wrapper
+    when the lane was already committed — unwrap to the TCP conn."""
+    tcp = getattr(conn, "tcp", conn)
+    tcp.meta["shm_detached"] = True
+    demux = getattr(tcp, "mux_demux", None)
+    tcp.mux_demux = None
+    if demux is not None:
+        demux.close()
+    return {"ok": True}

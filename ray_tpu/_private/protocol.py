@@ -331,6 +331,11 @@ class Connection:
         self.writer = writer
         self.meta: Dict[str, Any] = {}  # handshake info (worker id, role, ...)
         self.closed = False
+        # mux/shm hook (ISSUE 11): once a session attaches a shm lane,
+        # inbound frames route through its demux (session-seq reordering
+        # + dispatch via the lane-aware reply connection) instead of the
+        # plain per-frame dispatch below. None costs one attribute load.
+        self.mux_demux = None
         # fault-injection peer class: accepted TCP sockets report a
         # (host, port) peername, unix sockets a path/empty string
         self.kind = "tcp" if isinstance(
@@ -538,6 +543,12 @@ class RpcServer:
                     if rule.action == "drop":
                         continue  # frame read, never dispatched
                     await asyncio.sleep(rule.delay_s)
+                demux = conn.mux_demux
+                if demux is not None:
+                    # shm-attached session: the demux restores cross-lane
+                    # dispatch order and replies via the lane-aware conn
+                    demux.feed_tcp(msg)
+                    continue
                 hold_task(asyncio.get_running_loop().create_task(
                     self._dispatch(conn, msg)), "rpc-dispatch")
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
@@ -598,6 +609,18 @@ class AsyncRpcClient:
         # silent channel with pings (partitions don't RST)
         self.last_recv = time.monotonic()
         self._idle_task: Optional[asyncio.Task] = None
+        # mux hook (ISSUE 11): seq-stamped ("q") frames of a shm-attached
+        # session route through the session's reorder stage; None for
+        # every plain client costs one attribute load per frame
+        self._mux_feed: Optional[Callable[[Dict], None]] = None
+        self._batch_counter = 0
+
+    def next_batch_id(self) -> int:
+        """Allocate a BatchItems router id unique on this channel (mux
+        streams override this with a session-scoped counter so sibling
+        streams sharing one connection can never collide)."""
+        self._batch_counter += 1
+        return self._batch_counter
 
     async def connect_tcp(self, host: str, port: int,
                           limit: Optional[int] = None) -> None:
@@ -649,6 +672,24 @@ class AsyncRpcClient:
             return True
         self._queue_frame(data)
         return True
+
+    def send_msg_nowait(self, msg: Dict) -> bool:
+        """Pack + fault-check + queue one pre-built frame dict (mux
+        session flush path — the frame already carries its stream id and
+        lane seq). Loop-thread only, write-combined."""
+        return self._send_frame(pack(msg), msg.get("m"))
+
+    def register_call(self) -> Tuple[int, "asyncio.Future"]:
+        """Allocate a request id + pending reply future WITHOUT sending
+        (the mux session frames and routes the request itself). The
+        future self-cleans from the pending table when it settles."""
+        self._next_id += 1
+        req_id = self._next_id
+        fut = self._loop.create_future()
+        self._pending[req_id] = fut
+        fut.add_done_callback(
+            lambda _f, rid=req_id: self._pending.pop(rid, None))
+        return req_id, fut
 
     def _flush_out(self) -> None:
         self._flush_scheduled = False
@@ -710,90 +751,75 @@ class AsyncRpcClient:
                                     b"", raw_len - got)
                             got += len(piece)
                         continue
-                if "r" in msg:
-                    fut = self._pending.pop(msg["r"], None)
-                    raw_len = msg.get("z")
-                    if raw_len is not None:
-                        # bulk reply: `z` raw bytes follow the header frame.
-                        # Read in pieces (readexactly would stall until the
-                        # WHOLE body sat in the reader buffer — double
-                        # buffering + a buffer-limit deadlock risk for
-                        # bodies above the limit). Consumed even when the
-                        # caller already gave up (timeout popped the
-                        # future), to stay framed. With a registered dest
-                        # (call_raw_into) pieces land straight in the
-                        # caller's buffer — no accumulate-and-join, no
-                        # second copy.
-                        dest = self._raw_dest.pop(msg["r"], None)
-                        direct = dest is not None
-                        dest_broken = False
-                        parts, got = [], 0
-                        try:
-                            while got < raw_len:
-                                piece = await self._reader.read(
-                                    min(raw_len - got, 1 << 20))
-                                if not piece:
-                                    raise asyncio.IncompleteReadError(
-                                        b"", raw_len - got)
-                                if direct:
-                                    if dest_broken or fut is None \
-                                            or fut.done():
-                                        # caller gave up mid-body (cancel/
-                                        # timeout): its buffer may be
-                                        # aborted or reused — stop writing,
-                                        # keep consuming to stay framed
-                                        pass
-                                    else:
-                                        try:
-                                            dest[got:got + len(piece)] = \
-                                                piece
-                                        except Exception:
-                                            dest_broken = True
-                                elif fut is not None and not fut.done():
-                                    parts.append(piece)
-                                got += len(piece)
-                        except BaseException:
-                            # fut was already popped from _pending, so the
-                            # loop's generic cleanup can't reach it — fail
-                            # it NOW or the caller stalls its full timeout
-                            # (forever without one) on a dead connection
-                            if fut and not fut.done():
-                                fut.set_exception(
-                                    ConnectionLost("connection lost"))
-                            raise
-                        STATS["bytes_in"] += raw_len
-                        if fut and not fut.done():
-                            if direct and dest_broken:
-                                fut.set_exception(RpcError(
-                                    "raw destination buffer rejected write"))
-                            elif direct:
-                                fut.set_result(raw_len)  # bytes written
+                raw_len = msg.get("z") if "r" in msg else None
+                if raw_len is None:
+                    # plain reply or push: one delivery path, optionally
+                    # detoured through the mux session's reorder stage
+                    # (seq-stamped frames of a shm-attached session)
+                    if self._mux_feed is not None and "q" in msg:
+                        self._mux_feed(msg)
+                    else:
+                        self._deliver_msg(msg)
+                    continue
+                fut = self._pending.pop(msg["r"], None)
+                # bulk reply: `z` raw bytes follow the header frame.
+                # Read in pieces (readexactly would stall until the
+                # WHOLE body sat in the reader buffer — double
+                # buffering + a buffer-limit deadlock risk for
+                # bodies above the limit). Consumed even when the
+                # caller already gave up (timeout popped the
+                # future), to stay framed. With a registered dest
+                # (call_raw_into) pieces land straight in the
+                # caller's buffer — no accumulate-and-join, no
+                # second copy.
+                dest = self._raw_dest.pop(msg["r"], None)
+                direct = dest is not None
+                dest_broken = False
+                parts, got = [], 0
+                try:
+                    while got < raw_len:
+                        piece = await self._reader.read(
+                            min(raw_len - got, 1 << 20))
+                        if not piece:
+                            raise asyncio.IncompleteReadError(
+                                b"", raw_len - got)
+                        if direct:
+                            if dest_broken or fut is None \
+                                    or fut.done():
+                                # caller gave up mid-body (cancel/
+                                # timeout): its buffer may be
+                                # aborted or reused — stop writing,
+                                # keep consuming to stay framed
+                                pass
                             else:
-                                fut.set_result(
-                                    parts[0] if len(parts) == 1
-                                    else b"".join(parts) if parts else b"")
-                        continue
+                                try:
+                                    dest[got:got + len(piece)] = \
+                                        piece
+                                except Exception:
+                                    dest_broken = True
+                        elif fut is not None and not fut.done():
+                            parts.append(piece)
+                        got += len(piece)
+                except BaseException:
+                    # fut was already popped from _pending, so the
+                    # loop's generic cleanup can't reach it — fail
+                    # it NOW or the caller stalls its full timeout
+                    # (forever without one) on a dead connection
                     if fut and not fut.done():
-                        if "e" in msg:
-                            fut.set_exception(RpcError(f"{msg['e'][0]}: {msg['e'][1]}"))
-                        else:
-                            fut.set_result(msg.get("p"))
-                elif self._push_handler:
-                    # sync handlers run inline (the streamed batch-item
-                    # path is a hot loop — a task per item would drown the
-                    # loop); async handlers still get their own task. A
-                    # handler bug must not kill the read loop — every
-                    # pending future on this connection would hang.
-                    try:
-                        res = self._push_handler(msg.get("m"), msg.get("p"))
-                        if asyncio.iscoroutine(res):
-                            hold_task(asyncio.get_running_loop()
-                                      .create_task(res), "push-handler")
-                    except Exception:
-                        import logging
-
-                        logging.getLogger("ray_tpu").exception(
-                            "push handler failed for %s", msg.get("m"))
+                        fut.set_exception(
+                            ConnectionLost("connection lost"))
+                    raise
+                STATS["bytes_in"] += raw_len
+                if fut and not fut.done():
+                    if direct and dest_broken:
+                        fut.set_exception(RpcError(
+                            "raw destination buffer rejected write"))
+                    elif direct:
+                        fut.set_result(raw_len)  # bytes written
+                    else:
+                        fut.set_result(
+                            parts[0] if len(parts) == 1
+                            else b"".join(parts) if parts else b"")
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             self.connected = False
             for fut in self._pending.values():
@@ -801,6 +827,35 @@ class AsyncRpcClient:
                     fut.set_exception(ConnectionLost("connection lost"))
             self._pending.clear()
             self._raw_dest.clear()
+
+    def _deliver_msg(self, msg: Dict) -> None:
+        """Resolve one inbound non-raw frame: a reply settles its pending
+        future, a push runs the push handler. Factored out of the read
+        loop so a shm lane / mux reorder stage can deliver frames through
+        EXACTLY the same path (ISSUE 11)."""
+        if "r" in msg:
+            fut = self._pending.pop(msg["r"], None)
+            if fut and not fut.done():
+                if "e" in msg:
+                    fut.set_exception(
+                        RpcError(f"{msg['e'][0]}: {msg['e'][1]}"))
+                else:
+                    fut.set_result(msg.get("p"))
+        elif self._push_handler:
+            # sync handlers run inline (the streamed batch-item
+            # path is a hot loop — a task per item would drown the
+            # loop); async handlers still get their own task. A
+            # handler bug must not kill the read loop — every
+            # pending future on this connection would hang.
+            try:
+                res = self._push_handler(msg.get("m"), msg.get("p"))
+                if asyncio.iscoroutine(res):
+                    hold_task(self._loop.create_task(res), "push-handler")
+            except Exception:
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "push handler failed for %s", msg.get("m"))
 
     def call_future(self, method: str, payload: Any) -> asyncio.Future:
         """Issue a request and return the reply future without awaiting.

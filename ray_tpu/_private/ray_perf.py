@@ -161,6 +161,24 @@ def main(filter_substr: str = "") -> Dict[str, float]:
     for act in actors + [a]:
         ray_tpu.kill(act)
 
+    # direct-call transport columns (ISSUE 11): which lane the actor
+    # benches above actually rode — shm frame counts prove same-node
+    # calls bypassed loopback TCP; fallback counters prove the ladder
+    # engaged rather than dropping frames
+    try:
+        from ray_tpu._private.mux import MUX_STATS
+        from ray_tpu._private.shm_rpc import stats_snapshot
+
+        transport = {
+            "mux_sessions_opened": MUX_STATS["sessions_opened"],
+            "mux_streams_opened": MUX_STATS["streams_opened"],
+            **{f"shm_{k}": v for k, v in stats_snapshot().items()},
+        }
+        print(json.dumps({"transport": transport}))
+        results["transport"] = transport  # type: ignore[assignment]
+    except Exception:
+        pass
+
     print(json.dumps(results))
     return results
 

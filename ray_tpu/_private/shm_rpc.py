@@ -1,0 +1,381 @@
+"""Shared-memory local RPC lane (ISSUE 11).
+
+Same-node direct calls (driver ↔ worker, worker ↔ owner) normally cross
+the loopback TCP stack even though both processes already mmap the same
+store arena mount. This module provides the fast lane a mux session
+attaches when caller and callee share a node: one SPSC byte ring per
+direction living in a tmpfs file under the store arena directory, plus a
+named-FIFO doorbell per direction so a sleeping peer wakes without
+polling (the eventfd/pipe doorbell of the reference's plasma client,
+``src/ray/object_manager/plasma/client.cc`` — here carrying RPC frames,
+not object handshakes).
+
+Wire format inside the ring is EXACTLY the TCP framing (u32 LE length +
+msgpack body), so a frame can transparently fall back to the session's
+TCP lane when it is oversized or the ring is full; the mux layer's
+session-seq reorder stage keeps cross-lane dispatch order identical to a
+single TCP stream.
+
+Concurrency contract: each ring is single-producer single-consumer —
+every send and every drain happens on its process's asyncio loop thread.
+Head/tail are monotonically increasing u64 counters at fixed aligned
+offsets (aligned 8-byte stores are effectively atomic for same-host
+coherency); the producer publishes payload bytes BEFORE bumping head,
+the consumer bumps tail only after copying a frame out.
+
+Doorbell discipline: the consumer sets a ``waiting`` flag in the ring
+header before parking and re-checks for frames (closing the lost-wakeup
+race); the producer writes the FIFO only when it observes the flag, so a
+hot stream costs ~zero doorbell syscalls and an idle one exactly one
+write + one read per burst.
+
+MUST NOT import jax (warm/parked workers ride this module; the MULTICHIP
+dryrun gate requires jax stays unimported until user code pulls it in).
+"""
+
+from __future__ import annotations
+
+import errno
+import mmap
+import os
+import struct
+from typing import Dict, List
+
+_HDR_FMT = struct.Struct("<QQ")  # (head, tail) at their own offsets
+_MAGIC = 0x5348_4D52_5043_3131  # "SHMRPC11"
+_OFF_MAGIC = 0
+_OFF_CAP = 8
+_OFF_HEAD = 16
+_OFF_TAIL = 24
+_OFF_WAITING = 32
+RING_HDR = 64
+_LEN = struct.Struct("<I")
+
+# Process-wide lane counters (same shape as protocol.STATS): read by the
+# driver's CallbackGauges (ray_tpu_shm_calls_total, fallback counters),
+# the CLI status view and the bench transport columns.
+SHM_STATS: Dict[str, int] = {
+    "calls_out": 0,        # frames this process sent via a shm lane
+    "frames_in": 0,
+    "bytes_out": 0,
+    "bytes_in": 0,
+    "fallback_oversize": 0,  # frames > shm_rpc_max_frame_bytes -> TCP
+    "fallback_ring_full": 0,  # ring momentarily full -> TCP
+    "attach_ok": 0,        # client-side successful lane attaches
+    "attach_served": 0,    # server-side accepted attaches
+    "attach_declined": 0,
+    "order_gap_flushes": 0,  # reorder stage gave up on a missing seq
+}
+
+
+class ShmRing:
+    """SPSC byte ring over an mmapped file.
+
+    Positions are monotonic u64; ``index = pos % capacity``. A frame is
+    ``u32 length + payload`` written with byte-wise wraparound.
+    """
+
+    def __init__(self, path: str, capacity: int = 0, create: bool = False):
+        self.path = path
+        if create:
+            if capacity <= RING_HDR + 16:
+                raise ValueError(f"ring capacity too small: {capacity}")
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, RING_HDR + capacity)
+                self._mm = mmap.mmap(fd, RING_HDR + capacity)
+            finally:
+                os.close(fd)
+            struct.pack_into("<Q", self._mm, _OFF_CAP, capacity)
+            struct.pack_into("<Q", self._mm, _OFF_HEAD, 0)
+            struct.pack_into("<Q", self._mm, _OFF_TAIL, 0)
+            # consumer assumed idle until it first arms itself: the very
+            # first frame always rings the doorbell
+            struct.pack_into("<I", self._mm, _OFF_WAITING, 1)
+            # magic LAST: an attacher seeing it knows the header is valid
+            struct.pack_into("<Q", self._mm, _OFF_MAGIC, _MAGIC)
+            self.capacity = capacity
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            (magic,) = struct.unpack_from("<Q", self._mm, _OFF_MAGIC)
+            if magic != _MAGIC:
+                self._mm.close()
+                raise ValueError(f"not a shm-rpc ring: {path}")
+            (self.capacity,) = struct.unpack_from("<Q", self._mm, _OFF_CAP)
+            if RING_HDR + self.capacity > size:
+                self._mm.close()
+                raise ValueError(f"truncated shm-rpc ring: {path}")
+        self._closed = False
+
+    # ------------------------------------------------------------- low level
+    def _head(self) -> int:
+        return struct.unpack_from("<Q", self._mm, _OFF_HEAD)[0]
+
+    def _tail(self) -> int:
+        return struct.unpack_from("<Q", self._mm, _OFF_TAIL)[0]
+
+    def _copy_in(self, pos: int, data) -> None:
+        cap = self.capacity
+        idx = pos % cap
+        first = min(len(data), cap - idx)
+        self._mm[RING_HDR + idx:RING_HDR + idx + first] = data[:first]
+        if first < len(data):
+            rest = len(data) - first
+            self._mm[RING_HDR:RING_HDR + rest] = data[first:]
+
+    def _copy_out(self, pos: int, n: int) -> bytes:
+        cap = self.capacity
+        idx = pos % cap
+        first = min(n, cap - idx)
+        out = self._mm[RING_HDR + idx:RING_HDR + idx + first]
+        if first < n:
+            out += self._mm[RING_HDR:RING_HDR + (n - first)]
+        return out
+
+    # ------------------------------------------------------------- producer
+    def try_write(self, payload: bytes) -> bool:
+        """Append one frame; False when it does not fit right now."""
+        if self._closed:
+            return False
+        need = 4 + len(payload)
+        head, tail = self._head(), self._tail()
+        if need > self.capacity - (head - tail):
+            return False
+        self._copy_in(head, _LEN.pack(len(payload)))
+        self._copy_in(head + 4, payload)
+        # publish AFTER the payload bytes are in place
+        struct.pack_into("<Q", self._mm, _OFF_HEAD, head + need)
+        return True
+
+    def consumer_waiting(self) -> bool:
+        return struct.unpack_from("<I", self._mm, _OFF_WAITING)[0] != 0
+
+    def clear_waiting(self) -> None:
+        struct.pack_into("<I", self._mm, _OFF_WAITING, 0)
+
+    # ------------------------------------------------------------- consumer
+    def arm_waiting(self) -> bool:
+        """Consumer parks: set the flag, then re-check for frames (the
+        re-check closes the producer-raced lost-wakeup window). Returns
+        True when it is safe to sleep (ring empty)."""
+        struct.pack_into("<I", self._mm, _OFF_WAITING, 1)
+        if self._head() != self._tail():
+            struct.pack_into("<I", self._mm, _OFF_WAITING, 0)
+            return False
+        return True
+
+    def read_frames(self, max_frames: int = 0) -> List[bytes]:
+        """Pop up to max_frames (0 = all currently visible) frames."""
+        out: List[bytes] = []
+        if self._closed:
+            return out
+        tail = self._tail()
+        head = self._head()
+        while tail < head and (not max_frames or len(out) < max_frames):
+            if head - tail < 4:
+                break  # torn mid-publish; next wake sees the rest
+            (length,) = _LEN.unpack(self._copy_out(tail, 4))
+            if head - tail < 4 + length:
+                break
+            out.append(self._copy_out(tail + 4, length))
+            tail += 4 + length
+            struct.pack_into("<Q", self._mm, _OFF_TAIL, tail)
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass  # an exported view keeps the map alive until GC
+
+
+# --------------------------------------------------------------- doorbells
+def make_fifo(path: str) -> None:
+    os.mkfifo(path, 0o600)
+
+
+def open_bell_read(path: str) -> int:
+    """Reader end; opening RDONLY|NONBLOCK succeeds with no writer yet."""
+    return os.open(path, os.O_RDONLY | os.O_NONBLOCK)
+
+
+def open_bell_write(path: str) -> int:
+    """Writer end; requires the peer's reader to be open (ENXIO else)."""
+    return os.open(path, os.O_WRONLY | os.O_NONBLOCK)
+
+
+def ring_bell(fd: int) -> None:
+    try:
+        os.write(fd, b"\x01")
+    except (BlockingIOError, InterruptedError):
+        pass  # pipe full = a wakeup is already pending
+    except OSError as e:
+        if e.errno not in (errno.EPIPE,):
+            raise
+
+
+def drain_bell(fd: int) -> None:
+    while True:
+        try:
+            if not os.read(fd, 4096):
+                return  # writer closed
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            return
+
+
+class ShmLane:
+    """One direction-pair of rings + doorbells bound to an asyncio loop.
+
+    ``tx``/``tx_bell`` carry frames we produce; ``rx``/``rx_bell_fd`` is
+    the side we consume with ``loop.add_reader``. ``on_frame`` receives
+    each inbound payload (bytes) on the loop thread. Frame PROCESSING is
+    bounded per wakeup (``max_frames_per_wake``) so a hot peer cannot
+    starve the rest of the event loop.
+    """
+
+    MAX_FRAMES_PER_WAKE = 256
+
+    def __init__(self, loop, tx: ShmRing, rx: ShmRing,
+                 tx_bell_fd: int, rx_bell_fd: int, on_frame):
+        self._loop = loop
+        self.tx = tx
+        self.rx = rx
+        self._tx_bell_fd = tx_bell_fd
+        self._rx_bell_fd = rx_bell_fd
+        self._on_frame = on_frame
+        self.closed = False
+        self._more_scheduled = False
+        self._park_probe_scheduled = False
+        loop.add_reader(rx_bell_fd, self._on_bell)
+
+    # ------------------------------------------------------------- send side
+    def try_send(self, frame: bytes) -> bool:
+        """Write one frame to the tx ring; rings the peer's doorbell only
+        when the peer parked itself. False = ring full (caller falls back
+        to the TCP lane; cross-lane order is restored by the mux seq)."""
+        if self.closed:
+            return False
+        if not self.tx.try_write(frame):
+            SHM_STATS["fallback_ring_full"] += 1
+            return False
+        SHM_STATS["calls_out"] += 1
+        SHM_STATS["bytes_out"] += len(frame)
+        if self.tx.consumer_waiting():
+            self.tx.clear_waiting()
+            ring_bell(self._tx_bell_fd)
+        return True
+
+    # ---------------------------------------------------------- receive side
+    def _on_bell(self) -> None:
+        drain_bell(self._rx_bell_fd)
+        self._pump()
+
+    def _pump(self) -> None:
+        if self.closed:
+            return
+        frames = self.rx.read_frames(self.MAX_FRAMES_PER_WAKE)
+        for frame in frames:
+            SHM_STATS["frames_in"] += 1
+            SHM_STATS["bytes_in"] += len(frame)
+            try:
+                self._on_frame(frame)
+            except Exception:
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "shm lane frame handler failed")
+        if len(frames) >= self.MAX_FRAMES_PER_WAKE:
+            # more queued: yield one loop tick, keep the lane hot
+            if not self._more_scheduled:
+                self._more_scheduled = True
+                self._loop.call_soon(self._pump_more)
+            return
+        # park: arm the waiting flag; the re-check covers a racing write
+        if not self.rx.arm_waiting():
+            if not self._more_scheduled:
+                self._more_scheduled = True
+                self._loop.call_soon(self._pump_more)
+            return
+        # Dekker backstop: the flag protocol's store→load pairs run
+        # un-fenced on plain mmap, so one adversarially-timed store-
+        # buffer window can lose a wakeup (producer reads stale
+        # waiting=0 while we read stale head). One short deferred probe
+        # per park turns that would-be-forever stall into ≤2 ms.
+        if not self._park_probe_scheduled:
+            self._park_probe_scheduled = True
+            self._loop.call_later(0.002, self._park_probe)
+
+    def _pump_more(self) -> None:
+        self._more_scheduled = False
+        self._pump()
+
+    def _park_probe(self) -> None:
+        self._park_probe_scheduled = False
+        if self.closed:
+            return
+        if self.rx._head() != self.rx._tail():
+            # lost wakeup caught: consume and (possibly) re-park
+            self._pump()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._loop.remove_reader(self._rx_bell_fd)
+        except Exception:
+            pass
+        for fd in (self._tx_bell_fd, self._rx_bell_fd):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self.tx.close()
+        self.rx.close()
+
+
+def lane_paths(store_dir: str, token: str) -> Dict[str, str]:
+    """The four rendezvous paths of one lane, all under the store arena
+    mount (same tmpfs the object segments live on)."""
+    base = os.path.join(store_dir, f"shmrpc-{token}")
+    return {
+        "ring_c2s": base + ".c2s",
+        "ring_s2c": base + ".s2c",
+        "bell_c2s": base + ".c2s.bell",
+        "bell_s2c": base + ".s2c.bell",
+    }
+
+
+def unlink_lane_paths(paths: Dict[str, str]) -> None:
+    """Both sides hold fds/maps after attach; the names are pure litter
+    (and an unlinked rendezvous cannot be attached twice)."""
+    for p in paths.values():
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def path_in_dir(path: str, directory: str) -> bool:
+    """Server-side check that a client-proposed rendezvous path really
+    lives under this node's store arena (no attaching arbitrary files)."""
+    try:
+        real = os.path.realpath(path)
+        base = os.path.realpath(directory)
+    except OSError:
+        return False
+    return real.startswith(base + os.sep)
+
+
+def stats_snapshot() -> Dict[str, int]:
+    return dict(SHM_STATS)

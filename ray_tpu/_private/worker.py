@@ -34,6 +34,9 @@ from ray_tpu._private.async_util import (
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID, _Counter
 from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.mux import (
+    MuxPool, attach_batch_router as _attach_batch_router,
+    handle_shm_attach, handle_shm_detach)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import StoreClient, make_store_client
 from ray_tpu._private.protocol import (
@@ -66,6 +69,12 @@ EXC = 1
 IN_PLASMA = 2
 
 global_worker: Optional["Worker"] = None
+
+
+def _shm_stats() -> Dict:
+    from ray_tpu._private.shm_rpc import SHM_STATS
+
+    return SHM_STATS
 
 
 def node_ip() -> str:
@@ -307,6 +316,13 @@ class Worker:
         # GIL) and the loop flushes
         self._pending_unpins: deque = deque()
         self._owner_conn_pool = ConnectionPool()
+        # Multiplexed direct-call plane (ISSUE 11): ONE session per peer
+        # process carries every actor/lease/owner channel as a stream;
+        # same-node sessions attach the shm doorbell lane. Identity fns
+        # are lazy — node_id/store land at registration.
+        self._mux_pool = MuxPool(
+            node_id_fn=lambda: self.node_id or None,
+            store_dir_fn=lambda: getattr(self.store, "store_dir", None))
         # batched control RPCs (ISSUE 10): queued anonymous CreateActor
         # payloads (one CreateActorBatch frame per flush window) and the
         # LeaseItem routers for in-flight RequestWorkerLeaseBatch calls
@@ -396,6 +412,22 @@ class Worker:
                 ("ray_tpu_lease_pools",
                  "Distinct scheduling categories with live lease pools.",
                  lambda: len(self._lease_pools)),
+                # direct-call plane (ISSUE 11)
+                ("ray_tpu_mux_streams",
+                 "Open streams across this driver's mux sessions.",
+                 lambda: self._mux_pool.total_streams()),
+                ("ray_tpu_mux_sessions",
+                 "Live per-peer-process mux sessions.",
+                 lambda: len(self._mux_pool._sessions)),
+                ("ray_tpu_shm_calls_total",
+                 "Frames this process sent over shm doorbell lanes.",
+                 lambda: _shm_stats()["calls_out"]),
+                ("ray_tpu_shm_fallback_oversize_total",
+                 "Oversized frames that fell back to the TCP lane.",
+                 lambda: _shm_stats()["fallback_oversize"]),
+                ("ray_tpu_shm_fallback_ring_full_total",
+                 "Ring-full frames that fell back to the TCP lane.",
+                 lambda: _shm_stats()["fallback_ring_full"]),
             ):
                 CallbackGauge(name, desc, fn)
         except Exception:
@@ -591,6 +623,7 @@ class Worker:
                 if client is not None:
                     await client.aclose()
             await self._owner_conn_pool.aclose_all()
+            await self._mux_pool.aclose_all()
 
         try:
             self._acall(_close(), timeout=5)
@@ -628,9 +661,14 @@ class Worker:
 
     def direct_addr(self) -> Dict:
         addr = self._direct_addr_cache
-        if addr is None or addr["port"] != self.direct_port:
+        if addr is None or addr["port"] != self.direct_port \
+                or addr.get("node_id", "") != self.node_id:
             addr = {"host": node_ip(), "port": self.direct_port,
                     "worker_id": self.worker_id.hex()}
+            if self.node_id:
+                # lets a same-node caller select the shm lane without a
+                # probe round trip (mux shm eligibility check)
+                addr["node_id"] = self.node_id
             self._direct_addr_cache = addr
         return addr
 
@@ -753,6 +791,24 @@ class Worker:
         r("ObjectLocationAdded", self._handle_location_added)
         r("StreamingReturn", self._handle_streaming_return)
         r("Ping", self._handle_ping)
+        r("ShmAttach", self._handle_shm_attach)
+        r("ShmDetach", handle_shm_detach)
+        self.direct_server.set_disconnect_handler(
+            self._on_direct_disconnect)
+
+    async def _handle_shm_attach(self, conn, p) -> Dict:
+        """Same-node caller upgrading its session to the shm lane
+        (ISSUE 11). Declines (cross-node, no arena, disabled) leave the
+        session on TCP."""
+        return await handle_shm_attach(
+            self.direct_server, conn, p, self.node_id,
+            getattr(self.store, "store_dir", None))
+
+    async def _on_direct_disconnect(self, conn) -> None:
+        demux = getattr(conn, "mux_demux", None)
+        if demux is not None:
+            conn.mux_demux = None
+            demux.close()  # unmaps rings, closes doorbell fds
 
     async def _handle_streaming_return(self, conn, p) -> Dict:
         """One yielded item of a streaming-generator task (reference:
@@ -885,6 +941,9 @@ class Worker:
             # spilled lease requests / owner RPCs in flight to that agent
             # fail now (close() fails their pending futures)
             self._owner_conn_pool.drop(addr["host"], addr["port"])
+            self._mux_pool.drop(addr["host"], addr["port"])
+        # every mux session to a process ON that node dies with it
+        self._mux_pool.drop_node(node_id)
         for pool in list(self._lease_pools.values()):
             pool.on_node_removed(node_id)
 
@@ -918,11 +977,34 @@ class Worker:
         except RuntimeError:
             pass
 
-    async def _owner_client(self, addr: Dict) -> AsyncRpcClient:
-        # shared race-guarded pool (protocol.ConnectionPool): concurrent
-        # spillback leases to one agent used to both connect and leak the
-        # overwritten loser's read loop — the bench-tail "second client in
-        # the connection pool" destroyed-pending warning
+    async def _direct_stream(self, addr: Dict, label: str = "",
+                             node_id: Optional[str] = None):
+        """Open a direct-call channel to a peer process: a stream on the
+        shared per-process mux session (ISSUE 11 — the connection is
+        multiplexed, same-node peers ride the shm lane), or a dedicated
+        AsyncRpcClient when the mux plane is disabled."""
+        if CONFIG.direct_call_mux_enabled:
+            return await self._mux_pool.stream(
+                addr["host"], addr["port"], label=label,
+                peer_node_id=node_id or addr.get("node_id"))
+        client = AsyncRpcClient()
+        await client.connect_tcp(addr["host"], addr["port"])
+        client.start_idle_monitor(CONFIG.client_idle_deadline_s)
+        return client
+
+    async def _owner_client(self, addr: Dict):
+        # shared race-guarded pool: concurrent spillback leases to one
+        # agent used to both connect and leak the overwritten loser's
+        # read loop — the bench-tail "second client in the connection
+        # pool" destroyed-pending warning. With the mux plane enabled
+        # the channel is the session's shared owner stream, so owner
+        # callbacks and actor/lease traffic to one process share ONE
+        # socket pair.
+        if CONFIG.direct_call_mux_enabled:
+            sess = await self._mux_pool.session(
+                addr["host"], addr["port"],
+                peer_node_id=addr.get("node_id"))
+            return sess.shared_stream("owner")
         return await self._owner_conn_pool.get(addr["host"], addr["port"])
 
     # ------------------------------------------------------------------ put
@@ -1968,24 +2050,6 @@ class KvClient:
 # ---------------------------------------------------------------------------
 
 
-def _attach_batch_router(client) -> Dict[int, Callable]:
-    """Route streamed BatchItem pushes on this client to their batch's
-    per-item callback. One sync push handler per connection; batches
-    register/unregister by id."""
-    batches: Dict[int, Callable] = {}
-
-    def on_push(method, payload):
-        if method == "BatchItems":
-            cb = batches.get(payload.get("b"))
-            if cb is not None:
-                for i, reply in payload.get("xs", ()):
-                    cb(i, reply)
-
-    client.set_push_handler(on_push)
-    client._stream_batches = batches
-    return batches
-
-
 class _PlacementGroupGone(Exception):
     """The target placement group was removed; queued tasks must fail."""
 
@@ -2304,10 +2368,11 @@ class _LeasePool:
             raise w.node_death_error(grant["node_id"],
                                      "lease granted by dead node")
         conn.assigned_instances = grant.get("assigned_instances", {})
-        client = AsyncRpcClient()
-        await client.connect_tcp(conn.addr["host"], conn.addr["port"])
-        client.start_idle_monitor(CONFIG.client_idle_deadline_s)
-        conn.client = client
+        # stream on the shared per-process session (ISSUE 11) — a leased
+        # worker that later becomes an actor reuses the same socket pair
+        conn.client = await w._direct_stream(
+            conn.addr, label=f"lease-{grant['worker_id'][:8]}",
+            node_id=conn.node_id)
         self.conns.append(conn)
         self.inflight_leases -= 1
         conn.idle_since = time.monotonic()
@@ -2406,8 +2471,8 @@ class _LeasePool:
         batches = getattr(client, "_stream_batches", None)
         if batches is None:
             batches = _attach_batch_router(client)
-        self._batch_seq = getattr(self, "_batch_seq", 0) + 1
-        bid = self._batch_seq
+        # channel-scoped (see _ActorState._push_batch)
+        bid = client.next_batch_id()
         resolved = [False] * len(live)
 
         def on_item(i, reply):
@@ -2715,10 +2780,11 @@ class _ActorState:
     async def _connect_then_flush(self, worker: Worker) -> None:
         addr = self.addr
         try:
-            client = AsyncRpcClient()
-            await client.connect_tcp(addr["host"], addr["port"])
-            client.start_idle_monitor(CONFIG.client_idle_deadline_s)
-            self.client = client
+            # a stream on the shared per-process session (ISSUE 11):
+            # same-node actors ride the shm lane, and closing this
+            # actor's stream later cannot tear down its siblings'
+            self.client = await worker._direct_stream(
+                addr, label=f"actor-{self.actor_id.hex()[:8]}")
         except Exception:
             self.client = None
             # The addr may be stale (actor died) or freshly updated while we
@@ -2751,8 +2817,10 @@ class _ActorState:
         batches = getattr(client, "_stream_batches", None)
         if batches is None:
             batches = _attach_batch_router(client)
-        self._batch_seq = getattr(self, "_batch_seq", 0) + 1
-        bid = self._batch_seq
+        # channel-scoped id: sibling streams on a shared mux session
+        # route BatchItems through ONE session router, so a per-actor
+        # counter would collide across actors
+        bid = client.next_batch_id()
         resolved = [False] * len(records)
 
         def on_item(i, reply):

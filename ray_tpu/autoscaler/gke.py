@@ -132,7 +132,9 @@ class GkeTpuPodSliceProvider(NodeProvider):
                                provider_config["head_port"],
                                provider_config["session_dir"])
         self._slices: Dict[str, Dict] = {}
-        self._lock = threading.Lock()
+        # RLock: provider state reads are reachable from GC context
+        # (raylint R1) via the session pools' reap paths
+        self._lock = threading.RLock()
         self._counter = 0
         for name, spec in self.node_types.items():
             topo = spec.get("tpu_topology")

@@ -69,7 +69,9 @@ class LocalNodeProvider(NodeProvider):
         self.session_dir: str = provider_config["session_dir"]
         self.node_types: Dict[str, Dict] = provider_config["node_types"]
         self._nodes: Dict[str, Dict] = {}
-        self._lock = threading.Lock()
+        # RLock: provider state reads are reachable from GC context
+        # (raylint R1) via the session pools' reap paths
+        self._lock = threading.RLock()
         self._counter = 0
 
     def non_terminated_nodes(self) -> List[str]:

@@ -202,6 +202,7 @@ def cmd_status(args) -> int:
     _print_head_status()
     _print_data_plane()
     _print_worker_pool()
+    _print_direct_call_plane()
     return 0
 
 
@@ -282,6 +283,38 @@ def _print_data_plane() -> None:
         pass
 
 
+def _print_direct_call_plane() -> None:
+    """Multiplexed direct-call plane view (ISSUE 11): this process's mux
+    sessions/streams and shm-lane counters (each process keeps its own —
+    the numbers here are the status driver's, plus the node agent's
+    demand-paged pool view below)."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.mux import MUX_STATS
+        from ray_tpu._private.shm_rpc import SHM_STATS
+
+        w = worker_mod.global_worker
+        sessions = len(w._mux_pool._sessions)
+        streams = w._mux_pool.total_streams()
+        shm_sessions = w._mux_pool.shm_sessions()
+    except Exception:
+        return
+    print("\nDirect-call plane (this process)")
+    print("-" * 40)
+    print(f"  mux sessions {sessions} ({shm_sessions} shm-attached)   "
+          f"streams {streams}   "
+          f"opened {MUX_STATS['streams_opened']} / "
+          f"closed {MUX_STATS['streams_closed']}")
+    print(f"  shm frames out {SHM_STATS['calls_out']} "
+          f"({SHM_STATS['bytes_out']} B) / in {SHM_STATS['frames_in']} "
+          f"({SHM_STATS['bytes_in']} B)")
+    print(f"  fallbacks: oversize {SHM_STATS['fallback_oversize']}, "
+          f"ring-full {SHM_STATS['fallback_ring_full']}   "
+          f"attach ok {SHM_STATS['attach_ok']} / "
+          f"declined {SHM_STATS['attach_declined']}   "
+          f"order-gap flushes {SHM_STATS['order_gap_flushes']}")
+
+
 def _fmt_hist(hist) -> str:
     if not hist:
         return "-"
@@ -305,12 +338,16 @@ def _print_worker_pool() -> None:
     print("\nWorker pool (this node)")
     print("-" * 40)
     hits, misses = st.get("hits", 0), st.get("misses", 0)
-    ratio = hits / (hits + misses) if hits + misses else 0.0
+    demand = st.get("demand_hits", 0)
+    served = hits + demand
+    ratio = served / (served + misses) if served + misses else 0.0
     print(f"  warm {st.get('warm', 0)}/{st.get('warm_target', 0)}   "
           f"idle {st.get('idle', 0)}   workers {st.get('workers', 0)}   "
-          f"starting {st.get('starting', 0)}")
-    print(f"  actor starts: {hits} warm hits / {misses} cold forks "
-          f"(hit ratio {ratio:.0%})   refills {st.get('refills', 0)}   "
+          f"starting {st.get('starting', 0)}   "
+          f"waiters {st.get('waiters', 0)}")
+    print(f"  actor starts: {hits} warm hits + {demand} demand-paged / "
+          f"{misses} cold forks (hit ratio {ratio:.0%})   "
+          f"refills {st.get('refills', 0)}   "
           f"ttl-reaped {st.get('reaped', 0)}")
     print(f"  lease batch sizes: {_fmt_hist(st.get('lease_batch_hist'))}")
     print(f"  ready batch sizes: {_fmt_hist(st.get('ready_batch_hist'))}")

@@ -141,16 +141,43 @@ def bench_actor_scale(quick: bool) -> dict:
             out["envelope"] = run_round(5000, straggler_timeout=1800)
         after = pool_stats()
         hits = after["hits"] - before["hits"]
+        demand_hits = (after.get("demand_hits", 0)
+                       - before.get("demand_hits", 0))
         misses = after["misses"] - before["misses"]
+        served = hits + demand_hits
+        hit_ratio = (round(served / (served + misses), 3)
+                     if served + misses else 0.0)
         out["pool"] = {
             "warm_target": after["warm_target"],
-            "hits": hits, "misses": misses,
-            "hit_ratio": round(hits / (hits + misses), 3)
-            if hits + misses else 0.0,
+            "hits": hits, "demand_hits": demand_hits, "misses": misses,
+            "hit_ratio": hit_ratio,
             "refills": after["refills"] - before["refills"],
             "ready_batch_hist": after["ready_batch_hist"],
             "lease_batch_hist": after["lease_batch_hist"],
         }
+        # direct-call plane transport columns (ISSUE 11): the driver's
+        # own mux/shm counters — every same-node actor call above rode
+        # (or deliberately fell back from) the shm doorbell lane
+        from ray_tpu._private.mux import MUX_STATS
+        from ray_tpu._private.shm_rpc import stats_snapshot
+
+        shm = stats_snapshot()
+        out["transport"] = {
+            "mux_sessions_opened": MUX_STATS["sessions_opened"],
+            "mux_streams_opened": MUX_STATS["streams_opened"],
+            "shm_frames_out": shm["calls_out"],
+            "shm_frames_in": shm["frames_in"],
+            "shm_attach_ok": shm["attach_ok"],
+            "shm_fallback_oversize": shm["fallback_oversize"],
+            "shm_fallback_ring_full": shm["fallback_ring_full"],
+            "order_gap_flushes": shm["order_gap_flushes"],
+        }
+        # the predictive refill exists to make bursts pool-served: a
+        # quick run falling under 0.5 is a regression, fail loudly
+        # (ISSUE 11 satellite; pre-PR baseline was 0.17)
+        if quick and served + misses >= 100:
+            assert hit_ratio >= 0.5, (
+                f"warm-pool hit_ratio {hit_ratio} < 0.5: {out['pool']}")
     finally:
         ray_tpu.shutdown()
         from ray_tpu._private import lifecycle

@@ -91,6 +91,10 @@ FAST_FILES = {
     "test_actor_scale.py",
     "test_serve_load.py",
     "test_raylint.py",
+    "test_direct_call.py",
+    # in FAST so tier-1 exercises the gate (its standalone failure used
+    # to hide behind the `-m 'not slow'` deselection — ISSUE 11)
+    "test_dryrun_gate.py",
 }
 SLOW_TESTS: set = set()
 

@@ -333,14 +333,22 @@ class TestWarmPoolCluster:
             ray_tpu.kill(a)
 
     def test_warm_worker_never_imports_jax(self, warm_cluster):
+        from ray_tpu._private.shm_rpc import SHM_STATS
+
         _wait_warm(2)
         before = _pool_stats()
+        shm_before = SHM_STATS["calls_out"]
         probe = Probe.options(num_cpus=0.001).remote()
         assert ray_tpu.get(probe.jax_was_preimported.remote(),
                            timeout=120) is False
         after = _pool_stats()
         assert after["hits"] > before["hits"], \
             "probe was expected to ride a warm worker"
+        # the new direct-call paths keep the gate contract too: the
+        # probe's calls rode the shm lane (same node) and the parked
+        # worker STILL never touched jax (mux/shm_rpc import none)
+        assert SHM_STATS["calls_out"] > shm_before, \
+            "same-node probe call did not ride the shm lane"
         ray_tpu.kill(probe)
 
     def test_kill_warm_then_leased_worker(self, warm_cluster):

@@ -1,0 +1,421 @@
+"""Multiplexed direct-call plane + shm local RPC (ISSUE 11).
+
+Unit layers (no cluster): ShmRing wraparound/full-ring refusal, the
+cross-lane frame orderer (in-order, buffering, gap give-up), fair
+round-robin interleaving across streams on a shared session (fake
+client), session-scoped BatchItems demux, per-stream close semantics
+(typed StreamClosedError, siblings + session survive), ring-full →
+TCP fallback with the seq preserved, and the ShmAttach server-side
+decline ladder (disabled / cross-node / no arena / foreign paths).
+
+Integration: same-node actor calls measurably ride the shm lane with
+byte-identical results while the worker keeps jax unimported; a tiny
+max-frame knob forces constant lane alternation and execution order
+still matches submission order (the reorder stage's contract); kill -9
+of the peer mid-multiplexed-call surfaces a typed error promptly with
+no hang; with the lane disabled everything runs on pure TCP.
+"""
+
+import asyncio
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import shm_rpc
+from ray_tpu._private.mux import (
+    MuxSession, StreamClosedError, _FrameOrderer, handle_shm_attach,
+    handle_shm_detach)
+from ray_tpu._private.shm_rpc import SHM_STATS, ShmRing
+
+
+# ---------------------------------------------------------------------------
+# unit: ring
+# ---------------------------------------------------------------------------
+class TestShmRing:
+    def test_wraparound_byte_identical(self, tmp_path):
+        import random
+
+        path = str(tmp_path / "ring")
+        producer = ShmRing(path, capacity=512, create=True)
+        consumer = ShmRing(path)  # second mapping = the peer process
+        rng = random.Random(7)
+        sent, recvd = [], []
+        for i in range(3000):
+            frame = bytes([i % 251]) * rng.randint(0, 200)
+            while not producer.try_write(frame):
+                recvd.extend(consumer.read_frames())
+            sent.append(frame)
+        recvd.extend(consumer.read_frames())
+        assert recvd == sent
+
+    def test_full_ring_refuses_not_corrupts(self, tmp_path):
+        ring = ShmRing(str(tmp_path / "r"), capacity=128, create=True)
+        peer = ShmRing(str(tmp_path / "r"))
+        assert ring.try_write(b"a" * 100)
+        assert not ring.try_write(b"b" * 100)  # no room: refused
+        assert peer.read_frames() == [b"a" * 100]
+        assert ring.try_write(b"b" * 100)  # space reclaimed
+        assert peer.read_frames() == [b"b" * 100]
+
+    def test_doorbell_waiting_protocol(self, tmp_path):
+        ring = ShmRing(str(tmp_path / "r"), capacity=256, create=True)
+        peer = ShmRing(str(tmp_path / "r"))
+        # fresh ring: consumer assumed idle -> first write must bell
+        assert ring.consumer_waiting()
+        assert peer.read_frames() == []
+        assert peer.arm_waiting() is True  # empty: safe to sleep
+        ring.try_write(b"x")
+        # parked consumer re-checking must refuse to sleep
+        assert peer.arm_waiting() is False
+        assert peer.read_frames() == [b"x"]
+
+
+# ---------------------------------------------------------------------------
+# unit: frame orderer
+# ---------------------------------------------------------------------------
+class TestFrameOrderer:
+    def test_reorders_cross_lane_arrivals(self):
+        async def run():
+            got = []
+            o = _FrameOrderer(asyncio.get_running_loop(), got.append, 5.0)
+            o.feed({"q": 2, "v": "b"})   # shm lane raced ahead
+            assert got == []             # held for the TCP frame
+            o.feed({"q": 1, "v": "a"})
+            assert [m["v"] for m in got] == ["a", "b"]
+            o.feed({"q": 3, "v": "c"})
+            o.feed({"v": "unstamped"})   # pre-attach frame: immediate
+            assert [m.get("v") for m in got] == \
+                ["a", "b", "c", "unstamped"]
+            o.close()
+
+        asyncio.run(run())
+
+    def test_gap_gives_up_instead_of_wedging(self):
+        async def run():
+            got = []
+            before = SHM_STATS["order_gap_flushes"]
+            o = _FrameOrderer(asyncio.get_running_loop(), got.append, 0.05)
+            o.feed({"q": 5, "v": "late"})  # q1-4 eaten by a fault rule
+            await asyncio.sleep(0.15)
+            assert [m["v"] for m in got] == ["late"]
+            assert SHM_STATS["order_gap_flushes"] == before + 1
+            # stream continues from past the gap
+            o.feed({"q": 6, "v": "next"})
+            assert [m["v"] for m in got] == ["late", "next"]
+            o.close()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# unit: mux session vs fake client
+# ---------------------------------------------------------------------------
+class _FakeClient:
+    """AsyncRpcClient stand-in capturing frames in send order."""
+
+    def __init__(self, loop):
+        self._loop = loop
+        self.connected = True
+        self.sent = []
+        self._next = 0
+        self._batch_counter = 0
+
+    def register_call(self):
+        self._next += 1
+        return self._next, self._loop.create_future()
+
+    def send_msg_nowait(self, msg):
+        self.sent.append(msg)
+        return True
+
+    def _send_frame(self, body, method):
+        self.sent.append({"raw": body, "m": method})
+        return True
+
+    def start_idle_monitor(self, *a, **kw):
+        pass
+
+
+def _fake_session(loop):
+    sess = MuxSession(None, "127.0.0.1", 0)
+    sess.loop = loop
+    sess.client = _FakeClient(loop)
+    return sess
+
+
+class TestMuxUnits:
+    def test_chatty_stream_cannot_head_of_line_block(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_DIRECT_CALL_FAIR_FRAMES_PER_ROUND",
+                           "4")
+
+        async def run():
+            sess = _fake_session(asyncio.get_running_loop())
+            chatty = sess.open_stream("chatty")
+            quiet = sess.open_stream("quiet")
+            for i in range(40):
+                chatty.push_nowait("Spam", i)
+            quiet.push_nowait("OneCall", None)
+            await asyncio.sleep(0)  # run the scheduled fair flush
+            order = [m["s"] for m in sess.client.sent]
+            assert len(order) == 41
+            # quiet's single frame leaves within one quantum of the
+            # chatty backlog, not behind all 40 frames
+            assert order.index(quiet.sid) == 4
+            # within-stream FIFO is preserved for the chatty stream
+            chatty_payloads = [m["p"] for m in sess.client.sent
+                               if m["s"] == chatty.sid]
+            assert chatty_payloads == list(range(40))
+
+        asyncio.run(run())
+
+    def test_batch_router_demux_per_stream(self):
+        async def run():
+            sess = _fake_session(asyncio.get_running_loop())
+            s1 = sess.open_stream("a1")
+            s2 = sess.open_stream("a2")
+            assert s1._stream_batches is s2._stream_batches
+            got1, got2 = [], []
+            b1, b2 = s1.next_batch_id(), s2.next_batch_id()
+            assert b1 != b2  # session-scoped: no cross-stream collision
+            s1._stream_batches[b1] = lambda i, r: got1.append((i, r))
+            s2._stream_batches[b2] = lambda i, r: got2.append((i, r))
+            sess._on_push("BatchItems", {"b": b1, "xs": [(0, "x")]})
+            sess._on_push("BatchItems", {"b": b2, "xs": [(0, "y"),
+                                                         (1, "z")]})
+            sess._on_push("BatchItems", {"b": 999, "xs": [(0, "?")]})
+            assert got1 == [(0, "x")]
+            assert got2 == [(0, "y"), (1, "z")]
+
+        asyncio.run(run())
+
+    def test_per_stream_close_spares_siblings(self):
+        async def run():
+            sess = _fake_session(asyncio.get_running_loop())
+            doomed = sess.open_stream("doomed")
+            sibling = sess.open_stream("sibling")
+            f1 = doomed.call_future("M", {})
+            f2 = sibling.call_future("M", {})
+            doomed.close()
+            with pytest.raises(StreamClosedError):
+                await f1
+            assert not f2.done()  # sibling's call still in flight
+            assert not sibling.closed
+            assert sess.client.connected  # session survives
+            # a closed stream fails fast instead of queueing silently
+            with pytest.raises(StreamClosedError):
+                await doomed.call("M", {})
+
+        asyncio.run(run())
+
+    def test_ring_full_falls_back_to_tcp_with_seq(self):
+        async def run():
+            sess = _fake_session(asyncio.get_running_loop())
+
+            class _FullLane:
+                closed = False
+
+                def try_send(self, frame):
+                    return False  # ring momentarily full
+
+            sess.lane = _FullLane()
+            stream = sess.open_stream("s")
+            stream.push_nowait("M", {"x": 1})
+            await asyncio.sleep(0)
+            sent = sess.client.sent
+            assert len(sent) == 1
+            # fell back to the TCP lane, seq preserved for the reorder
+            # stage on the receiver
+            assert "raw" in sent[0]
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# unit: server-side attach decline ladder (arena unavailable etc.)
+# ---------------------------------------------------------------------------
+class _FakeConn:
+    closed = False
+    mux_demux = None
+
+    def __init__(self):
+        self.meta = {}
+
+
+class TestAttachDeclines:
+    def _attach(self, payload, node_id, store_dir):
+        return asyncio.run(handle_shm_attach(
+            None, _FakeConn(), payload, node_id, store_dir))
+
+    def test_declines_cleanly(self, tmp_path, monkeypatch):
+        store = str(tmp_path / "store")
+        os.makedirs(store)
+        # disabled
+        monkeypatch.setenv("RAY_TPU_SHM_RPC_ENABLED", "0")
+        assert self._attach({"node_id": "n1"}, "n1", store) == \
+            {"ok": False, "reason": "disabled"}
+        monkeypatch.setenv("RAY_TPU_SHM_RPC_ENABLED", "1")
+        # cross-node caller
+        r = self._attach({"node_id": "other"}, "n1", store)
+        assert r["ok"] is False and r["reason"] == "cross-node"
+        # arena unavailable
+        r = self._attach({"node_id": "n1"}, "n1", None)
+        assert r["ok"] is False and "arena" in r["reason"]
+        # rendezvous paths outside the arena are refused
+        evil = {k: "/etc/passwd" for k in
+                ("ring_c2s", "ring_s2c", "bell_c2s", "bell_s2c")}
+        r = self._attach({"node_id": "n1", "paths": evil}, "n1", store)
+        assert r["ok"] is False and "bad path" in r["reason"]
+
+    def test_detach_blocks_late_attach(self, tmp_path):
+        """Client attach-timeout protocol: its ShmDetach must stop a
+        still-queued attach from committing a lane nobody will read."""
+        store = str(tmp_path / "store")
+        os.makedirs(store)
+        conn = _FakeConn()
+
+        async def run():
+            await handle_shm_detach(conn, {})
+            r = await handle_shm_attach(None, conn, {"node_id": "n1"},
+                                        "n1", store)
+            assert r["ok"] is False and "detached" in r["reason"]
+
+        asyncio.run(run())
+
+    def test_modules_never_import_jax(self):
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import ray_tpu._private.mux, ray_tpu._private.shm_rpc;"
+             "import sys; assert 'jax' not in sys.modules, 'jax leaked'"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# integration
+# ---------------------------------------------------------------------------
+@ray_tpu.remote
+class Echo:
+    def echo(self, x):
+        return x
+
+    def sleep(self, s):
+        time.sleep(s)
+        return "woke"
+
+    def state(self):
+        import sys
+
+        return {"pid": os.getpid(), "jax": "jax" in sys.modules}
+
+
+@ray_tpu.remote
+class Seq:
+    def __init__(self):
+        self.log = []
+
+    def add(self, i, payload):
+        self.log.append(i)
+        return len(payload)
+
+    def log_so_far(self):
+        return self.log
+
+
+class TestShmIntegration:
+    def test_same_node_calls_ride_shm_byte_identical(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_WORKER_POOL_WARM_TARGET", "2")
+        ray_tpu.init(num_cpus=2)
+        try:
+            before_out = SHM_STATS["calls_out"]
+            before_in = SHM_STATS["frames_in"]
+            a = Echo.remote()
+            payload = bytes(range(256)) * 37  # ~9.5 KB, rides inline
+            back = ray_tpu.get(a.echo.remote(payload), timeout=120)
+            assert back == payload  # byte-identical through the ring
+            assert ray_tpu.get([a.echo.remote(i) for i in range(100)],
+                               timeout=120) == list(range(100))
+            # the driver measurably used the lane, both directions
+            assert SHM_STATS["calls_out"] > before_out
+            assert SHM_STATS["frames_in"] > before_in
+            # ... while the worker (warm-pool contract) kept jax cold
+            st = ray_tpu.get(a.state.remote(), timeout=60)
+            assert st["jax"] is False
+            ray_tpu.kill(a)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_lane_alternation_preserves_call_order(self, monkeypatch):
+        """Force constant shm↔TCP alternation (tiny max-frame) and prove
+        a sync actor still executes calls in submission order — the
+        cross-lane seq/reorder contract, end to end."""
+        monkeypatch.setenv("RAY_TPU_SHM_RPC_MAX_FRAME_BYTES", "1500")
+        ray_tpu.init(num_cpus=2)
+        try:
+            before = SHM_STATS["fallback_oversize"]
+            before_gaps = SHM_STATS["order_gap_flushes"]
+            s = Seq.remote()
+            refs = []
+            for i in range(60):
+                # alternate tiny and >1500B payloads: odd frames fall
+                # back to TCP, even ones ride the ring
+                payload = b"x" * (4000 if i % 2 else 8)
+                refs.append(s.add.remote(i, payload))
+            ray_tpu.get(refs, timeout=120)
+            log = ray_tpu.get(s.log_so_far.remote(), timeout=60)
+            assert log == list(range(60))
+            assert SHM_STATS["fallback_oversize"] > before
+            # order came from the seq/reorder stage, not from gap
+            # give-ups (those would mean frames were lost or stalled)
+            assert SHM_STATS["order_gap_flushes"] == before_gaps
+            ray_tpu.kill(s)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_kill9_mid_call_typed_error_no_hang(self):
+        from ray_tpu.exceptions import ActorDiedError
+
+        ray_tpu.init(num_cpus=2)
+        try:
+            victim = Echo.remote()
+            bystander = Echo.remote()
+            pid = ray_tpu.get(victim.state.remote(), timeout=120)["pid"]
+            assert ray_tpu.get(bystander.echo.remote(1), timeout=120) == 1
+
+            @ray_tpu.remote
+            def _noop():
+                return None
+
+            slow = victim.sleep.remote(30)  # guaranteed mid-call
+            time.sleep(0.5)
+            os.kill(pid, signal.SIGKILL)
+            t0 = time.monotonic()
+            with pytest.raises(ActorDiedError):
+                # in-flight call on the killed peer's stream fails with
+                # the typed error instead of riding a dead socket
+                ray_tpu.get(slow, timeout=60)
+            assert time.monotonic() - t0 < 55
+            # no session/plane-wide damage: other peers answer promptly
+            assert ray_tpu.get(bystander.echo.remote(2), timeout=60) == 2
+            assert ray_tpu.get(_noop.remote(), timeout=120) is None
+            ray_tpu.kill(bystander)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_disabled_lane_runs_pure_tcp(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_SHM_RPC_ENABLED", "0")
+        ray_tpu.init(num_cpus=2)
+        try:
+            before = SHM_STATS["calls_out"]
+            a = Echo.remote()
+            assert ray_tpu.get([a.echo.remote(i) for i in range(20)],
+                               timeout=120) == list(range(20))
+            assert SHM_STATS["calls_out"] == before  # never attached
+            ray_tpu.kill(a)
+        finally:
+            ray_tpu.shutdown()
