@@ -112,6 +112,28 @@ _flag("object_spill_disk_max_bytes", 0)
 # (where the real NIC constraint does not exist).
 _flag("object_serve_bandwidth_bytes_ps", 0)
 
+# --- streaming data plane (ISSUE 12) -----------------------------------------
+# DataContext seeds its per-process defaults from these (env-overridable
+# like every flag); the streaming shuffle + executor read the context.
+# Kill switch: route random_shuffle/sort back through the materializing
+# AllToAll exchange.
+_flag("data_streaming_shuffle", True)
+# Byte budget over the input shards of ADMITTED-but-unfinished reducers
+# (0 = unlimited): a slow reducer backpressures further admission instead
+# of the exchange buffering the whole dataset in worker memory.
+_flag("data_shuffle_inflight_bytes", 256 * 1024 * 1024)
+# Map re-executions / reduce resubmissions tolerated per record before a
+# shuffle loss becomes a hard ObjectLostError.
+_flag("data_shuffle_max_reduce_retries", 4)
+# Concurrent shuffle tasks (maps + admitted reducers + sort samples).
+_flag("data_shuffle_max_concurrency", 16)
+# Blocks the consumer-side iterator keeps in its prefetch window (pull
+# initiated one batched WaitObjects window ahead of consumption).
+_flag("data_iter_prefetch_blocks", 2)
+# Event-paced executor drive loop: fallback wake period when no task
+# completion / queue transition fires (liveness guard, not a poll rate).
+_flag("data_exec_idle_wait_s", 0.25)
+
 # --- workers ----------------------------------------------------------------
 _flag("num_workers_soft_limit", 0)  # 0 = num_cpus
 _flag("worker_forkserver", True)  # fork plain workers from a warm template

@@ -48,11 +48,30 @@ class MemoryStore:
         self._lock = threading.RLock()
         self._objects: Dict[bytes, _Entry] = {}
         self._waiters: List[_Waiter] = []
+        # coarse completion hooks (no per-id filtering): pollers that
+        # sleep between scans — the streaming executor's event-paced
+        # drive loop (ISSUE 12) — register a callback instead of
+        # busy-polling. Called OUTSIDE the lock, must be cheap and
+        # exception-free (Event.set).
+        self._put_listeners: List = []
+
+    def add_put_listener(self, cb) -> None:
+        with self._lock:
+            if cb not in self._put_listeners:
+                self._put_listeners.append(cb)
+
+    def remove_put_listener(self, cb) -> None:
+        with self._lock:
+            try:
+                self._put_listeners.remove(cb)
+            except ValueError:
+                pass
 
     def put(self, object_id: bytes, data: bytes, is_exception: bool = False) -> None:
         wake: List[_Waiter] = []
         with self._lock:
             self._objects[object_id] = _Entry(data, is_exception)
+            listeners = tuple(self._put_listeners)
             if self._waiters:
                 still = []
                 for w in self._waiters:
@@ -66,6 +85,11 @@ class MemoryStore:
                 self._waiters = still
         for w in wake:
             w.event.set()
+        for cb in listeners:
+            try:
+                cb()
+            except Exception:
+                pass
 
     def contains(self, object_id: bytes) -> bool:
         with self._lock:

@@ -1053,6 +1053,7 @@ class Worker:
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         self._n_gets = getattr(self, "_n_gets", 0) + 1
         deadline = None if timeout is None else time.monotonic() + timeout
+        self._batch_resolve_borrows(refs)
         self._prefetch_plasma(refs)
         out: List[Any] = [None] * len(refs)
         for i, ref in enumerate(refs):
@@ -1062,7 +1063,58 @@ class Worker:
             out[i] = self._get_one(ref, remaining)
         return out
 
-    def _prefetch_plasma(self, refs: List[ObjectRef]) -> None:
+    def _batch_resolve_borrows(self, refs: List[ObjectRef]) -> None:
+        """Resolve every still-unresolved BORROWED ref in one concurrent
+        owner gather, so their plasma pulls can all start in the same
+        WaitObjects window. The serial path below paid one owner round
+        trip per ref — a shuffle reducer pulling M shards (ISSUE 12)
+        stalled M round trips before its first byte moved. Best-effort:
+        any ref this pass skips (pending producer, owner hiccup) is
+        resolved — and its errors raised — by the per-ref path."""
+        need: List[ObjectRef] = []
+        seen = set()
+        for ref in refs:
+            b = ref.binary()
+            if b in seen:
+                continue
+            seen.add(b)
+            if self.memory_store.get(b) is not None:
+                continue
+            if self.reference_counter.get_owned_meta(b) is not None:
+                continue
+            if ref.owner_addr():
+                need.append(ref)
+        if len(need) < 2:
+            return  # serial path is one round trip anyway
+
+        async def _one(ref: ObjectRef):
+            try:
+                client = await self._owner_client(ref.owner_addr())
+                # block:False — resolve what is resolvable NOW. Blocking
+                # here would serialize every pull-start behind the
+                # SLOWEST producer (a reducer admitted mid-map-phase
+                # would move zero bytes until the last map sealed);
+                # still-pending refs fall to the per-ref path, which
+                # blocks per object and pulls each as it is produced.
+                reply = await client.call(
+                    "GetOwnedValue",
+                    {"object_id": ref.hex(), "block": False},
+                    timeout=CONFIG.borrow_resolve_timeout_s,
+                )
+            except Exception:
+                return
+            self._cache_owner_reply(ref, reply)
+
+        async def _all():
+            await asyncio.gather(*(_one(r) for r in need))
+
+        try:
+            self._acall(_all(), timeout=CONFIG.borrow_resolve_timeout_s + 5)
+        except Exception:
+            pass
+
+    def _prefetch_plasma(self, refs: List[ObjectRef],
+                         min_need: int = 2) -> None:
         """One WaitObjects frame covering every plasma-backed ref not yet
         local, so the agent STARTS all the pulls concurrently. Without
         this, the per-ref loop below paid one sequential cross-node pull
@@ -1084,7 +1136,7 @@ class Worker:
             if not in_plasma or self.store.contains(ref.id()):
                 continue
             need[hex_id] = ref
-        if len(need) < 2:
+        if len(need) < min_need:
             return  # the serial path's own WaitObjects is one call anyway
         try:
             # bounded: a stalled agent loop must surface as the per-ref
@@ -1142,6 +1194,24 @@ class Worker:
     def _time_left(deadline) -> Optional[float]:
         return None if deadline is None else deadline - time.monotonic()
 
+    def _cache_owner_reply(self, ref: ObjectRef, reply) -> Optional[str]:
+        """Decode one GetOwnedValue reply and cache what it reveals
+        (inline value / plasma marker + locations) in the local stores.
+        The ONE place the owner-reply contract is interpreted — shared
+        by the serial borrow resolver, the batched gather, and the
+        wait() probe. Returns the reply's status (None if no reply)."""
+        status = reply.get("status") if reply else None
+        if status == "inline":
+            flags = EXC if reply.get("is_exception") else VAL
+            self.memory_store.put(ref.binary(), reply["data"], flags)
+        elif status == "plasma":
+            self.memory_store.put(ref.binary(), b"", IN_PLASMA)
+            self._borrowed_locations = getattr(
+                self, "_borrowed_locations", {})
+            self._borrowed_locations[ref.binary()] = \
+                reply.get("locations", [])
+        return status
+
     def _resolve_borrowed(self, ref: ObjectRef, deadline) -> Tuple[bytes, int]:
         owner = ref.owner_addr()
         if not owner:
@@ -1163,15 +1233,11 @@ class Worker:
                     ask(), timeout=CONFIG.borrow_resolve_timeout_s + 5)
             except Exception as e:
                 raise ObjectLostError(ref.hex(), f"owner unreachable ({e})")
-            status = reply.get("status") if reply else "unknown"
+            status = self._cache_owner_reply(ref, reply) or "unknown"
             if status == "inline":
                 flags = EXC if reply.get("is_exception") else VAL
-                self.memory_store.put(ref.binary(), reply["data"], flags)
                 return reply["data"], flags
             if status == "plasma":
-                self.memory_store.put(ref.binary(), b"", IN_PLASMA)
-                self._borrowed_locations = getattr(self, "_borrowed_locations", {})
-                self._borrowed_locations[ref.binary()] = reply.get("locations", [])
                 return b"", IN_PLASMA
             if status == "freed":
                 raise ObjectLostError(ref.hex(), "was freed by its owner")
@@ -1264,6 +1330,35 @@ class Worker:
             except Exception:
                 pass
 
+    def recover_task_returns(self, ref: ObjectRef) -> bool:
+        """Lineage re-execution for a MULTI-return task: reset every
+        return of the task that produced ``ref`` and resubmit it once
+        under the SAME task id (so all return object ids stay stable).
+
+        ``_try_recover`` resets only the one object handed to it — for a
+        task with ``num_returns=R`` (the streaming shuffle's per-shard
+        map outputs) that leaves the sibling returns pointing at dead
+        locations, and a second consumer hitting a different shard would
+        resubmit the task again. Here the caller (e.g. the shuffle
+        operator's shuffle-scoped recovery) re-executes the whole task
+        exactly once per loss event."""
+        record = self._tasks.get(ref.id().task_id().binary())
+        if record is None or record.spec.task_type != NORMAL_TASK:
+            return False
+        if record.spec.max_retries <= 0:
+            return False
+        if not record.completed:
+            return True  # a re-execution is already in flight
+        for oid in record.return_ids:
+            meta = self.reference_counter.get_owned_meta(oid.binary())
+            if meta:
+                meta.state = "pending"
+                meta.locations = []
+            self.memory_store.delete(oid.binary())
+        record.completed = False
+        self._post(self._submit_to_pool_sync, record)
+        return True
+
     def _try_recover(self, ref: ObjectRef, attempt: int) -> bool:
         """Lineage reconstruction: resubmit the task that created this object
         (reference: src/ray/core_worker/object_recovery_manager.h)."""
@@ -1343,11 +1438,7 @@ class Worker:
             return False
         if not reply:
             return False
-        if reply.get("status") == "inline":
-            flags = EXC if reply.get("is_exception") else VAL
-            self.memory_store.put(ref.binary(), reply["data"], flags)
-            return True
-        return reply.get("status") == "plasma"
+        return self._cache_owner_reply(ref, reply) in ("inline", "plasma")
 
     # ------------------------------------------------------------ free/kill
     def free(self, refs: List[ObjectRef]) -> None:

@@ -128,6 +128,10 @@ class ResourceManager:
                 meta = getattr(bundle, "meta", None)
                 if meta is not None:
                     total += meta.size_bytes
+        # bytes held outside the queues: the streaming shuffle's sealed
+        # shard objects (ISSUE 12) — without this the budget policy was
+        # blind to the exchange's working set
+        total += op.extra_usage_bytes()
         return total
 
     def usage_bytes(self) -> int:

@@ -46,6 +46,16 @@ class ExecutorStats:
         self.start_time = time.perf_counter()
         self.wall_s = 0.0
         self.per_op: List[Dict] = []
+        # event-paced drive loop accounting (ISSUE 12): scheduling-loop
+        # iterations and how many ended parked on the wake event — the
+        # busy-poll regression guard asserts iters stays O(completions)
+        self.loop_iters = 0
+        self.idle_waits = 0
+        # consumer-side ingest accounting: wall seconds the block
+        # iterator spent blocked inside ray_tpu.get despite the prefetch
+        # window, and how many blocks it pulled
+        self.consumer_stall_s = 0.0
+        self.blocks_consumed = 0
 
     @staticmethod
     def _fmt_bytes(n: int) -> str:
@@ -70,19 +80,46 @@ class ExecutorStats:
                 f"* Task time: {rec['exec_s']:.3f}s total"
                 + (f", {rec['exec_s'] / rec['tasks']:.3f}s mean"
                    if rec['tasks'] else ""))
+            ex = rec.get("extra") or {}
+            if "shuffle_maps" in ex:
+                lines.append(
+                    f"* Shuffle: {ex['shuffle_maps']} maps -> "
+                    f"{ex['shuffle_reducers']} reducers, "
+                    f"{self._fmt_bytes(ex['shuffle_shard_bytes'])} shards "
+                    f"(peak in-flight "
+                    f"{self._fmt_bytes(ex['shuffle_inflight_peak_bytes'])}),"
+                    f" stall {ex['shuffle_stall_fraction']:.2f}, "
+                    f"re-execs {ex['shuffle_map_reexecs']}")
         lines.append(f"Dataset: {self.wall_s:.2f}s wall, "
-                     f"{sum(r['tasks'] for r in self.per_op)} tasks")
+                     f"{sum(r['tasks'] for r in self.per_op)} tasks, "
+                     f"{self.loop_iters} scheduler iterations "
+                     f"({self.idle_waits} idle waits)")
+        if self.blocks_consumed:
+            lines.append(
+                f"Consumer: {self.blocks_consumed} blocks pulled, "
+                f"{self.consumer_stall_s:.3f}s stalled on pulls")
         return "\n".join(lines)
 
     def to_dict(self) -> Dict:
-        return {"wall_s": round(self.wall_s, 4), "ops": self.per_op}
+        return {"wall_s": round(self.wall_s, 4), "ops": self.per_op,
+                "loop_iters": self.loop_iters,
+                "idle_waits": self.idle_waits,
+                "consumer_stall_s": round(self.consumer_stall_s, 4),
+                "blocks_consumed": self.blocks_consumed}
 
 
 class StreamingExecutor:
     """Drives a Topology on a daemon thread; final bundles land in a bounded
-    queue consumed by ``iter_bundles``."""
+    queue consumed by ``iter_bundles``.
 
-    POLL_INTERVAL = 0.003
+    The drive loop is EVENT-PACED (ISSUE 12): when a step makes no
+    progress, the thread parks on a wake event instead of busy-polling.
+    Wake sources: any memory-store put (every task completion —
+    inline value, plasma marker, or error — lands there), consumer
+    drains of the output queue (frees the output-buffer policy), and
+    shutdown. A bounded fallback wait (``DataContext.exec_idle_wait_s``)
+    covers anything that completes without a local put (e.g. a seal
+    notification lost to a dying worker)."""
 
     def __init__(self, topology: Topology, stats: Optional[ExecutorStats] = None):
         from ray_tpu.data.context import DataContext
@@ -95,6 +132,8 @@ class StreamingExecutor:
         self.error: Optional[BaseException] = None
         self.stats = stats or ExecutorStats()
         self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._idle_wait_s = ctx.exec_idle_wait_s
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="raytpu-data-exec")
         self.resource_manager = ResourceManager(
@@ -104,13 +143,31 @@ class StreamingExecutor:
                           else DEFAULT_BACKPRESSURE_POLICIES)
         self.policies = [cls(topology, self) for cls in policy_classes]
 
+    def _wake_cb(self) -> None:
+        self._wake.set()
+
     def start(self) -> "StreamingExecutor":
+        self._listening_store = None
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            if w is not None and getattr(w, "memory_store", None) is not None:
+                w.memory_store.add_put_listener(self._wake_cb)
+                self._listening_store = w.memory_store
+        except Exception:
+            pass
         self._thread.start()
         return self
 
     def shutdown(self) -> None:
         self._stop.set()
+        self._wake.set()
         self._thread.join(timeout=10)
+        store = getattr(self, "_listening_store", None)
+        if store is not None:
+            store.remove_put_listener(self._wake_cb)
+            self._listening_store = None
         for op in self.topology.ops:
             if hasattr(op, "shutdown"):
                 op.shutdown()
@@ -119,11 +176,16 @@ class StreamingExecutor:
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
+                # clear BEFORE stepping: a completion landing mid-step
+                # re-arms the event and the next wait falls through
+                self._wake.clear()
+                self.stats.loop_iters += 1
                 progressed = self._step()
                 if self._all_done():
                     break
                 if not progressed:
-                    time.sleep(self.POLL_INTERVAL)
+                    self.stats.idle_waits += 1
+                    self._wake.wait(self._idle_wait_s)
         except BaseException as e:  # surfaced via iter_bundles
             self.error = e
         finally:
@@ -184,20 +246,27 @@ class StreamingExecutor:
 
     def _record_stats(self):
         self.stats.wall_s = time.perf_counter() - self.stats.start_time
-        self.stats.per_op = [
-            {"name": op.name, "tasks": op.tasks_launched,
-             "rows": op.rows_out, "rows_in": op.rows_in,
-             "rows_out": op.rows_out, "bytes_in": op.bytes_in,
-             "bytes_out": op.bytes_out, "blocks_out": op.blocks_out,
-             "exec_s": round(op.exec_time_s, 4),
-             "wall_s": round(max(0.0, op.last_activity_t
-                                 - op.first_activity_t), 4)}
-            for op in self.topology.ops]
+        per_op = []
+        for op in self.topology.ops:
+            rec = {"name": op.name, "tasks": op.tasks_launched,
+                   "rows": op.rows_out, "rows_in": op.rows_in,
+                   "rows_out": op.rows_out, "bytes_in": op.bytes_in,
+                   "bytes_out": op.bytes_out, "blocks_out": op.blocks_out,
+                   "exec_s": round(op.exec_time_s, 4),
+                   "wall_s": round(max(0.0, op.last_activity_t
+                                       - op.first_activity_t), 4)}
+            extras = op.stats_extras()
+            if extras:
+                rec["extra"] = extras
+            per_op.append(rec)
+        self.stats.per_op = per_op
 
     # ------------------------------------------------------------- consume
     def iter_bundles(self):
         while True:
             bundle = self.out.get()
+            # a drained output slot can unblock the output-buffer policy
+            self._wake.set()
             if bundle is None:
                 if self.error is not None:
                     raise self.error

@@ -185,6 +185,16 @@ class PhysicalOperator:
         return (self.inputs_complete and not self.input_queue
                 and self.num_active_tasks() == 0)
 
+    def extra_usage_bytes(self) -> int:
+        """Bytes this operator holds OUTSIDE its input/output queues
+        (e.g. the shuffle's sealed shards); counted by the
+        ResourceManager toward the global memory budget."""
+        return 0
+
+    def stats_extras(self) -> Dict:
+        """Operator-specific counters merged into the stats record."""
+        return {}
+
     def _emit(self, bundle: RefBundle) -> None:
         import time as _t
 
@@ -346,9 +356,18 @@ class LimitOperator(PhysicalOperator):
         super().__init__(f"Limit[{limit}]")
         self.limit = limit
         self._taken = 0
+        # the one boundary-slice task in flight: (block_ref, meta_ref).
+        # Its metadata resolves in poll() — the old synchronous
+        # ray_tpu.get here stalled the whole scheduling loop for a full
+        # task round trip (ISSUE 12 hygiene).
+        self._slice_inflight: Optional[Tuple[Any, Any]] = None
+
+    def num_active_tasks(self) -> int:
+        return 1 if self._slice_inflight else 0
 
     def can_dispatch(self) -> bool:
-        return bool(self.input_queue) and self._taken < self.limit
+        return (bool(self.input_queue) and self._taken < self.limit
+                and self._slice_inflight is None)
 
     def dispatch(self) -> None:
         bundle = self.input_queue.popleft()
@@ -359,16 +378,26 @@ class LimitOperator(PhysicalOperator):
         else:
             refs = ray_tpu.remote(_slice_task).options(num_returns=2).remote(
                 bundle.block_ref, 0, remaining)
-            meta = ray_tpu.get(refs[1])
-            self._taken += meta.num_rows
-            self._emit(RefBundle(refs[0], meta))
+            self.tasks_launched += 1
+            # the slice is exactly `remaining` rows (the bundle had
+            # more): account now so the limit closes without waiting
+            self._taken += remaining
+            self._slice_inflight = (refs[0], refs[1])
 
     def poll(self) -> None:
+        if self._slice_inflight is not None:
+            block_ref, meta_ref = self._slice_inflight
+            ready, _ = ray_tpu.wait([meta_ref], num_returns=1, timeout=0)
+            if ready:
+                self._slice_inflight = None
+                self._emit(RefBundle(block_ref, ray_tpu.get(meta_ref)))
         if self._taken >= self.limit:
             self.input_queue.clear()
             self.inputs_complete = True
 
     def completed(self) -> bool:
+        if self._slice_inflight is not None:
+            return False
         return self._taken >= self.limit or super().completed()
 
 
@@ -434,6 +463,7 @@ class ZipOperator(PhysicalOperator):
         self._left_done = False
         self._right_done = False
         self._ran = False
+        self._inflight: Optional[Tuple[Any, Any]] = None
 
     def add_left(self, b: RefBundle):
         self.left.append(b)
@@ -449,11 +479,27 @@ class ZipOperator(PhysicalOperator):
         rrefs = [b.block_ref for b in self.right]
         refs = ray_tpu.remote(_zip_task).options(num_returns=2).remote(
             lrefs, rrefs)
-        self._emit(RefBundle(refs[0], ray_tpu.get(refs[1])))
+        self.tasks_launched += 1
+        self._inflight = (refs[0], refs[1])
         self._ran = True
 
+    def num_active_tasks(self) -> int:
+        return 1 if getattr(self, "_inflight", None) else 0
+
+    def poll(self) -> None:
+        # resolve the zip's metadata here instead of blocking dispatch
+        # (the scheduling loop kept running other operators meanwhile)
+        inflight = getattr(self, "_inflight", None)
+        if inflight is None:
+            return
+        block_ref, meta_ref = inflight
+        ready, _ = ray_tpu.wait([meta_ref], num_returns=1, timeout=0)
+        if ready:
+            self._inflight = None
+            self._emit(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+
     def completed(self) -> bool:
-        return self._ran
+        return self._ran and getattr(self, "_inflight", None) is None
 
 
 def _zip_task(left_refs, right_refs):

@@ -119,7 +119,19 @@ def _plan_chain(ops: List[L.LogicalOperator], topo: Topology,
         elif isinstance(op, L.Limit):
             idx = topo.add(P.LimitOperator(op.limit))
         elif isinstance(op, L.AbstractAllToAll):
-            idx = topo.add(P.AllToAllOperator(op.name, _bulk_fn(op)))
+            from ray_tpu.data.context import DataContext
+
+            if (op.kind in ("random_shuffle", "sort")
+                    and DataContext.get_current().streaming_shuffle):
+                # pipelined per-shard exchange (ISSUE 12); the
+                # materializing barrier below stays as the kill-switch
+                # path and for the remaining bulk kinds
+                from ray_tpu.data._internal.streaming_shuffle import (
+                    build_streaming_shuffle)
+
+                idx = topo.add(build_streaming_shuffle(op))
+            else:
+                idx = topo.add(P.AllToAllOperator(op.name, _bulk_fn(op)))
         elif isinstance(op, L.Union):
             idx = topo.add(P.UnionOperator(1 + len(op.others)))
             for branch in op.others:

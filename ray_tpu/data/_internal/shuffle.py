@@ -26,10 +26,11 @@ def _get_many(refs):
 # -------------------------------------------------------------- repartition
 def _slice_concat_task(parts: List[Tuple[Any, int, int]]):
     """parts: (block_ref, start, end) triples → one output block."""
-    blocks = []
-    for ref, start, end in parts:
-        b = ray_tpu.get(ref)
-        blocks.append(BlockAccessor(b).slice(start, end))
+    # one batched get: every source pull starts in the same WaitObjects
+    # window instead of paying a sequential round trip per part
+    blocks_in = ray_tpu.get([ref for ref, _, _ in parts])
+    blocks = [BlockAccessor(b).slice(start, end)
+              for b, (_, start, end) in zip(blocks_in, parts)]
     out = BlockAccessor.concat(blocks)
     return out, BlockAccessor(out).metadata()
 
@@ -57,8 +58,12 @@ def repartition_fn(num_blocks: int) -> Callable:
             out_refs.append(ray_tpu.remote(_slice_concat_task)
                             .options(name="Data::Repartition",
                                      num_returns=2).remote(parts))
-        # payloads stay in the object store; only metadata comes back
-        return [RefBundle(r[0], ray_tpu.get(r[1])) for r in out_refs]
+        # payloads stay in the object store; metadata comes back in ONE
+        # batched get (the per-bundle blocking get serialized the whole
+        # repartition behind its slowest predecessor — ISSUE 12)
+        metas = ray_tpu.get([r[1] for r in out_refs])
+        return [RefBundle(r[0], meta)
+                for r, meta in zip(out_refs, metas)]
 
     return bulk
 
@@ -78,7 +83,8 @@ def _shuffle_map(block: Block, n: int, seed: Optional[int], salt: int):
 
 
 def _shuffle_reduce(map_refs, i: int, seed: Optional[int]):
-    shards = [ray_tpu.get(r)[i] for r in map_refs]
+    # one batched get: all map outputs pull in one WaitObjects window
+    shards = [b[i] for b in ray_tpu.get(list(map_refs))]
     out = BlockAccessor.concat(shards)
     acc = BlockAccessor(out)
     rng = np.random.default_rng(None if seed is None else seed * 7919 + i)
@@ -86,22 +92,37 @@ def _shuffle_reduce(map_refs, i: int, seed: Optional[int]):
     return out, BlockAccessor(out).metadata()
 
 
+def _exchange_remote_args():
+    """The shuffle map/reduce pinning knobs apply to BOTH exchange
+    implementations so streaming-vs-materializing comparisons (and the
+    data_shuffle bench) measure the exchange, not task placement."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    return (dict(ctx.shuffle_map_remote_args or {}),
+            dict(ctx.shuffle_reduce_remote_args or {}))
+
+
 def random_shuffle_fn(seed: Optional[int] = None,
                       num_blocks: Optional[int] = None) -> Callable:
     def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
         if not bundles:
             return []
+        map_args, red_args = _exchange_remote_args()
         n = num_blocks or len(bundles)
         map_refs = [
-            ray_tpu.remote(_shuffle_map).options(name="Data::ShuffleMap")
+            ray_tpu.remote(_shuffle_map).options(
+                name="Data::ShuffleMap", **map_args)
             .remote(b.block_ref, n, seed, salt)
             for salt, b in enumerate(bundles)]
         red_refs = [
             ray_tpu.remote(_shuffle_reduce).options(
-                name="Data::ShuffleReduce", num_returns=2)
+                name="Data::ShuffleReduce", num_returns=2, **red_args)
             .remote(map_refs, i, seed)
             for i in range(n)]
-        return [RefBundle(r[0], ray_tpu.get(r[1])) for r in red_refs]
+        metas = ray_tpu.get([r[1] for r in red_refs])
+        return [RefBundle(r[0], meta)
+                for r, meta in zip(red_refs, metas)]
 
     return bulk
 
@@ -127,7 +148,7 @@ def _sort_map(block: Block, key, boundaries):
 
 
 def _sort_reduce(map_refs, i: int, key, descending: bool):
-    shards = [ray_tpu.get(r)[i] for r in map_refs]
+    shards = [b[i] for b in ray_tpu.get(list(map_refs))]
     out = BlockAccessor.concat(shards)
     acc = BlockAccessor(out)
     if acc.num_rows():
@@ -154,8 +175,8 @@ def sort_fn(key: Union[str, List[str]], descending: bool = False) -> Callable:
                     .options(name="Data::SortReduce", num_returns=2)
                     .remote(map_refs, i, key, descending) for i in range(n)]
         order = range(n - 1, -1, -1) if descending else range(n)
-        return [RefBundle(red_refs[i][0], ray_tpu.get(red_refs[i][1]))
-                for i in order]
+        metas = ray_tpu.get([r[1] for r in red_refs])
+        return [RefBundle(red_refs[i][0], metas[i]) for i in order]
 
     return bulk
 
@@ -181,7 +202,7 @@ def _agg_reduce(map_refs, i: int, key: str, agg_blobs: bytes):
     import cloudpickle
 
     aggs = cloudpickle.loads(agg_blobs)
-    shards = [ray_tpu.get(r)[i] for r in map_refs]
+    shards = [b[i] for b in ray_tpu.get(list(map_refs))]
     merged = BlockAccessor.concat(shards)
     acc = BlockAccessor(merged)
     nd = acc.to_numpy_dict()
@@ -219,12 +240,9 @@ def groupby_agg_fn(key: str, aggs: List[Any],
         red_refs = [ray_tpu.remote(_agg_reduce)
                     .options(name="Data::GroupByReduce", num_returns=2)
                     .remote(map_refs, i, key, blobs) for i in range(n)]
-        out = []
-        for r in red_refs:
-            meta = ray_tpu.get(r[1])
-            if meta.num_rows:
-                out.append(RefBundle(r[0], meta))
-        return out
+        metas = ray_tpu.get([r[1] for r in red_refs])
+        return [RefBundle(r[0], meta)
+                for r, meta in zip(red_refs, metas) if meta.num_rows]
 
     return bulk
 

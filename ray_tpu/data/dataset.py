@@ -203,7 +203,7 @@ class Dataset:
             pass
 
     # ----------------------------------------------------------- execution
-    def _execute_bundles(self) -> Iterator[RefBundle]:
+    def _execute_bundles(self, publish: bool = True) -> Iterator[RefBundle]:
         stats = ExecutorStats()
         topo = plan(optimize(self._last_op.chain()),
                     max_concurrency=self._max_concurrency)
@@ -213,7 +213,11 @@ class Dataset:
             yield from executor.iter_bundles()
         finally:
             executor.shutdown()
-            self._publish_stats(stats)
+            # publish=False: a windowed consumer (_iter_blocks) keeps
+            # pulling blocks AFTER this generator exhausts; it publishes
+            # itself once the stall/consume counters are final
+            if publish:
+                self._publish_stats(stats)
 
     def _publish_stats(self, stats: ExecutorStats) -> None:
         """Best-effort: per-operator stats land in the head KV so the
@@ -242,8 +246,106 @@ class Dataset:
         return self._execute_bundles()
 
     def _iter_blocks(self) -> Iterator[Block]:
-        for bundle in self._execute_bundles():
-            yield ray_tpu.get(bundle.block_ref)
+        """Consumer-edge block stream with pull prefetch (ISSUE 12).
+
+        The old loop blocked on each block's pull in turn — on a
+        multi-node pipeline every cross-node block cost a full pull
+        latency on the consumer's critical path. Here the next
+        ``iter_prefetch_blocks`` bundles' pulls are INITIATED (one
+        batched, non-blocking WaitObjects frame) while the current block
+        is being consumed, so ``iter_jax_batches`` overlaps network with
+        host→device transfer. Stall time that still leaks through is
+        reported in ``ExecutorStats.consumer_stall_s``.
+        """
+        import queue as _queue
+        import threading as _threading
+        import time as _time
+
+        from ray_tpu.data.context import DataContext
+
+        depth = max(0, DataContext.get_current().iter_prefetch_blocks)
+        it = self._execute_bundles(publish=False)
+        # Feeder thread: drains bundles AS THE EXECUTOR PRODUCES THEM,
+        # initiating each block's pull immediately (off the consumer's
+        # critical path, one frame per bundle), and parks them in a
+        # bounded window. The consumer below blocks only when NOTHING
+        # is available — never on filling the window ahead (a
+        # window-first loop would delay every yield behind producer
+        # progress, the opposite of overlap).
+        q: "_queue.Queue" = _queue.Queue(maxsize=depth + 1)
+        DONE = object()
+        err: list = []
+        stop = _threading.Event()
+
+        def initiate(bundle):
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                w = worker_mod.global_worker
+                if w is not None and w.connected:
+                    w._prefetch_plasma([bundle.block_ref], min_need=1)
+            except Exception:
+                pass  # prefetch is advisory; get() below is the contract
+
+        def feeder():
+            try:
+                for bundle in it:
+                    initiate(bundle)
+                    while not stop.is_set():
+                        try:
+                            q.put(bundle, timeout=0.1)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        break
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+            finally:
+                if stop.is_set():
+                    # abandoned consumer: close the source HERE (the
+                    # generator is owned by this thread) so the
+                    # executor tears down instead of leaking
+                    try:
+                        it.close()
+                    except Exception:
+                        pass
+                # DONE must reach a still-draining consumer even if the
+                # window is momentarily full (e.g. feeder errored with a
+                # full queue) — a dropped sentinel wedges q.get() forever
+                while True:
+                    try:
+                        q.put(DONE, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        if stop.is_set():
+                            break
+
+        t = _threading.Thread(target=feeder, daemon=True,
+                              name="raytpu-data-ingest")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    if err:
+                        raise err[0]
+                    return
+                # stall = time blocked in the PULL only (the stat's
+                # contract); producer wait shows up as executor wall
+                t0 = _time.perf_counter()
+                block = ray_tpu.get(item.block_ref)
+                stall = _time.perf_counter() - t0
+                stats = self._last_stats
+                if stats is not None:
+                    stats.consumer_stall_s += stall
+                    stats.blocks_consumed += 1
+                yield block
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            if self._last_stats is not None:
+                self._publish_stats(self._last_stats)
 
     def iterator(self) -> DataIterator:
         return DataIterator(self._iter_blocks, stats_fn=self.stats)

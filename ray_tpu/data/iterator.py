@@ -5,6 +5,15 @@ TPU-first addition: ``iter_jax_batches`` ships each batch to device —
 optionally onto a ``NamedSharding`` so a data-parallel mesh gets its
 per-device shards directly — with background prefetch so host→HBM transfer
 overlaps the train step.
+
+Ingest is stall-free end to end (ISSUE 12): the underlying block stream
+(``Dataset._iter_blocks``) initiates the next
+``DataContext.iter_prefetch_blocks`` blocks' pulls one batched
+non-blocking WaitObjects window ahead of consumption, so the network
+transfer of block N+1 overlaps decode/batch/device-put of block N; the
+``_prefetch`` thread below then overlaps host→device transfer with the
+consumer. Residual time blocked on pulls is reported as
+``consumer_stall_s`` in ``ExecutorStats`` (visible via ``stats()``).
 """
 
 from __future__ import annotations

@@ -201,6 +201,7 @@ def cmd_status(args) -> int:
         print(f"  {used:g}/{total[k]:g} {k}")
     _print_head_status()
     _print_data_plane()
+    _print_data_pipelines()
     _print_worker_pool()
     _print_direct_call_plane()
     return 0
@@ -281,6 +282,45 @@ def _print_data_plane() -> None:
                   f"{bs.get('reparents_total', 0)} reparents")
     except Exception:
         pass
+
+
+def _print_data_pipelines() -> None:
+    """Streaming-shuffle / pipeline counters of the most recent Dataset
+    execution (ISSUE 12): drivers publish ExecutorStats to the head KV
+    (``__data_stats__:``), so status works from any process."""
+    try:
+        import json as _json
+
+        from ray_tpu.experimental.internal_kv import (
+            _internal_kv_get, _internal_kv_list)
+
+        keys = sorted(_internal_kv_list(b"__data_stats__:"))
+        if not keys:
+            return
+        st = _json.loads(_internal_kv_get(keys[-1]))
+    except Exception:
+        return
+    print("\nData pipelines (last run)")
+    print("-" * 40)
+    print(f"  wall {st.get('wall_s', 0):.2f}s   "
+          f"scheduler iters {st.get('loop_iters', 0)} "
+          f"({st.get('idle_waits', 0)} idle waits)   "
+          f"consumer stall {st.get('consumer_stall_s', 0):.3f}s over "
+          f"{st.get('blocks_consumed', 0)} blocks")
+    for op in st.get("ops", []):
+        ex = op.get("extra") or {}
+        if "shuffle_maps" not in ex:
+            continue
+        print(f"  shuffle {op.get('name')}: "
+              f"{ex.get('shuffle_maps', 0)} maps -> "
+              f"{ex.get('shuffle_reducers', 0)} reducers, "
+              f"{ex.get('shuffle_shard_bytes', 0)} shard B "
+              f"(peak in-flight {ex.get('shuffle_inflight_peak_bytes', 0)})")
+        print(f"    stall fraction "
+              f"{ex.get('shuffle_stall_fraction', 0):.2f}, "
+              f"overlapped={ex.get('shuffle_reduce_overlapped_maps')}, "
+              f"map re-execs {ex.get('shuffle_map_reexecs', 0)}, "
+              f"reduce retries {ex.get('shuffle_reduce_retries', 0)}")
 
 
 def _print_direct_call_plane() -> None:

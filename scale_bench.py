@@ -1155,6 +1155,212 @@ def bench_serve_load(quick: bool = False) -> dict:
     return out
 
 
+def bench_data_shuffle(quick: bool = False) -> dict:
+    """Streaming multi-node shuffle trajectory (ISSUE 12).
+
+    Layout isolates what is being measured: input blocks are DRIVER-put
+    (head store), maps pinned to the head ("src"), reducers pinned to
+    the 3 consumer nodes ("red") — so agent bytes_fetched deltas count
+    the EXCHANGE's movement, not incidental task placement. ``streaming``
+    (per-shard zero-copy outputs, pipelined reduce) is compared against
+    ``materialize`` (the legacy AllToAll exchange: every reducer pulls
+    every map output) on identical clusters; the O(M+R)-vs-O(M×R)
+    claim is the measured pull_ratio. A chaos variant kills -9 one
+    shard-holding node mid-shuffle and checks byte-identical completion
+    with re-execution counters > 0.
+    """
+    import hashlib
+    import os
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.chaos import DaemonKiller
+
+    M = R = 8
+    rows_per = 512
+    width = 512 if quick else 4096  # 1 MB or 8 MB blocks
+    dataset_bytes = M * rows_per * (width * 4 + 8)
+
+    def make_blocks():
+        rng = np.random.default_rng(2026)
+        return [{"id": np.arange(i * rows_per, (i + 1) * rows_per),
+                 "x": rng.random((rows_per, width)).astype(np.float32)}
+                for i in range(M)]
+
+    def rows_sha(ds):
+        acc = []
+        for batch in ds.iter_batches(batch_size=None, prefetch_batches=0):
+            ids = np.asarray(batch["id"])
+            xs = np.ascontiguousarray(np.asarray(batch["x"]))
+            for i in range(len(ids)):
+                acc.append((int(ids[i]),
+                            hashlib.sha256(xs[i].tobytes()).hexdigest()))
+        acc.sort()
+        return hashlib.sha256(str(acc).encode()).hexdigest()
+
+    def node_pull_stats(i):
+        @ray_tpu.remote(resources={f"red{i}": 0.001})
+        def probe():
+            from ray_tpu._private import worker as wm
+
+            w = wm.global_worker
+            return w._acall(w.agent.call("GetPullStats", {}))
+
+        return ray_tpu.get(probe.remote(), timeout=120)
+
+    def cluster_pull_totals():
+        """Reducer-node pulls = the exchange's own movement (maps are
+        head-local to the driver-put inputs, and the driver's pulls of
+        the OUTPUT blocks ride the head agent, reported separately)."""
+        from ray_tpu._private import worker as wm
+
+        w = wm.global_worker
+        head = w._acall(w.agent.call("GetPullStats", {}))
+        nodes = [node_pull_stats(i) for i in range(3)]
+        return {
+            "bytes_fetched": sum(s["bytes_fetched"] for s in nodes),
+            "head_bytes_fetched": head["bytes_fetched"],
+            "zero_copy_puts": (head["zero_copy_puts"]
+                               + sum(s["zero_copy_puts"] for s in nodes)),
+        }
+
+    out = {"dataset_mb": round(dataset_bytes / 1024 / 1024, 2),
+           "maps": M, "reducers": R}
+    shas = {}
+    for mode in ("streaming", "materialize"):
+        cluster = None
+        try:
+            cluster = Cluster(
+                initialize_head=True,
+                head_node_args={"num_cpus": 4, "resources": {"src": 100}})
+            ray_tpu.init(_node=cluster.head_node)
+            for i in range(3):
+                cluster.add_node(num_cpus=2, resources={f"red{i}": 100,
+                                                        "red": 100})
+            cluster.wait_for_nodes()
+            import ray_tpu.data as rd
+            from ray_tpu.data.context import DataContext
+
+            ctx = DataContext.get_current()
+            ctx.streaming_shuffle = mode == "streaming"
+            ctx.shuffle_map_remote_args = {"resources": {"src": 0.001}}
+            ctx.shuffle_reduce_remote_args = {"resources": {"red": 0.001}}
+            before = cluster_pull_totals()
+            ds = rd.from_blocks(make_blocks()).random_shuffle(
+                seed=11, num_blocks=R)
+            t0 = time.perf_counter()
+            shas[mode] = rows_sha(ds)
+            wall = time.perf_counter() - t0
+            after = cluster_pull_totals()
+            pulled = after["bytes_fetched"] - before["bytes_fetched"]
+            rec = {
+                "wall_s": round(wall, 3),
+                "gb_per_s": round(dataset_bytes / 1024 ** 3 / wall, 4),
+                "bytes_pulled_mb": round(pulled / 1024 / 1024, 2),
+                "pull_ratio": round(pulled / dataset_bytes, 3),
+                "consume_pulled_mb": round(
+                    (after["head_bytes_fetched"]
+                     - before["head_bytes_fetched"]) / 1024 / 1024, 2),
+                "zero_copy_puts": (after["zero_copy_puts"]
+                                   - before["zero_copy_puts"]),
+            }
+            if mode == "streaming":
+                st = ds._last_stats.to_dict()
+                rec["loop_iters"] = st["loop_iters"]
+                rec["consumer_stall_s"] = st["consumer_stall_s"]
+                for op in st["ops"]:
+                    ex = op.get("extra") or {}
+                    if "shuffle_maps" in ex:
+                        rec["stall_fraction"] = ex["shuffle_stall_fraction"]
+                        rec["reduce_overlapped_maps"] = \
+                            ex["shuffle_reduce_overlapped_maps"]
+                        rec["inflight_peak_mb"] = round(
+                            ex["shuffle_inflight_peak_bytes"] / 1024
+                            / 1024, 2)
+            out[mode] = rec
+        finally:
+            ray_tpu.shutdown()
+            if cluster is not None:
+                cluster.shutdown()
+            from ray_tpu._private import lifecycle
+
+            lifecycle.gc_stale_sessions()
+    out["byte_identical"] = shas.get("streaming") == shas.get("materialize")
+    out["criteria"] = {
+        "pull_ratio_lt_1_5": out["streaming"]["pull_ratio"] < 1.5,
+        "materialize_ratio": out["materialize"]["pull_ratio"],
+        "stall_fraction_lt_0_5":
+            out["streaming"].get("stall_fraction", 1.0) < 0.5,
+        "zero_copy_puts_gt_0": out["streaming"]["zero_copy_puts"] > 0,
+    }
+
+    # chaos variant: kill -9 one shard-holding node mid-shuffle
+    cluster = None
+    try:
+        os.environ["RAY_TPU_PULL_DEAD_HOLDER_ROUNDS"] = "3"
+        os.environ["RAY_TPU_OBJECT_PULL_DEADLINE_S"] = "90"
+        cluster = Cluster(
+            initialize_head=True,
+            head_node_args={"num_cpus": 2, "resources": {"safe": 100}})
+        ray_tpu.init(_node=cluster.head_node)
+        nodes = [cluster.add_node(num_cpus=2, resources={"vic": 100})
+                 for _ in range(2)]
+        cluster.wait_for_nodes()
+        import ray_tpu.data as rd
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        ctx.streaming_shuffle = True
+        ctx.shuffle_map_remote_args = {"resources": {"vic": 0.001}}
+        ctx.shuffle_reduce_remote_args = {"resources": {"safe": 0.001}}
+        ds = rd.from_blocks(make_blocks()).random_shuffle(
+            seed=11, num_blocks=R)
+        t0 = time.perf_counter()
+        acc = []
+        killed = False
+        it = ds.iter_batches(batch_size=None, prefetch_batches=0)
+        import hashlib as _h
+        for batch in it:
+            ids = np.asarray(batch["id"])
+            xs = np.ascontiguousarray(np.asarray(batch["x"]))
+            for i in range(len(ids)):
+                acc.append((int(ids[i]),
+                            _h.sha256(xs[i].tobytes()).hexdigest()))
+            if not killed:
+                killed = True
+                killer = DaemonKiller(cluster.session_dir,
+                                      roles=("agent",), max_kills=1)
+                killer.kill_target(
+                    {"role": "agent", "pid": nodes[0].agent_proc.pid})
+        acc.sort()
+        sha = _h.sha256(str(acc).encode()).hexdigest()
+        extras = {}
+        for op in ds._last_stats.to_dict()["ops"]:
+            if "shuffle_maps" in (op.get("extra") or {}):
+                extras = op["extra"]
+        out["chaos"] = {
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "rows": len(acc),
+            "byte_identical": sha == shas.get("streaming"),
+            "map_reexecs": extras.get("shuffle_map_reexecs", 0),
+            "reduce_retries": extras.get("shuffle_reduce_retries", 0),
+        }
+    except Exception as e:  # noqa: BLE001 — chaos flake keeps main phases
+        out["chaos"] = {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        os.environ.pop("RAY_TPU_PULL_DEAD_HOLDER_ROUNDS", None)
+        os.environ.pop("RAY_TPU_OBJECT_PULL_DEADLINE_S", None)
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    return out
+
+
 def main(quick: bool = False) -> dict:
     import ray_tpu
 
@@ -1239,6 +1445,23 @@ def main(quick: bool = False) -> dict:
                              "HEAD_CHAOS_latest.json")
         with open(art, "w") as f:
             json.dump(results["head_chaos"], f, indent=2, sort_keys=True)
+    except Exception:
+        pass
+    # streaming-shuffle phase (ISSUE 12): own clusters per mode, written
+    # standalone so the shuffle trajectory diffs across rounds
+    try:
+        results["data_shuffle"] = bench_data_shuffle(quick)
+    except Exception as e:  # noqa: BLE001
+        results["data_shuffle"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        import os
+
+        if "error" not in results["data_shuffle"]:
+            art = os.environ.get("RAY_TPU_DATASHUFFLE_OUT",
+                                 "DATA_SHUFFLE_latest.json")
+            with open(art, "w") as f:
+                json.dump(results["data_shuffle"], f, indent=2,
+                          sort_keys=True)
     except Exception:
         pass
     # serving-plane phase (own cluster + serve control plane, same

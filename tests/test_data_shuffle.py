@@ -1,0 +1,373 @@
+"""Streaming multi-node shuffle on the device object plane (ISSUE 12).
+
+Unit layer (no cluster): the packed-shard codec round-trips numeric /
+Fortran-order / object columns and its output rides the ZeroCopyArray
+fast path.
+
+Integration: the streaming exchange produces byte-identical results
+(sha256 over sorted rows) vs the legacy materializing path on the same
+multi-node cluster; reduce admission overlaps map execution (no
+map→reduce barrier); admitted-reducer shard bytes never exceed the
+configured budget; the executor drive loop is event-paced (no
+busy-poll); shuffle workers never import jax (MULTICHIP gate).
+
+Chaos: kill -9 of a node holding unique map shards mid-shuffle — the
+job completes byte-identical with map re-execution counters > 0, never
+a hang.
+"""
+
+import dataclasses
+import hashlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.data.context import DataContext
+from ray_tpu.data._internal import shard_codec as sc
+
+MB = 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# packed-shard codec (no cluster)
+# ---------------------------------------------------------------------------
+class TestShardCodec:
+    def test_round_trips_numeric_and_object_columns(self):
+        rng = np.random.default_rng(0)
+        block = {
+            "id": np.arange(64, dtype=np.int64),
+            "x": rng.random((64, 16)).astype(np.float32),
+            "flag": rng.random(64) < 0.5,
+            "tag": np.array([f"row-{i}" for i in range(64)], dtype=object),
+        }
+        packed = sc.encode_shard(block)
+        assert sc.is_packed_shard(packed)
+        out = sc.decode_shard(packed)
+        assert set(out) == set(block)
+        for k in ("id", "x", "flag"):
+            assert out[k].dtype == block[k].dtype
+            assert np.array_equal(out[k], block[k])
+        assert list(out["tag"]) == list(block["tag"])
+
+    def test_fortran_order_and_empty(self):
+        f = {"m": np.asfortranarray(np.arange(24.).reshape(4, 6))}
+        assert np.array_equal(sc.decode_shard(sc.encode_shard(f))["m"],
+                              f["m"])
+        assert sc.decode_shard(sc.encode_shard({})) == {}
+        empty_col = {"id": np.empty(0, np.int64)}
+        out = sc.decode_shard(sc.encode_shard(empty_col))
+        assert out["id"].shape == (0,)
+
+    def test_packed_shard_rides_zero_copy_path(self):
+        from ray_tpu._private import serialization as ser
+
+        packed = sc.encode_shard(
+            {"x": np.random.default_rng(1).random((100, 32))})
+        zc = ser.try_serialize_array(packed)
+        assert zc is not None, \
+            "packed shard must be a bare contiguous array (ZC eligible)"
+        wire = memoryview(zc.to_bytes())
+        assert ser.is_zero_copy(wire)
+        # decode from the zero-copy (read-only) view, like a reducer does
+        view = ser.SerializationContext().deserialize(wire)
+        assert not view.flags.writeable
+        out = sc.decode_shard(view)
+        assert out["x"].shape == (100, 32)
+
+    def test_arrow_block_input(self):
+        pa = pytest.importorskip("pyarrow")
+        t = pa.table({"id": list(range(10)), "v": [float(i) for i in range(10)]})
+        out = sc.decode_shard(sc.encode_shard(t))
+        assert list(out["id"]) == list(range(10))
+
+    def test_decode_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            sc.decode_shard(np.zeros(128, np.uint8))
+
+
+def test_shuffle_modules_import_no_jax():
+    """MULTICHIP gate: the shuffle/executor import graph must not pull
+    jax into workers (same contract as the warm pool)."""
+    code = (
+        "import ray_tpu.data._internal.streaming_shuffle, "
+        "ray_tpu.data._internal.shard_codec, "
+        "ray_tpu.data._internal.executor, "
+        "ray_tpu.data._internal.shuffle; "
+        "import sys; assert 'jax' not in sys.modules, 'jax imported'"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# cluster fixtures
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def ctx():
+    """Fresh DataContext per test; restore the original afterwards."""
+    old = DataContext.get_current()
+    fresh = dataclasses.replace(old)
+    DataContext._set_current(fresh)
+    yield fresh
+    DataContext._set_current(old)
+
+
+@pytest.fixture
+def shuffle_cluster(monkeypatch):
+    """Factory: boot a head + N worker nodes localhost cluster."""
+    made = []
+
+    def boot(n_nodes=2, head_cpus=2, node_cpus=2, env=None,
+             node_resources=None, head_resources=None):
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        head_args = {"num_cpus": head_cpus}
+        if head_resources:
+            head_args["resources"] = head_resources
+        cluster = Cluster(initialize_head=True, head_node_args=head_args)
+        made.append(cluster)
+        ray_tpu.init(_node=cluster.head_node)
+        nodes = []
+        for i in range(n_nodes):
+            res = (node_resources[i] if node_resources else None)
+            nodes.append(cluster.add_node(num_cpus=node_cpus,
+                                          resources=res))
+        cluster.wait_for_nodes()
+        return cluster, nodes
+
+    yield boot
+    try:
+        ray_tpu.shutdown()
+    finally:
+        for cluster in made:
+            cluster.shutdown()
+
+
+def _payload_ds(rows=4096, parallelism=8, width=128):
+    def payload(batch):
+        n = len(batch["id"])
+        rng = np.random.default_rng(int(batch["id"][0]) if n else 0)
+        batch["x"] = rng.random((n, width)).astype(np.float32)
+        return batch
+
+    return rd.range(rows, parallelism=parallelism).map_batches(payload)
+
+
+def _rows_sha(ds) -> str:
+    """sha256 over sorted rows (id + payload checksum per row)."""
+    acc = []
+    for batch in ds.iter_batches(batch_size=None, prefetch_batches=0):
+        ids = np.asarray(batch["id"])
+        xs = np.ascontiguousarray(np.asarray(batch["x"]))
+        for i in range(len(ids)):
+            acc.append((int(ids[i]), hashlib.sha256(
+                xs[i].tobytes()).hexdigest()))
+    acc.sort()
+    return hashlib.sha256(str(acc).encode()).hexdigest()
+
+
+def _shuffle_extras(ds):
+    for op in ds._last_stats.to_dict()["ops"]:
+        if "shuffle_maps" in (op.get("extra") or {}):
+            return op["extra"]
+    raise AssertionError(
+        f"no shuffle extras in stats: {ds._last_stats.to_dict()}")
+
+
+# ---------------------------------------------------------------------------
+# integration
+# ---------------------------------------------------------------------------
+def test_streaming_matches_legacy_byte_identical(shuffle_cluster, ctx):
+    """Multi-node streaming shuffle == the single-path materializing
+    exchange, row for row (sha256 over sorted rows)."""
+    shuffle_cluster(n_nodes=2)
+    ctx.streaming_shuffle = True
+    ds1 = _payload_ds().random_shuffle(seed=7, num_blocks=8)
+    sha_streaming = _rows_sha(ds1)
+    extras = _shuffle_extras(ds1)
+    assert extras["shuffle_maps"] == 8
+    assert extras["shuffle_reducers"] == 8
+    assert extras["shuffle_map_reexecs"] == 0
+
+    ctx.streaming_shuffle = False
+    ds2 = _payload_ds().random_shuffle(seed=7, num_blocks=8)
+    sha_legacy = _rows_sha(ds2)
+    assert sha_streaming == sha_legacy, \
+        "streaming shuffle lost/duplicated/corrupted rows"
+
+
+def test_reduce_overlaps_maps(shuffle_cluster, ctx):
+    """No map→reduce barrier: the first reducer is admitted before the
+    last map finishes, and the pipeline-stall fraction stays low."""
+    shuffle_cluster(n_nodes=2)
+    ctx.streaming_shuffle = True
+    ds = _payload_ds(rows=8192, width=256).random_shuffle(
+        seed=3, num_blocks=8)
+    assert ds.count() == 8192
+    extras = _shuffle_extras(ds)
+    assert extras["shuffle_reduce_overlapped_maps"], extras
+    assert extras["shuffle_stall_fraction"] < 0.9, extras
+
+
+def test_sort_streaming_multi_node(shuffle_cluster, ctx):
+    shuffle_cluster(n_nodes=2)
+    ctx.streaming_shuffle = True
+    ds = _payload_ds(rows=2000, parallelism=5, width=8)
+    ids = [r["id"] for r in ds.sort("id").take_all()]
+    assert ids == sorted(ids) and len(ids) == 2000
+    desc = [r["id"] for r in ds.sort("id", descending=True).take(5)]
+    assert desc == [1999, 1998, 1997, 1996, 1995]
+
+
+def test_inflight_shard_bytes_bounded(shuffle_cluster, ctx):
+    """Admitted-reducer input bytes never exceed the configured budget
+    (a slow reducer backpressures admission, not memory)."""
+    shuffle_cluster(n_nodes=2)
+    ctx.streaming_shuffle = True
+    ds0 = _payload_ds().random_shuffle(seed=5, num_blocks=8)
+    assert ds0.count() == 4096
+    total = _shuffle_extras(ds0)["shuffle_shard_bytes"]
+    assert total > 0
+    # budget: ~2 of 8 reducers' input bytes
+    budget = max(1, total // 4)
+    ctx.shuffle_max_inflight_shard_bytes = budget
+    ds = _payload_ds().random_shuffle(seed=5, num_blocks=8)
+    assert ds.count() == 4096
+    extras = _shuffle_extras(ds)
+    assert 0 < extras["shuffle_inflight_peak_bytes"] <= budget, extras
+
+
+def test_executor_event_paced_and_prefetch_stats(ctx):
+    """The drive loop parks on completions instead of busy-polling
+    (~300 iters/s before): iterations stay O(task completions), and the
+    consumer-side prefetch window reports its stall time."""
+    ray_tpu.init(num_cpus=4)
+    try:
+        def slow(batch):
+            time.sleep(0.25)
+            return batch
+
+        ds = rd.range(64, parallelism=8).map_batches(slow)
+        rows = sum(1 for _ in ds.iter_rows())
+        assert rows == 64
+        st = ds._last_stats.to_dict()
+        wall = st["wall_s"]
+        assert wall > 0.4  # the sleeps actually serialized some work
+        busy_poll_iters = wall / 0.003
+        assert st["loop_iters"] < max(150, busy_poll_iters * 0.25), st
+        assert st["idle_waits"] > 0, "loop never parked"
+        assert st["blocks_consumed"] == 8
+        assert st["consumer_stall_s"] >= 0.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_shuffle_task_bodies_never_import_jax(ctx):
+    """Probe-asserted MULTICHIP contract: executing the map AND reduce
+    bodies in a worker leaves jax unimported."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def probe():
+            import sys
+
+            import numpy as _np
+
+            import ray_tpu as rt
+            from ray_tpu.data._internal.streaming_shuffle import (
+                _shuffle_map_shards, _shuffle_reduce_shards)
+
+            block = {"id": _np.arange(200),
+                     "x": _np.random.default_rng(0).random((200, 8))}
+            outs = _shuffle_map_shards(block, 4, seed=5, salt=0)
+            refs = [rt.put(s) for s in outs[:-1]]
+            blk, meta = _shuffle_reduce_shards([refs[0]], 0, seed=5)
+            assert meta.num_rows == outs[-1][0][0]
+            return "jax" in sys.modules
+
+        assert ray_tpu.get(probe.remote(), timeout=120) is False
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: node death mid-shuffle
+# ---------------------------------------------------------------------------
+def test_node_death_mid_shuffle_recovers(shuffle_cluster, ctx):
+    """kill -9 the agent of a node holding unique map shards while the
+    reduce plane is mid-flight: the shuffle re-executes exactly the dead
+    node's maps (same object ids via lineage) and completes
+    byte-identical — no hang, re-execution counters > 0."""
+    from ray_tpu.util.chaos import DaemonKiller
+
+    cluster, nodes = shuffle_cluster(
+        n_nodes=2, node_cpus=2,
+        env={
+            "RAY_TPU_PULL_DEAD_HOLDER_ROUNDS": "3",
+            "RAY_TPU_OBJECT_PULL_DEADLINE_S": "90",
+        },
+        node_resources=[{"vic": 100}, {"vic": 100}],
+        head_resources={"safe": 100})
+    ctx.streaming_shuffle = True
+    # maps pinned to the two "vic" nodes so every shard lives off-head;
+    # reducers pinned to the head so REDUCE outputs survive the kill
+    # (losing reduce outputs is driver-lineage territory — this test
+    # exercises the operator-local slice: lost MAP shards); input blocks
+    # are driver-owned (head store) and survive too
+    ctx.shuffle_map_remote_args = {"resources": {"vic": 0.001}}
+    ctx.shuffle_reduce_remote_args = {"resources": {"safe": 0.001}}
+
+    rng = np.random.default_rng(42)
+    # 2 KB rows -> ~130 KB shards: ABOVE the inline threshold, so every
+    # shard is a plasma object on a vic node (losable by the kill)
+    blocks = [{"id": np.arange(i * 512, (i + 1) * 512),
+               "x": rng.random((512, 512)).astype(np.float32)}
+              for i in range(8)]
+    expected = []
+    for b in blocks:
+        for i in range(512):
+            expected.append((int(b["id"][i]), hashlib.sha256(
+                np.ascontiguousarray(b["x"][i]).tobytes()).hexdigest()))
+    expected.sort()
+    expected_sha = hashlib.sha256(str(expected).encode()).hexdigest()
+
+    ds = rd.from_blocks(blocks).random_shuffle(seed=11, num_blocks=8)
+
+    acc = []
+    killed = False
+    deadline = time.monotonic() + 240
+    it = ds.iter_batches(batch_size=None, prefetch_batches=0)
+    while True:
+        assert time.monotonic() < deadline, "shuffle hung after the kill"
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        ids = np.asarray(batch["id"])
+        xs = np.ascontiguousarray(np.asarray(batch["x"]))
+        for i in range(len(ids)):
+            acc.append((int(ids[i]), hashlib.sha256(
+                xs[i].tobytes()).hexdigest()))
+        if not killed:
+            # first reduce output consumed -> the exchange is mid-flight;
+            # SIGKILL one shard-holding node's agent now
+            killed = True
+            killer = DaemonKiller(cluster.session_dir, roles=("agent",),
+                                  max_kills=1)
+            record = killer.kill_target(
+                {"role": "agent", "pid": nodes[0].agent_proc.pid})
+            assert record is not None, "victim agent was not killed"
+
+    assert killed
+    acc.sort()
+    got_sha = hashlib.sha256(str(acc).encode()).hexdigest()
+    assert len(acc) == 8 * 512, f"lost rows: {len(acc)}"
+    assert got_sha == expected_sha, "recovery corrupted or duplicated rows"
+    extras = _shuffle_extras(ds)
+    assert extras["shuffle_map_reexecs"] >= 1, extras
+    assert extras["shuffle_reduce_retries"] >= 1, extras
