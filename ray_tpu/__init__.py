@@ -16,7 +16,6 @@ import atexit
 import inspect
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu import exceptions
@@ -124,7 +123,9 @@ def shutdown() -> None:
     w = _worker_mod.global_worker
     if w is not None:
         try:
-            w.flush_task_events()
+            # acked flush: events/spans recorded right before shutdown
+            # survive into the head ring instead of racing the disconnect
+            w.flush_task_events(wait=True)
         except Exception:
             pass
         w.disconnect()
@@ -257,40 +258,29 @@ def available_resources() -> Dict[str, float]:
 
 
 def timeline(filename: Optional[str] = None) -> List[Dict]:
-    """Chrome-trace task timeline (reference: python/ray/_private/state.py:924
-    ``ray.timeline`` — load the result into chrome://tracing / Perfetto).
+    """Chrome-trace / Perfetto timeline (reference:
+    python/ray/_private/state.py:924 ``ray.timeline``).
 
-    Emits complete ("X") events spanning PENDING→FINISHED/FAILED per task
-    attempt, plus instant events for states without a closing edge.
+    Built from the cluster flight recorder (ISSUE 14): nested per-phase
+    ``X`` slices — submit → lease-wait → exec (arg-resolve / return-put),
+    put/pull/broadcast object slices, actor-call enqueue→exec — grouped
+    into one lane per trace with ``M`` process metadata, plus instant
+    markers for legacy task state transitions. Spans exist only when
+    ``task_event_sample_rate`` > 0; the state-transition instants are
+    always present.
+
+    The flush is ACKED through the head before reading (read-your-writes
+    — the old ``time.sleep(0.05)`` race is gone).
     """
+    from ray_tpu._private.events import to_chrome_trace
+
     w = _require_worker()
-    w.flush_task_events()
-    time.sleep(0.05)
+    w.flush_task_events(wait=True)
     events = w._acall(w.head.call("ListTaskEvents", {"limit": 100000},
-                              timeout=CONFIG.control_rpc_timeout_s))
-    open_start: Dict[str, Dict] = {}
-    out: List[Dict] = []
-    for e in sorted(events, key=lambda e: e.get("time", 0)):
-        tid = e.get("task_id")
-        state = e.get("state")
-        if state in ("PENDING", "RETRYING"):
-            open_start[tid] = e
-        elif state in ("FINISHED", "FAILED") and tid in open_start:
-            s = open_start.pop(tid)
-            out.append({
-                "cat": "task", "name": e.get("name"), "ph": "X",
-                "ts": s["time"] * 1e6,
-                "dur": max(e["time"] - s["time"], 0) * 1e6,
-                "pid": e.get("node_id", "")[:8], "tid": tid[:8],
-                "args": {"state": state, "task_id": tid},
-            })
-        else:
-            out.append({
-                "cat": "task", "name": e.get("name"), "ph": "i",
-                "ts": e.get("time", 0) * 1e6,
-                "pid": e.get("node_id", "")[:8], "tid": (tid or "")[:8],
-                "args": e,
-            })
+                                  timeout=CONFIG.control_rpc_timeout_s))
+    spans = w._acall(w.head.call("ListSpans", {"limit": 100000},
+                                 timeout=CONFIG.control_rpc_timeout_s))
+    out = to_chrome_trace(spans, events)
     if filename:
         import json
 

@@ -24,6 +24,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import lifecycle
 from ray_tpu._private.async_util import (
     DecorrelatedJitterBackoff, spawn_tracked)
@@ -266,6 +267,9 @@ class NodeAgent:
         report_event("INFO", "NODE_STARTED",
                      f"node agent {self.node_id[:12]} starting",
                      node_id=self.node_id)
+        # flight recorder (ISSUE 14): pull / broadcast / spill / actor-start
+        # spans ride the same crash-durable ring workers use
+        _events.configure(self.session_dir, "agent")
         await self.server.start_unix(self.unix_path)
         self.tcp_port = await self.server.start_tcp("0.0.0.0", 0)
         self.server.set_disconnect_handler(self._on_disconnect)
@@ -274,6 +278,8 @@ class NodeAgent:
         spawn_tracked(self._worker_reaper_loop(), "agent-worker-reaper")
         spawn_tracked(self._node_stats_loop(), "agent-node-stats")
         spawn_tracked(self._head_watchdog_loop(), "agent-head-watchdog")
+        if _events.REC.enabled:
+            spawn_tracked(self._events_flush_loop(), "agent-events-flush")
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             from ray_tpu._private.log_monitor import LogMonitor
 
@@ -322,6 +328,26 @@ class NodeAgent:
         if CONFIG.prestart_workers:
             spawn_tracked(self._prestart(), "agent-prestart")
             spawn_tracked(self._warm_pool_loop(), "agent-warm-pool")
+
+    async def _events_flush_loop(self) -> None:
+        """Batch-flush this agent's flight-recorder ring to the head
+        (extending the ReportTaskEvents path the way driver/worker
+        processes do). The ring itself stays the crash-durable copy."""
+        rec = _events.REC
+        while not self._closing:
+            await asyncio.sleep(max(0.5, CONFIG.task_event_flush_interval_s))
+            if rec.counter == rec.flushed:
+                continue
+            spans = rec.drain()
+            try:
+                await self.head.call(
+                    "ReportTaskEvents",
+                    {"node_id": self.node_id, "spans": spans,
+                     "role": "agent", "pid": os.getpid(),
+                     "ring": rec.stats()},
+                    timeout=CONFIG.control_rpc_timeout_s)
+            except Exception:
+                pass  # head mid-bounce: spans stay readable in the ring
 
     async def aclose_clients(self) -> None:
         """Await every outbound client's read loop (head + the per-peer
@@ -718,6 +744,7 @@ class NodeAgent:
                     self._fenced_suicide()
                 except Exception:
                     if time.monotonic() - down_since > give_up_s:
+                        _events.REC.dump_local("head_gone_exit")
                         self.teardown_processes()
                         os._exit(1)
                     await asyncio.sleep(backoff.next_delay())
@@ -1181,6 +1208,8 @@ class NodeAgent:
             "store_dir": self.store_dir,
             # folded-in GetNodeInfo: one fewer boot round trip per worker
             "tcp_port": self.tcp_port,
+            # flight-recorder ring files live under <session>/events/
+            "session_dir": self.session_dir,
             "cluster_config": CONFIG.snapshot(),
         }
 
@@ -1676,6 +1705,10 @@ class NodeAgent:
 
     # ---------------------------------------------------------------- actors
     async def _start_actor(self, p: Dict) -> None:
+        rec_ev = _events.REC
+        ev_trace = rec_ev.new_trace() if rec_ev.enabled and rec_ev.sample() \
+            else None
+        ev_t0 = time.time() if ev_trace is not None else 0.0
         spec = p["spec"]
         request = ResourceSet.from_wire(spec.get("resources", {}))
         pg = spec.get("pg")
@@ -1720,6 +1753,7 @@ class NodeAgent:
         # pays only class unpickle + __init__. Cold fork is the fallback,
         # never a failure mode.
         handle = self._lease_warm_worker()
+        ev_source = "warm_hit"
         if handle is not None:
             self._pool_hits += 1
         else:
@@ -1731,9 +1765,17 @@ class NodeAgent:
             if handle is not None:
                 self._demand_hits += 1
                 self._last_warm_lease = time.monotonic()
+                ev_source = "demand_hit"
             else:
                 self._pool_misses += 1
                 handle = self._spawn_worker()
+                ev_source = "fork"
+        if ev_trace is not None:
+            # resource wait + pool decision, tagged with how the start was
+            # served — the per-hop answer to "warm hit or cold fork?"
+            rec_ev.record("actor_start::" + ev_source, "actor", ev_t0,
+                          time.time() - ev_t0, ev_trace[0], ev_trace[1], 0,
+                          {"actor": str(p.get("actor_id", ""))[:16]})
         handle.is_actor = True
         handle.actor_id = p["actor_id"]
         handle.assigned_resources = None  # released via actor-death path below
@@ -1877,6 +1919,7 @@ class NodeAgent:
         owners: Dict[str, Dict] = p.get("owners", {})
         num_returns = p.get("num_returns", len(ids))
         timeout_ms = p.get("timeout_ms")
+        tc = p.get("tc")  # caller's trace context (sampled get)
         futs = {}
         for hex_id in ids:
             if self.store.contains(hex_id):
@@ -1889,7 +1932,7 @@ class NodeAgent:
             owner = owners.get(hex_id)
             if owner and hex_id not in self._pulls_inflight:
                 self._pulls_inflight[hex_id] = asyncio.get_running_loop().create_task(
-                    self._pull_object(hex_id, owner)
+                    self._pull_object(hex_id, owner, tc=tc)
                 )
 
         def ready_count() -> int:
@@ -1984,7 +2027,34 @@ class NodeAgent:
 
         spawn_tracked(reap(), "agent-orphan-pull-reap")
 
-    async def _pull_object(self, hex_id: str, owner: Dict) -> None:
+    async def _pull_object(self, hex_id: str, owner: Dict,
+                           tc=None) -> None:
+        """Flight-recorder shell around the pull: one ``pull`` span per
+        admission, stitched under the caller's get() trace when the
+        WaitObjects frame carried one, else its own sampled root."""
+        rec = _events.REC
+        if rec.enabled and (tc is not None or rec.sample()):
+            if tc is None:
+                trace, parent = rec.new_trace()[0], 0
+            else:
+                trace, parent = tc[0], tc[1]
+            span = rec.next_id()
+            t0 = time.time()
+            rec.open_marker("pull", "object", trace, span, parent,
+                            {"obj": hex_id[:16]})
+            try:
+                await self._pull_object_inner(hex_id, owner,
+                                              tc=(trace, span))
+            finally:
+                rec.record("pull", "object", t0, time.time() - t0,
+                           trace, span, parent,
+                           {"obj": hex_id[:16],
+                            "sealed": bool(self.store.contains(hex_id))})
+        else:
+            await self._pull_object_inner(hex_id, owner)
+
+    async def _pull_object_inner(self, hex_id: str, owner: Dict,
+                                 tc=None) -> None:
         """Owner-directed pull (reference: pull_manager.h + ownership-based
         object directory): ask the owner where the object lives, then hand
         the holder set to the pull manager — windowed pipeline, multi-
@@ -2039,7 +2109,8 @@ class NodeAgent:
                     remote_locs = self.store.remote_sources_for(hex_id)
                 st = "absent"
                 if remote_locs:
-                    st = await self._fetch_routed(hex_id, remote_locs)
+                    st = await self._fetch_routed(hex_id, remote_locs,
+                                                  tc=tc)
                 if st == "ok":
                     self._notify_sealed(hex_id)
                     # Tell the owner we now hold a copy.
@@ -2086,7 +2157,8 @@ class NodeAgent:
             if not fut.done():
                 fut.set_result(True)
 
-    async def _fetch_routed(self, hex_id: str, holders: List[Dict]) -> str:
+    async def _fetch_routed(self, hex_id: str, holders: List[Dict],
+                            tc=None) -> str:
         """Route one pull: the spanning broadcast tree for large objects
         (K consumers of the same object share O(log N) distribution via
         chunk-level relay) with transparent degradation to the plain
@@ -2094,22 +2166,42 @@ class NodeAgent:
         never a new failure mode."""
         from ray_tpu._private import broadcast
 
+        rec = _events.REC
+
+        async def spanned(name, coro, n_holders):
+            if tc is None or not rec.enabled:
+                return await coro
+            t0 = time.time()
+            st = await coro
+            rec.record(name, "object", t0, time.time() - t0, tc[0],
+                       rec.next_id(), tc[1],
+                       {"obj": hex_id[:16], "st": st,
+                        "holders": n_holders})
+            return st
+
         size, alive, any_absent = await self.pulls._probe_meta(
             hex_id, holders)
         if size is None:
             return "absent" if any_absent else "conn"
         meta = (size, alive, any_absent)
         if not (CONFIG.bcast_enabled and size >= CONFIG.bcast_min_bytes):
-            return await self.pulls.fetch(hex_id, alive, meta=meta)
+            return await spanned(
+                "stripe_pull", self.pulls.fetch(hex_id, alive, meta=meta),
+                len(alive))
         progress = self.pulls.register_progress(hex_id, size)
         try:
-            st = await broadcast.bcast_fetch(self, hex_id, size, alive,
-                                             progress)
+            st = await spanned(
+                "bcast_pull",
+                broadcast.bcast_fetch(self, hex_id, size, alive, progress),
+                len(alive))
             if st == "fallback":
                 # keep the SAME progress registered: children this node
                 # was assigned relay off the striped pull just the same
-                st = await self.pulls.fetch(hex_id, alive, meta=meta,
-                                            progress=progress)
+                st = await spanned(
+                    "stripe_pull",
+                    self.pulls.fetch(hex_id, alive, meta=meta,
+                                     progress=progress),
+                    len(alive))
             return st
         finally:
             self.pulls.unregister_progress(hex_id, progress)
@@ -2237,7 +2329,16 @@ class NodeAgent:
         self.store.unpin(p["object_id"])
 
     async def _restore_spilled(self, conn: Connection, p: Dict) -> bool:
-        ok = self.store.restore(p["object_id"])
+        rec = _events.REC
+        if rec.enabled and rec.sample():
+            t0 = time.time()
+            ok = self.store.restore(p["object_id"])
+            trace, span = rec.new_trace()
+            rec.record("spill_restore", "object", t0, time.time() - t0,
+                       trace, span, 0,
+                       {"obj": str(p["object_id"])[:16], "ok": bool(ok)})
+        else:
+            ok = self.store.restore(p["object_id"])
         if ok:
             self._restored_count = getattr(self, "_restored_count", 0) + 1
         return ok
@@ -2552,10 +2653,15 @@ class NodeAgent:
         with NodeManager::QueryAllWorkerStates, node_manager.h:217)."""
         out = []
         for w in self.workers.values():
+            if w.proc is None:
+                # still parked in the spawn admission queue: there is no
+                # process (and no pid) to report yet — listing it raced
+                # observers that treat every row as a live worker process
+                continue
             out.append({
                 "worker_id": w.worker_id,
                 "node_id": self.node_id,
-                "pid": w.proc.pid if w.proc else None,
+                "pid": w.proc.pid,
                 "state": ("ACTOR" if w.is_actor
                           else "LEASED" if w.leased_to else "IDLE"),
                 "actor_id": w.actor_id,
@@ -2667,6 +2773,9 @@ def main() -> None:
         except (NotImplementedError, RuntimeError):
             pass
         await stop.wait()
+        from ray_tpu._private import events as _ev
+
+        _ev.REC.dump_local("sigterm")
         # close RPC clients cleanly (cancel + await read loops) BEFORE the
         # loop dies: a close() here would strand cancelled tasks and spray
         # "Task was destroyed but it is pending!" into the agent log the

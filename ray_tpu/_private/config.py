@@ -274,7 +274,31 @@ _flag("collective_inline_max_bytes", 65536)
 _flag("pubsub_poll_timeout_s", 30)
 _flag("kv_namespace_default", "default")
 _flag("metrics_report_interval_ms", 5_000)
+# Prometheus scrape endpoint on the head (ISSUE 14): a minimal asyncio
+# HTTP server answering GET /metrics with the merged cluster exposition
+# text. 0 = disabled; the bound port is written to <session>/metrics_port
+# so `ray_tpu metrics --scrape` and tests can find it.
+_flag("metrics_export_port", 0)
 _flag("task_event_buffer_max", 100_000)
+
+# --- cluster flight recorder (ISSUE 14) --------------------------------------
+# Fraction of trace ROOTS (task submits, puts, gets, pulls, engine
+# steps) that record span trees; children inherit the parent's verdict
+# via the trace context on the task-spec wire. 0 (default) disarms the
+# recorder entirely — every instrumentation site is then one attribute
+# load + branch (events.overhead_probe / the ray_perf A/B verify the
+# ~zero cost). Set to 1.0 when debugging where time goes per hop.
+_flag("task_event_sample_rate", 0.0)
+# Per-process ring geometry: fixed-size mmap'd slots under
+# <session>/events/<role>-<pid>.ring. The file IS the flight recorder —
+# a kill -9'd process's spans are recovered from it with no exit handler.
+_flag("task_event_ring_slots", 4096)
+_flag("task_event_ring_slot_bytes", 256)
+# Head-side span ring (deque maxlen) fed by ReportTaskEvents flushes.
+_flag("task_event_span_buffer_max", 200_000)
+# Executor workers flush spans to the head at most this often (drivers
+# flush on the watchdog tick + synchronously from timeline()).
+_flag("task_event_flush_interval_s", 1.0)
 _flag("task_event_flush_batch", 5000)  # size backstop between periodic
 # flushes (the watchdog's periodic flush is the normal path — reference
 # flushes on a 1s timer, task_events_report_interval_ms; a small size

@@ -32,11 +32,13 @@ reason ``lost_during_head_outage``: no ghost actors, no zombie nodes.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import logging
 import os
 import time
 import bisect
+from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import CONFIG
@@ -241,7 +243,19 @@ class HeadServer:
         from ray_tpu._private.broadcast import BcastTreeRegistry
 
         self.bcast = BcastTreeRegistry()
-        self.task_events: List[Dict] = []  # ring buffer of task state transitions
+        # task state-transition ring: deque(maxlen) makes overflow an O(1)
+        # popleft per append instead of the old O(n) list copy on EVERY
+        # overflowing flush (the buffered-count gauge reads len() as before)
+        self.task_events: deque = deque(
+            maxlen=max(1, int(CONFIG.task_event_buffer_max)))
+        # flight-recorder span ring (ISSUE 14): flushed per-process rings
+        # land here; ListSpans/timeline read it
+        self.span_events: deque = deque(
+            maxlen=max(1, int(CONFIG.task_event_span_buffer_max)))
+        self.span_events_total = 0  # appended ever (drop gauge = total-len)
+        # per-node flight-recorder flush stats: node_id -> {events, spans,
+        # flushes, last_flush, rings: {role-pid: ring stats}}
+        self.event_node_stats: Dict[str, Dict] = {}
         self.cluster_config = CONFIG.snapshot()
         self._pg_counter = 0
         # GCS fault tolerance (reference: storage backend selected at
@@ -834,7 +848,83 @@ class HeadServer:
                 ("broadcast", self._broadcast_loop),
                 ("metrics", self._metrics_loop)):
             self._hold_task(loop.create_task(self._supervise(name, factory)))
+        await self._start_metrics_http()
         return self.port
+
+    # ------------------------------------------------ Prometheus scrape (14)
+    async def _start_metrics_http(self) -> None:
+        """Minimal asyncio HTTP endpoint serving GET /metrics in
+        Prometheus exposition format (``metrics_export_port``, 0 =
+        disabled) — the head already aggregates every process's snapshot
+        in the ``_metrics`` KV namespace, so scraping is a read + render,
+        no extra agent. The bound port lands in <session>/metrics_port
+        for the CLI (`ray_tpu metrics --scrape`) and tests."""
+        self.metrics_port = 0
+        self._metrics_http = None
+        port = int(CONFIG.metrics_export_port)
+        if port <= 0:
+            return
+        try:
+            self._metrics_http = await asyncio.start_server(
+                self._handle_metrics_http, host="0.0.0.0", port=port)
+            self.metrics_port = \
+                self._metrics_http.sockets[0].getsockname()[1]
+            with open(os.path.join(self.session_dir, "metrics_port"),
+                      "w") as f:
+                f.write(str(self.metrics_port))
+        except Exception:
+            logging.getLogger("ray_tpu").exception(
+                "metrics scrape endpoint failed to bind port %d", port)
+
+    async def _handle_metrics_http(self, reader, writer) -> None:
+        try:
+            try:
+                req = await asyncio.wait_for(reader.readline(), timeout=5)
+                # drain request headers, bounded: the per-line timeout
+                # alone lets a drip-feed client pin this coroutine forever
+                for _ in range(100):
+                    line = await asyncio.wait_for(reader.readline(),
+                                                  timeout=5)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                else:
+                    return  # >100 header lines: not a scraper, drop it
+            except (asyncio.TimeoutError, ConnectionError):
+                return
+            parts = req.split()
+            path = parts[1] if len(parts) > 1 else b"/"
+            if parts and parts[0] != b"GET":
+                status, body = b"405 Method Not Allowed", b"GET only\n"
+            elif path.split(b"?")[0] in (b"/metrics", b"/"):
+                status = b"200 OK"
+                body = self._render_prometheus().encode()
+            else:
+                status, body = b"404 Not Found", b"try /metrics\n"
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\n"
+                b"Content-Type: text/plain; version=0.0.4; "
+                b"charset=utf-8\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: close\r\n\r\n" + body)
+            await writer.drain()
+        except Exception:
+            pass  # a malformed scrape must never hurt the head
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _render_prometheus(self) -> str:
+        from ray_tpu.util.metrics import render_prometheus
+
+        snaps: List[Dict] = []
+        for raw in (self.kv.get("_metrics") or {}).values():
+            try:
+                snaps.extend(json.loads(raw))
+            except Exception:
+                continue
+        return render_prometheus(snaps)
 
     async def _supervise(self, name: str, factory) -> None:
         """Restart-on-crash supervisor for the head's background loops. A
@@ -900,6 +990,8 @@ class HeadServer:
         r("ListPlacementGroups", self._list_placement_groups)
         r("ReportTaskEvents", self._report_task_events)
         r("ListTaskEvents", self._list_task_events)
+        r("ListSpans", self._list_spans)
+        r("GetEventStats", self._get_event_stats)
         r("RegisterJob", self._register_job)
         r("ListJobs", self._list_jobs)
         r("DrainNode", self._drain_node)
@@ -1213,6 +1305,13 @@ class HeadServer:
                     g("ray_tpu_gcs_task_events_buffered",
                       "Task state-transition events held in the ring.",
                       len(self.task_events)),
+                    g("ray_tpu_gcs_spans_buffered",
+                      "Flight-recorder spans held in the head ring.",
+                      len(self.span_events)),
+                    g("ray_tpu_gcs_spans_dropped_total",
+                      "Spans evicted from the head ring (overflow).",
+                      max(0, self.span_events_total
+                          - len(self.span_events))),
                     g("ray_tpu_gcs_named_actors",
                       "Named actors registered.", len(self.named_actors)),
                     g("ray_tpu_gcs_driver_connections",
@@ -1250,6 +1349,14 @@ class HeadServer:
                         count, {"state": state}))
                 ns = self.kv.setdefault("_metrics", {})
                 ns[b"metrics::head::gcs"] = _json.dumps(snaps).encode()
+                from ray_tpu._private.events import REC as _rec
+
+                if _rec.enabled and _rec.counter != _rec.flushed:
+                    # the head's own ring drains in-process — no RPC
+                    for sp in _rec.drain():
+                        self.span_events.append(
+                            ("head", "head", os.getpid(), sp))
+                        self.span_events_total += 1
             except Exception:
                 pass  # metrics must never take the head down
 
@@ -1903,17 +2010,37 @@ class HeadServer:
         return list(self.placement_groups.values())
 
     # ----------------------------------------------------------- task events
-    async def _report_task_events(self, conn, p) -> None:
+    async def _report_task_events(self, conn, p) -> Dict:
         # v2: columnar tuples (task_id, job_id, name, state, type, time)
-        # with node_id once per frame — dicts are built only on query
+        # with node_id once per frame — dicts are built only on query.
+        # Eviction is the deque's own maxlen (was an O(n) list copy per
+        # overflow). The reply is the read-your-writes ack: a flush that
+        # awaits it is guaranteed visible to the next ListTaskEvents.
         node_id = p.get("node_id", "")
+        n_ev = 0
         for ev in p.get("events_v2", ()):
             self.task_events.append((node_id, ev))
+            n_ev += 1
         for ev in p.get("events", ()):  # legacy dict form
             self.task_events.append((ev.get("node_id", node_id), ev))
-        cap = CONFIG.task_event_buffer_max
-        if len(self.task_events) > cap:
-            self.task_events = self.task_events[-cap:]
+            n_ev += 1
+        spans = p.get("spans") or ()
+        if spans or p.get("ring"):
+            role, pid = p.get("role", ""), p.get("pid", 0)
+            for sp in spans:
+                self.span_events.append((node_id, role, pid, sp))
+            self.span_events_total += len(spans)
+            st = self.event_node_stats.setdefault(
+                node_id, {"events": 0, "spans": 0, "flushes": 0,
+                          "rings": {}})
+            st["events"] += n_ev
+            st["spans"] += len(spans)
+            st["flushes"] += 1
+            st["last_flush"] = time.time()
+            ring = p.get("ring")
+            if ring:
+                st["rings"][f"{role}-{pid}"] = ring
+        return {"ok": True, "events": n_ev, "spans": len(spans)}
 
     @staticmethod
     def _event_to_dict(node_id: str, ev) -> Dict:
@@ -1947,8 +2074,63 @@ class HeadServer:
                         break
             picked.reverse()
         else:
-            picked = self.task_events[-limit:]
+            skip = max(0, len(self.task_events) - limit)
+            picked = list(itertools.islice(self.task_events, skip, None))
         return [self._event_to_dict(nid, ev) for nid, ev in picked]
+
+    async def _list_spans(self, conn, p) -> List[Dict]:
+        """Flight-recorder spans, filterable by trace id or the task-hex
+        prefix carried in span extras (``ray_tpu trace <task_id>``)."""
+        from ray_tpu._private.events import _span_dict
+
+        limit = p.get("limit", 20000)
+        trace = p.get("trace")
+        task = p.get("task")  # hex prefix match on extra["task"]
+        out: List[Dict] = []
+        for node_id, role, pid, sp in reversed(self.span_events):
+            if trace is not None and sp[0] != trace:
+                continue
+            if task is not None:
+                extra = sp[7] if len(sp) > 7 else None
+                t = (extra or {}).get("task") or ""
+                # empty t must NOT match (task.startswith("") is True for
+                # every query — phase spans without a task tag are
+                # reachable via their trace id, not the task filter)
+                if not t or not (t.startswith(task) or task.startswith(t)):
+                    continue
+            out.append(_span_dict(sp, role=role, pid=pid, node_id=node_id))
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    async def _get_event_stats(self, conn, p) -> Dict:
+        """Per-node flight-recorder health for CLI `status` (buffered /
+        dropped / flushed counts per node)."""
+        now = time.time()
+        nodes = {}
+        for node_id, st in self.event_node_stats.items():
+            rings = st.get("rings", {})
+            nodes[node_id] = {
+                "events": st.get("events", 0),
+                "spans": st.get("spans", 0),
+                "flushes": st.get("flushes", 0),
+                "last_flush_age_s": round(
+                    now - st.get("last_flush", now), 1),
+                "recorded": sum(r.get("recorded", 0)
+                                for r in rings.values()),
+                "clipped": sum(r.get("clipped", 0) for r in rings.values()),
+                "rings": len(rings),
+            }
+        return {
+            "nodes": nodes,
+            "head": {
+                "task_events_buffered": len(self.task_events),
+                "spans_buffered": len(self.span_events),
+                "spans_dropped": max(
+                    0, self.span_events_total - len(self.span_events)),
+            },
+        }
 
     # ----------------------------------------------------------------- jobs
     async def _register_job(self, conn, p) -> None:
@@ -1978,6 +2160,9 @@ def main() -> None:
         from ray_tpu._private.protocol import set_fault_self_id
 
         set_fault_self_id("head")  # chaos rules may target the head
+        from ray_tpu._private import events as _ev
+
+        _ev.configure(args.session_dir, "head")
         lifecycle.register_self("gcs", args.session_dir)
         # die with the spawning driver/runner: a SIGKILL'd driver must not
         # strand the head control plane (lifecycle supervisor contract)
@@ -1996,6 +2181,7 @@ def main() -> None:
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
+        _ev.REC.dump_local("sigterm")
         # flush the last debounce window so a clean stop loses nothing;
         # the snapshot's seq stamp lets the next boot skip the WAL prefix
         head._save_state()

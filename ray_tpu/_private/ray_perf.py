@@ -161,6 +161,43 @@ def main(filter_substr: str = "") -> Dict[str, float]:
     for act in actors + [a]:
         ray_tpu.kill(act)
 
+    # flight-recorder A/B (ISSUE 14): the same async-task bench with the
+    # recorder OFF (the default this suite runs under) vs ON at sample
+    # rate 1.0 — the honest cost of full span recording — plus the
+    # measured disabled-guard cost, which is what the <2% hard
+    # requirement is actually about (you cannot A/B the disabled path
+    # against "no instrumentation at runtime"; the guard probe times the
+    # exact branch every site pays)
+    if not filter_substr or "events" in filter_substr:
+        from ray_tpu._private import events as _ev
+
+        @ray_tpu.remote
+        def noop_ev():
+            pass
+
+        ray_tpu.get(noop_ev.remote(), timeout=60)
+
+        def run_batch():
+            ray_tpu.get([noop_ev.remote() for _ in range(N_ASYNC)])
+
+        off_rate = timeit("tasks async (events off)", run_batch,
+                          multiplier=N_ASYNC)
+        w = ray_tpu._worker_mod.global_worker
+        armed = _ev.configure(w.session_dir or "/tmp", w.mode,
+                              sample_rate=1.0)
+        on_rate = timeit("tasks async (events on)", run_batch,
+                         multiplier=N_ASYNC)
+        _ev.REC.enabled = False  # restore the suite's default
+        results["events ab"] = {
+            "off_tasks_per_s": round(off_rate, 1),
+            "on_tasks_per_s": round(on_rate, 1),
+            "on_overhead_pct": round(
+                (off_rate - on_rate) / off_rate * 100, 2) if off_rate else 0,
+            "recorder_armed": armed,
+            "disabled_guard_ns": round(_ev.overhead_probe(100_000), 1),
+        }
+        print(json.dumps({"events ab": results["events ab"]}))
+
     # direct-call transport columns (ISSUE 11): which lane the actor
     # benches above actually rode — shm frame counts prove same-node
     # calls bypassed loopback TCP; fallback counters prove the ladder

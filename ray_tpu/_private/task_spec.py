@@ -37,6 +37,11 @@ _WIRE_FIELDS = (
     "actor_method", "seq", "scheduling_strategy", "placement_group_id",
     "placement_group_bundle_index", "max_concurrency", "namespace",
     "actor_name", "max_restarts", "runtime_env", "label_selector",
+    # flight-recorder trace context (ISSUE 14): (trace_id, parent_span_id)
+    # or None. Riding the spec wire is what propagates a sampled trace
+    # across every transport for free — TCP, mux streams, and the shm
+    # lane all carry the same per-call dict.
+    "trace_ctx",
 )
 
 
@@ -78,6 +83,7 @@ class TaskSpec:
         max_restarts: int = 0,
         runtime_env: Optional[Dict] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        trace_ctx: Optional[Tuple[int, int]] = None,
     ):
         self.task_id = task_id
         self.job_id = job_id
@@ -104,6 +110,7 @@ class TaskSpec:
         self.max_restarts = max_restarts
         self.runtime_env = runtime_env
         self.label_selector = label_selector
+        self.trace_ctx = trace_ctx
         self._wire = None
 
     def to_wire(self) -> Dict[str, Any]:

@@ -28,6 +28,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.async_util import (
     DecorrelatedJitterBackoff, hold_task, spawn_tracked)
@@ -250,6 +251,21 @@ class TaskRecord:
         self.submitted_at = time.time()
         # ObjectRefGenerator for num_returns=-1 streaming tasks
         self.streaming_gen = None
+
+
+def _span_since(record: "TaskRecord", name: str) -> None:
+    """Record a submit->now phase slice (lease_wait / enqueue_wait) as a
+    child of the task's root span. Callers pre-check
+    ``record.spec.trace_ctx is not None`` so the unsampled path never
+    pays the call."""
+    rec = _events.REC
+    if not rec.enabled:
+        return
+    tc = record.spec.trace_ctx
+    now = time.time()
+    rec.record(name, "task", record.submitted_at,
+               max(0.0, now - record.submitted_at), tc[0], rec.next_id(),
+               tc[1])
 
 
 class WorkerConn:
@@ -499,6 +515,14 @@ class Worker:
         # cold worker's first task failed with "init() must be called
         # first" (caught by the ISSUE 9 broadcast consumers)
         self.connected = True
+        # arm the flight recorder (ISSUE 14) AFTER the cluster config
+        # landed so the head-broadcast sample rate applies; the ring file
+        # lives under <session>/events/ so a kill -9 here is recoverable
+        self.session_dir = (reply.get("session_dir")
+                            or os.environ.get("RAY_TPU_SESSION_DIR", ""))
+        if self.session_dir:
+            _events.configure(self.session_dir, self.mode)
+        self._last_span_flush = time.monotonic()
         mark("ready")
         self.ready_event.set()
 
@@ -1015,6 +1039,9 @@ class Worker:
         return ObjectRef(object_id, self.direct_addr())
 
     def put_object(self, object_id: ObjectID, value: Any) -> None:
+        rec = _events.REC
+        trace = rec.new_trace() if rec.enabled and rec.sample() else None
+        t0 = time.time() if trace is not None else 0.0
         sobj = self._serialize_value(value)
         meta = self.reference_counter.register_owned(object_id)
         size = sobj.total_size()
@@ -1038,6 +1065,10 @@ class Worker:
             self.reference_counter.set_resolved(
                 object_id.binary(), "plasma", [self.agent_tcp_addr]
             )
+        if trace is not None:
+            rec.record("put", "object", t0, time.time() - t0,
+                       trace[0], trace[1], 0,
+                       {"obj": object_id.hex()[:16], "bytes": size})
 
     def _serialize_value(self, value: Any):
         """Returns a SerializedObject, or a ZeroCopyArray for bare
@@ -1052,15 +1083,33 @@ class Worker:
     # ------------------------------------------------------------------ get
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         self._n_gets = getattr(self, "_n_gets", 0) + 1
+        rec = _events.REC
+        tc = None
+        if rec.enabled:
+            # join the ambient trace (a sampled task calling get, or a
+            # trace_parent scope) so the agent-side pull slices stitch
+            # under the caller; else roll the root dice
+            amb = _events.parent_ctx() or _events.current_ctx()
+            if amb is not None:
+                tc = (amb[0], rec.next_id(), amb[1])
+            elif rec.sample():
+                t, span = rec.new_trace()
+                tc = (t, span, 0)
+        t0 = time.time() if tc is not None else 0.0
         deadline = None if timeout is None else time.monotonic() + timeout
         self._batch_resolve_borrows(refs)
-        self._prefetch_plasma(refs)
+        self._prefetch_plasma(refs, tc=tc)
         out: List[Any] = [None] * len(refs)
-        for i, ref in enumerate(refs):
-            remaining = None
-            if deadline is not None:
-                remaining = max(0.0, deadline - time.monotonic())
-            out[i] = self._get_one(ref, remaining)
+        try:
+            for i, ref in enumerate(refs):
+                remaining = None
+                if deadline is not None:
+                    remaining = max(0.0, deadline - time.monotonic())
+                out[i] = self._get_one(ref, remaining, tc=tc)
+        finally:
+            if tc is not None:
+                rec.record("get", "object", t0, time.time() - t0,
+                           tc[0], tc[1], tc[2], {"refs": len(refs)})
         return out
 
     def _batch_resolve_borrows(self, refs: List[ObjectRef]) -> None:
@@ -1114,7 +1163,7 @@ class Worker:
             pass
 
     def _prefetch_plasma(self, refs: List[ObjectRef],
-                         min_need: int = 2) -> None:
+                         min_need: int = 2, tc=None) -> None:
         """One WaitObjects frame covering every plasma-backed ref not yet
         local, so the agent STARTS all the pulls concurrently. Without
         this, the per-ref loop below paid one sequential cross-node pull
@@ -1146,11 +1195,13 @@ class Worker:
                 "owners": {h: r.owner_addr() for h, r in need.items()},
                 "num_returns": 0,
                 "timeout_ms": 0,
+                "tc": [tc[0], tc[1]] if tc is not None else None,
             }), timeout=5)
         except Exception:
             pass
 
-    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float],
+                 tc=None) -> Any:
         binary = ref.binary()
         deadline = None if timeout is None else time.monotonic() + timeout
         attempt = 0
@@ -1172,7 +1223,7 @@ class Worker:
                 entry = self._resolve_borrowed(ref, deadline)
             data, flags = entry
             if flags == IN_PLASMA:
-                value = self._get_from_plasma(ref, deadline)
+                value = self._get_from_plasma(ref, deadline, tc=tc)
                 if value is _LOST:
                     attempt += 1
                     if not self._try_recover(ref, attempt):
@@ -1245,7 +1296,7 @@ class Worker:
                 raise ObjectLostError(ref.hex(), "unknown to its owner")
             # pending: loop again
 
-    def _get_from_plasma(self, ref: ObjectRef, deadline):
+    def _get_from_plasma(self, ref: ObjectRef, deadline, tc=None):
         hex_id = ref.hex()
         view = self.store.get_view(ref.id())
         if view is None:
@@ -1268,6 +1319,7 @@ class Worker:
                         "locations": {hex_id: locations},
                         "num_returns": 1,
                         "timeout_ms": timeout_ms,
+                        "tc": [tc[0], tc[1]] if tc is not None else None,
                     },
                 )
             )
@@ -1481,6 +1533,25 @@ class Worker:
             self._tasks.pop(task_binary, None)
 
     # =================================================================== tasks
+    def _trace_for_submit(self):
+        """Trace context for a new submission (ISSUE 14): join the ambient
+        parent trace — an orchestration layer's trace_parent() override,
+        or the trace of the sampled task currently executing on this
+        thread — else roll the root sampling dice. Returns
+        (trace_id, span_id, parent_span_id) or None; the first two ride
+        the spec wire to the executor, the third parents the root span
+        recorded at completion."""
+        rec = _events.REC
+        if not rec.enabled:
+            return None
+        parent = _events.parent_ctx() or _events.current_ctx()
+        if parent is not None:
+            return (parent[0], rec.next_id(), parent[1])
+        if rec.sample():
+            t, span = rec.new_trace()
+            return (t, span, 0)
+        return None
+
     def submit_task(
         self,
         function,
@@ -1530,6 +1601,7 @@ class Worker:
             placement_group_id=(pg[0] if pg else None),
             placement_group_bundle_index=(pg[1] if pg else -1),
             runtime_env=runtime_env,
+            trace_ctx=self._trace_for_submit(),
         )
         if num_returns == -1:  # streaming generator
             record = TaskRecord(spec, [])
@@ -1774,27 +1846,89 @@ class Worker:
         # stay columnar; the state API renders dicts only on query —
         # reference analog: TaskEventBuffer batches binary protos,
         # task_event_buffer.h:206)
+        tc = spec.trace_ctx
+        if tc is not None and state in ("FINISHED", "FAILED"):
+            # close the sampled root span: submit -> reply, one per
+            # attempt chain (retries extend the same span)
+            rec = _events.REC
+            if rec.enabled:
+                record = self._tasks.get(spec.task_id)
+                t0 = record.submitted_at if record is not None else time.time()
+                name = ("actor_call::" if spec.task_type == ACTOR_TASK
+                        else "task::") + spec.function_name
+                rec.record(name, "task", t0, max(0.0, time.time() - t0),
+                           tc[0], tc[1], tc[2] if len(tc) > 2 else 0,
+                           {"task": spec.task_id.hex()[:16], "state": state})
         self.task_events.append(
             (spec.task_id, spec.job_id, spec.function_name, state,
              spec.task_type, time.time()))
         if len(self.task_events) >= CONFIG.task_event_flush_batch:
             self.flush_task_events()
 
-    def flush_task_events(self) -> None:
+    def flush_task_events(self, wait: bool = False) -> None:
+        """Flush buffered task state events AND the flight-recorder ring
+        to the head. ``wait=True`` (timeline(), shutdown) blocks until the
+        head ACKED the frame, so an immediately following ListTaskEvents/
+        ListSpans is read-your-writes — the fix for the old
+        ``time.sleep(0.05)`` flush race (ISSUE 14 satellite)."""
         events, self.task_events = self.task_events, []
-        if not events or not self.head or not self.connected:
+        rec = _events.REC
+        spans = rec.drain() if rec.enabled else []
+        if (not events and not spans) or not self.head or not self.connected:
+            return
+        self._last_span_flush = time.monotonic()
+        payload = {"events_v2": events, "node_id": self.node_id,
+                   "spans": spans, "role": self.mode,
+                   "pid": os.getpid(),
+                   # None when disarmed: a ring entry in the frame is what
+                   # creates per-node recorder stats head-side
+                   "ring": rec.stats() if rec.enabled else None}
+        if wait and threading.current_thread() is not self._loop_thread:
+            try:
+                self._acall(self.head.call(
+                    "ReportTaskEvents", payload,
+                    timeout=CONFIG.control_rpc_timeout_s),
+                    timeout=CONFIG.control_rpc_timeout_s)
+            except Exception:
+                pass
             return
 
         async def send():
             try:
                 await self.head.call(
-                    "ReportTaskEvents",
-                    {"events_v2": events, "node_id": self.node_id},
+                    "ReportTaskEvents", payload,
                     timeout=CONFIG.control_rpc_timeout_s)
             except Exception:
                 pass
 
         self._spawn(send())
+
+    def _maybe_flush_spans(self) -> None:
+        """Executor-side pacing: push recorded spans to the head at most
+        every task_event_flush_interval_s, so a timeline pulled moments
+        after a task finishes already has its worker-side slices. Too-
+        early calls arm ONE deferred flush for the window's end — a task
+        that runs once and never again still gets its spans out without
+        waiting for the 15 s worker watchdog (loop-thread only)."""
+        rec = _events.REC
+        if not rec.enabled or rec.counter == rec.flushed:
+            return
+        now = time.monotonic()
+        due = getattr(self, "_last_span_flush", 0.0) + \
+            CONFIG.task_event_flush_interval_s
+        if now >= due:
+            self.flush_task_events()
+            return
+        if not getattr(self, "_span_flush_armed", False):
+            self._span_flush_armed = True
+            self.loop.call_later(max(0.05, due - now),
+                                 self._deferred_span_flush)
+
+    def _deferred_span_flush(self) -> None:
+        self._span_flush_armed = False
+        rec = _events.REC
+        if self.connected and rec.enabled and rec.counter != rec.flushed:
+            self.flush_task_events()
 
     def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
         record = self._tasks.get(ref.id().task_id().binary())
@@ -2026,6 +2160,7 @@ class Worker:
             actor_method=method_name,
             seq=seq,
             max_retries=max_retries,
+            trace_ctx=self._trace_for_submit(),
         )
         if num_returns == -1:  # streaming actor method
             record = TaskRecord(spec, [])
@@ -2502,6 +2637,8 @@ class _LeasePool:
         if record.cancelled:
             self._after_task(conn)
             return
+        if record.spec.trace_ctx is not None:
+            _span_since(record, "lease_wait")
         try:
             wire = dict(record.spec.to_wire())  # copy: cached base
             wire["assigned_instances"] = getattr(conn, "assigned_instances", {})
@@ -2551,6 +2688,8 @@ class _LeasePool:
             if record.cancelled:
                 self._after_task(conn)
                 continue
+            if record.spec.trace_ctx is not None:
+                _span_since(record, "lease_wait")
             wire = dict(record.spec.to_wire())  # copy: cached base
             wire["assigned_instances"] = getattr(
                 conn, "assigned_instances", {})
@@ -2890,6 +3029,8 @@ class _ActorState:
     def _push_nowait(self, worker: Worker, record: TaskRecord) -> None:
         """Pipelined, sequenced push over the write-combined client; the
         receiver orders by seq (reference: direct_actor_task_submitter.h)."""
+        if record.spec.trace_ctx is not None:
+            _span_since(record, "enqueue_wait")
         try:
             fut = self.client.call_future("PushTask", record.spec.to_wire())
         except Exception:
@@ -2941,6 +3082,9 @@ class _ActorState:
                 worker._on_task_failure(record, e, retriable=False)
 
         batches[bid] = on_item
+        for r in records:
+            if r.spec.trace_ctx is not None:
+                _span_since(r, "enqueue_wait")
         try:
             fut = client.call_future(
                 "PushTaskBatchStream",

@@ -22,6 +22,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.function_table import load_function
@@ -88,6 +89,8 @@ class Executor:
         # tasks pipeline deep to amortize wakeups; long tasks stay shallow).
         if isinstance(reply, dict) and "exec_ms" not in reply:
             reply["exec_ms"] = (time.monotonic() - start) * 1000.0
+        if _events.REC.enabled:
+            self.worker._maybe_flush_spans()
         return reply
 
     async def handle_push_task_batch_stream(self, conn, p: Dict) -> Dict:
@@ -279,13 +282,32 @@ class Executor:
         ctx.task_name = spec.function_name
         ctx.placement_group_id = spec.placement_group_id
         start = time.time()
+        # flight recorder (ISSUE 14): the trace context rode the spec wire
+        # from the submitter; the OPEN marker written before user code runs
+        # is the post-mortem breadcrumb a kill -9 leaves behind
+        rec = _events.REC
+        tc = spec.trace_ctx if rec.enabled else None
+        exec_span = cur_tok = 0
+        if tc is not None:
+            exec_span = rec.next_id()
+            rec.open_marker("exec::" + spec.function_name, "exec",
+                            tc[0], exec_span, tc[1],
+                            {"task": spec.task_id.hex()[:16]})
+            cur_tok = _events.set_current((tc[0], exec_span))
         try:
             if spec.runtime_env:
                 from ray_tpu.runtime_env import setup_runtime_env
 
                 setup_runtime_env(spec.runtime_env,
                                   os.environ.get("RAY_TPU_SESSION_DIR"))
-            args, kwargs = self._resolve_args(spec)
+            if tc is not None:
+                t_args = time.time()
+                args, kwargs = self._resolve_args(spec)
+                rec.record("arg_resolve", "exec", t_args,
+                           time.time() - t_args, tc[0], rec.next_id(),
+                           exec_span)
+            else:
+                args, kwargs = self._resolve_args(spec)
             if spec.task_type == ACTOR_TASK:
                 if spec.actor_method == "__ray_apply__":
                     # reserved dispatch: args[0] is a callable run WITH the
@@ -305,6 +327,13 @@ class Executor:
                 # async callable that evaded static detection (e.g. attached
                 # via __getattr__): run it to completion on this thread
                 result = asyncio.run(result)
+            if tc is not None:
+                t_ret = time.time()
+                reply = self._package_returns(spec, result)
+                rec.record("return_put", "exec", t_ret,
+                           time.time() - t_ret, tc[0], rec.next_id(),
+                           exec_span)
+                return reply
             return self._package_returns(spec, result)
         except SystemExit:
             raise
@@ -321,6 +350,11 @@ class Executor:
                 ],
             }
         finally:
+            if tc is not None:
+                rec.record("exec::" + spec.function_name, "exec", start,
+                           time.time() - start, tc[0], exec_span, tc[1],
+                           {"task": spec.task_id.hex()[:16]})
+                _events.reset_current(cur_tok)
             ctx.task_id = None
             ctx.task_name = None
             ctx.placement_group_id = None
@@ -346,6 +380,19 @@ class Executor:
 
     async def _run_async_method(self, spec: TaskSpec, method) -> Dict:
         loop = asyncio.get_running_loop()
+        rec = _events.REC
+        tc = spec.trace_ctx if rec.enabled else None
+        exec_span = 0
+        cur_tok = None
+        t0 = time.time()
+        if tc is not None:
+            exec_span = rec.next_id()
+            rec.open_marker("exec::" + spec.function_name, "exec",
+                            tc[0], exec_span, tc[1],
+                            {"task": spec.task_id.hex()[:16], "async": 1})
+            # awaited user code inherits this coroutine's context, so a
+            # ray_tpu.get() inside the async method nests under exec::
+            cur_tok = _events.set_current((tc[0], exec_span))
         try:
             args, kwargs = await loop.run_in_executor(
                 None, lambda: self._resolve_args(spec)
@@ -365,6 +412,12 @@ class Executor:
                     for _ in range(spec.num_returns)
                 ],
             }
+        finally:
+            if tc is not None:
+                rec.record("exec::" + spec.function_name, "exec", t0,
+                           time.time() - t0, tc[0], exec_span, tc[1],
+                           {"task": spec.task_id.hex()[:16], "async": 1})
+                _events.reset_current(cur_tok)
 
     def _package_one(self, spec: TaskSpec, i: int, value: Any,
                      is_exception: bool = False) -> Dict:
@@ -714,6 +767,9 @@ def main() -> None:
             time.sleep(CONFIG.worker_park_poll_s)
     except KeyboardInterrupt:
         pass
+    # fatal-exit breadcrumb (agent gone / interrupted): the mmap ring is
+    # already durable, the jsonl dump just makes it human-greppable
+    _events.REC.dump_local("worker_exit")
     os._exit(0)
 
 

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private import events as _ev
 from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data._internal.physical import PhysicalOperator, RefBundle
 from ray_tpu.data._internal.shard_codec import decode_shard, encode_shard
@@ -90,10 +91,28 @@ def _sort_map_shards(block, key, boundaries, n: int):
     return outs
 
 
+def _pull_shards(shard_refs):
+    """Reducer-side shard fetch with a ``shard_pull`` flight-recorder
+    slice: the single batched ``get`` resolves every borrow and starts
+    every pull in one WaitObjects window, and when the enclosing task is
+    sampled the pull time lands as its own nested slice (the "lease wait
+    vs pull vs merge?" answer per reducer)."""
+    rec = _ev.REC
+    ctx = _ev.current_ctx() if rec.enabled else None
+    if ctx is None:
+        return ray_tpu.get(list(shard_refs))
+    t0 = time.time()
+    try:
+        return ray_tpu.get(list(shard_refs))
+    finally:
+        rec.record("shard_pull", "data", t0, time.time() - t0,
+                   ctx[0], rec.next_id(), ctx[1],
+                   {"shards": len(shard_refs)})
+
+
 def _shuffle_reduce_shards(shard_refs, i: int, seed: int):
-    """Merge this reducer's M shards. The single batched ``get`` resolves
-    every borrow and starts every pull in one WaitObjects window."""
-    shards = [decode_shard(s) for s in ray_tpu.get(list(shard_refs))]
+    """Merge this reducer's M shards (see ``_pull_shards``)."""
+    shards = [decode_shard(s) for s in _pull_shards(shard_refs)]
     out = BlockAccessor.concat(shards)
     acc = BlockAccessor(out)
     rng = np.random.default_rng(seed * 7919 + i)
@@ -102,7 +121,7 @@ def _shuffle_reduce_shards(shard_refs, i: int, seed: int):
 
 
 def _sort_reduce_shards(shard_refs, i: int, key, descending: bool):
-    shards = [decode_shard(s) for s in ray_tpu.get(list(shard_refs))]
+    shards = [decode_shard(s) for s in _pull_shards(shard_refs)]
     out = BlockAccessor.concat(shards)
     acc = BlockAccessor(out)
     if acc.num_rows():
@@ -225,9 +244,10 @@ class SortAlgo(_ShuffleAlgo):
 # --------------------------------------------------------------------------
 class _MapRec:
     __slots__ = ("bundle", "salt", "shard_refs", "meta_ref", "done",
-                 "sizes", "reexecs", "reexec_inflight")
+                 "sizes", "reexecs", "reexec_inflight", "t0")
 
     def __init__(self, bundle: RefBundle, salt: int, refs):
+        self.t0 = time.time()
         self.bundle = bundle
         self.salt = salt
         self.shard_refs = list(refs[:-1])
@@ -240,9 +260,10 @@ class _MapRec:
 
 class _ReduceRec:
     __slots__ = ("index", "block_ref", "meta_ref", "running", "done",
-                 "bundle", "attempts", "bytes_in")
+                 "bundle", "attempts", "bytes_in", "t0")
 
     def __init__(self, index: int):
+        self.t0 = 0.0
         self.index = index
         self.block_ref = None
         self.meta_ref = None
@@ -295,6 +316,13 @@ class StreamingShuffleOperator(PhysicalOperator):
         self._t_map_last_done = 0.0
         self._t_reduce_first_admit = 0.0
         self._t_start = time.perf_counter()
+        # flight recorder (ISSUE 14): one sampled trace per exchange;
+        # every map/reduce task submitted under trace_parent joins it, so
+        # `ray_tpu trace` shows map -> shard_pull -> reduce as one tree
+        self._trace = (_ev.REC.new_trace()
+                       if _ev.REC.enabled and _ev.REC.sample() else None)
+        self._trace_t0 = time.time()
+        self._trace_closed = False
 
     # ------------------------------------------------------------ helpers
     @staticmethod
@@ -415,14 +443,17 @@ class StreamingShuffleOperator(PhysicalOperator):
 
     def _dispatch_map(self, bundle: RefBundle) -> None:
         salt = len(self._maps)
-        refs = self.algo.map_submit(bundle.block_ref, salt, self._n)
+        with _ev.trace_parent(self._trace):
+            refs = self.algo.map_submit(bundle.block_ref, salt, self._n)
         self.tasks_launched += 1
         self._maps.append(_MapRec(bundle, salt, refs))
 
     def _admit_reduce(self, r: _ReduceRec) -> None:
         shard_refs = [m.shard_refs[r.index] for m in self._maps]
-        r.block_ref, r.meta_ref = self.algo.reduce_submit(
-            shard_refs, r.index)
+        with _ev.trace_parent(self._trace):
+            r.block_ref, r.meta_ref = self.algo.reduce_submit(
+                shard_refs, r.index)
+        r.t0 = time.time()
         r.bytes_in = self._reducer_bytes_estimate(r.index)
         r.running = True
         self.tasks_launched += 1
@@ -506,6 +537,12 @@ class StreamingShuffleOperator(PhysicalOperator):
                 self.shard_bytes_total += sum(s[1] for s in sz)
                 self._held_shard_bytes += sum(
                     s[1] for i, s in enumerate(sz) if i not in done_idx)
+                if self._trace is not None:
+                    _ev.REC.record(
+                        "shuffle_map", "data", m.t0, time.time() - m.t0,
+                        self._trace[0], _ev.REC.next_id(), self._trace[1],
+                        {"salt": m.salt,
+                         "bytes": int(sum(x[1] for x in sz))})
         if not self._t_map_first_done:
             self._t_map_first_done = now
         self._t_map_last_done = now
@@ -531,6 +568,11 @@ class StreamingShuffleOperator(PhysicalOperator):
                 continue
             r.done = True
             r.running = False
+            if self._trace is not None:
+                _ev.REC.record(
+                    "shuffle_reduce", "data", r.t0, time.time() - r.t0,
+                    self._trace[0], _ev.REC.next_id(), self._trace[1],
+                    {"index": r.index, "bytes": int(r.bytes_in)})
             r.bundle = RefBundle(r.block_ref, meta)
             for m in self._maps:
                 if m.sizes is not None:
@@ -622,9 +664,20 @@ class StreamingShuffleOperator(PhysicalOperator):
 
     def completed(self) -> bool:
         if self._n == 0 and self.inputs_complete and not self.input_queue:
-            return True
-        return (self._reducers is not None and self._emit_order is not None
-                and self._emit_pos >= len(self._emit_order))
+            done = True
+        else:
+            done = (self._reducers is not None
+                    and self._emit_order is not None
+                    and self._emit_pos >= len(self._emit_order))
+        if done and self._trace is not None and not self._trace_closed:
+            self._trace_closed = True
+            _ev.REC.record(
+                "shuffle::" + self.name, "data", self._trace_t0,
+                time.time() - self._trace_t0, self._trace[0],
+                self._trace[1], 0,
+                {"maps": len(self._maps),
+                 "reducers": len(self._reducers or [])})
+        return done
 
     # ------------------------------------------------------------- stats
     def extra_usage_bytes(self) -> int:

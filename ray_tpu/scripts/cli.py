@@ -200,6 +200,7 @@ def cmd_status(args) -> int:
         used = total[k] - avail.get(k, 0.0)
         print(f"  {used:g}/{total[k]:g} {k}")
     _print_head_status()
+    _print_events()
     _print_data_plane()
     _print_data_pipelines()
     _print_worker_pool()
@@ -242,6 +243,34 @@ def _print_head_status() -> None:
         print(f"  still recovering: {recv.get('nodes', 0)} nodes, "
               f"{recv.get('actors', 0)} actors, "
               f"{recv.get('jobs', 0)} jobs")
+
+
+def _print_events() -> None:
+    """Flight-recorder health (ISSUE 14): head ring occupancy plus
+    per-node recorded/clipped/flushed counters."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        st = w.head_call("GetEventStats", {}, timeout=3)
+    except Exception:
+        return  # older head without the RPC, or a head mid-bounce
+    head = st.get("head") or {}
+    nodes = st.get("nodes") or {}
+    print("\nEvents")
+    print("-" * 40)
+    print(f"  head ring: {head.get('task_events_buffered', 0)} task events"
+          f" / {head.get('spans_buffered', 0)} spans buffered"
+          f" ({head.get('spans_dropped', 0)} dropped)")
+    if not nodes:
+        print("  (no flight-recorder flushes — task_event_sample_rate=0?)")
+    for node_id, n in sorted(nodes.items()):
+        print(f"  {str(node_id)[:12]}: recorded {n.get('recorded', 0)} "
+              f"(clipped {n.get('clipped', 0)}) / flushed "
+              f"{n.get('spans', 0)} spans + {n.get('events', 0)} events "
+              f"in {n.get('flushes', 0)} flushes "
+              f"({n.get('rings', 0)} rings, last "
+              f"{n.get('last_flush_age_s', 0)}s ago)")
 
 
 def _print_data_plane() -> None:
@@ -422,13 +451,52 @@ def cmd_summary(args) -> int:
 
 
 def cmd_timeline(args) -> int:
-    ray_tpu = _connect()
-    events = ray_tpu.timeline()
+    if getattr(args, "session", ""):
+        # post-mortem mode: no cluster needed — parse the crash-durable
+        # ring files straight off the session dir (DaemonKiller / kill -9
+        # debugging: the rings of dead processes are still there)
+        from ray_tpu._private.events import recover_session, to_chrome_trace
+
+        rings = recover_session(args.session)
+        spans = [sp for ring in rings for sp in ring["spans"]]
+        events = to_chrome_trace(spans)
+        src = f"{len(rings)} ring file(s)"
+    else:
+        ray_tpu = _connect()
+        events = ray_tpu.timeline()
+        src = "head"
     path = args.output or f"/tmp/ray_tpu_timeline_{int(time.time())}.json"
     with open(path, "w") as f:
         json.dump(events, f)
-    print(f"wrote {len(events)} events to {path} "
+    print(f"wrote {len(events)} events ({src}) to {path} "
           "(open in chrome://tracing or Perfetto)")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Span tree of one task across driver/agent/worker (ISSUE 14):
+    resolves the task id (hex prefix) against the head's span ring and
+    prints every span sharing its trace, nested by parent."""
+    from ray_tpu._private.events import format_trace_tree
+
+    ray_tpu = _connect()
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    w.flush_task_events(wait=True)
+    hits = w.head_call("ListSpans", {"task": args.task_id, "limit": 1000},
+                       timeout=10)
+    if not hits:
+        print(f"no spans for task {args.task_id!r} (is "
+              "task_event_sample_rate > 0, and did the task run "
+              "recently?)")
+        return 1
+    traces = {sp["trace"] for sp in hits}
+    for tr in sorted(traces):
+        spans = w.head_call("ListSpans", {"trace": tr, "limit": 10000},
+                            timeout=10)
+        print(f"trace {tr:x} ({len(spans)} spans)")
+        print(format_trace_tree(spans))
     return 0
 
 
@@ -441,6 +509,30 @@ def cmd_memory(args) -> int:
 
 
 def cmd_metrics(args) -> int:
+    if getattr(args, "scrape", False) or getattr(args, "url", ""):
+        # hit the head's HTTP scrape endpoint (metrics_export_port) the
+        # way Prometheus would — proves the whole export path, not just
+        # the in-process renderer
+        import urllib.request
+
+        url = args.url
+        if not url:
+            from ray_tpu._private import lifecycle
+
+            for sess in lifecycle.list_sessions():
+                port_file = os.path.join(sess["path"], "metrics_port")
+                if sess["live"] and os.path.exists(port_file):
+                    with open(port_file) as f:
+                        url = f"http://127.0.0.1:{f.read().strip()}/metrics"
+                    break
+            if not url:
+                print("no live session exports metrics "
+                      "(set RAY_TPU_METRICS_EXPORT_PORT and restart the "
+                      "head, or pass --url)")
+                return 1
+        with urllib.request.urlopen(url, timeout=10) as r:
+            sys.stdout.write(r.read().decode())
+        return 0
     from ray_tpu.util.metrics import prometheus_text
 
     _connect()
@@ -532,14 +624,29 @@ def main(argv=None) -> int:
     s.add_argument("resource", choices=["tasks", "actors", "objects"])
     s.set_defaults(fn=cmd_summary)
 
-    s = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    s = sub.add_parser(
+        "timeline",
+        help="dump Perfetto/chrome-trace timeline (flight-recorder spans)")
     s.add_argument("--output", default="")
+    s.add_argument("--session", default="",
+                   help="offline mode: read ring files from this session "
+                        "dir instead of a live head (post-mortem)")
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser(
+        "trace", help="print one task's cross-process span tree")
+    s.add_argument("task_id", help="task id hex (prefix ok)")
+    s.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser("memory", help="object store usage")
     s.set_defaults(fn=cmd_memory)
 
     s = sub.add_parser("metrics", help="Prometheus metrics dump")
+    s.add_argument("--scrape", action="store_true",
+                   help="GET the head's HTTP scrape endpoint instead of "
+                        "rendering in-process")
+    s.add_argument("--url", default="",
+                   help="explicit scrape URL (implies --scrape)")
     s.set_defaults(fn=cmd_metrics)
 
     serve_p = sub.add_parser("serve", help="serve control")

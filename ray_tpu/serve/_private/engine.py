@@ -303,6 +303,14 @@ class ContinuousBatchingEngine:
         return None, None
 
     def _step(self, model_id: str, batch: List[_Request]) -> None:
+        # flight recorder (ISSUE 14): one sampled `engine_step` slice per
+        # iteration — batch size / bucket / pad in the extras answer
+        # "where did serving time go" without any engine-specific probe
+        from ray_tpu._private.events import REC as _rec
+
+        ev_trace = _rec.new_trace() if _rec.enabled and _rec.sample() \
+            else None
+        ev_t0 = time.time() if ev_trace is not None else 0.0
         states: List[Optional[Any]] = [r.state for r in batch]
         bucket = self.bucket_for(len(states))
         pad = bucket - len(states)
@@ -322,6 +330,11 @@ class ContinuousBatchingEngine:
                 r.out.put(_EngineError(e))
             return
         self._steps += 1
+        if ev_trace is not None:
+            _rec.record("engine_step::" + str(model_id), "serve", ev_t0,
+                        time.time() - ev_t0, ev_trace[0], ev_trace[1], 0,
+                        {"batch": len(batch), "bucket": bucket,
+                         "pad": pad})
         self._max_batch_seen = max(self._max_batch_seen, len(batch))
         self._padded_slots += pad
         if results is None or len(results) < len(batch):

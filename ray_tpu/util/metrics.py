@@ -194,6 +194,14 @@ def prometheus_text() -> str:
     (what the reference's metrics agent serves to Prometheus). Always
     includes baseline liveness gauges (reference: metric_defs.cc system
     metrics) so the endpoint is non-empty before any user metrics exist."""
+    return render_prometheus(collect_cluster_metrics())
+
+
+def render_prometheus(snapshots: List[Dict]) -> str:
+    """Exposition-format rendering over an explicit snapshot list — the
+    piece the head's scrape endpoint (ISSUE 14) shares with the
+    driver-side ``prometheus_text()``: the head reads the ``_metrics`` KV
+    namespace directly instead of round-tripping through a worker."""
     lines_prefix = [
         "# HELP ray_tpu_cluster_up Dashboard liveness gauge.",
         "# TYPE ray_tpu_cluster_up gauge",
@@ -203,7 +211,7 @@ def prometheus_text() -> str:
         f"ray_tpu_collect_time_seconds {time.time():.3f}",
     ]
     merged: Dict[str, Dict] = {}
-    for snap in collect_cluster_metrics():
+    for snap in snapshots:
         cur = merged.setdefault(snap["name"], snap)
         if cur is snap:
             continue

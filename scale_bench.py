@@ -17,9 +17,17 @@ import json
 import time
 
 
-def bench_many_tasks(ray, n: int) -> dict:
+def bench_many_tasks(ray, n: int, quick: bool = False) -> dict:
     """n short tasks submitted at once: end-to-end completion rate
-    (reference: many_tasks — 10k tasks across the cluster)."""
+    (reference: many_tasks — 10k tasks across the cluster).
+
+    Also the flight-recorder disabled-path gate (ISSUE 14): the
+    instrumentation sites on the submit→reply hot path must cost ~zero
+    when ``task_event_sample_rate=0`` (the default this phase runs
+    under). The gate is deterministic — it times the ACTUAL disabled
+    guard (``events.overhead_probe``), multiplies by the per-task site
+    count, and asserts the total against the measured per-task budget —
+    instead of differencing two noisy end-to-end runs."""
 
     @ray.remote
     def noop():
@@ -31,9 +39,32 @@ def bench_many_tasks(ray, n: int) -> dict:
     submitted = time.perf_counter() - t0
     ray.get(refs, timeout=600)
     total = time.perf_counter() - t0
-    return {"n": n, "submit_s": round(submitted, 3),
-            "total_s": round(total, 3),
-            "tasks_per_s": round(n / total, 1)}
+    out = {"n": n, "submit_s": round(submitted, 3),
+           "total_s": round(total, 3),
+           "tasks_per_s": round(n / total, 1)}
+    from ray_tpu._private import events as _ev
+    from ray_tpu._private.config import CONFIG as _cfg
+
+    # ~8 disabled-guard hits per task round trip today (submit root
+    # check, record-event tc check, lease_wait, dispatch, worker-side
+    # exec/arg/return guards, reply flush check); 2x headroom
+    sites_per_task = 16
+    guard_ns = _ev.overhead_probe(100_000)
+    per_task_us = total / n * 1e6
+    overhead_pct = guard_ns * sites_per_task / 1000.0 / per_task_us * 100
+    out["events_disabled"] = {
+        "sample_rate": float(_cfg.task_event_sample_rate),
+        "guard_ns_per_site": round(guard_ns, 1),
+        "sites_per_task_budgeted": sites_per_task,
+        "overhead_pct_of_task": round(overhead_pct, 4),
+    }
+    if quick:
+        assert overhead_pct < 2.0, (
+            f"flight-recorder disabled path costs {overhead_pct:.2f}% of "
+            f"a many_tasks round trip (guard {guard_ns:.0f}ns x "
+            f"{sites_per_task} sites vs {per_task_us:.0f}us/task) — the "
+            "ISSUE 14 hard requirement is <2%")
+    return out
 
 
 def bench_many_actors(ray, n: int) -> dict:
@@ -1368,7 +1399,7 @@ def main(quick: bool = False) -> dict:
     try:
         results = {}
         results["many_tasks"] = bench_many_tasks(
-            ray_tpu, 2000 if quick else 10_000)
+            ray_tpu, 2000 if quick else 10_000, quick=quick)
         results["many_actors"] = bench_many_actors(
             ray_tpu, 200 if quick else 1000)
         results["pg_churn"] = bench_pg_churn(ray_tpu, 50 if quick else 200)

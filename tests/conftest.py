@@ -93,6 +93,7 @@ FAST_FILES = {
     "test_raylint.py",
     "test_direct_call.py",
     "test_data_shuffle.py",
+    "test_flight_recorder.py",
     # in FAST so tier-1 exercises the gate (its standalone failure used
     # to hide behind the `-m 'not slow'` deselection — ISSUE 11)
     "test_dryrun_gate.py",

@@ -1,0 +1,521 @@
+"""Cluster flight recorder (ISSUE 14): crash-durable span rings, trace
+propagation across transports (mux TCP + shm lanes), Chrome-trace/Perfetto
+timeline validity, the Prometheus scrape endpoint, read-your-writes event
+flushes, and the sampling-off zero-cost contract."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import events
+
+
+# ---------------------------------------------------------------------------
+# ring unit tests (no cluster)
+# ---------------------------------------------------------------------------
+def _armed_recorder(tmp_path, role="unit", slots=None):
+    rec = events.SpanRecorder()
+    if slots is not None:
+        os.environ["RAY_TPU_TASK_EVENT_RING_SLOTS"] = str(slots)
+    try:
+        assert rec.configure(str(tmp_path), role, sample_rate=1.0)
+    finally:
+        os.environ.pop("RAY_TPU_TASK_EVENT_RING_SLOTS", None)
+    return rec
+
+
+def test_ring_roundtrip_wrap_and_clip(tmp_path):
+    rec = _armed_recorder(tmp_path, slots=128)
+    tid, root = rec.new_trace()
+    rec.open_marker("exec::f", "exec", tid, root)
+    rec.record("exec::f", "exec", time.time(), 0.005, tid, root, 0,
+               {"task": "abc"})
+    info = events.read_ring(rec.path)
+    assert info["role"] == "unit" and info["pid"] == os.getpid()
+    assert info["recorded"] == 2 and len(info["spans"]) == 2
+    opens = [s for s in info["spans"] if s["dur_us"] < 0]
+    assert len(opens) == 1 and opens[0]["name"] == "exec::f"
+    # wrap: ring keeps exactly the newest <slots> records
+    for i in range(300):
+        rec.record(f"s{i}", "x", time.time(), 0.0, tid, rec.next_id(), 0)
+    info = events.read_ring(rec.path)
+    assert info["recorded"] == 302
+    assert len(info["spans"]) == 128
+    assert any(s["name"] == "s299" for s in info["spans"])
+    assert not any(s["name"] == "s0" for s in info["spans"])
+    # oversize extra is clipped, span itself survives
+    rec.record("big", "x", time.time(), 0.0, tid, rec.next_id(), 0,
+               {"blob": "v" * 4096})
+    assert rec.clipped == 1
+    last = events.read_ring(rec.path)["spans"][-1]
+    assert last["name"] == "big" and last["extra"] is None
+    # drain is incremental and bounded by the ring
+    drained = rec.drain()
+    assert len(drained) == 128 and rec.drain() == []
+    # recover_session finds the ring like a post-mortem would
+    rings = events.recover_session(str(tmp_path))
+    assert len(rings) == 1 and rings[0]["clipped"] == 1
+
+
+def test_disabled_recorder_records_nothing(tmp_path):
+    rec = events.SpanRecorder()
+    assert not rec.configure(str(tmp_path), "unit", sample_rate=0.0)
+    assert not rec.enabled and not rec.sample()
+    rec.record("x", "x", time.time(), 0.0, 1, 2)  # no ring -> no-op
+    assert rec.counter == 0
+    assert not os.path.exists(os.path.join(str(tmp_path), "events"))
+
+
+def test_disabled_guard_overhead_probe():
+    # sanity bound only — the calibrated <2%-of-task-budget assert lives
+    # in scale_bench's many_tasks gate where the task budget is measured
+    ns = events.overhead_probe(100_000)
+    assert ns < 1500, f"disabled guard costs {ns:.0f}ns/site"
+
+
+def test_chrome_trace_export_schema_unit():
+    tid = 0x123456
+    spans = [
+        {"trace": tid, "span": 1, "parent": 0, "name": "task::f",
+         "cat": "task", "ts_us": 1000, "dur_us": 500, "extra": None,
+         "role": "driver", "pid": 10, "node": "n1"},
+        {"trace": tid, "span": 2, "parent": 1, "name": "exec::f",
+         "cat": "exec", "ts_us": 1100, "dur_us": 300, "extra": None,
+         "role": "worker", "pid": 11, "node": "n1"},
+        # open marker superseded by its close must not double-render
+        {"trace": tid, "span": 2, "parent": 1, "name": "exec::f",
+         "cat": "exec", "ts_us": 1100, "dur_us": -1, "extra": None,
+         "role": "worker", "pid": 11, "node": "n1"},
+        # genuinely open marker renders as an instant
+        {"trace": tid, "span": 3, "parent": 1, "name": "exec::g",
+         "cat": "exec", "ts_us": 1200, "dur_us": -1, "extra": None,
+         "role": "worker", "pid": 12, "node": "n1"},
+    ]
+    out = events.to_chrome_trace(spans)
+    assert [e["ts"] for e in out] == sorted(e["ts"] for e in out)
+    assert {e["ph"] for e in out} <= {"X", "i", "M"}
+    xs = [e for e in out if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"task::f", "exec::f"}
+    opens = [e for e in out if e["ph"] == "i"]
+    assert len(opens) == 1 and opens[0]["name"] == "exec::g"
+    metas = [e for e in out if e["ph"] == "M"]
+    assert len(metas) == 3  # one process_name per (node, role, pid)
+
+
+# ---------------------------------------------------------------------------
+# cluster tests (sampling armed + scrape endpoint bound)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def traced_cluster():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    os.environ["RAY_TPU_TASK_EVENT_SAMPLE_RATE"] = "1"
+    os.environ["RAY_TPU_METRICS_EXPORT_PORT"] = str(port)
+    assert not ray_tpu.is_initialized()
+    ctx = ray_tpu.init(num_cpus=2)
+    yield ctx, port
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_TASK_EVENT_SAMPLE_RATE", None)
+    os.environ.pop("RAY_TPU_METRICS_EXPORT_PORT", None)
+
+
+def _spans(**filters):
+    w = ray_tpu._worker_mod.global_worker
+    w.flush_task_events(wait=True)
+    return w._acall(w.head.call("ListSpans", {"limit": 50000, **filters}))
+
+
+def _wait_for(pred, timeout=20.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        val = pred()
+        if val:
+            return val
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+
+def _named(spans, kind, fn=None):
+    """Match spans by phase kind and (optionally) function suffix — task
+    functions defined inside tests carry qualnames like
+    ``test_x.<locals>.add``, so exact-name matching is wrong."""
+    out = []
+    for sp in spans:
+        name = sp["name"]
+        if fn is None:
+            if name == kind:
+                out.append(sp)
+        elif name.startswith(kind + "::") and name.endswith(fn):
+            out.append(sp)
+    return out
+
+
+def test_task_phases_nest_under_one_trace(traced_cluster):
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    ref = add.remote(20, 22)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    task_hex = ref.id().task_id().hex()[:16]
+
+    def find_tree():
+        spans = _spans(task=task_hex)
+        roots = _named(spans, "task", "add")
+        if not roots:
+            return None
+        all_tr = _spans(trace=roots[0]["trace"])
+        # worker-side flush is paced; wait until exec phases landed
+        if (_named(all_tr, "exec", "add")
+                and _named(all_tr, "arg_resolve")
+                and _named(all_tr, "return_put")):
+            return all_tr
+        return None
+
+    spans = _wait_for(find_tree, what="full cross-process trace tree")
+    root = _named(spans, "task", "add")[0]
+    assert root["role"] == "driver"
+    lease = _named(spans, "lease_wait")[0]
+    assert lease["parent"] == root["span"]
+    execs = [s for s in _named(spans, "exec", "add")
+             if s["dur_us"] >= 0]
+    assert execs and execs[0]["role"] == "worker"
+    assert execs[0]["parent"] == root["span"]
+    assert execs[0]["trace"] == root["trace"]  # ONE shared trace id
+    for child in ("arg_resolve", "return_put"):
+        c = _named(spans, child)[0]
+        assert c["parent"] == execs[0]["span"]
+    # phases nest in time: exec inside the root slice
+    assert root["ts_us"] <= execs[0]["ts_us"]
+    assert (execs[0]["ts_us"] + execs[0]["dur_us"]
+            <= root["ts_us"] + root["dur_us"] + 50_000)
+
+
+def test_actor_call_trace_rides_shm_lane(traced_cluster):
+    from ray_tpu._private.shm_rpc import SHM_STATS
+
+    @ray_tpu.remote
+    class Echo:
+        def hi(self, x):
+            return x
+
+    a = Echo.remote()
+    ref = a.hi.remote("ping")
+    assert ray_tpu.get(ref, timeout=60) == "ping"
+    task_hex = ref.id().task_id().hex()[:16]
+    # same-node actor calls ride the shm doorbell lane by default
+    # (test_direct_call asserts the lane selection itself; here we assert
+    # the trace context SURVIVES that lane)
+    assert SHM_STATS["calls_out"] > 0
+
+    def find():
+        spans = _spans(task=task_hex)
+        roots = _named(spans, "actor_call", "hi")
+        if not roots:
+            return None
+        tr = _spans(trace=roots[0]["trace"])
+        if any(s["role"] == "worker" and s["dur_us"] >= 0
+               for s in _named(tr, "exec", "hi")):
+            return tr
+        return None
+
+    spans = _wait_for(find, what="actor-call trace across the shm lane")
+    root = _named(spans, "actor_call", "hi")[0]
+    ex = next(s for s in _named(spans, "exec", "hi") if s["dur_us"] >= 0)
+    assert ex["trace"] == root["trace"] and ex["parent"] == root["span"]
+    assert _named(spans, "enqueue_wait")
+
+
+def test_timeline_chrome_schema_and_read_your_writes(traced_cluster):
+    @ray_tpu.remote
+    def probe():
+        return 1
+
+    assert ray_tpu.get(probe.remote(), timeout=60) == 1
+    # NO sleep: flush_task_events(wait=True) inside timeline() must make
+    # the just-finished task visible (the old 50ms race is the bug)
+    tl = ray_tpu.timeline()
+    assert tl, "empty timeline"
+    finished = [e for e in tl if e.get("cat") == "task_state"
+                and e.get("args", {}).get("state") == "FINISHED"
+                and "probe" in str(e.get("name"))]
+    assert finished, "read-your-writes: FINISHED state missing"
+    last_ts = None
+    for e in tl:
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            assert key in e, f"chrome-trace event missing {key}: {e}"
+        assert e["ph"] in events._ALLOWED_PH
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e.get("dur", -1) >= 0
+        if last_ts is not None:
+            assert e["ts"] >= last_ts, "timeline not ts-monotonic"
+        last_ts = e["ts"]
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in tl)
+    assert any(e["ph"] == "X" and e["name"].startswith("task::")
+               for e in tl)
+    # and it round-trips through json (what Perfetto actually loads)
+    json.loads(json.dumps(tl))
+
+
+def test_prometheus_scrape_endpoint(traced_cluster):
+    ctx, port = traced_cluster
+    session_dir = ctx.address_info["session_dir"]
+    port_file = os.path.join(session_dir, "metrics_port")
+    _wait_for(lambda: os.path.exists(port_file), what="metrics_port file")
+    with open(port_file) as f:
+        assert int(f.read()) == port
+
+    def scrape():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers.get("Content-Type", "")
+                return r.read().decode()
+        except (ConnectionError, OSError):
+            return None
+
+    text = _wait_for(scrape, what="scrape endpoint")
+    assert "ray_tpu_cluster_up 1" in text
+    assert "# TYPE ray_tpu_collect_time_seconds gauge" in text
+    # head gauges ride the same pipeline; poll until a metrics tick ran
+    text = _wait_for(
+        lambda: (lambda t: t if "ray_tpu_gcs_nodes_alive" in t else None)(
+            scrape() or ""),
+        what="head gauges in scrape output")
+    assert "ray_tpu_gcs_task_events_buffered" in text
+
+
+def test_prometheus_scrape_404(traced_cluster):
+    _, port = traced_cluster
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_event_stats_and_cli_surfaces(traced_cluster, capsys):
+    @ray_tpu.remote
+    def traced_fn():
+        return 7
+
+    ref = traced_fn.remote()
+    assert ray_tpu.get(ref, timeout=60) == 7
+    w = ray_tpu._worker_mod.global_worker
+    w.flush_task_events(wait=True)
+    st = w._acall(w.head.call("GetEventStats", {}))
+    assert st["head"]["task_events_buffered"] > 0
+    assert st["nodes"], "no per-node flight-recorder stats"
+    node = next(iter(st["nodes"].values()))
+    assert node["flushes"] > 0 and node["spans"] > 0
+    # CLI `trace <task_id>` prints the cross-process tree
+    from ray_tpu.scripts import cli
+
+    task_hex = ref.id().task_id().hex()[:16]
+    _wait_for(lambda: _named(_spans(task=task_hex), "exec", "traced_fn"),
+              what="worker exec span flushed")
+
+    class Args:
+        task_id = task_hex
+
+    assert cli.cmd_trace(Args()) == 0
+    out = capsys.readouterr().out
+    assert "traced_fn" in out and "exec::" in out and "task::" in out
+    # CLI `status` renders the Events section off the same RPC
+    cli._print_events()
+    out = capsys.readouterr().out
+    assert "Events" in out and "head ring:" in out
+
+
+def test_kill9_worker_ring_recovered_from_disk(traced_cluster, tmp_path):
+    """The chaos contract: a kill -9'd worker's flight-recorder ring is
+    on disk mid-task, open exec marker included — no exit handler ran."""
+    ctx, _ = traced_cluster
+    session_dir = ctx.address_info["session_dir"]
+
+    @ray_tpu.remote
+    class Sleeper:
+        def pid(self):
+            return os.getpid()
+
+        def nap_marker(self, seconds):
+            time.sleep(seconds)
+            return "done"
+
+    a = Sleeper.remote()
+    pid = ray_tpu.get(a.pid.remote(), timeout=60)
+    ref = a.nap_marker.remote(60)
+
+    def exec_started():
+        try:
+            info = events.read_ring(os.path.join(
+                session_dir, "events", f"worker-{pid}.ring"))
+        except (FileNotFoundError, ValueError):
+            return None
+        return any(s["name"].endswith("nap_marker")
+                   for s in info["spans"])
+
+    _wait_for(exec_started, what="open exec marker in the worker ring")
+    # kill -9 through the chaos harness (no SIGTERM, no dump handler —
+    # the mmap IS the dump), pinned to the worker that is mid-task
+    from ray_tpu._private import lifecycle
+    from ray_tpu.util import chaos
+
+    killer = chaos.DaemonKiller(session_dir, roles=("worker",))
+    target = next(r for r in lifecycle.live_registered(session_dir)
+                  if r["pid"] == pid)
+    assert killer.kill_target(target)
+    _wait_for(lambda: not lifecycle._pid_alive(pid), what="worker death")
+    rings = events.recover_session(session_dir)
+    mine = [r for r in rings if r["pid"] == pid]
+    assert mine, f"no ring recovered for killed worker {pid}"
+    spans = mine[0]["spans"]
+    naps = [s for s in spans if s["name"].startswith("exec::")
+            and s["name"].endswith("nap_marker")]
+    open_exec = [s for s in naps if s["dur_us"] < 0]
+    closed_exec = [s for s in naps if s["dur_us"] >= 0]
+    assert open_exec and not closed_exec, (
+        "post-mortem must show the task OPEN at death")
+    # offline timeline over the rings (ray_tpu timeline --session)
+    from ray_tpu.scripts import cli
+
+    class Args:
+        session = session_dir
+        output = str(tmp_path / "postmortem.json")
+
+    assert cli.cmd_timeline(Args()) == 0
+    with open(Args.output) as f:
+        tl = json.load(f)
+    assert any(e["ph"] == "i" and e["name"].endswith("nap_marker")
+               and e.get("args", {}).get("open") for e in tl)
+    # cleanup: the actor is gone; make the driver forget it
+    try:
+        ray_tpu.kill(a)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# isolated-cluster tests (different env per cluster -> subprocess)
+# ---------------------------------------------------------------------------
+_SUBPROC_COMMON = """
+import os, sys, time
+import ray_tpu
+
+def wait_for(pred, timeout=30, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.25)
+    raise AssertionError("timed out: " + what)
+
+def spans(**filters):
+    w = ray_tpu._worker_mod.global_worker
+    w.flush_task_events(wait=True)
+    return w._acall(w.head.call("ListSpans", {"limit": 50000, **filters}))
+"""
+
+
+def _run_subproc(body, env=None):
+    full_env = dict(os.environ)
+    full_env["JAX_PLATFORMS"] = "cpu"
+    full_env.update(env or {})
+    proc = subprocess.run(
+        [sys.executable, "-c", _SUBPROC_COMMON + body],
+        capture_output=True, text=True, timeout=300, env=full_env)
+    assert proc.returncode == 0, (
+        f"subprocess failed:\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    return proc.stdout
+
+
+def test_trace_propagates_over_tcp_lane():
+    """Same assertion as the shm-lane test, with the shm doorbell lane
+    disabled: the trace context must ride the plain mux TCP stream
+    byte-identically (the spec wire IS the propagation)."""
+    _run_subproc("""
+ray_tpu.init(num_cpus=2)
+try:
+    from ray_tpu._private.shm_rpc import SHM_STATS
+
+    @ray_tpu.remote
+    class Echo:
+        def hi(self, x):
+            return x
+
+    a = Echo.remote()
+    ref = a.hi.remote("tcp")
+    assert ray_tpu.get(ref, timeout=60) == "tcp"
+    assert SHM_STATS["calls_out"] == 0, "shm lane should be disabled"
+    task_hex = ref.id().task_id().hex()[:16]
+
+    def find():
+        sp = spans(task=task_hex)
+        roots = [s for s in sp if s["name"].startswith("actor_call::")
+                 and s["name"].endswith("hi")]
+        if not roots:
+            return None
+        tr = spans(trace=roots[0]["trace"])
+        ex = [s for s in tr if s["name"].startswith("exec::")
+              and s["name"].endswith("hi")
+              and s["role"] == "worker" and s["dur_us"] >= 0]
+        return (roots[0], ex[0]) if ex else None
+
+    root, ex = wait_for(find, what="trace across TCP lane")
+    assert ex["trace"] == root["trace"] and ex["parent"] == root["span"]
+    print("TCP_LANE_OK")
+finally:
+    ray_tpu.shutdown()
+""", env={"RAY_TPU_TASK_EVENT_SAMPLE_RATE": "1",
+          "RAY_TPU_SHM_RPC_ENABLED": "0"})
+
+
+def test_sampling_zero_records_nothing_cluster():
+    """The default (sample_rate=0) leaves no trace anywhere: recorder
+    disarmed in every process, no ring files, no spans at the head —
+    while task state events and the timeline keep working."""
+    _run_subproc("""
+from ray_tpu._private import events
+ctx = ray_tpu.init(num_cpus=2)
+try:
+    sdir = ctx.address_info["session_dir"]
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(5)], timeout=60) \\
+        == [1] * 5
+    assert not events.REC.enabled
+    assert not os.path.exists(os.path.join(sdir, "events")), \\
+        os.listdir(os.path.join(sdir, "events"))
+    assert spans() == []
+    # legacy state-transition pairing still yields DURATION slices with
+    # the recorder disarmed (the pre-recorder timeline behavior), but no
+    # span-category events exist at all
+    tl = ray_tpu.timeline()
+    assert any(e["ph"] == "X" and e.get("cat") == "task_state"
+               for e in tl)
+    assert all(e.get("cat") in ("task_state", None) or e["ph"] == "M"
+               for e in tl), [e for e in tl if e.get("cat")
+                              not in ("task_state", None)][:3]
+    print("SAMPLING_ZERO_OK")
+finally:
+    ray_tpu.shutdown()
+""", env={"RAY_TPU_TASK_EVENT_SAMPLE_RATE": "0",
+          "RAY_TPU_METRICS_EXPORT_PORT": "0"})
