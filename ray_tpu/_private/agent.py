@@ -17,6 +17,7 @@ socket; remote agents and spilled-back submitters connect over TCP.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import subprocess
 import sys
@@ -252,6 +253,21 @@ class NodeAgent:
         # zero-copy array puts sealed on this node (device object plane)
         self._zero_copy_puts = 0
 
+        # object ownership ledger (ISSUE 15): hex -> {owner addr, creating
+        # task, sealed_at} recorded from ObjectSealed/WaitObjects; pruned
+        # on free and whenever a scan observes the object gone from the
+        # store. Feeds GetObjectRefs and the leak watchdog.
+        self._object_owners: Dict[str, Dict] = {}
+        # driver processes registered on this node (worker_id -> {addr,
+        # pid}); workers are in self.workers, but the DRIVER owns most
+        # objects and must be introspectable too. Pruned on disconnect.
+        self._driver_clients: Dict[str, Dict] = {}
+        # leak watchdog state: first-seen stamps of leak candidates and
+        # the last scan's confirmed suspects (CLI/metrics read these)
+        self._leak_candidates: Dict[str, float] = {}
+        self._leak_suspects: List[Dict] = []
+        self._leak_scans = 0
+
         # placement groups: (pg_id, bundle_index) -> reserved ResourceSet
         self._pg_bundles: Dict[Tuple[str, int], ResourceSet] = {}
         self._pg_available: Dict[Tuple[str, int], ResourceSet] = {}
@@ -278,6 +294,9 @@ class NodeAgent:
         spawn_tracked(self._worker_reaper_loop(), "agent-worker-reaper")
         spawn_tracked(self._node_stats_loop(), "agent-node-stats")
         spawn_tracked(self._head_watchdog_loop(), "agent-head-watchdog")
+        if float(CONFIG.object_leak_scan_interval_s) > 0:
+            # default-off: the watchdog only exists when the knob arms it
+            spawn_tracked(self._leak_watchdog_loop(), "agent-leak-watchdog")
         if _events.REC.enabled:
             spawn_tracked(self._events_flush_loop(), "agent-events-flush")
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
@@ -405,6 +424,7 @@ class NodeAgent:
         r("ListEvents", self._list_events)
         r("GetNodeStats", self._get_node_stats)
         r("ListStoreObjects", self._list_store_objects)
+        r("GetObjectRefs", self._get_object_refs)
         r("SetResource", self._set_resource)
         r("RestoreSpilled", self._restore_spilled)
         # remote agents
@@ -1171,6 +1191,15 @@ class NodeAgent:
     async def _register_client(self, conn: Connection, p: Dict) -> Dict:
         role = p.get("role")
         conn.meta["role"] = role
+        if role == "driver" and p.get("direct_addr"):
+            # drivers own most objects: keep their direct addr so the
+            # introspection plane (GetObjectRefs fan-out, leak watchdog)
+            # can read their ref tables like any worker's
+            client_id = p.get("worker_id") or f"driver-{p.get('pid', 0)}"
+            conn.meta["driver_id"] = client_id
+            self._driver_clients[client_id] = {
+                "direct_addr": dict(p["direct_addr"]),
+                "pid": p.get("pid", 0)}
         if role == "worker":
             worker_id = p["worker_id"]
             handle = self.workers.get(worker_id)
@@ -1214,6 +1243,9 @@ class NodeAgent:
         }
 
     async def _on_disconnect(self, conn: Connection) -> None:
+        driver_id = conn.meta.get("driver_id")
+        if driver_id:
+            self._driver_clients.pop(driver_id, None)
         worker_id = conn.meta.get("worker_id")
         if worker_id:
             handle = self.workers.get(worker_id)
@@ -1906,6 +1938,18 @@ class NodeAgent:
         self.store.on_sealed(hex_id, p["size"])
         if p.get("zero_copy"):
             self._zero_copy_puts += 1
+        owner = p.get("owner")
+        if owner:
+            # object ledger (ISSUE 15): remember who OWNS each sealed
+            # object (+ its creating task/callsite) so the leak watchdog
+            # can interrogate the owner later and attribution survives
+            # the owner row dropping (free in flight). Pruned on free
+            # and by the watchdog/stats scan when the object leaves the
+            # store.
+            self._object_owners[hex_id] = {
+                "owner": owner, "task": p.get("task") or "",
+                "callsite": p.get("callsite") or "",
+                "sealed_at": time.time()}
         for fut in self._object_waits.pop(hex_id, []):
             if not fut.done():
                 fut.set_result(True)
@@ -1922,6 +1966,13 @@ class NodeAgent:
         tc = p.get("tc")  # caller's trace context (sampled get)
         futs = {}
         for hex_id in ids:
+            waited_owner = owners.get(hex_id)
+            if waited_owner and hex_id not in self._object_owners:
+                # pulls announce owners too: a pulled copy on this node is
+                # leak-scannable even though it was sealed elsewhere
+                self._object_owners[hex_id] = {
+                    "owner": waited_owner, "task": "",
+                    "sealed_at": time.time()}
             if self.store.contains(hex_id):
                 continue
             fut = asyncio.get_running_loop().create_future()
@@ -2321,6 +2372,8 @@ class NodeAgent:
             # release the serve view (and its pin) before the store delete
             self._serve_view_cache.pop(hex_id, None)
             self.store.delete(hex_id)
+            self._object_owners.pop(hex_id, None)
+            self._leak_candidates.pop(hex_id, None)
 
     async def _pin_object(self, conn: Connection, p: Dict) -> None:
         self.store.pin(p["object_id"])
@@ -2423,6 +2476,21 @@ class NodeAgent:
             cutoff = time.monotonic() - 2 * period
             for hex_id in [h for h, e in cache.items() if e[1] < cutoff]:
                 cache.pop(hex_id, None)
+            # object-owner ledger prune (ISSUE 15): evictions bypass the
+            # FreeObjects handler, so without this tick the ledger would
+            # grow with cumulative traffic when the leak watchdog (whose
+            # scan also prunes) is disarmed — the default. Entries get a
+            # 30s settle window (a just-waited object may not be sealed
+            # yet); remote-tier objects are live and keep their entry.
+            if self._object_owners:
+                now_wall = time.time()
+                for hex_id, info in list(self._object_owners.items()):
+                    if now_wall - info.get("sealed_at", 0) < 30:
+                        continue
+                    if self.store.spill_tier(hex_id) == "remote" or \
+                            self.store.contains(hex_id):
+                        continue
+                    self._object_owners.pop(hex_id, None)
             try:
                 self.node_stats = await asyncio.to_thread(
                     self._sample_node_stats)
@@ -2603,6 +2671,23 @@ class NodeAgent:
                           "Control-plane bytes sent by this process.",
                           _rpc_stats["bytes_out"]),
                 ]
+                # object ownership ledger (ISSUE 15): store bytes by
+                # spill tier + the watchdog's current suspect count
+                tiers = self.store.tier_stats()
+                for tier, nbytes in (
+                        ("shm", tiers.get("shm_bytes",
+                                          store_stats.get("used", 0))),
+                        ("disk", tiers.get("disk_bytes", 0)),
+                        ("remote", tiers.get("remote_bytes", 0))):
+                    snaps.append(make_gauge_snapshot(
+                        "ray_tpu_store_bytes",
+                        "Object store bytes held, by spill tier.",
+                        nbytes,
+                        {"node_id": self.node_id, "tier": tier}))
+                snaps.append(gauge(
+                    "ray_tpu_object_leak_suspects",
+                    "Objects the leak watchdog currently flags.",
+                    len(self._leak_suspects)))
                 # per-resource availability (reference: resources gauge
                 # per resource name)
                 for rname, total_amt in self.resources.total.to_dict() \
@@ -2677,6 +2762,214 @@ class NodeAgent:
         limit = int(p.get("limit", 1000)) if isinstance(p, dict) else 1000
         return [dict(row, node_id=self.node_id)
                 for row in self.store.list_entries(limit)]
+
+    # ------------------------------------ object introspection (ISSUE 15)
+    def _introspect_targets(self) -> List[Dict]:
+        """Direct addrs of every local process with a ref table: the
+        registered drivers plus the live registered workers."""
+        targets: List[Dict] = []
+        seen = set()
+        for info in list(self._driver_clients.values()):
+            addr = info.get("direct_addr") or {}
+            key = (addr.get("host"), addr.get("port"))
+            if addr.get("port") and key not in seen:
+                seen.add(key)
+                targets.append(addr)
+        for w in list(self.workers.values()):
+            addr = w.direct_addr or {}
+            key = (addr.get("host"), addr.get("port"))
+            if (w.alive and w.registered.is_set() and addr.get("port")
+                    and key not in seen):
+                seen.add(key)
+                targets.append(addr)
+        return targets
+
+    async def _call_local_process(self, addr: Dict, payload: Dict):
+        client = await self.pool.get(addr["host"], addr["port"])
+        return await client.call(
+            "GetObjectRefs", payload,
+            timeout=CONFIG.object_introspect_timeout_s)
+
+    async def _gather_local_ref_dumps(self, limit: int) -> List[Dict]:
+        targets = self._introspect_targets()
+
+        async def one(addr: Dict) -> Dict:
+            try:
+                return await self._call_local_process(addr,
+                                                      {"limit": limit})
+            except Exception as e:
+                return {"error": f"{type(e).__name__}: {e}",
+                        "addr": {"host": addr.get("host"),
+                                 "port": addr.get("port")}}
+
+        return list(await asyncio.gather(*(one(a) for a in targets)))
+
+    async def _get_object_refs(self, conn: Connection, p) -> Dict:
+        """Node-wide object introspection: store tier usage + every local
+        process's ref tables with provenance + the watchdog's current
+        leak suspects. The head's ObjectSummary fans this out."""
+        p = p or {}
+        limit = int(p.get("limit", 10000))
+        objects = []
+        for row in self.store.list_entries(limit):
+            info = self._object_owners.get(row["object_id"])
+            row = dict(row, node_id=self.node_id)
+            if info:
+                row["owner"] = {"host": info["owner"].get("host"),
+                                "port": info["owner"].get("port")}
+                row["creator_task"] = info.get("task") or ""
+                row["creator_callsite"] = info.get("callsite") or ""
+            objects.append(row)
+        return {
+            "node_id": self.node_id,
+            "store": self.store.stats(),
+            "tiers": self.store.tier_stats(),
+            "objects": objects,
+            "processes": await self._gather_local_ref_dumps(limit),
+            "leak_suspects": list(self._leak_suspects),
+            "leak_scans": self._leak_scans,
+        }
+
+    async def _leak_watchdog_loop(self) -> None:
+        """Default-off leak scan (``object_leak_scan_interval_s`` > 0
+        arms it at boot): every interval, interrogate each big sealed
+        object's OWNER — an object whose owner reports zero local refs /
+        borrowers / task pins (or no longer knows it) yet that remains
+        unevicted past ``object_leak_grace_s`` is a leak suspect, as is a
+        borrower entry whose owner no longer lists the borrow."""
+        while not self._closing:
+            interval = float(CONFIG.object_leak_scan_interval_s)
+            await asyncio.sleep(interval if interval > 0 else 2.0)
+            if interval <= 0:
+                continue
+            try:
+                await self._scan_for_leaks()
+            except Exception:
+                logging.getLogger("ray_tpu").exception("leak scan failed")
+
+    async def _scan_for_leaks(self) -> List[Dict]:
+        min_bytes = int(CONFIG.object_leak_min_bytes)
+        grace = float(CONFIG.object_leak_grace_s)
+        now = time.time()
+        self._leak_scans += 1
+        all_entries = self.store.list_entries(100000)
+        # remote-tier entries hold no local bytes but ARE still live and
+        # restorable: keep their owner attribution, just don't scan them
+        present = {row["object_id"] for row in all_entries}
+        entries = {row["object_id"]: row for row in all_entries
+                   if row.get("tier") != "remote"}
+        # the ledger tracks only what the store still holds (any tier)
+        for hex_id in [h for h in self._object_owners if h not in present]:
+            self._object_owners.pop(hex_id, None)
+        # -- big sealed objects, batched one owner round trip per owner
+        by_owner: Dict[tuple, List[str]] = {}
+        owner_addr: Dict[tuple, Dict] = {}
+        for hex_id, row in entries.items():
+            if row["size_bytes"] < min_bytes:
+                continue
+            info = self._object_owners.get(hex_id)
+            if not info or not info.get("owner"):
+                continue
+            key = (info["owner"].get("host"), info["owner"].get("port"))
+            owner_addr[key] = info["owner"]
+            by_owner.setdefault(key, []).append(hex_id)
+        candidates: Dict[str, Dict] = {}
+
+        def add_candidate(key: str, row: Dict) -> None:
+            candidates[key] = row
+
+        for key, ids in by_owner.items():
+            try:
+                reply = await self._call_local_process(
+                    owner_addr[key], {"ids": ids})
+                refs = reply.get("refs", {})
+            except Exception:
+                # owner process gone: every big object it owned that the
+                # store still holds is orphaned by definition
+                for h in ids:
+                    add_candidate(h, {
+                        "object_id": h, "reason": "owner_unreachable",
+                        "size_bytes": entries[h]["size_bytes"],
+                        "tier": entries[h]["tier"],
+                        "pinned": bool(entries[h].get("pinned")),
+                        "callsite": "", "creator": ""})
+                continue
+            for h in ids:
+                v = refs.get(h) or {}
+                dropped = not v.get("owned") or v.get("state") == "freed"
+                zero_refs = (v.get("local_refs", 0) <= 0
+                             and v.get("borrowers", 0) <= 0
+                             and v.get("task_pins", 0) <= 0)
+                if not (dropped or zero_refs):
+                    continue
+                add_candidate(h, {
+                    "object_id": h,
+                    "reason": "owner_dropped" if dropped else "zero_refs",
+                    "size_bytes": entries[h]["size_bytes"],
+                    "tier": entries[h]["tier"],
+                    "pinned": bool(entries[h].get("pinned")),
+                    "callsite": v.get("callsite", ""),
+                    "creator": v.get("creator", "")})
+        # -- orphan borrowers: local borrow entries the owner forgot.
+        # Batched like the sealed-object pass: ONE ref_info RPC per
+        # distinct owner, not one per borrowed entry.
+        borrow_rows: Dict[tuple, List[Tuple[Dict, int]]] = {}
+        borrow_owner: Dict[tuple, Dict] = {}
+        for dump in await self._gather_local_ref_dumps(10000):
+            for row in dump.get("borrowed") or []:
+                owner = row.get("owner") or {}
+                if not owner.get("port"):
+                    continue
+                key = (owner.get("host"), owner.get("port"))
+                borrow_owner[key] = owner
+                borrow_rows.setdefault(key, []).append(
+                    (row, dump.get("pid", 0)))
+        for key, rows in borrow_rows.items():
+            ids = sorted({row["object_id"] for row, _pid in rows})
+            try:
+                reply = await self._call_local_process(
+                    borrow_owner[key], {"ids": ids})
+                refs = reply.get("refs") or {}
+            except Exception:
+                refs = {}
+            for row, pid in rows:
+                v = refs.get(row["object_id"]) or {}
+                if v.get("owned") and v.get("state") != "freed" \
+                        and v.get("borrowers", 0) > 0:
+                    continue
+                add_candidate("borrow:" + row["object_id"], {
+                    "object_id": row["object_id"],
+                    "reason": "orphan_borrow",
+                    "size_bytes": v.get("size_bytes", 0),
+                    "tier": "", "pinned": False,
+                    "callsite": v.get("callsite", ""),
+                    "creator": v.get("creator", ""),
+                    "borrower_pid": pid})
+        # -- grace accounting: a candidate first seen on an EARLIER scan
+        # and older than the grace graduates to suspect
+        for stale in [k for k in self._leak_candidates
+                      if k not in candidates]:
+            self._leak_candidates.pop(stale, None)
+        suspects: List[Dict] = []
+        for key, row in candidates.items():
+            first = self._leak_candidates.setdefault(key, now)
+            if first < now and now - first >= grace:
+                suspects.append(dict(row, age_s=round(now - first, 1)))
+        prev = {s["object_id"] + s["reason"] for s in self._leak_suspects}
+        self._leak_suspects = suspects
+        rec = _events.REC
+        if rec.enabled:
+            for s in suspects:
+                if s["object_id"] + s["reason"] in prev:
+                    continue  # already on the timeline
+                trace, span = rec.new_trace()
+                rec.record("leak_suspect", "object", now, 0.0, trace,
+                           span, 0,
+                           {"obj": s["object_id"][:16],
+                            "bytes": s["size_bytes"],
+                            "reason": s["reason"],
+                            "callsite": s.get("callsite", "")[:64]})
+        return suspects
 
     async def _set_resource(self, conn: Connection, p: Dict) -> Dict:
         """Dynamically re-declare a custom resource's total (reference:

@@ -112,6 +112,27 @@ _flag("object_spill_disk_max_bytes", 0)
 # (where the real NIC constraint does not exist).
 _flag("object_serve_bandwidth_bytes_ps", 0)
 
+# --- object ownership ledger + leak watchdog (ISSUE 15) ----------------------
+# Agent-side leak scan cadence in seconds. 0 (default) disarms the
+# watchdog entirely — no loop is spawned, ledger bookkeeping stays O(1)
+# dict writes per put. Armed, each scan interrogates the OWNER of every
+# sealed object above object_leak_min_bytes; an object whose owner
+# reports zero local refs / borrowers / task pins (or no longer knows
+# it) yet remains unevicted past object_leak_grace_s is flagged, as is
+# a borrow entry whose owner no longer lists the borrower.
+_flag("object_leak_scan_interval_s", 0.0)
+# Objects below this size are never leak-scanned (owner round trips are
+# per-owner-batched, but scanning kilobyte debris is pure noise).
+_flag("object_leak_min_bytes", 1024 * 1024)
+# How long a zero-ref sealed object may linger before it graduates from
+# candidate to suspect. 0 = flag on the second consecutive scan that
+# sees it (the free path is asynchronous; one scan of slack avoids
+# flagging frees in flight).
+_flag("object_leak_grace_s", 0.0)
+# Per-process deadline for GetObjectRefs introspection round trips
+# (memory debugger fan-out + watchdog owner interrogation).
+_flag("object_introspect_timeout_s", 10.0)
+
 # --- streaming data plane (ISSUE 12) -----------------------------------------
 # DataContext seeds its per-process defaults from these (env-overridable
 # like every flag); the streaming shuffle + executor read the context.

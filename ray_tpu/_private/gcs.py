@@ -58,6 +58,13 @@ ACTOR_RECOVERING = "RECOVERING"
 # tests and operators match on it EXACTLY (DeathContext.reason)
 LOST_DURING_HEAD_OUTAGE = "lost_during_head_outage"
 
+# Dead-entry cache caps (reference: maximum_gcs_dead_node_cached_count /
+# maximum_gcs_destroyed_actor_cached_count): dead nodes/actors stay
+# queryable for post-mortems, but churn must bound to live+cache, never
+# grow with cumulative cluster history (raylint R10).
+_DEAD_NODE_CACHE = 256
+_DEAD_ACTOR_CACHE = 1024
+
 
 class _RestoredConn:
     """Placeholder connection for entities restored from the durable
@@ -203,11 +210,18 @@ class HeadServer:
         self.session_dir = session_dir
         self.port = port
         self.server = RpcServer("head")
+        # dead entries are CACHED, not kept forever: pruned past
+        # _DEAD_NODE_CACHE / _DEAD_ACTOR_CACHE below (reference:
+        # maximum_gcs_dead_node_cached_count /
+        # maximum_gcs_destroyed_actor_cached_count) — node/actor churn
+        # must not grow the head with cumulative, rather than live, state
         self.nodes: Dict[str, NodeInfo] = {}
         # node_id -> highest fenced incarnation: dead incarnations may
         # never rejoin (their leases/objects were already declared lost)
         self.fenced_incarnations: Dict[str, int] = {}
-        # loop name -> restart count (ray_tpu_gcs_loop_restarts)
+        # loop name -> restart count (ray_tpu_gcs_loop_restarts); keyed
+        # by the ~6 static supervisor loop names, bounded by construction
+        # raylint: disable=R10 -- bounded: keys are the fixed loop names
         self.loop_restarts: Dict[str, int] = {}
         self.report_stats = {}
         self.actors: Dict[str, ActorInfo] = {}
@@ -727,6 +741,35 @@ class HeadServer:
                     bucket.discard(info.actor_id)
                     if not bucket:
                         self._actors_by_node.pop(info.node_id, None)
+            self._prune_dead_actors()
+
+    def _prune_dead_actors(self) -> None:
+        """Dead-actor cache cap (raylint R10): keep the most recent
+        ``_DEAD_ACTOR_CACHE`` DEAD actors for GetActor post-mortems and
+        evict the rest — an actor-churning job (the actor_scale bench
+        creates thousands) must not grow the head's table with every
+        actor that ever lived. O(n) scan only on the death that crosses
+        the cap."""
+        if self._actor_state_counts.get(ACTOR_DEAD, 0) <= _DEAD_ACTOR_CACHE:
+            return
+        dead = [a for a in self.actors.values() if a.state == ACTOR_DEAD]
+        # timeline[-1][0] is the death note's timestamp: evict oldest
+        dead.sort(key=lambda a: a.timeline[-1][0] if a.timeline else 0.0)
+        for victim in dead[:len(dead) - _DEAD_ACTOR_CACHE]:
+            self.actors.pop(victim.actor_id, None)
+            n = self._actor_state_counts.get(ACTOR_DEAD, 0) - 1
+            if n > 0:
+                self._actor_state_counts[ACTOR_DEAD] = n
+            else:
+                self._actor_state_counts.pop(ACTOR_DEAD, None)
+            if victim.name and self.named_actors.get(
+                    (victim.namespace, victim.name)) == victim.actor_id:
+                self.named_actors.pop((victim.namespace, victim.name), None)
+            bucket = self._actors_by_job.get(victim.owner_job)
+            if bucket is not None:
+                bucket.discard(victim.actor_id)
+                if not bucket:
+                    self._actors_by_job.pop(victim.owner_job, None)
 
     def _actor_set_node(self, info: ActorInfo, node_id: Optional[str]) -> None:
         if node_id == info.node_id:
@@ -982,6 +1025,7 @@ class HeadServer:
         r("ListActors", self._list_actors)
         r("KillActor", self._kill_actor)
         r("ListNodes", self._list_nodes)
+        r("ObjectSummary", self._object_summary)
         r("Subscribe", self._subscribe)
         r("Publish", self._publish)
         r("CreatePlacementGroup", self._create_placement_group)
@@ -1173,6 +1217,146 @@ class HeadServer:
             await node.conn.push("Drain", {})
         return {"ok": True}
 
+    # --------------------------------- object ownership ledger (ISSUE 15)
+    async def _gather_object_refs(self, limit: int) -> Dict[str, Dict]:
+        """Fan GetObjectRefs out to every alive agent. Per-request
+        clients (this is a debugger surface, not a hot path); a node
+        that fails to answer contributes an error entry, never a hang."""
+        from ray_tpu._private.protocol import AsyncRpcClient
+
+        alive = [(nid, n.addr) for nid, n in self.nodes.items()
+                 if n.alive and n.addr and n.addr.get("port")]
+
+        async def one(node_id: str, addr: Dict) -> Tuple[str, Dict]:
+            client = AsyncRpcClient()
+            try:
+                await client.connect_tcp(addr["host"], addr["port"])
+                reply = await client.call(
+                    "GetObjectRefs", {"limit": limit},
+                    timeout=CONFIG.object_introspect_timeout_s)
+                return node_id, reply
+            except Exception as e:
+                return node_id, {"error": f"{type(e).__name__}: {e}"}
+            finally:
+                try:
+                    await client.aclose()
+                except Exception:
+                    pass
+
+        return dict(await asyncio.gather(
+            *(one(nid, addr) for nid, addr in alive)))
+
+    async def _object_summary(self, conn: Connection, p) -> Dict:
+        """Cluster-wide object rollup: store bytes + ref tables of every
+        process on every node, grouped by node / callsite / creator /
+        tier (``ray_tpu memory``, util.state list/summarize_objects)."""
+        p = p or {}
+        group_by = p.get("group_by") or "node"
+        limit = int(p.get("limit", 10000))
+        nodes = await self._gather_object_refs(limit)
+
+        # join key: object hex -> (node, tier, pinned) from store entries
+        residency: Dict[str, Dict] = {}
+        for node_id, nd in nodes.items():
+            for row in nd.get("objects") or []:
+                residency.setdefault(row["object_id"], {
+                    "node_id": node_id, "tier": row.get("tier", ""),
+                    "pinned": bool(row.get("pinned")),
+                    "store_size": row.get("size_bytes", 0),
+                    "creator_task": row.get("creator_task", "")})
+
+        rows: List[Dict] = []
+        for node_id, nd in nodes.items():
+            for proc in nd.get("processes") or []:
+                for o in proc.get("owned") or []:
+                    res = residency.get(o["object_id"], {})
+                    rows.append({
+                        **o,
+                        "owner_node_id": node_id,
+                        "owner_pid": proc.get("pid", 0),
+                        "owner_worker_id": proc.get("worker_id", ""),
+                        "node_id": res.get("node_id", node_id),
+                        "tier": res.get("tier",
+                                        "inline" if o["state"] == "inline"
+                                        else ""),
+                        "pinned": res.get("pinned", False),
+                    })
+
+        out: Dict[str, Any] = {
+            "nodes": {
+                node_id: {
+                    "store": nd.get("store") or {},
+                    "tiers": nd.get("tiers") or {},
+                    "leak_suspects": nd.get("leak_suspects") or [],
+                    "leak_scans": nd.get("leak_scans", 0),
+                    "num_processes": len(nd.get("processes") or []),
+                    "error": nd.get("error"),
+                }
+                for node_id, nd in nodes.items()
+            },
+        }
+        # per-COPY attribution: every sealed byte on every node counts
+        # once per copy (a shard pulled to three reducers is three
+        # copies of store usage), and a copy is attributed when its
+        # object traces to an owner row or a recorded creating task —
+        # the "≥95% of used store bytes attributable" acceptance stat
+        owned_ids = {r["object_id"] for r in rows}
+        store_bytes = attributed_bytes = 0
+        for node_id, nd in nodes.items():
+            for row in nd.get("objects") or []:
+                if row.get("tier") == "remote":
+                    continue  # no local bytes: the copy lives elsewhere
+                sz = int(row.get("size_bytes") or 0)
+                store_bytes += sz
+                if row["object_id"] in owned_ids or row.get("creator_task") \
+                        or row.get("creator_callsite"):
+                    attributed_bytes += sz
+        out["attribution"] = {
+            "store_bytes": store_bytes,
+            "attributed_bytes": attributed_bytes,
+            "ratio": (attributed_bytes / store_bytes) if store_bytes else 1.0,
+        }
+        if p.get("detail"):
+            out["rows"] = rows[:limit]
+        if group_by == "tier":
+            groups: Dict[str, Dict] = {}
+            for node_id, nd in nodes.items():
+                for row in nd.get("objects") or []:
+                    g = groups.setdefault(row.get("tier") or "?", {
+                        "count": 0, "total_bytes": 0})
+                    g["count"] += 1
+                    g["total_bytes"] += int(row.get("size_bytes") or 0)
+        elif group_by == "node":
+            groups = {}
+            for node_id, nd in nodes.items():
+                store = nd.get("store") or {}
+                counts: Dict[str, int] = {}
+                for proc in nd.get("processes") or []:
+                    for k, v in (proc.get("counts") or {}).items():
+                        counts[k] = counts.get(k, 0) + v
+                groups[node_id] = {
+                    "count": int(store.get("num_objects") or 0),
+                    "total_bytes": int(store.get("used") or 0),
+                    "refs": counts,
+                    "leak_suspects": len(nd.get("leak_suspects") or []),
+                }
+        else:  # callsite | creator — owner-side provenance grouping
+            key = "callsite" if group_by == "callsite" else "creator"
+            groups = {}
+            for row in rows:
+                g = groups.setdefault(row.get(key) or "<unknown>", {
+                    "count": 0, "total_bytes": 0, "borrowers": 0,
+                    "task_pins": 0, "local_refs": 0, "pinned": 0})
+                g["count"] += 1
+                g["total_bytes"] += int(row.get("size_bytes") or 0)
+                g["borrowers"] += int(row.get("borrowers") or 0)
+                g["task_pins"] += int(row.get("task_pins") or 0)
+                g["local_refs"] += int(row.get("local_refs") or 0)
+                g["pinned"] += 1 if row.get("pinned") else 0
+        out["group_by"] = group_by
+        out["groups"] = groups
+        return out
+
     # ------------------------------------------- broadcast trees (ISSUE 9)
     async def _bcast_join(self, conn: Connection, p: Dict) -> Dict:
         return self.bcast.join(p["object_id"], p.get("size", 0),
@@ -1272,6 +1456,15 @@ class HeadServer:
                 actor.death_incarnation = node.incarnation
                 actor.note(f"node {node.node_id[:12]} died: {reason}")
                 await self._handle_actor_failure(actor, f"node died: {reason}")
+        # dead-node cache cap: the table must bound to live + recent dead
+        # (the fence map stays — fencing is a safety contract, and an int
+        # per ever-seen node_id is noise next to a NodeInfo)
+        dead = [n for n in self.nodes.values() if not n.alive]
+        if len(dead) > _DEAD_NODE_CACHE:
+            dead.sort(key=lambda n: n.last_heartbeat)
+            for victim in dead[:len(dead) - _DEAD_NODE_CACHE]:
+                self.nodes.pop(victim.node_id, None)
+                self.event_node_stats.pop(victim.node_id, None)
 
     async def _metrics_loop(self) -> None:
         """Publish head-level system gauges into the same KV pipeline the

@@ -247,6 +247,11 @@ class StoreDirectory:
         self._native_pins: Dict[str, Optional[memoryview]] = {}
         self._spilled: "OrderedDict[str, int]" = OrderedDict()  # disk tier
         self._remote: "OrderedDict[str, int]" = OrderedDict()   # remote tier
+        # native-arena deletes refused because a reader still pinned the
+        # object (C++ rc=-2): retried on later bookkeeping ops so the
+        # bytes free when the last view drops instead of lingering until
+        # LRU pressure — exact accounting for the memory debugger
+        self._deferred_deletes: set = set()
         # hex -> [addr]: holders known to keep a sealed copy (recorded by
         # the pull plane; survives local eviction so the remote tier can
         # point a restore pull at them)
@@ -257,8 +262,28 @@ class StoreDirectory:
         self.num_remote_demotions = 0
 
     # -- bookkeeping ---------------------------------------------------------
-    def on_sealed(self, object_id_hex: str, size: int) -> None:
+    def _retry_deferred_deletes(self) -> None:
+        """Native deletes refused while a reader pinned the object; the
+        pin is gone once the Python view dies, so retry cheaply from the
+        bookkeeping paths (no-op when the set is empty)."""
+        if not self._deferred_deletes:
+            return
         with self._lock:
+            for hex_id in list(self._deferred_deletes):
+                oid = ObjectID.from_hex(hex_id)
+                # settle on EITHER outcome: deleted now, or already gone
+                # (arena LRU beat us) — a not-found id must not park here
+                # forever re-paying a futile C call per bookkeeping op
+                if self.client.delete(oid) or not self.client.contains(oid):
+                    self._deferred_deletes.discard(hex_id)
+
+    def on_sealed(self, object_id_hex: str, size: int) -> None:
+        self._retry_deferred_deletes()
+        with self._lock:
+            # a re-seal (lineage recovery re-announce) revives the
+            # object: a deferred delete from its past life must not
+            # reap the new copy
+            self._deferred_deletes.discard(object_id_hex)
             self._remote.pop(object_id_hex, None)  # restored locally
             if object_id_hex in self._objects:
                 return
@@ -378,7 +403,13 @@ class StoreDirectory:
         with self._lock:
             size = self._objects.pop(object_id_hex, None)
             if size is not None:
-                self.client.delete(ObjectID.from_hex(object_id_hex))
+                oid = ObjectID.from_hex(object_id_hex)
+                deleted = self.client.delete(oid)
+                if self.native and not deleted and self.client.contains(oid):
+                    # a reader's pin refused the arena delete (still
+                    # present): retry once the view dies. A not-found
+                    # refusal (arena LRU already took it) needs nothing.
+                    self._deferred_deletes.add(object_id_hex)
                 self.used -= size
             if object_id_hex in self._spilled:
                 self._spilled.pop(object_id_hex)
@@ -394,6 +425,7 @@ class StoreDirectory:
                 self.client.release(ObjectID.from_hex(object_id_hex))
 
     def stats(self) -> Dict:
+        self._retry_deferred_deletes()
         if self.native:
             # arena-side numbers are authoritative (incl. its own evictions)
             st = dict(self.client.stats())
@@ -413,8 +445,15 @@ class StoreDirectory:
 
     def tier_stats(self) -> Dict:
         """Spill-tier breakdown (GetPullStats / CLI status / bench)."""
+        self._retry_deferred_deletes()
+        if self.native:
+            shm_bytes = int(self.client.stats().get("used", 0))
+        else:
+            with self._lock:
+                shm_bytes = sum(self._objects.values())
         with self._lock:
             return {
+                "shm_bytes": shm_bytes,
                 "shm_objects": len(self._objects),
                 "disk_objects": len(self._spilled),
                 "disk_bytes": sum(self._spilled.values()),

@@ -495,6 +495,8 @@ class RpcServer:
 
     def __init__(self, name: str = "server"):
         self.name = name
+        # raylint: disable=R10 -- bounded: keys are the method names
+        # registered at boot (add_handler), not per-traffic state
         self._handlers: Dict[str, Handler] = {}
         self._on_disconnect: Optional[Callable[[Connection], Awaitable[None]]] = None
         self._server: Optional[asyncio.AbstractServer] = None

@@ -23,6 +23,7 @@ import asyncio
 import hashlib
 import os
 import socket
+import sys
 import threading
 import time
 from collections import deque
@@ -82,13 +83,72 @@ def node_ip() -> str:
     return os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
 
 
+# Callsite interning (ISSUE 15): one tag string per (code object, line),
+# so the per-put cost after the first hit at a site is two dict probes.
+# Bounded by clear-on-cap rather than eviction — real programs have a
+# few hundred distinct put/remote sites, and a clear simply re-interns.
+_CALLSITE_CACHE: Dict[tuple, str] = {}
+_CALLSITE_CACHE_MAX = 4096
+_RAY_TPU_PKG_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _user_callsite(depth: int = 2) -> str:
+    """``module:qualname:line`` of the nearest stack frame OUTSIDE the
+    ray_tpu package — the user's ``put()``/``.remote()`` call, even when
+    it reached us through api/remote_function/data-plane layers. Falls
+    back to the innermost frame when everything is framework code (e.g.
+    internal shuffle puts: the data-plane callsite is still the right
+    attribution target). Never raises."""
+    try:
+        f = sys._getframe(depth)
+    except ValueError:
+        return "<unknown>"
+    inner = f
+    hops = 0
+    while f is not None and hops < 20:
+        if not f.f_code.co_filename.startswith(_RAY_TPU_PKG_DIR):
+            break
+        f = f.f_back
+        hops += 1
+    if f is None:
+        f = inner
+    # pre-3.12 comprehensions run in their own "<listcomp>"-style frame:
+    # fold into the enclosing function (same statement, readable name)
+    while (f.f_code.co_name in ("<listcomp>", "<dictcomp>", "<setcomp>",
+                                "<genexpr>")
+           and f.f_back is not None
+           and not f.f_back.f_code.co_filename.startswith(_RAY_TPU_PKG_DIR)):
+        f = f.f_back
+    code, line = f.f_code, f.f_lineno
+    key = (code, line)
+    tag = _CALLSITE_CACHE.get(key)
+    if tag is None:
+        mod = os.path.splitext(os.path.basename(code.co_filename))[0]
+        qual = getattr(code, "co_qualname", None) or code.co_name
+        tag = sys.intern(f"{mod}:{qual}:{line}")
+        if len(_CALLSITE_CACHE) >= _CALLSITE_CACHE_MAX:
+            _CALLSITE_CACHE.clear()
+        _CALLSITE_CACHE[key] = tag
+    return tag
+
+
 class OwnedObjectMeta:
-    __slots__ = ("state", "locations", "resolved_event")
+    __slots__ = ("state", "locations", "resolved_event",
+                 # creation provenance (ISSUE 15): who made this object,
+                 # where in the code, how big — the attribution the
+                 # memory debugger / leak watchdog group by
+                 "size", "created_at", "callsite", "creator", "creator_id")
 
     def __init__(self):
         self.state = "pending"  # pending | inline | plasma | error | freed
         self.locations: List[Dict] = []  # agent tcp addrs holding a copy
         self.resolved_event: Optional[asyncio.Event] = None
+        self.size = 0
+        self.created_at = 0.0
+        self.callsite = ""       # interned module:qualname:line
+        self.creator = ""        # "driver" | "task:<fn>" | "actor:<method>"
+        self.creator_id = ""     # creating task id hex ("" for driver puts)
 
 
 class ReferenceCounter:
@@ -105,11 +165,22 @@ class ReferenceCounter:
         self._is_borrower: Dict[bytes, Dict] = {}  # binary -> owner addr
 
     # -- ownership -----------------------------------------------------------
-    def register_owned(self, object_id: ObjectID) -> OwnedObjectMeta:
+    def register_owned(self, object_id: ObjectID,
+                       callsite: str = "", creator: str = "",
+                       creator_id: str = "",
+                       size: int = 0) -> OwnedObjectMeta:
+        """Idempotent; provenance fields are set on first registration
+        only (a later register of the same id — streaming re-push, lineage
+        re-execution — must not re-stamp created_at)."""
         with self._lock:
             meta = self._owned.get(object_id.binary())
             if meta is None:
                 meta = OwnedObjectMeta()
+                meta.created_at = time.time()
+                meta.callsite = callsite
+                meta.creator = creator
+                meta.creator_id = creator_id
+                meta.size = size
                 self._owned[object_id.binary()] = meta
             return meta
 
@@ -117,13 +188,22 @@ class ReferenceCounter:
         with self._lock:
             return self._owned.get(binary)
 
-    def set_resolved(self, binary: bytes, state: str, locations: Optional[List[Dict]] = None):
+    def set_resolved(self, binary: bytes, state: str,
+                     locations: Optional[List[Dict]] = None,
+                     size: Optional[int] = None):
         with self._lock:
             meta = self._owned.get(binary)
             if meta is None:
-                meta = OwnedObjectMeta()
-                self._owned[binary] = meta
+                # NEVER resurrect: a reply landing after every ref was
+                # dropped (free raced the task's completion) used to
+                # re-create the owned entry here — with no ref left to
+                # ever free it again, the entry (and its memory-store
+                # value, written by the caller) leaked forever. Found by
+                # the ISSUE 15 conftest ref-leak gate.
+                return
             meta.state = state
+            if size is not None:
+                meta.size = size
             if locations:
                 for loc in locations:
                     if loc not in meta.locations:
@@ -237,12 +317,70 @@ class ReferenceCounter:
                 "num_borrowed": len(self._is_borrower),
             }
 
+    # -- introspection (ISSUE 15) -------------------------------------------
+    def dump(self, limit: int = 10000) -> Dict:
+        """Snapshot of every ref table with provenance — the payload of
+        the ``GetObjectRefs`` RPC the memory debugger aggregates."""
+        with self._lock:
+            owned = []
+            for b, meta in list(self._owned.items())[:limit]:
+                owned.append({
+                    "object_id": b.hex(),
+                    "state": meta.state,
+                    "size_bytes": meta.size,
+                    "created_at": meta.created_at,
+                    "callsite": meta.callsite,
+                    "creator": meta.creator,
+                    "creator_id": meta.creator_id,
+                    "local_refs": self._local.get(b, 0),
+                    "borrowers": self._borrows.get(b, 0),
+                    "task_pins": self._task_pins.get(b, 0),
+                    "locations": len(meta.locations),
+                })
+            borrowed = [
+                {"object_id": b.hex(),
+                 "owner": dict(addr) if isinstance(addr, dict) else {},
+                 "local_refs": self._local.get(b, 0)}
+                for b, addr in list(self._is_borrower.items())[:limit]
+            ]
+            return {
+                "owned": owned,
+                "borrowed": borrowed,
+                "counts": {
+                    "owned": len(self._owned),
+                    "local_refs": len(self._local),
+                    "borrows": len(self._borrows),
+                    "task_pins": len(self._task_pins),
+                    "borrowed": len(self._is_borrower),
+                },
+            }
+
+    def ref_info(self, binaries: List[bytes]) -> Dict[str, Dict]:
+        """Per-id ownership verdict for the leak watchdog: does this
+        process still hold ANY reason for the object to exist?"""
+        out: Dict[str, Dict] = {}
+        with self._lock:
+            for b in binaries:
+                meta = self._owned.get(b)
+                out[b.hex()] = {
+                    "owned": meta is not None,
+                    "state": meta.state if meta is not None else "unknown",
+                    "local_refs": self._local.get(b, 0),
+                    "borrowers": self._borrows.get(b, 0),
+                    "task_pins": self._task_pins.get(b, 0),
+                    "callsite": meta.callsite if meta is not None else "",
+                    "creator": meta.creator if meta is not None else "",
+                    "size_bytes": meta.size if meta is not None else 0,
+                }
+        return out
+
 
 class TaskRecord:
     __slots__ = ("spec", "attempts", "return_ids", "future", "cancelled",
-                 "submitted_at", "completed", "streaming_gen")
+                 "submitted_at", "completed", "streaming_gen", "callsite")
 
-    def __init__(self, spec: TaskSpec, return_ids: List[ObjectID]):
+    def __init__(self, spec: TaskSpec, return_ids: List[ObjectID],
+                 callsite: str = ""):
         self.spec = spec
         self.attempts = 0
         self.return_ids = return_ids
@@ -251,6 +389,8 @@ class TaskRecord:
         self.submitted_at = time.time()
         # ObjectRefGenerator for num_returns=-1 streaming tasks
         self.streaming_gen = None
+        # submit-site tag: provenance for streaming yields registered later
+        self.callsite = callsite
 
 
 def _span_since(record: "TaskRecord", name: str) -> None:
@@ -421,10 +561,18 @@ class Worker:
                  lambda: self._n_puts),
                 ("ray_tpu_gets_total", "ray_tpu.get calls.",
                  lambda: self._n_gets),
-                ("ray_tpu_owned_objects",
-                 "Objects this driver currently owns.",
+                # object ownership ledger (ISSUE 15): canonical names the
+                # memory debugger / dashboards scrape (ray_tpu_owned_refs
+                # REPLACES the old ray_tpu_owned_objects — same value,
+                # one name)
+                ("ray_tpu_owned_refs",
+                 "Entries in this process's owned-object ledger.",
                  lambda: len(getattr(self.reference_counter, "_owned",
                                      ()) or ())),
+                ("ray_tpu_borrowed_refs",
+                 "Objects this process borrows from remote owners.",
+                 lambda: len(getattr(self.reference_counter,
+                                     "_is_borrower", ()) or ())),
                 ("ray_tpu_lease_pools",
                  "Distinct scheduling categories with live lease pools.",
                  lambda: len(self._lease_pools)),
@@ -814,6 +962,7 @@ class Worker:
         r("RemoveBorrow", self._handle_remove_borrow)
         r("ObjectLocationAdded", self._handle_location_added)
         r("StreamingReturn", self._handle_streaming_return)
+        r("GetObjectRefs", self._handle_get_object_refs)
         r("Ping", self._handle_ping)
         r("ShmAttach", self._handle_shm_attach)
         r("ShmDetach", handle_shm_detach)
@@ -843,7 +992,10 @@ class Worker:
         if record is None or record.streaming_gen is None:
             return {"accepted": False}
         oid = ObjectID.for_task_return(TaskID(task_binary), p["index"])
-        self.reference_counter.register_owned(oid)
+        self.reference_counter.register_owned(
+            oid, callsite=record.callsite,
+            creator="task:" + record.spec.function_name,
+            creator_id=record.spec.task_id.hex())
         self._resolve_return(oid, p["ret"])
         record.return_ids.append(oid)
         record.streaming_gen._push(ObjectRef(oid, self.direct_addr()))
@@ -851,6 +1003,26 @@ class Worker:
 
     async def _handle_ping(self, conn, p):
         return {"worker_id": self.worker_id.hex()}
+
+    async def _handle_get_object_refs(self, conn, p) -> Dict:
+        """Dump this process's ref tables (ISSUE 15). With ``ids`` the
+        reply is the leak watchdog's targeted per-id verdict; without,
+        the full provenance dump the memory debugger aggregates."""
+        p = p or {}
+        ids = p.get("ids")
+        if ids is not None:
+            binaries = []
+            for h in ids:
+                try:
+                    binaries.append(bytes.fromhex(h))
+                except ValueError:
+                    continue
+            return {"refs": self.reference_counter.ref_info(binaries)}
+        out = self.reference_counter.dump(
+            limit=int(p.get("limit", 10000)))
+        out.update({"worker_id": self.worker_id.hex(), "pid": os.getpid(),
+                    "mode": self.mode, "node_id": self.node_id})
+        return out
 
     async def _resolve_owned(self, binary: bytes, timeout: float) -> Optional[OwnedObjectMeta]:
         meta = self.reference_counter.get_owned_meta(binary)
@@ -1038,16 +1210,31 @@ class Worker:
         self.put_object(object_id, value)
         return ObjectRef(object_id, self.direct_addr())
 
+    def _current_creator(self) -> Tuple[str, str]:
+        """(creator tag, creating task id hex) for provenance: the task
+        executing on this thread, else the driver itself."""
+        info = self.current_task_info
+        tid = getattr(info, "task_id", None)
+        if tid is not None:
+            name = getattr(info, "task_name", "") or ""
+            return "task:" + name, tid.hex()
+        return "driver", ""
+
     def put_object(self, object_id: ObjectID, value: Any) -> None:
         rec = _events.REC
         trace = rec.new_trace() if rec.enabled and rec.sample() else None
         t0 = time.time() if trace is not None else 0.0
+        creator, creator_id = self._current_creator()
+        callsite = _user_callsite()
         sobj = self._serialize_value(value)
-        meta = self.reference_counter.register_owned(object_id)
         size = sobj.total_size()
+        self.reference_counter.register_owned(
+            object_id, callsite=callsite, creator=creator,
+            creator_id=creator_id, size=size)
         if size <= CONFIG.inline_object_max_size_bytes:
             self.memory_store.put(object_id.binary(), sobj.to_bytes(), False)
-            self.reference_counter.set_resolved(object_id.binary(), "inline")
+            self.reference_counter.set_resolved(
+                object_id.binary(), "inline", size=size)
         else:
             zero_copy = isinstance(sobj, ser.ZeroCopyArray)
             view, handle = self.store.create(object_id, size)
@@ -1056,15 +1243,20 @@ class Worker:
             # Fire-and-forget: the seal notification rides the agent socket
             # ahead of any later lease/pin request (frame order on one
             # connection preserves happens-before), so the blocking round
-            # trip the old path paid per put is unnecessary.
+            # trip the old path paid per put is unnecessary. The owner addr
+            # rides along so the leak watchdog (ISSUE 15) can ask the owner
+            # about any sealed object without a directory walk.
             self._post(self.agent.push_nowait,
                        "ObjectSealed", {"object_id": object_id.hex(),
                                         "size": used,
-                                        "zero_copy": zero_copy})
+                                        "zero_copy": zero_copy,
+                                        "owner": self.direct_addr(),
+                                        "callsite": callsite,
+                                        "task": creator_id})
             self.memory_store.put(object_id.binary(), b"", IN_PLASMA)
             self.reference_counter.set_resolved(
-                object_id.binary(), "plasma", [self.agent_tcp_addr]
-            )
+                object_id.binary(), "plasma", [self.agent_tcp_addr],
+                size=used)
         if trace is not None:
             rec.record("put", "object", t0, time.time() - t0,
                        trace[0], trace[1], 0,
@@ -1603,8 +1795,9 @@ class Worker:
             runtime_env=runtime_env,
             trace_ctx=self._trace_for_submit(),
         )
+        callsite = _user_callsite()
         if num_returns == -1:  # streaming generator
-            record = TaskRecord(spec, [])
+            record = TaskRecord(spec, [], callsite=callsite)
             from ray_tpu._private.streaming import ObjectRefGenerator
 
             record.streaming_gen = ObjectRefGenerator(task_id.hex())
@@ -1616,9 +1809,11 @@ class Worker:
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         refs = []
         for oid in return_ids:
-            self.reference_counter.register_owned(oid)
+            self.reference_counter.register_owned(
+                oid, callsite=callsite, creator="task:" + spec.function_name,
+                creator_id=task_id.hex())
             refs.append(ObjectRef(oid, self.direct_addr()))
-        record = TaskRecord(spec, return_ids)
+        record = TaskRecord(spec, return_ids, callsite=callsite)
         self._tasks[task_id.binary()] = record
         self._pin_args(spec)
         self._record_task_event(spec, "PENDING")
@@ -1663,13 +1858,16 @@ class Worker:
             max_retries=0,
             runtime_env={"language": language},
         )
+        callsite = _user_callsite()
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(num_returns)]
         refs = []
         for oid in return_ids:
-            self.reference_counter.register_owned(oid)
+            self.reference_counter.register_owned(
+                oid, callsite=callsite, creator="task:" + function_name,
+                creator_id=task_id.hex())
             refs.append(ObjectRef(oid, self.direct_addr()))
-        record = TaskRecord(spec, return_ids)
+        record = TaskRecord(spec, return_ids, callsite=callsite)
         self._tasks[task_id.binary()] = record
         self._record_task_event(spec, "PENDING")
         self._post(self._submit_to_pool_sync, record)
@@ -1764,17 +1962,43 @@ class Worker:
                 self._tasks.pop(spec.task_id, None)
 
     def _maybe_drop_streaming_record(self, record: TaskRecord) -> None:
-        """A completed streaming task whose yields were all freed already
-        (the for-loop consumption pattern frees each ref as it goes) gets
-        no later free event to drop its record — check now."""
-        def gone(oid: ObjectID) -> bool:
-            meta = self.reference_counter.get_owned_meta(oid.binary())
-            return meta is None or meta.state == "freed"
-
-        if all(gone(oid) for oid in record.return_ids):
-            self._tasks.pop(record.spec.task_id, None)
+        """Drop a COMPLETED streaming task's record unconditionally: the
+        executor acks every yield before the closing reply, so no more
+        StreamingReturn items can need routing, and streaming tasks have
+        no retry/lineage path that would reread the record. Keeping it
+        until every yield was freed (the old conditional) pinned an
+        ABANDONED generator forever: _tasks -> record -> generator ->
+        queued refs -> owned metas, a cycle anchored by the worker that
+        no gc pass may collect — the ISSUE 15 ref-leak gate caught a
+        replica-killed mid-stream call leaking exactly this way."""
+        self._tasks.pop(record.spec.task_id, None)
 
     def _resolve_return(self, oid: ObjectID, ret: Dict) -> None:
+        if self.reference_counter.get_owned_meta(oid.binary()) is None:
+            # every ref was dropped while the task ran: caching the value
+            # now would leak the entry (no-resurrect contract in
+            # set_resolved), and a plasma copy the executor already
+            # sealed would leak its BYTES — free it at its node
+            node_addr = ret.get("node_addr")
+            if ret.get("inline") is None and node_addr and self.connected:
+                hex_id = oid.hex()
+
+                async def free_orphan():
+                    try:
+                        if node_addr == self.agent_tcp_addr:
+                            await self.agent.call(
+                                "FreeObjects", {"ids": [hex_id]},
+                                timeout=CONFIG.control_rpc_timeout_s)
+                        else:
+                            client = await self._owner_client(node_addr)
+                            await client.call(
+                                "FreeObjects", {"ids": [hex_id]},
+                                timeout=CONFIG.control_rpc_timeout_s)
+                    except Exception:
+                        pass
+
+                self._spawn(free_orphan())
+            return
         if ret.get("xlang") is not None:
             # cross-language return (a C++ worker's msgpack payload):
             # re-encode with the local context so ray_tpu.get is uniform
@@ -1797,12 +2021,14 @@ class Worker:
             flags = EXC if ret.get("is_exception") else VAL
             self.memory_store.put(oid.binary(), ret["inline"], flags)
             self.reference_counter.set_resolved(
-                oid.binary(), "error" if flags == EXC else "inline"
+                oid.binary(), "error" if flags == EXC else "inline",
+                size=len(ret["inline"])
             )
         else:
             self.memory_store.put(oid.binary(), b"", IN_PLASMA)
             self.reference_counter.set_resolved(
-                oid.binary(), "plasma", [ret.get("node_addr")]
+                oid.binary(), "plasma", [ret.get("node_addr")],
+                size=int(ret.get("size") or 0)
             )
 
     def _count_task_failure(self) -> None:
@@ -1837,6 +2063,8 @@ class Worker:
         )
         data = self._serialize_value(err).to_bytes()
         for oid in record.return_ids:
+            if self.reference_counter.get_owned_meta(oid.binary()) is None:
+                continue  # ref dropped mid-flight: don't leak the error blob
             self.memory_store.put(oid.binary(), data, EXC)
             self.reference_counter.set_resolved(oid.binary(), "error")
         self._record_task_event(spec, "FAILED")
@@ -2102,6 +2330,21 @@ class Worker:
         st = self._actor_states.get(actor_id.binary())
         if st is not None:
             st.update(view, self)
+            if st.state == "DEAD":
+                self._prune_dead_actor_states()
+
+    def _prune_dead_actor_states(self, cap: int = 256) -> None:
+        """Caller-side dead-actor cache cap (raylint R10): a long-lived
+        driver churning actors must not keep a pipeline object for every
+        actor that ever died. DEAD states with nothing queued are safe to
+        drop — a late call through a surviving handle re-fetches the
+        (dead) view from the head and fails the same way."""
+        dead = [b for b, st in self._actor_states.items()
+                if st.state == "DEAD" and not st.queue and not st._retry_buf]
+        if len(dead) <= cap:
+            return
+        for b in dead[:len(dead) - cap]:
+            self._actor_states.pop(b, None)
 
     def actor_state_for(self, actor_id: ActorID) -> "_ActorState":
         st = self._actor_states.get(actor_id.binary())
@@ -2162,8 +2405,9 @@ class Worker:
             max_retries=max_retries,
             trace_ctx=self._trace_for_submit(),
         )
+        callsite = _user_callsite()
         if num_returns == -1:  # streaming actor method
-            record = TaskRecord(spec, [])
+            record = TaskRecord(spec, [], callsite=callsite)
             from ray_tpu._private.streaming import ObjectRefGenerator
 
             record.streaming_gen = ObjectRefGenerator(task_id.hex())
@@ -2174,9 +2418,11 @@ class Worker:
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         refs = []
         for oid in return_ids:
-            self.reference_counter.register_owned(oid)
+            self.reference_counter.register_owned(
+                oid, callsite=callsite, creator="actor:" + method_name,
+                creator_id=task_id.hex())
             refs.append(ObjectRef(oid, self.direct_addr()))
-        record = TaskRecord(spec, return_ids)
+        record = TaskRecord(spec, return_ids, callsite=callsite)
         self._tasks[task_id.binary()] = record
         self._pin_args(spec)
         self._post(st.enqueue, self, record)
@@ -2334,6 +2580,9 @@ class _LeasePool:
         # per-function exec EMAs: the pool-wide EMA sizes the pipeline,
         # but whether it is safe to stack behind a specific head-of-line
         # task depends on THAT function's history (see _conn_depth)
+        # raylint: disable=R10 -- bounded: one float per function NAME
+        # submitted through this scheduling key — grows with code, not
+        # traffic, and the pool itself dies with its idle TTL
         self._fn_ema: Dict[str, float] = {}
         self._reaper: Optional[asyncio.Task] = None
         self._pump_scheduled = False

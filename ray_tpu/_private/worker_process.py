@@ -441,7 +441,9 @@ class Executor:
                 self.worker._post(self.worker.agent.push_nowait,
                                   "ObjectSealed",
                                   {"object_id": oid.hex(), "size": used,
-                                   "zero_copy": _ser.is_zero_copy(view)})
+                                   "zero_copy": _ser.is_zero_copy(view),
+                                   "owner": spec.owner_addr,
+                                   "task": spec.task_id.hex()})
                 return {"plasma": True, "size": used,
                         "node_addr": self.worker.agent_tcp_addr}
         view, handle = self.worker.store.create(oid, size)
@@ -453,7 +455,12 @@ class Executor:
         self.worker._post(self.worker.agent.push_nowait,
                           "ObjectSealed",
                           {"object_id": oid.hex(), "size": used,
-                           "zero_copy": isinstance(sobj, _ser.ZeroCopyArray)})
+                           "zero_copy": isinstance(sobj, _ser.ZeroCopyArray),
+                           # owner addr + creating task: the agent's object
+                           # ledger (ISSUE 15) attributes every sealed byte
+                           # and the leak watchdog knows whom to interrogate
+                           "owner": spec.owner_addr,
+                           "task": spec.task_id.hex()})
         return {"plasma": True, "size": used,
                 "node_addr": self.worker.agent_tcp_addr}
 

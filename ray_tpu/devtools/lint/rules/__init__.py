@@ -22,6 +22,7 @@ from . import (
     r7_untracked_spawn,
     r8_config_knobs,
     r9_view_escape,
+    r10_grow_only,
 )
 
 ALL_RULES = [
@@ -34,6 +35,7 @@ ALL_RULES = [
     r7_untracked_spawn,
     r8_config_knobs,
     r9_view_escape,
+    r10_grow_only,
 ]
 
 RULES_BY_ID: Dict[str, object] = {m.RULE_ID: m for m in ALL_RULES}

@@ -201,11 +201,50 @@ def cmd_status(args) -> int:
         print(f"  {used:g}/{total[k]:g} {k}")
     _print_head_status()
     _print_events()
+    _print_object_plane()
     _print_data_plane()
     _print_data_pipelines()
     _print_worker_pool()
     _print_direct_call_plane()
     return 0
+
+
+def _print_object_plane() -> None:
+    """Object ownership rollup (ISSUE 15): per-node store bytes by tier,
+    cluster ref-table totals, and the leak watchdog's verdict."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        st = w.head_call("ObjectSummary", {"group_by": "node"}, timeout=15)
+    except Exception:
+        return  # older head without the RPC, or a head mid-bounce
+    nodes = st.get("nodes") or {}
+    if not nodes:
+        return
+    print("\nObject plane")
+    print("-" * 40)
+    total_refs: dict = {}
+    suspects = 0
+    for node_id, nd in sorted(nodes.items()):
+        if nd.get("error"):
+            print(f"  {str(node_id)[:12]}: unreachable")
+            continue
+        tiers = nd.get("tiers") or {}
+        store = nd.get("store") or {}
+        suspects += len(nd.get("leak_suspects") or [])
+        g = (st.get("groups") or {}).get(node_id) or {}
+        for k, v in (g.get("refs") or {}).items():
+            total_refs[k] = total_refs.get(k, 0) + v
+        print(f"  {str(node_id)[:12]}: {_fmt_bytes(store.get('used', 0))}"
+              f"/{_fmt_bytes(store.get('capacity', 0))} used   "
+              f"tiers shm {tiers.get('shm_objects', 0)} / "
+              f"disk {tiers.get('disk_objects', 0)} / "
+              f"remote {tiers.get('remote_objects', 0)}")
+    print(f"  refs: {total_refs.get('owned', 0)} owned, "
+          f"{total_refs.get('borrowed', 0)} borrowed, "
+          f"{total_refs.get('task_pins', 0)} task-pinned   "
+          f"leak suspects {suspects}")
 
 
 def _print_head_status() -> None:
@@ -500,11 +539,89 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
 def cmd_memory(args) -> int:
-    ray_tpu = _connect()
-    for n in ray_tpu.nodes():
-        print(f"node {n['node_id'][:12]}: "
-              f"object store {n.get('store_bytes_used', '?')} bytes used")
+    """Cluster memory debugger (ISSUE 15; reference: ``ray memory``):
+    every owned byte in the object plane attributed to the callsite /
+    task that created it, plus the leak watchdog's current suspects."""
+    from ray_tpu.util import state as state_api
+
+    _connect()
+    group_by = args.group_by
+    out = state_api.object_summary(group_by=group_by,
+                                   detail=group_by in ("callsite", "creator"),
+                                   limit=args.limit)
+    nodes = out.get("nodes") or {}
+    total_used = sum((nd.get("store") or {}).get("used", 0)
+                     for nd in nodes.values())
+    total_objs = sum((nd.get("store") or {}).get("num_objects", 0)
+                     for nd in nodes.values())
+    attr = out.get("attribution") or {}
+    print(f"Object store: {_fmt_bytes(total_used)} used across "
+          f"{len(nodes)} node(s), {total_objs} sealed object(s); "
+          f"{attr.get('ratio', 0):.0%} of copies attributed to a "
+          f"creating callsite/task")
+    for node_id, nd in sorted(nodes.items()):
+        if nd.get("error"):
+            print(f"  {node_id[:12]}: unreachable ({nd['error']})")
+            continue
+        tiers = nd.get("tiers") or {}
+        print(f"  {node_id[:12]}: "
+              f"shm {_fmt_bytes(tiers.get('shm_bytes', 0))} "
+              f"({tiers.get('shm_objects', 0)}) / "
+              f"disk {_fmt_bytes(tiers.get('disk_bytes', 0))} "
+              f"({tiers.get('disk_objects', 0)}) / "
+              f"remote {tiers.get('remote_objects', 0)}   "
+              f"processes {nd.get('num_processes', 0)}")
+
+    groups = out.get("groups") or {}
+    sort_key = {"bytes": "total_bytes", "count": "count"}[args.sort_by]
+    ordered = sorted(groups.items(),
+                     key=lambda kv: kv[1].get(sort_key, 0), reverse=True)
+    print(f"\nGrouped by {group_by} (top {args.limit}, by {args.sort_by})")
+    print("-" * 72)
+    if group_by in ("callsite", "creator"):
+        print(f"{'BYTES':>12} {'COUNT':>6} {'LOCAL':>6} {'BORROW':>6} "
+              f"{'PINS':>5} {group_by.upper()}")
+        for name, g in ordered[:args.limit]:
+            print(f"{_fmt_bytes(g['total_bytes']):>12} {g['count']:>6} "
+                  f"{g.get('local_refs', 0):>6} {g.get('borrowers', 0):>6} "
+                  f"{g.get('task_pins', 0):>5} {name}")
+    else:
+        print(f"{'BYTES':>12} {'COUNT':>6} {group_by.upper()}")
+        for name, g in ordered[:args.limit]:
+            print(f"{_fmt_bytes(g['total_bytes']):>12} {g['count']:>6} "
+                  f"{name}")
+    if not ordered:
+        print("  (no objects)")
+
+    if args.leaks:
+        print("\nLeak suspects")
+        print("-" * 72)
+        any_suspect = False
+        scans = 0
+        for node_id, nd in sorted(nodes.items()):
+            scans = max(scans, nd.get("leak_scans", 0))
+            for s in nd.get("leak_suspects") or []:
+                any_suspect = True
+                print(f"  {node_id[:12]} {s['object_id'][:16]} "
+                      f"{_fmt_bytes(s.get('size_bytes', 0)):>12} "
+                      f"{s.get('reason'):<18} age {s.get('age_s', 0)}s  "
+                      f"{s.get('callsite') or s.get('creator') or ''}")
+        if not any_suspect:
+            armed = scans > 0
+            print("  none" + ("" if armed else
+                              " (watchdog disarmed — set "
+                              "RAY_TPU_OBJECT_LEAK_SCAN_INTERVAL_S > 0 "
+                              "on node start to arm it)"))
     return 0
 
 
@@ -638,7 +755,24 @@ def main(argv=None) -> int:
     s.add_argument("task_id", help="task id hex (prefix ok)")
     s.set_defaults(fn=cmd_trace)
 
-    s = sub.add_parser("memory", help="object store usage")
+    s = sub.add_parser(
+        "memory",
+        help="cluster memory debugger: store bytes attributed to the "
+             "callsite/task that created them, plus leak suspects")
+    s.add_argument("--group-by", dest="group_by", default="callsite",
+                   choices=["node", "callsite", "creator", "tier"],
+                   help="attribution axis: creating callsite "
+                        "(module:qualname:line of the put()/.remote()), "
+                        "creating task/actor, residency tier, or node")
+    s.add_argument("--sort-by", dest="sort_by", default="bytes",
+                   choices=["bytes", "count"],
+                   help="order groups by total bytes (default) or count")
+    s.add_argument("--leaks", action="store_true",
+                   help="show the leak watchdog's current suspects "
+                        "(requires object_leak_scan_interval_s > 0 on "
+                        "the node agents)")
+    s.add_argument("--limit", type=int, default=20,
+                   help="rows per section (default 20)")
     s.set_defaults(fn=cmd_memory)
 
     s = sub.add_parser("metrics", help="Prometheus metrics dump")

@@ -172,6 +172,16 @@ class ProxyActor:
                     sid, routes = updates["routes"]
                     self._routes_snapshot = sid
                     self._routes = routes or {}
+                    # drop cached handles for apps no longer routed
+                    # (deleted/redeployed apps must not pin their old
+                    # handles — and their routers — forever; raylint
+                    # R10). Keyed by app, not (app, dep): the generic
+                    # handler fetches non-ingress deployments of LIVE
+                    # apps, and those caches stay warm across updates.
+                    live_apps = {app for app, _dep in self._routes.values()}
+                    for key in [k for k in self._handles
+                                if k[0] not in live_apps]:
+                        self._handles.pop(key, None)
             except Exception:
                 await asyncio.sleep(0.5)
 

@@ -182,10 +182,24 @@ def collect_cluster_metrics() -> List[Dict]:
     return out
 
 
+def _escape_label_value(v) -> str:
+    """Exposition-format label-value escaping (Prometheus text format
+    0.0.4): backslash, double-quote and newline must be escaped or a
+    value containing any of them corrupts every later line of the
+    scrape."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    """# HELP lines escape backslash and newline (but not quotes)."""
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt_tags(tag_list: List) -> str:
     if not tag_list:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in tag_list)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in tag_list)
     return "{" + inner + "}"
 
 
@@ -242,8 +256,14 @@ def render_prometheus(snapshots: List[Dict]) -> str:
     lines = list(lines_prefix)
     for snap in merged.values():
         name = snap["name"]
-        lines.append(f"# HELP {name} {snap['description']}")
-        lines.append(f"# TYPE {name} {snap['kind']}")
+        # conformance (ISSUE 15 satellite): HELP is escaped, TYPE falls
+        # back to "untyped" for unknown kinds rather than emitting a
+        # token Prometheus rejects
+        kind = snap["kind"] if snap["kind"] in (
+            "counter", "gauge", "histogram", "summary") else "untyped"
+        lines.append(
+            f"# HELP {name} {_escape_help(snap.get('description') or '')}")
+        lines.append(f"# TYPE {name} {kind}")
         if snap["kind"] == "histogram":
             for key, counts in snap.get("counts", []):
                 cum = 0

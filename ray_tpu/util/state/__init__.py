@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "list_actors", "list_nodes", "list_tasks", "list_placement_groups",
-    "list_jobs", "list_workers", "list_objects",
+    "list_jobs", "list_workers", "list_objects", "object_summary",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "get_node_stats", "profile_worker", "capture_jax_trace",
     "list_cluster_events",
@@ -137,9 +137,16 @@ def list_workers(filters=None, limit: int = 1000) -> List[Dict]:
     return _apply_filters(rows, filters)[:limit]
 
 
-def list_objects(filters=None, limit: int = 1000) -> List[Dict]:
-    """Objects resident in every node's store (reference:
-    util/state/api.py list_objects over core-worker object views)."""
+def list_objects(filters=None, limit: int = 1000,
+                 detail: bool = True) -> List[Dict]:
+    """Every owned object across the cluster with creation provenance —
+    callsite, creator task/actor, size, refs, residency tier (ISSUE 15;
+    reference: util/state/api.py list_objects over core-worker object
+    views). ``detail=False`` falls back to the raw per-node store
+    listing (no owner join — objects whose owner died still show)."""
+    if detail:
+        out = _call("ObjectSummary", {"detail": True, "limit": limit})
+        return _apply_filters(out.get("rows") or [], filters)[:limit]
     rows: List[Dict] = []
     for node in _each_alive_agent():
         try:
@@ -249,15 +256,25 @@ def capture_jax_trace(worker_id: str, duration_s: float = 2.0,
     return w._acall(go(), timeout=duration_s + 185)
 
 
-def summarize_objects() -> Dict[str, Any]:
-    """Totals by node (reference: ``ray summary objects``)."""
-    by_node: Dict[str, Dict[str, int]] = {}
-    for o in list_objects(limit=100000):
-        agg = by_node.setdefault(o["node_id"],
-                                 {"count": 0, "total_bytes": 0})
-        agg["count"] += 1
-        agg["total_bytes"] += int(o.get("size_bytes") or 0)
-    return by_node
+def summarize_objects(group_by: str = "node") -> Dict[str, Any]:
+    """Cluster object totals grouped by ``node`` / ``callsite`` /
+    ``creator`` / ``tier`` (reference: ``ray summary objects`` +
+    ``ray memory`` group-by; the head's ObjectSummary does the
+    fan-out + merge)."""
+    if group_by not in ("node", "callsite", "creator", "tier"):
+        raise ValueError(
+            f"group_by must be node|callsite|creator|tier, got {group_by!r}")
+    out = _call("ObjectSummary", {"group_by": group_by, "limit": 100000})
+    return out.get("groups") or {}
+
+
+def object_summary(group_by: str = "node", detail: bool = False,
+                   limit: int = 10000) -> Dict[str, Any]:
+    """Full ObjectSummary reply: per-node store/tier stats, leak
+    suspects, groups, and (with detail) per-object provenance rows —
+    what ``ray_tpu memory`` renders."""
+    return _call("ObjectSummary", {"group_by": group_by, "detail": detail,
+                                   "limit": limit})
 
 
 def summarize_tasks() -> Dict[str, Dict]:

@@ -519,6 +519,7 @@ def bench_broadcast(quick: bool = False) -> dict:
             ray_tpu.get([consumer(i).remote([warm])
                          for i in range(n_consumers)], timeout=120)
 
+            ledger_base = _ledger_probe()
             ref = produce.remote()
             ray_tpu.wait([ref], num_returns=1, timeout=120)
             t0 = time.perf_counter()
@@ -538,7 +539,7 @@ def bench_broadcast(quick: bool = False) -> dict:
             w = worker_mod.global_worker
             head_stats = w._acall(w.agent.call("GetPullStats", {}))
             lat = sorted(r["seconds"] for r in results)
-            return {
+            result = {
                 "consumers": n_consumers,
                 "wall_s": round(wall, 4),
                 "aggregate_gb_per_s": round(
@@ -552,6 +553,15 @@ def bench_broadcast(quick: bool = False) -> dict:
                 "fallbacks": sum(r["fallbacks"] for r in results),
                 "zero_copy_puts": head_stats["zero_copy_puts"],
             }
+            # ledger hygiene (ISSUE 15): dropping the broadcast ref must
+            # return the driver ledger + head store to their pre-run
+            # counts — a broadcast whose refs outlive it is a leak
+            del ref
+            result["post_run_ledger"] = _ledger_drain(ledger_base)
+            assert result["post_run_ledger"]["drained"], (
+                f"broadcast {mode} leaked past the run: "
+                f"{result['post_run_ledger']}")
+            return result
         finally:
             ray_tpu.shutdown()
             if cluster is not None:
@@ -1186,6 +1196,46 @@ def bench_serve_load(quick: bool = False) -> dict:
     return out
 
 
+def _ledger_probe() -> dict:
+    """Driver owned-ref count + head-node store bytes (ISSUE 15): the
+    baseline every exchange must return to once its refs drop."""
+    import gc
+
+    from ray_tpu._private import worker as wm
+
+    gc.collect()
+    w = wm.global_worker
+    rc = w.reference_counter
+    with rc._lock:
+        owned = len(rc._owned)
+    store = w._acall(w.agent.call("GetStoreStats", {}, timeout=15),
+                     timeout=20)
+    return {"owned": owned, "store_used": int(store.get("used", 0))}
+
+
+def _ledger_drain(base: dict, timeout: float = 30.0) -> dict:
+    """Poll until the ledger returns to ``base`` (frees ride async
+    RPCs). Catches the PR 12 'shard refs stay owned for the exchange's
+    lifetime' contract ever outliving the exchange."""
+    import gc
+    import time as _t
+
+    deadline = _t.monotonic() + timeout
+    cur = _ledger_probe()
+    while (cur["owned"] > base["owned"]
+           or cur["store_used"] > base["store_used"]) \
+            and _t.monotonic() < deadline:
+        gc.collect()
+        _t.sleep(0.25)
+        cur = _ledger_probe()
+    return {
+        "owned_delta": cur["owned"] - base["owned"],
+        "store_bytes_delta": cur["store_used"] - base["store_used"],
+        "drained": (cur["owned"] <= base["owned"]
+                    and cur["store_used"] <= base["store_used"]),
+    }
+
+
 def bench_data_shuffle(quick: bool = False) -> dict:
     """Streaming multi-node shuffle trajectory (ISSUE 12).
 
@@ -1279,6 +1329,7 @@ def bench_data_shuffle(quick: bool = False) -> dict:
             ctx.shuffle_map_remote_args = {"resources": {"src": 0.001}}
             ctx.shuffle_reduce_remote_args = {"resources": {"red": 0.001}}
             before = cluster_pull_totals()
+            ledger_base = _ledger_probe()
             ds = rd.from_blocks(make_blocks()).random_shuffle(
                 seed=11, num_blocks=R)
             t0 = time.perf_counter()
@@ -1310,6 +1361,14 @@ def bench_data_shuffle(quick: bool = False) -> dict:
                         rec["inflight_peak_mb"] = round(
                             ex["shuffle_inflight_peak_bytes"] / 1024
                             / 1024, 2)
+            # ledger hygiene (ISSUE 15): once the dataset is dropped,
+            # every shard ref the exchange held must drain and the store
+            # must return to its pre-run byte count
+            del ds
+            rec["post_run_ledger"] = _ledger_drain(ledger_base)
+            assert rec["post_run_ledger"]["drained"], (
+                f"{mode} shuffle leaked past its exchange: "
+                f"{rec['post_run_ledger']}")
             out[mode] = rec
         finally:
             ray_tpu.shutdown()

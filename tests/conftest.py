@@ -94,6 +94,7 @@ FAST_FILES = {
     "test_direct_call.py",
     "test_data_shuffle.py",
     "test_flight_recorder.py",
+    "test_memory_debugger.py",
     # in FAST so tier-1 exercises the gate (its standalone failure used
     # to hide behind the `-m 'not slow'` deselection — ISSUE 11)
     "test_dryrun_gate.py",
@@ -194,6 +195,74 @@ def lifecycle_leak_gate():
             "them is broken):\n  " + "\n  ".join(report))
     if failures:
         pytest.fail("\n".join(failures), pytrace=False)
+
+
+# ---------------------------------------------------------------------------
+# Object-ref leak gate (ISSUE 15): after each FAST-tier test, the driver
+# worker's ownership ledger must be drained — a test that exits with
+# owned objects, registered borrowers or task pins left behind is the
+# exact leak shape the watchdog exists to catch in production, and the
+# suite is where it is cheapest to find. Mirrors the session leak gate
+# above. Opt out per test/module with @pytest.mark.ref_leaks_ok (for
+# tests that intentionally hold refs past their end, e.g. module-scoped
+# caches); disable wholesale with RAY_TPU_REF_LEAK_CHECK=0.
+# ---------------------------------------------------------------------------
+@pytest.fixture(autouse=True)
+def object_ref_leak_gate(request):
+    yield
+    if os.environ.get("RAY_TPU_REF_LEAK_CHECK", "1") == "0":
+        return
+    if request.node.get_closest_marker("ref_leaks_ok") is not None:
+        return
+    if request.node.get_closest_marker("fast") is None:
+        return  # slow tier: long e2e flows manage refs across tests
+    import sys as _sys
+
+    wm = _sys.modules.get("ray_tpu._private.worker")
+    if wm is None:
+        return
+    w = wm.global_worker
+    if w is None or not w.connected or w.mode != w.MODE_DRIVER:
+        return
+    import gc as _gc
+    import time as _time
+
+    rc = w.reference_counter
+
+    def leaked():
+        with rc._lock:
+            owned = {b: m for b, m in rc._owned.items()
+                     if m.state != "freed"}
+            return owned, dict(rc._borrows), dict(rc._task_pins)
+
+    # refs die via ObjectRef.__del__ → remove_local_ref, and borrow /
+    # pin releases ride async RPCs: collect + give the plumbing a
+    # bounded window to settle before calling anything a leak
+    deadline = _time.monotonic() + 2.0
+    _gc.collect()
+    owned, borrows, pins = leaked()
+    while (owned or borrows or pins) and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        _gc.collect()
+        owned, borrows, pins = leaked()
+    if not (owned or borrows or pins):
+        return
+    lines = []
+    for b, meta in list(owned.items())[:20]:
+        lines.append(
+            f"  owned {b.hex()[:16]} state={meta.state} "
+            f"size={meta.size} creator={meta.creator or '?'} "
+            f"callsite={meta.callsite or '?'}")
+    for b, n in list(borrows.items())[:10]:
+        lines.append(f"  borrowers {b.hex()[:16]} count={n}")
+    for b, n in list(pins.items())[:10]:
+        lines.append(f"  task-pin {b.hex()[:16]} count={n}")
+    pytest.fail(
+        f"object refs leaked past the end of the test "
+        f"({len(owned)} owned / {len(borrows)} borrowed / "
+        f"{len(pins)} task-pinned). Drop the refs (or mark the test "
+        f"ref_leaks_ok with justification):\n" + "\n".join(lines),
+        pytrace=False)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,65 @@
+"""Frozen R10 shape: the grow-only ledger in a long-lived service class.
+
+The leak class behind several in-PR fixes (the agent demand ledger and
+pool waiters of PR 11, the GCS task-event list of PR 13, the owned-table
+resurrection ISSUE 15's ref-leak gate caught): a resident process keys a
+dict by per-traffic ids (objects, tasks, workers) and nothing ever
+prunes it, so memory grows with cumulative load, not live state.
+
+Must keep tripping R10 exactly on the marked lines; the bounded and
+pruned shapes below must stay clean.
+"""
+
+import asyncio
+
+
+class LeakyAgentShape:
+    """Service class (async while-loop marker) with three ledgers: one
+    grow-only (flagged), one pruned (clean), one escaping (clean)."""
+
+    def __init__(self):
+        self._seen_objects = {}  # expect-R10: grown per seal, never pruned
+        self._leases = {}        # pruned on release: clean
+        self._delegated = []     # handed to a pruner: clean
+        self._bounded = None     # reassigned wholesale: not an empty ctor
+
+    async def _service_loop(self):
+        while True:
+            await asyncio.sleep(1)
+
+    def on_sealed(self, hex_id, size):
+        self._seen_objects[hex_id] = size
+
+    def on_lease(self, lease_id, worker):
+        self._leases[lease_id] = worker
+
+    def on_release(self, lease_id):
+        self._leases.pop(lease_id, None)
+
+    def on_delegate(self, item, pruner):
+        self._delegated.append(item)
+        pruner(self._delegated)
+
+
+class ShortLivedShape:
+    """No service loop: a request-scoped object may accumulate freely."""
+
+    def __init__(self):
+        self._accumulator = {}
+
+    def add(self, k, v):
+        self._accumulator[k] = v
+
+
+_MODULE_LEDGER = {}  # expect-R10: module-level, grown in a service module
+_MODULE_PRUNED = {}
+
+
+def note(key, value):
+    _MODULE_LEDGER[key] = value
+
+
+def note_pruned(key, value):
+    _MODULE_PRUNED[key] = value
+    if len(_MODULE_PRUNED) > 64:
+        _MODULE_PRUNED.clear()

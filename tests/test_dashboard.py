@@ -165,7 +165,7 @@ def test_system_metric_breadth(dash_port):
         "ray_tpu_gcs_kv_entries",
         # driver core-worker
         "ray_tpu_tasks_submitted_total", "ray_tpu_puts_total",
-        "ray_tpu_gets_total", "ray_tpu_owned_objects",
+        "ray_tpu_gets_total", "ray_tpu_owned_refs",
     ]
     while time.time() < deadline:
         from ray_tpu.util.metrics import flush_now

@@ -97,6 +97,7 @@ def _expect_lines(fixture, rule):
     ("r1_memorystore_shape.py", "R1"),
     ("r4_leaked_task_shape.py", "R4"),
     ("r9_view_escape_shape.py", "R9"),
+    ("r10_grow_only_shape.py", "R10"),
 ])
 def test_fixture_trips_exactly_on_marked_lines(fixture, rule):
     path, expected = _expect_lines(fixture, rule)
