@@ -267,6 +267,9 @@ class NodeAgent:
         self._leak_candidates: Dict[str, float] = {}
         self._leak_suspects: List[Dict] = []
         self._leak_scans = 0
+        # repair hook (ISSUE 17): store copies freed after a graduated
+        # owner_unreachable / zero_refs verdict
+        self._leak_repairs = 0
 
         # placement groups: (pg_id, bundle_index) -> reserved ResourceSet
         self._pg_bundles: Dict[Tuple[str, int], ResourceSet] = {}
@@ -1936,6 +1939,11 @@ class NodeAgent:
     async def _object_sealed(self, conn: Connection, p: Dict) -> None:
         hex_id = p["object_id"]
         self.store.on_sealed(hex_id, p["size"])
+        if "replayable" in p:
+            # lineage hints (ISSUE 17): drive the store's lineage-aware
+            # eviction (prefer dropping cheap-to-replay copies)
+            self.store.note_lineage(hex_id, bool(p.get("replayable")),
+                                    float(p.get("exec_ms") or 0.0))
         if p.get("zero_copy"):
             self._zero_copy_puts += 1
         owner = p.get("owner")
@@ -2688,6 +2696,11 @@ class NodeAgent:
                     "ray_tpu_object_leak_suspects",
                     "Objects the leak watchdog currently flags.",
                     len(self._leak_suspects)))
+                snaps.append(gauge(
+                    "ray_tpu_object_leak_repairs_total",
+                    "Leaked store copies freed by the watchdog repair "
+                    "hook.",
+                    self._leak_repairs))
                 # per-resource availability (reference: resources gauge
                 # per resource name)
                 for rname, total_amt in self.resources.total.to_dict() \
@@ -2828,6 +2841,7 @@ class NodeAgent:
             "processes": await self._gather_local_ref_dumps(limit),
             "leak_suspects": list(self._leak_suspects),
             "leak_scans": self._leak_scans,
+            "leak_repairs": self._leak_repairs,
         }
 
     async def _leak_watchdog_loop(self) -> None:
@@ -2956,6 +2970,8 @@ class NodeAgent:
             if first < now and now - first >= grace:
                 suspects.append(dict(row, age_s=round(now - first, 1)))
         prev = {s["object_id"] + s["reason"] for s in self._leak_suspects}
+        if CONFIG.object_leak_repair_enabled:
+            self._repair_leaks(suspects, now)
         self._leak_suspects = suspects
         rec = _events.REC
         if rec.enabled:
@@ -2970,6 +2986,34 @@ class NodeAgent:
                             "reason": s["reason"],
                             "callsite": s.get("callsite", "")[:64]})
         return suspects
+
+    def _repair_leaks(self, suspects: List[Dict], now: float) -> None:
+        """Repair hook (ISSUE 17): a graduated ``owner_unreachable`` /
+        ``zero_refs`` suspect is garbage by definition — its owner can
+        never serve another pull (process gone) or holds no reference
+        that could reach the bytes again. Free the local store copy
+        instead of merely reporting it; the verdict already survived the
+        scan grace, so a transient owner blip cannot trip this.
+        ``orphan_borrow`` stays report-only: those bytes live in a remote
+        process's memory store, not this node's object store."""
+        rec = _events.REC
+        for s in suspects:
+            if s.get("reason") not in ("owner_unreachable", "zero_refs"):
+                continue
+            hex_id = s.get("object_id") or ""
+            if not hex_id or not (self.store.contains(hex_id)
+                                  or self.store.is_spilled(hex_id)):
+                continue
+            self.store.delete(hex_id)
+            self._object_owners.pop(hex_id, None)
+            self._leak_repairs += 1
+            s["repaired"] = True
+            if rec.enabled:
+                trace, span = rec.new_trace()
+                rec.record("leak_repair", "object", now, 0.0, trace, span,
+                           0, {"obj": hex_id[:16],
+                               "bytes": s.get("size_bytes", 0),
+                               "reason": s.get("reason", "")})
 
     async def _set_resource(self, conn: Connection, p: Dict) -> Dict:
         """Dynamically re-declare a custom resource's total (reference:

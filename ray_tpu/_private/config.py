@@ -251,7 +251,24 @@ _flag("task_max_retries_default", 3)
 _flag("actor_max_restarts_default", 0)
 _flag("health_check_period_ms", 3_000)
 _flag("health_check_failure_threshold", 5)
-_flag("max_lineage_bytes", 64 * 1024 * 1024)
+# --- lineage reconstruction (ISSUE 17) ---------------------------------------
+# Owner-side lineage ledger cap: serialized replayable task specs are
+# retained while any plasma return is still referenced, up to this many
+# bytes; past the cap the oldest records are evicted (their objects
+# become non-reconstructable, like the reference's
+# max_lineage_bytes / task_manager.h:202 evict-on-cap).
+_flag("lineage_max_bytes", 64 * 1024 * 1024)
+# Chain-reconstruction bounds: how deep a recursive argument-replay
+# chain may go, and how many times any single object may be
+# reconstructed, before a typed ObjectReconstructionFailedError
+# surfaces instead of resubmitting again.
+_flag("lineage_max_reconstruction_depth", 20)
+_flag("lineage_max_reconstruction_attempts", 3)
+# Leak-watchdog repair hook: when a suspect graduates with an
+# owner_unreachable / zero_refs verdict, the agent frees the store
+# copy instead of merely reporting it (the object is garbage — its
+# owner can never pull it again, or holds no reference to it).
+_flag("object_leak_repair_enabled", True)
 # Node fencing (partition tolerance): a node marked dead has its
 # incarnation fenced; a late re-register from that incarnation (the
 # partition healed) is rejected and the agent self-terminates, so no

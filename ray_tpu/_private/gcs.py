@@ -1282,6 +1282,16 @@ class HeadServer:
                         "pinned": res.get("pinned", False),
                     })
 
+        def lineage_rollup(nd: Dict) -> Dict[str, int]:
+            # each process dump carries its owner-side LineageLedger
+            # summary (ISSUE 17); the node view is the sum
+            lin = {"records": 0, "bytes": 0, "reconstructions": 0,
+                   "evictions": 0}
+            for proc in nd.get("processes") or []:
+                for k, v in (proc.get("lineage") or {}).items():
+                    lin[k] = lin.get(k, 0) + int(v or 0)
+            return lin
+
         out: Dict[str, Any] = {
             "nodes": {
                 node_id: {
@@ -1289,6 +1299,8 @@ class HeadServer:
                     "tiers": nd.get("tiers") or {},
                     "leak_suspects": nd.get("leak_suspects") or [],
                     "leak_scans": nd.get("leak_scans", 0),
+                    "leak_repairs": nd.get("leak_repairs", 0),
+                    "lineage": lineage_rollup(nd),
                     "num_processes": len(nd.get("processes") or []),
                     "error": nd.get("error"),
                 }
@@ -1346,13 +1358,17 @@ class HeadServer:
             for row in rows:
                 g = groups.setdefault(row.get(key) or "<unknown>", {
                     "count": 0, "total_bytes": 0, "borrowers": 0,
-                    "task_pins": 0, "local_refs": 0, "pinned": 0})
+                    "task_pins": 0, "local_refs": 0, "pinned": 0,
+                    "lineage": 0})
                 g["count"] += 1
                 g["total_bytes"] += int(row.get("size_bytes") or 0)
                 g["borrowers"] += int(row.get("borrowers") or 0)
                 g["task_pins"] += int(row.get("task_pins") or 0)
                 g["local_refs"] += int(row.get("local_refs") or 0)
                 g["pinned"] += 1 if row.get("pinned") else 0
+                # objects a lost copy of which the owner can rebuild by
+                # task replay (lineage record retained, ISSUE 17)
+                g["lineage"] += 1 if row.get("lineage") else 0
         out["group_by"] = group_by
         out["groups"] = groups
         return out

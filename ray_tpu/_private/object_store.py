@@ -256,10 +256,17 @@ class StoreDirectory:
         # the pull plane; survives local eviction so the remote tier can
         # point a restore pull at them)
         self._remote_sources: Dict[str, List[Dict]] = {}
+        # hex -> {"replayable": bool, "exec_ms": float EMA} lineage hints
+        # from ObjectSealed (ISSUE 17): replayable copies are preferred
+        # eviction victims (cheapest replay first), and — as the final
+        # tier — droppable with NO remote holder, because their owner
+        # rebuilds them by task replay on the next failed pull
+        self._lineage_info: Dict[str, Dict] = {}
         self.num_evictions = 0
         self.num_spills = 0
         self.num_restores = 0
         self.num_remote_demotions = 0
+        self.num_lineage_evictions = 0
 
     # -- bookkeeping ---------------------------------------------------------
     def _retry_deferred_deletes(self) -> None:
@@ -291,6 +298,27 @@ class StoreDirectory:
                 self._ensure_space(size)
             self._objects[object_id_hex] = size
             self.used += size
+
+    def note_lineage(self, object_id_hex: str, replayable: bool,
+                     exec_ms: float) -> None:
+        """Record the seal's lineage hints (ISSUE 17). Exec time is kept
+        as an EMA across re-seals (same 0.8/0.2 curve as the lease pools'
+        exec model) so a flaky first run doesn't mislabel a copy cheap."""
+        with self._lock:
+            info = self._lineage_info.get(object_id_hex)
+            if info is None:
+                self._lineage_info[object_id_hex] = {
+                    "replayable": bool(replayable),
+                    "exec_ms": float(exec_ms),
+                }
+            else:
+                info["replayable"] = bool(replayable)
+                info["exec_ms"] = 0.8 * info["exec_ms"] + 0.2 * float(exec_ms)
+
+    def lineage_replayable(self, object_id_hex: str) -> bool:
+        with self._lock:
+            info = self._lineage_info.get(object_id_hex)
+            return bool(info and info.get("replayable"))
 
     def note_remote_source(self, object_id_hex: str,
                            addrs: List[Dict]) -> None:
@@ -355,14 +383,16 @@ class StoreDirectory:
             resident = list(self._objects.items())[:limit]
             spilled = list(self._spilled.items())[:max(0, limit - len(resident))]
             pins = set(self._pins)
+            replayable = {h for h, info in self._lineage_info.items()
+                          if info.get("replayable")}
         rows = [
             {"object_id": h, "size_bytes": size, "pinned": h in pins,
-             "spilled": False, "tier": "shm"}
+             "spilled": False, "tier": "shm", "lineage": h in replayable}
             for h, size in resident if self.contains(h)
         ]
         rows += [
             {"object_id": h, "size_bytes": size, "pinned": False,
-             "spilled": True, "tier": "disk"}
+             "spilled": True, "tier": "disk", "lineage": h in replayable}
             for h, size in spilled
         ]
         with self._lock:
@@ -419,6 +449,7 @@ class StoreDirectory:
                     pass
             self._remote.pop(object_id_hex, None)
             self._remote_sources.pop(object_id_hex, None)
+            self._lineage_info.pop(object_id_hex, None)
             self._pins.pop(object_id_hex, None)
             if self.native and self._native_pins.pop(
                     object_id_hex, None) is not None:
@@ -432,6 +463,10 @@ class StoreDirectory:
             with self._lock:
                 st["num_spilled"] = len(self._spilled)
                 st["num_spills"] = self.num_spills
+                # deletes a reader pin refused (bytes still in the arena
+                # until the view dies): the first place to look when
+                # arena used > directory bytes — a leaked view upstream
+                st["deferred_deletes"] = sorted(self._deferred_deletes)
             return st
         with self._lock:
             return {
@@ -464,6 +499,8 @@ class StoreDirectory:
                 "num_restores": self.num_restores,
                 "num_remote_demotions": self.num_remote_demotions,
                 "num_evictions": self.num_evictions,
+                "num_lineage_evictions": self.num_lineage_evictions,
+                "lineage_hinted_objects": len(self._lineage_info),
             }
 
     # -- eviction / tiered spilling ------------------------------------------
@@ -479,16 +516,14 @@ class StoreDirectory:
                 f"object of size {size} exceeds store capacity {self.capacity}"
             )
         while self.used + size > self.capacity:
-            victim = None
-            for hex_id in self._objects:  # oldest first
-                if self._pins.get(hex_id, 0) == 0:
-                    victim = hex_id
-                    break
+            victim = self._pick_victim()
             if victim is not None:
                 vsize = self._objects.pop(victim)
                 self.client.delete(ObjectID.from_hex(victim))
                 self.used -= vsize
                 self.num_evictions += 1
+                if self._lineage_info.get(victim, {}).get("replayable"):
+                    self.num_lineage_evictions += 1
                 continue
             # Everything is pinned: spill the oldest pinned object to disk.
             if any(self._spill(hex_id) for hex_id in list(self._objects)):
@@ -499,10 +534,64 @@ class StoreDirectory:
             if any(self._demote_remote(hex_id)
                    for hex_id in list(self._objects)):
                 continue
+            # Final tier (ISSUE 17): drop a copy with NO remote holder
+            # but a live replayable lineage record — its owner rebuilds
+            # it by task replay when the next pull misses.
+            if any(self._drop_lineage_backed(hex_id)
+                   for hex_id in list(self._objects)):
+                continue
             raise ObjectStoreFullError(
                 f"store full ({self.used}/{self.capacity}) and nothing can "
-                "be evicted, spilled, or demoted to a remote holder"
+                "be evicted, spilled, demoted to a remote holder, or "
+                "dropped against a replayable lineage record"
             )
+
+    # bounded preference window: scanning the whole LRU per eviction
+    # would make eviction O(n^2) under churn
+    _LINEAGE_SCAN = 32
+
+    def _pick_victim(self) -> Optional[str]:
+        """Next shm eviction victim (caller holds the lock): LRU order,
+        but within a bounded window an unpinned copy whose lineage record
+        is live and CHEAP to replay (lowest exec-EMA) is preferred over
+        expensive or lineage-less copies (ISSUE 17) — losing it costs one
+        fast task replay instead of the object."""
+        first = None
+        best = None
+        best_ms = 0.0
+        scanned = 0
+        for hex_id in self._objects:  # oldest first
+            if self._pins.get(hex_id, 0):
+                continue
+            if first is None:
+                first = hex_id
+            info = self._lineage_info.get(hex_id)
+            if info is not None and info.get("replayable"):
+                ms = float(info.get("exec_ms", 0.0))
+                if best is None or ms < best_ms:
+                    best, best_ms = hex_id, ms
+            scanned += 1
+            if scanned >= self._LINEAGE_SCAN:
+                break
+        return best if best is not None else first
+
+    def _drop_lineage_backed(self, object_id_hex: str) -> bool:
+        """Last-resort demotion: delete a (possibly pinned) shm copy that
+        has no remote holder but IS rebuildable by its owner's lineage
+        replay. Memory-safe for pinned objects on the tmpfs backend (live
+        mmaps outlive the unlink), exactly like ``_demote_remote``."""
+        if self.native:
+            return False
+        info = self._lineage_info.get(object_id_hex)
+        if not info or not info.get("replayable"):
+            return False
+        size = self._objects.pop(object_id_hex, None)
+        if size is None:
+            return False
+        self.client.delete(ObjectID.from_hex(object_id_hex))
+        self.used -= size
+        self.num_lineage_evictions += 1
+        return True
 
     def _spill(self, object_id_hex: str) -> bool:
         if self.native:
@@ -546,8 +635,10 @@ class StoreDirectory:
     def _enforce_disk_cap(self) -> None:
         """Keep the disk tier under ``object_spill_disk_max_bytes`` by
         demoting its OLDEST entries with a known remote holder (drop the
-        file, keep the record). Entries without a source stay — they are
-        the only copy."""
+        file, keep the record). Entries without a source may still go if
+        a live replayable lineage record backs them (ISSUE 17: the owner
+        replays the producing task on the next failed pull); everything
+        else stays — it is the only copy."""
         cap = CONFIG.object_spill_disk_max_bytes
         if not cap:
             return
@@ -556,6 +647,16 @@ class StoreDirectory:
             if disk_bytes <= cap:
                 break
             if not self._remote_sources.get(hex_id):
+                info = self._lineage_info.get(hex_id)
+                if not (info and info.get("replayable")):
+                    continue
+                size = self._spilled.pop(hex_id)
+                try:
+                    os.unlink(os.path.join(self.spill_dir, hex_id))
+                except OSError:
+                    pass
+                self.num_lineage_evictions += 1
+                disk_bytes -= size
                 continue
             size = self._spilled.pop(hex_id)
             try:

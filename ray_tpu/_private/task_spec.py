@@ -42,6 +42,11 @@ _WIRE_FIELDS = (
     # across every transport for free — TCP, mux streams, and the shm
     # lane all carry the same per-call dict.
     "trace_ctx",
+    # lineage reconstruction (ISSUE 17): deterministic RNG seed stamped
+    # at first submission and replayed verbatim, so a reconstructed
+    # return is byte-identical to the original even when the task body
+    # draws randomness. None = task never seeded (pre-17 senders).
+    "replay_seed",
 )
 
 
@@ -84,6 +89,7 @@ class TaskSpec:
         runtime_env: Optional[Dict] = None,
         label_selector: Optional[Dict[str, str]] = None,
         trace_ctx: Optional[Tuple[int, int]] = None,
+        replay_seed: Optional[int] = None,
     ):
         self.task_id = task_id
         self.job_id = job_id
@@ -111,6 +117,7 @@ class TaskSpec:
         self.runtime_env = runtime_env
         self.label_selector = label_selector
         self.trace_ctx = trace_ctx
+        self.replay_seed = replay_seed
         self._wire = None
 
     def to_wire(self) -> Dict[str, Any]:

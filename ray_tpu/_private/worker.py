@@ -26,7 +26,8 @@ import socket
 import sys
 import threading
 import time
-from collections import deque
+import weakref
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import events as _events
@@ -59,6 +60,7 @@ from ray_tpu.exceptions import (
     GetTimeoutError,
     NodeDiedError,
     ObjectLostError,
+    ObjectReconstructionFailedError,
     RayActorError,
     RayTaskError,
     TaskCancelledError,
@@ -377,7 +379,8 @@ class ReferenceCounter:
 
 class TaskRecord:
     __slots__ = ("spec", "attempts", "return_ids", "future", "cancelled",
-                 "submitted_at", "completed", "streaming_gen", "callsite")
+                 "submitted_at", "completed", "streaming_gen", "callsite",
+                 "reconstructions")
 
     def __init__(self, spec: TaskSpec, return_ids: List[ObjectID],
                  callsite: str = ""):
@@ -391,6 +394,18 @@ class TaskRecord:
         self.streaming_gen = None
         # submit-site tag: provenance for streaming yields registered later
         self.callsite = callsite
+        # lineage reconstruction replays of this task (ISSUE 17), bounded
+        # by lineage_max_reconstruction_attempts — distinct from
+        # `attempts`, which counts failure retries
+        self.reconstructions = 0
+
+
+def _replay_seed(task_binary: bytes) -> int:
+    """Deterministic per-task RNG seed derived from the task id
+    (ISSUE 17): the same value rides every resubmission of the spec, so
+    a task body drawing randomness produces byte-identical returns on
+    lineage replay."""
+    return int.from_bytes(task_binary[:8], "little") & 0x7FFF_FFFF_FFFF_FFFF
 
 
 def _span_since(record: "TaskRecord", name: str) -> None:
@@ -406,6 +421,166 @@ def _span_since(record: "TaskRecord", name: str) -> None:
     rec.record(name, "task", record.submitted_at,
                max(0.0, now - record.submitted_at), tc[0], rec.next_id(),
                tc[1])
+
+
+class LineageLedger:
+    """Owner-side accounting for replayable task lineage (ISSUE 17;
+    reference: task_manager.h lineage pinning + max_lineage_bytes
+    evict-on-cap).
+
+    A completed NORMAL_TASK whose plasma returns are still referenced is
+    *retained*: its :class:`TaskRecord` stays in ``Worker._tasks`` and
+    its argument refs stay task-pinned, so the whole producing chain can
+    be replayed if a copy dies with a node. The ledger tracks, per
+    retained task, the serialized-spec byte cost and the set of
+    still-live return ids; a record is released (and its arg pins
+    dropped, cascading up the chain) when its LAST live output ref dies,
+    or evicted FIFO when total bytes exceed ``lineage_max_bytes`` —
+    evicted objects simply become non-reconstructable.
+    """
+
+    def __init__(self, worker: "Worker"):
+        self.worker = worker
+        # RLock: on_output_freed/discard run in GC context
+        # (ObjectRef.__del__ -> _free_owned) and may fire on the very
+        # thread already holding this lock mid-critical-section
+        self._lock = threading.RLock()
+        # task_binary -> {"size": int, "live": set of return binaries};
+        # insertion order = retention order = FIFO eviction order
+        self._entries: "OrderedDict[bytes, Dict]" = OrderedDict()
+        # replay observers (weak: a dead subscriber — a finished shuffle
+        # exchange, say — drops out on the next notify, no unregister
+        # protocol needed). Most losses resolve inside the owner's pull
+        # path now, so a layer that used to drive its own re-execution
+        # (and count it) has to HEAR about replays to keep its counters
+        # truthful.
+        self._listeners: List = []
+        self.bytes = 0
+        self.evictions = 0
+        self.reconstructions = 0
+
+    @staticmethod
+    def _estimate(spec: TaskSpec) -> int:
+        n = 512  # spec envelope (ids, resources, strategy, ...)
+        n += len(spec.function_blob or b"")
+        for entry in list(spec.args) + list(spec.kwargs.values()):
+            for part in entry:
+                if isinstance(part, (bytes, bytearray, memoryview)):
+                    n += len(part)
+        return n
+
+    def retain(self, record: TaskRecord, live_outputs: List[bytes]) -> bool:
+        """Idempotent: a reconstruction replay's second completion keeps
+        the first retention's live-output set (outputs freed meanwhile
+        must stay freed)."""
+        task_binary = record.spec.task_id
+        with self._lock:
+            if task_binary in self._entries:
+                return True
+            size = self._estimate(record.spec)
+            self._entries[task_binary] = {"size": size,
+                                          "live": set(live_outputs)}
+            self.bytes += size
+        self._enforce_cap()
+        return True
+
+    def is_retained(self, task_binary: bytes) -> bool:
+        with self._lock:
+            return task_binary in self._entries
+
+    def discard(self, task_binary: bytes) -> bool:
+        """Drop the ledger entry WITHOUT touching pins (callers that
+        still owe an unpin — terminal failure paths — follow up with one
+        ``_unpin_args``)."""
+        with self._lock:
+            ent = self._entries.pop(task_binary, None)
+            if ent is None:
+                return False
+            self.bytes -= ent["size"]
+        return True
+
+    def on_output_freed(self, task_binary: bytes, binary: bytes) -> str:
+        """One of the task's return refs died. Returns ``"keep"`` while
+        sibling outputs still anchor the record, ``"drop"`` when this was
+        the last (caller pops the record and unpins its args), or
+        ``"untracked"`` for non-lineage records."""
+        with self._lock:
+            ent = self._entries.get(task_binary)
+            if ent is None:
+                return "untracked"
+            ent["live"].discard(binary)
+            if ent["live"]:
+                return "keep"
+            self._entries.pop(task_binary, None)
+            self.bytes -= ent["size"]
+        return "drop"
+
+    def _enforce_cap(self) -> None:
+        cap = int(CONFIG.lineage_max_bytes)
+        victims: List[Tuple[bytes, Optional[TaskRecord]]] = []
+        with self._lock:
+            scanned, max_scan = 0, len(self._entries)
+            while self.bytes > cap and self._entries and scanned < max_scan:
+                task_binary, ent = self._entries.popitem(last=False)
+                scanned += 1
+                record = self.worker._tasks.get(task_binary)
+                if record is not None and not record.completed:
+                    # replay in flight: not evictable right now — rotate
+                    # to the back; a later retain() pass retries
+                    self._entries[task_binary] = ent
+                    continue
+                self.bytes -= ent["size"]
+                self.evictions += 1
+                victims.append((task_binary, record))
+        # pin release happens OUTSIDE the lock: unpinning cascades into
+        # _free_owned -> on_output_freed of upstream records
+        for task_binary, record in victims:
+            self.worker._tasks.pop(task_binary, None)
+            if record is not None:
+                self.worker._unpin_args(record.spec)
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(task_binary)`` to lineage resubmissions. Bound
+        methods are held weakly — the subscriber's death IS the
+        unsubscribe (the streaming shuffle registers per exchange and
+        never cleans up explicitly)."""
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            ref = (lambda f: (lambda: f))(fn)  # plain callable: hold it
+        with self._lock:
+            self._listeners.append(ref)
+
+    def notify_replay(self, task_binary: bytes) -> None:
+        """Tell subscribers a task was just resubmitted from lineage.
+        Runs on the recovery path — listener errors are swallowed, dead
+        weak refs are pruned in passing."""
+        with self._lock:
+            refs = list(self._listeners)
+        dead = []
+        for r in refs:
+            fn = r()
+            if fn is None:
+                dead.append(r)
+                continue
+            try:
+                fn(task_binary)
+            except Exception:
+                pass
+        if dead:
+            with self._lock:
+                self._listeners = [r for r in self._listeners
+                                   if r not in dead]
+
+    def task_hexes(self) -> set:
+        with self._lock:
+            return {tb.hex() for tb in self._entries}
+
+    def summary(self) -> Dict:
+        with self._lock:
+            return {"records": len(self._entries), "bytes": self.bytes,
+                    "reconstructions": self.reconstructions,
+                    "evictions": self.evictions}
 
 
 class WorkerConn:
@@ -460,6 +635,8 @@ class Worker:
         # submitter state (loop-owned)
         self._lease_pools: Dict[Tuple, "_LeasePool"] = {}
         self._tasks: Dict[bytes, TaskRecord] = {}
+        # replayable-lineage cap/accounting over _tasks (ISSUE 17)
+        self._lineage = LineageLedger(self)
         self._actor_states: Dict[bytes, "_ActorState"] = {}
         self._actor_sub_started = False
         # node_id -> {"incarnation", "reason", "time"}: death verdicts from
@@ -576,6 +753,16 @@ class Worker:
                 ("ray_tpu_lease_pools",
                  "Distinct scheduling categories with live lease pools.",
                  lambda: len(self._lease_pools)),
+                # lineage reconstruction (ISSUE 17)
+                ("ray_tpu_lineage_reconstructions_total",
+                 "Lost objects rebuilt by replaying their producing task.",
+                 lambda: self._lineage.reconstructions),
+                ("ray_tpu_lineage_bytes",
+                 "Bytes of replayable task specs retained for lineage.",
+                 lambda: self._lineage.bytes),
+                ("ray_tpu_lineage_evictions_total",
+                 "Lineage records evicted under lineage_max_bytes.",
+                 lambda: self._lineage.evictions),
                 # direct-call plane (ISSUE 11)
                 ("ray_tpu_mux_streams",
                  "Open streams across this driver's mux sessions.",
@@ -963,6 +1150,7 @@ class Worker:
         r("ObjectLocationAdded", self._handle_location_added)
         r("StreamingReturn", self._handle_streaming_return)
         r("GetObjectRefs", self._handle_get_object_refs)
+        r("ReconstructObject", self._handle_reconstruct_object)
         r("Ping", self._handle_ping)
         r("ShmAttach", self._handle_shm_attach)
         r("ShmDetach", handle_shm_detach)
@@ -1020,9 +1208,43 @@ class Worker:
             return {"refs": self.reference_counter.ref_info(binaries)}
         out = self.reference_counter.dump(
             limit=int(p.get("limit", 10000)))
+        # lineage annotations (ISSUE 17): per-object "is the producing
+        # task's record retained" + the ledger totals the memory
+        # debugger's lineage column renders
+        retained = self._lineage.task_hexes()
+        for row in out.get("owned", ()):
+            row["lineage"] = row.get("creator_id", "") in retained
         out.update({"worker_id": self.worker_id.hex(), "pid": os.getpid(),
-                    "mode": self.mode, "node_id": self.node_id})
+                    "mode": self.mode, "node_id": self.node_id,
+                    "lineage": self._lineage.summary()})
         return out
+
+    async def _handle_reconstruct_object(self, conn, p) -> Dict:
+        """A borrower's pull failed and it asks us — the owner — to
+        replay the producing chain (ISSUE 17; reference:
+        object_recovery_manager.h borrower->owner recovery RPC). Nothing
+        here blocks: a successful recovery is a resubmit, and the caller
+        re-resolves the object once the replay seals it."""
+        p = p or {}
+        try:
+            binary = bytes.fromhex(p["object_id"])
+        except (KeyError, ValueError, TypeError):
+            return {"status": "no_lineage", "reason": "malformed object id",
+                    "chain": []}
+        ref = ObjectRef(ObjectID(binary), self.direct_addr())
+        chain: List[Dict] = []
+        try:
+            ok = self._recover_chain(ref, int(p.get("attempt", 1)), 0, chain)
+        except ObjectLostError as e:
+            return {"status": "no_lineage",
+                    "reason": getattr(e, "reason", "") or str(e),
+                    "chain": list(getattr(e, "chain", None) or chain)}
+        if not ok:
+            return {"status": "no_lineage",
+                    "reason": "task opted out of lineage reconstruction "
+                              "(max_retries=0) or retry budget exhausted",
+                    "chain": chain}
+        return {"status": "resubmitted", "chain": chain}
 
     async def _resolve_owned(self, binary: bytes, timeout: float) -> Optional[OwnedObjectMeta]:
         meta = self.reference_counter.get_owned_meta(binary)
@@ -1131,6 +1353,9 @@ class Worker:
             "incarnation": msg.get("incarnation", 0),
             "reason": msg.get("reason", ""),
             "time": msg.get("time") or time.time(),
+            # agent addr: lets lineage recovery match an object's known
+            # locations (host/port dicts) against death verdicts
+            "addr": dict(msg.get("addr") or {}),
         }
         addr = msg.get("addr") or {}
         if addr.get("host") is not None and addr.get("port") is not None:
@@ -1418,7 +1643,7 @@ class Worker:
                 value = self._get_from_plasma(ref, deadline, tc=tc)
                 if value is _LOST:
                     attempt += 1
-                    if not self._try_recover(ref, attempt):
+                    if not self._recover_lost_object(ref, attempt, tc=tc):
                         raise ObjectLostError(ref.hex())
                     continue
                 result = value
@@ -1575,52 +1800,200 @@ class Worker:
                 pass
 
     def recover_task_returns(self, ref: ObjectRef) -> bool:
-        """Lineage re-execution for a MULTI-return task: reset every
-        return of the task that produced ``ref`` and resubmit it once
-        under the SAME task id (so all return object ids stay stable).
+        """Lineage re-execution of the task that produced ``ref`` (every
+        return is reset and the task resubmitted once under the SAME task
+        id, so all return object ids stay stable). Thin wrapper over the
+        general chain machinery kept for callers that want a bool, never
+        an exception (the streaming shuffle's fresh-dispatch fallback)."""
+        try:
+            return self._recover_chain(ref, 1, 0, [])
+        except ObjectLostError:
+            return False
 
-        ``_try_recover`` resets only the one object handed to it — for a
-        task with ``num_returns=R`` (the streaming shuffle's per-shard
-        map outputs) that leaves the sibling returns pointing at dead
-        locations, and a second consumer hitting a different shard would
-        resubmit the task again. Here the caller (e.g. the shuffle
-        operator's shuffle-scoped recovery) re-executes the whole task
-        exactly once per loss event."""
-        record = self._tasks.get(ref.id().task_id().binary())
-        if record is None or record.spec.task_type != NORMAL_TASK:
-            return False
-        if record.spec.max_retries <= 0:
-            return False
+    def _try_recover(self, ref: ObjectRef, attempt: int) -> bool:
+        """Lineage reconstruction of one owned object (reference:
+        src/ray/core_worker/object_recovery_manager.h). Propagates
+        :class:`ObjectReconstructionFailedError` when the lineage path
+        was taken and is truly exhausted; returns False when the task
+        opted out (max_retries=0) or the retry budget is spent."""
+        return self._recover_chain(ref, attempt, 0, [])
+
+    def _location_dead(self, loc: Optional[Dict]) -> bool:
+        """Is this object location (an agent host/port addr) on a node
+        the GCS has declared dead? Unknown locations count as live — the
+        pull path is the authority for those; this only pre-triggers
+        chain replay for copies we KNOW died."""
+        if not loc:
+            return True
+        for info in self._dead_nodes.values():
+            addr = info.get("addr") or {}
+            if addr and addr.get("host") == loc.get("host") \
+                    and addr.get("port") == loc.get("port"):
+                return True
+        return False
+
+    def _recover_chain(self, ref: ObjectRef, attempt: int, depth: int,
+                       chain: List[Dict]) -> bool:
+        """Resubmit the task that created ``ref``, first recursively
+        replaying any owned plasma ARGUMENT whose every known copy died
+        with its node (ISSUE 17 chained replay). ``chain`` accumulates
+        the replayed hops (outermost first) and rides the typed error so
+        a failed reconstruction shows how far it got. Arguments borrowed
+        from other owners recover lazily instead: the executor's pull
+        fails and asks THAT owner via ReconstructObject."""
+        binary = ref.binary()
+        task_binary = ref.id().task_id().binary()
+        hex_id = ref.hex()
+        depth_cap = int(CONFIG.lineage_max_reconstruction_depth)
+        if depth >= depth_cap:
+            chain.append({"object_id": hex_id, "task": task_binary.hex(),
+                          "why": "depth cap"})
+            raise ObjectReconstructionFailedError(
+                hex_id,
+                f"lineage chain exceeds lineage_max_reconstruction_depth="
+                f"{depth_cap}", chain)
+        record = self._tasks.get(task_binary)
+        if record is None:
+            meta = self.reference_counter.get_owned_meta(binary)
+            creator = meta.creator if meta is not None else ""
+            if ref.id().is_put():
+                why = "created by put(), no task lineage"
+            elif creator.startswith("actor:"):
+                why = "actor task result (actor state is not replayable)"
+            elif creator.startswith("task:"):
+                why = ("lineage record evicted (lineage_max_bytes) or "
+                       "already released")
+            else:
+                return False  # not ours / no provenance: plain ObjectLostError
+            chain.append({"object_id": hex_id, "task": task_binary.hex(),
+                          "why": why})
+            raise ObjectReconstructionFailedError(hex_id, why, chain)
+        spec = record.spec
+        if spec.task_type != NORMAL_TASK:
+            why = "actor task result (actor state is not replayable)"
+            chain.append({"object_id": hex_id, "task": task_binary.hex(),
+                          "why": why})
+            raise ObjectReconstructionFailedError(hex_id, why, chain)
+        if spec.max_retries <= 0 or attempt > spec.max_retries:
+            return False  # max_retries=0 opts out of lineage reconstruction
+        attempts_cap = int(CONFIG.lineage_max_reconstruction_attempts)
+        if record.reconstructions >= attempts_cap:
+            why = (f"lineage_max_reconstruction_attempts={attempts_cap} "
+                   f"exhausted")
+            chain.append({"object_id": hex_id, "task": task_binary.hex(),
+                          "why": why})
+            raise ObjectReconstructionFailedError(hex_id, why, chain)
         if not record.completed:
-            return True  # a re-execution is already in flight
+            return True  # a re-execution is already in flight: just re-pull
+        chain.append({"object_id": hex_id, "task": task_binary.hex(),
+                      "why": "replayed"})
+        # Chain step: an argument this process owns whose every known
+        # plasma copy sits on a dead node must be replayed FIRST — the
+        # resubmitted task's executor would otherwise stall pulling it.
+        for entry in list(spec.args) + list(spec.kwargs.values()):
+            if entry[0] != "r":
+                continue
+            arg_binary = entry[1]
+            arg_meta = self.reference_counter.get_owned_meta(arg_binary)
+            if arg_meta is None or arg_meta.state != "plasma":
+                continue
+            if any(not self._location_dead(loc)
+                   for loc in arg_meta.locations):
+                continue
+            arg_ref = ObjectRef(ObjectID(arg_binary), self.direct_addr())
+            self._recover_chain(arg_ref, 1, depth + 1, chain)
+        record.reconstructions += 1
+        self._lineage.reconstructions += 1
+        # reset EVERY return, not just ref: sibling returns of a
+        # multi-return task point at the same dead copy, and the replay
+        # regenerates them all under the original ids. Only KNOWN-dead
+        # locations are forgotten, though — a replica pulled to a
+        # surviving node (a reducer's copy of a map shard, say) is real
+        # bytes the final free must still reach, and wiping its location
+        # here would orphan them in that node's store. The pending state
+        # + dropped memory entry are what make get() wait for the replay
+        # seal, so keeping an unproven location is safe either way.
         for oid in record.return_ids:
             meta = self.reference_counter.get_owned_meta(oid.binary())
             if meta:
                 meta.state = "pending"
-                meta.locations = []
+                meta.locations = [loc for loc in meta.locations
+                                  if not self._location_dead(loc)]
             self.memory_store.delete(oid.binary())
-        record.completed = False
-        self._post(self._submit_to_pool_sync, record)
-        return True
-
-    def _try_recover(self, ref: ObjectRef, attempt: int) -> bool:
-        """Lineage reconstruction: resubmit the task that created this object
-        (reference: src/ray/core_worker/object_recovery_manager.h)."""
-        record = self._tasks.get(ref.id().task_id().binary())
-        if record is None or record.spec.task_type != NORMAL_TASK:
-            return False
-        if record.spec.max_retries <= 0 or attempt > record.spec.max_retries:
-            return False  # max_retries=0 opts out of lineage reconstruction
-        meta = self.reference_counter.get_owned_meta(ref.binary())
-        if meta:
-            meta.state = "pending"
-            meta.locations = []
-        self.memory_store.delete(ref.binary())
         # the record finished once already; reopen it or the reconstruction
         # attempt's reply would be dropped as a stale late reply
         record.completed = False
         self._post(self._submit_to_pool_sync, record)
+        self._lineage.notify_replay(task_binary)
         return True
+
+    def _reconstruct_borrowed(self, ref: ObjectRef, attempt: int) -> bool:
+        """Borrower-side recovery: ask the object's OWNER to replay its
+        lineage, then forget the stale location hints so the next pull
+        loop re-resolves fresh ones once the replay seals."""
+        owner = ref.owner_addr()
+        if not owner:
+            return False
+        if attempt > int(CONFIG.lineage_max_reconstruction_attempts):
+            raise ObjectReconstructionFailedError(
+                ref.hex(),
+                f"lineage_max_reconstruction_attempts="
+                f"{int(CONFIG.lineage_max_reconstruction_attempts)} "
+                f"exhausted by this borrower")
+
+        async def ask():
+            client = await self._owner_client(owner)
+            return await client.call(
+                "ReconstructObject",
+                {"object_id": ref.hex(), "attempt": attempt},
+                timeout=CONFIG.control_rpc_timeout_s)
+
+        try:
+            reply = self._acall(ask(),
+                                timeout=CONFIG.control_rpc_timeout_s + 5)
+        except Exception as e:
+            # a dead owner holds the only lineage record — nothing can
+            # rebuild this object (the ISSUE 17 put()-with-dead-owner
+            # contract covers task returns of dead drivers identically)
+            raise ObjectReconstructionFailedError(
+                ref.hex(), f"owner unreachable for reconstruction ({e})")
+        status = (reply or {}).get("status")
+        if status == "resubmitted":
+            self.memory_store.delete(ref.binary())
+            getattr(self, "_borrowed_locations", {}).pop(ref.binary(), None)
+            return True
+        if status == "no_lineage":
+            raise ObjectReconstructionFailedError(
+                ref.hex(), reply.get("reason") or "owner holds no lineage",
+                reply.get("chain") or [])
+        return False
+
+    def _recover_lost_object(self, ref: ObjectRef, attempt: int,
+                             tc=None) -> bool:
+        """A pull came back lost: owned refs replay their producing chain
+        locally, borrowed refs ask the owner (ISSUE 17). True = a replay
+        is in flight, re-pull; False = the object never opted into
+        lineage (plain ObjectLostError at the caller); raises the typed
+        error when the lineage path is exhausted or absent."""
+        t0 = time.time()
+        owned = self.reference_counter.get_owned_meta(ref.binary()) is not None
+        outcome = "failed"
+        try:
+            if owned:
+                ok = self._recover_chain(ref, attempt, 0, [])
+            else:
+                ok = self._reconstruct_borrowed(ref, attempt)
+            outcome = "resubmitted" if ok else "opted_out"
+            return ok
+        finally:
+            rec = _events.REC
+            if rec.enabled and tc is not None:
+                # nested under the triggering get's span
+                rec.record("reconstruct::" + ref.hex()[:12], "object", t0,
+                           max(0.0, time.time() - t0), tc[0], rec.next_id(),
+                           tc[1], {"obj": ref.hex()[:16],
+                                   "owned": owned, "outcome": outcome,
+                                   "attempt": attempt})
 
     # ----------------------------------------------------------------- wait
     def wait(self, refs: List[ObjectRef], num_returns: int,
@@ -1719,10 +2092,20 @@ class Worker:
         self.reference_counter.drop_owned(binary)
         task_binary = ObjectID(binary).task_id().binary()
         record = self._tasks.get(task_binary)
+        if record is None:
+            return
         # a live streaming task's record must outlive early freed yields —
         # it routes the still-arriving StreamingReturn items
-        if record is None or record.streaming_gen is None or record.completed:
-            self._tasks.pop(task_binary, None)
+        if record.streaming_gen is not None and not record.completed:
+            return
+        verdict = self._lineage.on_output_freed(task_binary, binary)
+        if verdict == "keep":
+            return  # sibling returns still referenced anchor the lineage
+        self._tasks.pop(task_binary, None)
+        if verdict == "drop":
+            # the record's LAST live output died: release its arg pins,
+            # which may cascade-free (and cascade-release) upstream lineage
+            self._unpin_args(record.spec)
 
     # =================================================================== tasks
     def _trace_for_submit(self):
@@ -1794,6 +2177,10 @@ class Worker:
             placement_group_bundle_index=(pg[1] if pg else -1),
             runtime_env=runtime_env,
             trace_ctx=self._trace_for_submit(),
+            # stamped at FIRST submission and replayed verbatim, so a
+            # lineage re-execution seeds the task body's RNG identically
+            # and reproduces byte-identical returns (ISSUE 17)
+            replay_seed=_replay_seed(task_id.binary()),
         )
         callsite = _user_callsite()
         if num_returns == -1:  # streaming generator
@@ -1925,7 +2312,18 @@ class Worker:
             self._submit_to_pool_sync(record)
             return
         record.completed = True
-        self._unpin_args(spec)
+        if record.streaming_gen is None:
+            # Lineage retention decides the arg pins' fate (ISSUE 17): a
+            # retained record KEEPS them so the producing chain stays
+            # replayable; everything else releases them here, exactly
+            # once (a retained record's unpin happens when the record is
+            # released — last output freed, cap eviction, or terminal
+            # failure of a replay).
+            if not self._maybe_retain_lineage(record, reply):
+                self._lineage.discard(spec.task_id)
+                self._unpin_args(spec)
+        else:
+            self._unpin_args(spec)
         if record.streaming_gen is not None:
             # items already arrived via StreamingReturn; the reply only
             # closes the stream (a pre-generator error closes it broken)
@@ -1960,6 +2358,29 @@ class Worker:
             # drop it if every return was inline (nothing to reconstruct).
             if all(r.get("inline") is not None for r in returns):
                 self._tasks.pop(spec.task_id, None)
+
+    def _maybe_retain_lineage(self, record: TaskRecord, reply: Dict) -> bool:
+        """Should this completed task's record (spec + pinned args) be
+        retained as replayable lineage? Yes iff it is a successful
+        NORMAL_TASK that opted into retries and produced at least one
+        plasma return whose ref is still live (ISSUE 17)."""
+        spec = record.spec
+        if (spec.task_type != NORMAL_TASK or spec.max_retries <= 0
+                or reply.get("error")):
+            return False
+        if self._tasks.get(spec.task_id) is not record:
+            return False  # evicted mid-replay: pins already released
+        plasma = [
+            oid.binary()
+            for oid, ret in zip(record.return_ids, reply.get("returns", []))
+            if ret.get("inline") is None and ret.get("xlang") is None
+            and ret.get("xlang_error") is None
+        ]
+        plasma = [b for b in plasma
+                  if self.reference_counter.get_owned_meta(b) is not None]
+        if not plasma:
+            return False
+        return self._lineage.retain(record, plasma)
 
     def _maybe_drop_streaming_record(self, record: TaskRecord) -> None:
         """Drop a COMPLETED streaming task's record unconditionally: the
@@ -2057,6 +2478,10 @@ class Worker:
             self._submit_to_pool_sync(record)
             return
         record.completed = True
+        # a replay's terminal failure must release the retained record's
+        # ledger entry BEFORE the single unpin below (else the later
+        # record drop would unpin a second time)
+        self._lineage.discard(spec.task_id)
         self._unpin_args(spec)
         err = error if isinstance(error, Exception) else RayTaskError(
             spec.function_name, str(error)
@@ -3048,7 +3473,11 @@ class _LeasePool:
             if conn.node_id == node_id and not conn.dead:
                 conn.dead = True
                 if conn.client is not None:
+                    # close() first for the synchronous fail-fast, then
+                    # close_soon() so the cancelled read loop is awaited
+                    # instead of stranded on the dying loop
                     conn.client.close()
+                    conn.client.close_soon()
 
     def _on_batch_failed(self, conn: WorkerConn,
                          records: List[TaskRecord]) -> None:

@@ -33,6 +33,22 @@ from ray_tpu._private.worker import EXC, VAL, Worker
 from ray_tpu.exceptions import RayTaskError
 
 
+def _seed_task_rng(seed: int) -> None:
+    """Seed the task body's RNGs for deterministic lineage replay
+    (ISSUE 17). Only seeds libraries the process ALREADY imported —
+    replay must not warm numpy/jax in otherwise-light map/reduce
+    workers."""
+    import random as _random
+
+    _random.seed(seed)
+    np = sys.modules.get("numpy")
+    if np is not None:
+        try:
+            np.random.seed(seed & 0xFFFFFFFF)
+        except Exception:
+            pass
+
+
 class Executor:
     def __init__(self, worker: Worker):
         self.worker = worker
@@ -322,11 +338,19 @@ class Executor:
             else:
                 fn = load_function(spec.function_id, spec.function_blob,
                                    self.worker, name=spec.function_name)
+                if spec.replay_seed is not None:
+                    # lineage replay determinism (ISSUE 17): the seed was
+                    # stamped at FIRST submission, so the original run and
+                    # every replay draw identical randomness
+                    _seed_task_rng(spec.replay_seed)
                 result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 # async callable that evaded static detection (e.g. attached
                 # via __getattr__): run it to completion on this thread
                 result = asyncio.run(result)
+            # exec duration for the store's lineage-aware eviction cost
+            # model (cheap-to-replay copies are preferred victims)
+            ctx.exec_ms = (time.time() - start) * 1000.0
             if tc is not None:
                 t_ret = time.time()
                 reply = self._package_returns(spec, result)
@@ -419,6 +443,17 @@ class Executor:
                            {"task": spec.task_id.hex()[:16], "async": 1})
                 _events.reset_current(cur_tok)
 
+    def _lineage_hints(self, spec: TaskSpec) -> Dict:
+        """ObjectSealed extras for the store's lineage-aware eviction
+        (ISSUE 17): is this copy rebuildable by task replay, and how
+        expensive was the producing execution."""
+        return {
+            "replayable": spec.task_type == NORMAL_TASK
+            and spec.max_retries > 0,
+            "exec_ms": float(getattr(self.worker.current_task_info,
+                                     "exec_ms", 0.0) or 0.0),
+        }
+
     def _package_one(self, spec: TaskSpec, i: int, value: Any,
                      is_exception: bool = False) -> Dict:
         sobj = self.worker._serialize_value(value)
@@ -443,7 +478,8 @@ class Executor:
                                   {"object_id": oid.hex(), "size": used,
                                    "zero_copy": _ser.is_zero_copy(view),
                                    "owner": spec.owner_addr,
-                                   "task": spec.task_id.hex()})
+                                   "task": spec.task_id.hex(),
+                                   **self._lineage_hints(spec)})
                 return {"plasma": True, "size": used,
                         "node_addr": self.worker.agent_tcp_addr}
         view, handle = self.worker.store.create(oid, size)
@@ -460,7 +496,8 @@ class Executor:
                            # ledger (ISSUE 15) attributes every sealed byte
                            # and the leak watchdog knows whom to interrogate
                            "owner": spec.owner_addr,
-                           "task": spec.task_id.hex()})
+                           "task": spec.task_id.hex(),
+                           **self._lineage_hints(spec)})
         return {"plasma": True, "size": used,
                 "node_addr": self.worker.agent_tcp_addr}
 

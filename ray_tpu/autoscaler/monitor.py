@@ -37,7 +37,16 @@ class GcsChannel:
         return fut.result(timeout)
 
     def close(self) -> None:
+        # aclose ON the private loop BEFORE stopping it, or the client's
+        # cancelled read-loop task is stranded and the dying loop warns
+        # "Task was destroyed but it is pending!" at teardown
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._client.aclose(), self._loop).result(5)
+        except Exception:
+            pass
         self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
 
 
 class Monitor:

@@ -23,12 +23,17 @@ Here the exchange is a single streaming ``PhysicalOperator``:
   backpressures admission instead of OOMing workers. The operator's
   held shard bytes also feed the executor's
   ``ResourceBudgetBackpressurePolicy`` via ``extra_usage_bytes``.
-- **Shuffle-scoped recovery.** The operator records which map task
-  produced each shard. A reduce failing with ``ObjectLostError`` (node
-  death mid-shuffle) re-executes exactly that map — same task id, same
-  shard object ids via ``Worker.recover_task_returns`` lineage — and
-  resubmits the reduce; one node death degrades throughput instead of
-  killing the job.
+- **Recovery = thin client of ownership lineage (ISSUE 17).** Most
+  losses never reach the operator any more: a reducer pulling a lost
+  shard triggers the owner's chained lineage replay from inside its own
+  ``get``. When a loss does surface here (reduce meta lost, or lineage
+  evicted), the operator maps the hex back to the producing map record
+  and calls ``Worker.recover_task_returns`` — the general machinery
+  replays the map under its original task/object ids and recursively
+  reconstructs a lost map INPUT too, so the operator keeps no recovery
+  logic of its own beyond a fresh-dispatch fallback for lineage-less
+  records; one node death degrades throughput instead of killing the
+  job.
 
 Map/reduce task bodies in this module run in shuffle workers and must
 never import jax (MULTICHIP gate, probe-asserted in
@@ -323,6 +328,15 @@ class StreamingShuffleOperator(PhysicalOperator):
                        if _ev.REC.enabled and _ev.REC.sample() else None)
         self._trace_t0 = time.time()
         self._trace_closed = False
+        # Most shard losses resolve inside the owner's pull path now
+        # (ISSUE 17) and never reach _recover_lost — subscribe to the
+        # ledger's replay feed so a lineage re-execution of one of OUR
+        # maps still shows up in map_reexecs. Weakly held: this exchange
+        # dying IS the unsubscribe.
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.global_worker
+        if w is not None:
+            w._lineage.add_listener(self._on_lineage_replay)
 
     # ------------------------------------------------------------ helpers
     @staticmethod
@@ -600,23 +614,20 @@ class StreamingShuffleOperator(PhysicalOperator):
         self.tasks_launched += 1
 
     def _recover_lost(self, lost_hex: str) -> None:
-        """Map a lost object id back to the map (or map input) that
-        produced it and re-execute exactly that lineage."""
+        """Map a lost object id back to the map record that produced (or
+        consumed) it and replay that map through the general lineage
+        machinery. A lost map INPUT needs no special casing any more:
+        ``Worker._recover_chain`` recursively reconstructs lost owned
+        arguments before resubmitting, so one call covers the chain."""
         from ray_tpu._private import worker as worker_mod
 
         if lost_hex in self._retired_shards:
             return  # already re-dispatched fresh; retries read current refs
         w = worker_mod.global_worker
         for m in self._maps:
-            if any(ref is not None and ref.hex() == lost_hex
-                   for ref in m.shard_refs):
-                self._reexec_map(w, m)
-                return
-            if m.bundle.block_ref.hex() == lost_hex:
-                # the map's INPUT died too: recover it through its own
-                # producing task's lineage, then replay the map on top
-                if w is not None:
-                    w._try_recover(m.bundle.block_ref, m.reexecs + 1)
+            if (m.bundle.block_ref.hex() == lost_hex
+                    or any(ref is not None and ref.hex() == lost_hex
+                           for ref in m.shard_refs)):
                 self._reexec_map(w, m)
                 return
         raise ObjectLostError(
@@ -631,6 +642,10 @@ class StreamingShuffleOperator(PhysicalOperator):
                 m.shard_refs[0].hex(),
                 f"lost; map re-executed {m.reexecs - 1} times without "
                 "sticking")
+        # general machinery (ISSUE 17): resubmits the map under its
+        # original task/object ids, replay-seeded for byte-identical
+        # shards, recursively reconstructing lost inputs; returns False
+        # (never raises here) when the record is evicted or opted out
         recovered = False
         if w is not None:
             recovered = w.recover_task_returns(m.meta_ref)
@@ -646,9 +661,23 @@ class StreamingShuffleOperator(PhysicalOperator):
             m.shard_refs = list(refs[:-1])
             m.meta_ref = refs[-1]
             self.tasks_launched += 1
+            # the lineage path is counted by _on_lineage_replay (the
+            # ledger notifies on resubmit); only the fresh dispatch
+            # needs a manual bump or map_reexecs would double-count
+            self.map_reexecs += 1
         m.done = False
         m.reexec_inflight = True
-        self.map_reexecs += 1
+
+    def _on_lineage_replay(self, task_binary: bytes) -> None:
+        """Ledger callback: the owner resubmitted ``task_binary`` from
+        lineage. When it is one of our maps the map genuinely ran again
+        — whether we asked (_reexec_map) or a reducer's failed pull
+        triggered it behind our back — so it belongs in map_reexecs."""
+        for m in self._maps:
+            if m.meta_ref is not None and \
+                    m.meta_ref.id().task_id().binary() == task_binary:
+                self.map_reexecs += 1
+                return
 
     # -------------------------------------------------------------- emit
     def _emit_ready(self) -> None:

@@ -23,6 +23,7 @@ from . import (
     r8_config_knobs,
     r9_view_escape,
     r10_grow_only,
+    r11_loop_stop_strands_client,
 )
 
 ALL_RULES = [
@@ -36,6 +37,7 @@ ALL_RULES = [
     r8_config_knobs,
     r9_view_escape,
     r10_grow_only,
+    r11_loop_stop_strands_client,
 ]
 
 RULES_BY_ID: Dict[str, object] = {m.RULE_ID: m for m in ALL_RULES}

@@ -193,6 +193,45 @@ class ObjectFetchTimedOutError(ObjectLostError):
     pass
 
 
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage reconstruction was attempted for a lost object and could
+    not complete: the lineage is truly absent (actor state, ``put()``
+    value with a dead owner, record evicted under ``lineage_max_bytes``)
+    or a bound tripped (``lineage_max_reconstruction_depth`` /
+    ``_attempts``). Subclasses :class:`ObjectLostError` so existing
+    "object is gone" handlers keep firing; carries the attempted chain
+    (outermost first) so postmortems can see how far replay got.
+    """
+
+    def __init__(self, object_id_hex: str = "", reason: str = "",
+                 chain: Optional[List[Dict]] = None):
+        # each chain entry: {"object_id", "task", "why"} — plain data only
+        self.chain = [dict(c) for c in (chain or [])]
+        detail = "could not be reconstructed"
+        if reason:
+            detail += f": {reason}"
+        if self.chain:
+            hops = " <- ".join(
+                str(c.get("object_id", "?"))[:12] for c in self.chain)
+            detail += f" (lineage chain: {hops})"
+        super().__init__(object_id_hex, detail)
+
+    def __reduce__(self):
+        # rebuild from the real fields, not the formatted message
+        # (raylint R5); the chain round-trips as plain dicts
+        return (_rebuild_reconstruction_failed,
+                (self.object_id_hex, self.reason, self.chain))
+
+
+def _rebuild_reconstruction_failed(object_id_hex, reason, chain):
+    err = ObjectReconstructionFailedError.__new__(ObjectReconstructionFailedError)
+    # bypass __init__'s re-formatting: `reason` is already the formatted
+    # detail ("could not be reconstructed: ...") stored by the base ctor
+    ObjectLostError.__init__(err, object_id_hex, reason)
+    err.chain = [dict(c) for c in (chain or [])]
+    return err
+
+
 class OwnerDiedError(ObjectLostError):
     def __init__(self, object_id_hex: str = "", node_id: str = "",
                  incarnation: int = 0, reason: str = "",

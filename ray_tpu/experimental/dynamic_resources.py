@@ -45,6 +45,8 @@ def set_resource(resource_name: str, capacity: float,
             return await client.call("SetResource", payload,
                                       timeout=CONFIG.control_rpc_timeout_s)
         finally:
-            client.close()
+            # aclose, not close: close() leaves the cancelled read loop
+            # un-awaited and the loop warns about it at teardown
+            await client.aclose()
 
     w._acall(call_remote(), timeout=30)

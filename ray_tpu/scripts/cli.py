@@ -574,13 +574,18 @@ def cmd_memory(args) -> int:
             print(f"  {node_id[:12]}: unreachable ({nd['error']})")
             continue
         tiers = nd.get("tiers") or {}
+        lin = nd.get("lineage") or {}
         print(f"  {node_id[:12]}: "
               f"shm {_fmt_bytes(tiers.get('shm_bytes', 0))} "
               f"({tiers.get('shm_objects', 0)}) / "
               f"disk {_fmt_bytes(tiers.get('disk_bytes', 0))} "
               f"({tiers.get('disk_objects', 0)}) / "
               f"remote {tiers.get('remote_objects', 0)}   "
-              f"processes {nd.get('num_processes', 0)}")
+              f"processes {nd.get('num_processes', 0)}   "
+              f"lineage {lin.get('records', 0)} rec "
+              f"({_fmt_bytes(lin.get('bytes', 0))}), "
+              f"{lin.get('reconstructions', 0)} replayed, "
+              f"{lin.get('evictions', 0)} evicted")
 
     groups = out.get("groups") or {}
     sort_key = {"bytes": "total_bytes", "count": "count"}[args.sort_by]
@@ -589,12 +594,15 @@ def cmd_memory(args) -> int:
     print(f"\nGrouped by {group_by} (top {args.limit}, by {args.sort_by})")
     print("-" * 72)
     if group_by in ("callsite", "creator"):
+        # LINEAGE = how many of the group's objects the owner can rebuild
+        # by chained task replay if a copy is lost (ISSUE 17)
         print(f"{'BYTES':>12} {'COUNT':>6} {'LOCAL':>6} {'BORROW':>6} "
-              f"{'PINS':>5} {group_by.upper()}")
+              f"{'PINS':>5} {'LINEAGE':>7} {group_by.upper()}")
         for name, g in ordered[:args.limit]:
             print(f"{_fmt_bytes(g['total_bytes']):>12} {g['count']:>6} "
                   f"{g.get('local_refs', 0):>6} {g.get('borrowers', 0):>6} "
-                  f"{g.get('task_pins', 0):>5} {name}")
+                  f"{g.get('task_pins', 0):>5} {g.get('lineage', 0):>7} "
+                  f"{name}")
     else:
         print(f"{'BYTES':>12} {'COUNT':>6} {group_by.upper()}")
         for name, g in ordered[:args.limit]:

@@ -41,7 +41,17 @@ class _Channel:
         return fut.result(timeout)
 
     def close(self) -> None:
+        # aclose ON the private loop BEFORE stopping it: stopping first
+        # strands the client's cancelled read-loop task, which the dying
+        # loop reports as "Task was destroyed but it is pending!" at
+        # interpreter teardown (the BENCH tail-leak shape)
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.client.aclose(), self._loop).result(5)
+        except Exception:
+            pass
         self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
 
 
 class ClientObjectRef:

@@ -683,6 +683,40 @@ def bench_chaos(quick: bool = False) -> dict:
             except Exception:
                 time.sleep(0.1)
         out["actor_restart_s"] = round(time.perf_counter() - t2, 3)
+
+        # lineage reconstruction latency (ISSUE 17): lose every copy of
+        # owned plasma objects with their node, time until get() hands
+        # back the replayed values — and the counter must move
+        import numpy as _np
+
+        cluster.remove_node(node)  # fenced earlier; drop from the roster
+        lnode = cluster.add_node(num_cpus=2, resources={"lin": 4})
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(max_retries=2, resources={"lin": 1})
+        def lin_produce(i):
+            return _np.full(200_000, i, _np.int64)
+
+        refs = [lin_produce.remote(i) for i in range(2)]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+        from ray_tpu._private import worker as _wm
+
+        recon_before = _wm.global_worker._lineage.reconstructions
+        cluster.remove_node(lnode)
+        cluster.add_node(num_cpus=2, resources={"lin": 4})
+        cluster.wait_for_nodes()
+        time.sleep(2.5)  # node-death detection lag
+        t3 = time.perf_counter()
+        vals = ray_tpu.get(refs, timeout=120)
+        out["lineage_reconstruction_s"] = round(
+            time.perf_counter() - t3, 3)
+        out["lineage_reconstructions"] = (
+            _wm.global_worker._lineage.reconstructions - recon_before)
+        assert out["lineage_reconstructions"] > 0, (
+            "node kill replayed nothing through lineage: "
+            "ray_tpu_lineage_reconstructions_total stayed 0")
+        assert all(int(v[0]) == i for i, v in enumerate(vals))
+        del refs, vals
     finally:
         if partitioner is not None:
             partitioner.heal()
@@ -1405,6 +1439,10 @@ def bench_data_shuffle(quick: bool = False) -> dict:
         ctx.streaming_shuffle = True
         ctx.shuffle_map_remote_args = {"resources": {"vic": 0.001}}
         ctx.shuffle_reduce_remote_args = {"resources": {"safe": 0.001}}
+        from ray_tpu._private import worker as _wm
+
+        recon_before = _wm.global_worker._lineage.reconstructions
+        ledger_base = _ledger_probe()
         ds = rd.from_blocks(make_blocks()).random_shuffle(
             seed=11, num_blocks=R)
         t0 = time.perf_counter()
@@ -1430,13 +1468,40 @@ def bench_data_shuffle(quick: bool = False) -> dict:
         for op in ds._last_stats.to_dict()["ops"]:
             if "shuffle_maps" in (op.get("extra") or {}):
                 extras = op["extra"]
+        # replayed map bodies must stay light: the surviving victim
+        # node's workers just re-executed maps via lineage — jax must
+        # not have been warmed in them (ISSUE 17 satellite)
+        @ray_tpu.remote(resources={"vic": 0.001})
+        def jax_probe():
+            import sys as _s
+
+            return "jax" in _s.modules
+
+        jax_clean = ray_tpu.get(jax_probe.remote(), timeout=60) is False
+        reconstructions = (_wm.global_worker._lineage.reconstructions
+                           - recon_before)
         out["chaos"] = {
             "wall_s": round(time.perf_counter() - t0, 3),
             "rows": len(acc),
             "byte_identical": sha == shas.get("streaming"),
             "map_reexecs": extras.get("shuffle_map_reexecs", 0),
             "reduce_retries": extras.get("shuffle_reduce_retries", 0),
+            "lineage_reconstructions": reconstructions,
+            "jax_unimported_in_replay_workers": jax_clean,
         }
+        assert reconstructions > 0, (
+            "node kill replayed nothing through lineage: "
+            "ray_tpu_lineage_reconstructions_total stayed 0")
+        assert jax_clean, "lineage replay warmed jax in a map worker"
+        # ownership ledger (ISSUE 15) must still drain to zero delta
+        # once the dataset drops, replays and all. The loop vars hold
+        # zero-copy views of the LAST batch — a live view pins its
+        # arena object, which would read as a leaked reduce output here.
+        del ds, it, batch, ids, xs
+        out["chaos"]["post_run_ledger"] = _ledger_drain(ledger_base)
+        assert out["chaos"]["post_run_ledger"]["drained"], (
+            f"chaos shuffle leaked past its exchange: "
+            f"{out['chaos']['post_run_ledger']}")
     except Exception as e:  # noqa: BLE001 — chaos flake keeps main phases
         out["chaos"] = {"error": f"{type(e).__name__}: {e}"}
     finally:

@@ -92,6 +92,7 @@ FAST_FILES = {
     "test_serve_load.py",
     "test_raylint.py",
     "test_direct_call.py",
+    "test_lineage.py",
     "test_data_shuffle.py",
     "test_flight_recorder.py",
     "test_memory_debugger.py",

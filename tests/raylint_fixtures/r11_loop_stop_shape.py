@@ -1,0 +1,87 @@
+"""R11 regression fixture: loop-stop stranding an AsyncRpcClient read
+loop (the BENCH tail leak, ISSUE 17 satellite).
+
+The shipped bug: sync RPC facades over a private event-loop thread
+(``util/client/client.py::_Channel``, ``autoscaler/monitor.py::
+GcsChannel``) tore down with ``loop.call_soon_threadsafe(loop.stop)``
+alone. ``AsyncRpcClient.close()`` only *cancels* the read-loop task;
+the cancelled task needs one more loop tick, so stopping the loop first
+strands it and the dying loop prints "Task was destroyed but it is
+pending!" at interpreter teardown.
+
+R11 must flag the two stop-without-aclose shapes below and must NOT
+flag the aclose-first twin, the close_soon user, or loop stops in
+classes that hold no AsyncRpcClient.
+"""
+
+import asyncio
+import threading
+
+
+class AsyncRpcClient:  # stand-in: the rule keys on the name
+    async def aclose(self):
+        pass
+
+    def close_soon(self):
+        pass
+
+
+class ChannelBugShape:
+    """The bug: stop the private loop, never await the read loop."""
+
+    def __init__(self, host, port):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever)
+        self.client = AsyncRpcClient()
+
+    def close(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)  # expect-R11
+
+
+class DirectStopBugShape:
+    """Same bug, direct in-loop stop."""
+
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+        self.client = AsyncRpcClient()
+
+    def shutdown(self):
+        self._loop.stop()  # expect-R11
+
+
+class ChannelFixedShape:
+    """The fix: aclose ON the loop before stopping it."""
+
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+        self.client = AsyncRpcClient()
+
+    def close(self):
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.client.aclose(), self._loop).result(5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class CloseSoonShape:
+    """Also fine: close_soon schedules the awaiting task for us."""
+
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+        self.client = AsyncRpcClient()
+
+    def close(self):
+        self.client.close_soon()
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class NoClientShape:
+    """No AsyncRpcClient held — stopping a loop is not itself a bug."""
+
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+
+    def close(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)

@@ -98,6 +98,7 @@ def _expect_lines(fixture, rule):
     ("r4_leaked_task_shape.py", "R4"),
     ("r9_view_escape_shape.py", "R9"),
     ("r10_grow_only_shape.py", "R10"),
+    ("r11_loop_stop_shape.py", "R11"),
 ])
 def test_fixture_trips_exactly_on_marked_lines(fixture, rule):
     path, expected = _expect_lines(fixture, rule)
@@ -274,6 +275,8 @@ _SAMPLES = {
     "queue_depths": {"replica-a": 3, "replica-b": 0},
     "incarnation": 7,
     "cause": None,
+    # ObjectReconstructionFailedError: the attempted lineage chain
+    "chain": [{"object_id": "aa" * 18, "task": "f", "why": "replayed"}],
 }
 
 
