@@ -365,6 +365,21 @@ _flag("actor_reconnect_backoff_s", 0.2)  # actor-client reconnect pacing
 _flag("lease_retry_backoff_s", 0.2)  # lease-request retry pacing
 _flag("actor_call_batch_max", 64)  # specs per PushTaskBatch frame
 
+# --- submission/completion fast path (ISSUE 18) ------------------------------
+# Master switch for the driver-side fast path: spec-template cache on the
+# per-call submit paths, vectorized submit_many/fn.map, and the batched
+# completion delivery queue. Off = the pre-18 per-call path (the --ab
+# baseline arm in ray_perf flips this per round).
+_flag("submit_fastpath_enabled", True)
+# Frozen spec templates cached per (function id, options hash); cap with
+# clear-on-cap like the callsite cache — real programs have a bounded set
+# of (function, options) signatures, and a clear simply re-freezes.
+_flag("spec_template_cache_max", 512)
+# Batch completion delivery: task replies landing in one loop tick resolve
+# through one memory-store put_batch + one ref-counter pass instead of a
+# lock round trip per return.
+_flag("completion_batch_enabled", True)
+
 # --- round-3 sweep 2: poll cadences + 2PC/bootstrap deadlines ----------------
 _flag("actor_resource_wait_poll_s", 0.1)  # actor waiting on node/PG capacity
 # Fallback poll for the agent's hold-resources-until-death watcher. The

@@ -91,6 +91,44 @@ class MemoryStore:
             except Exception:
                 pass
 
+    def put_batch(self, entries: List[Tuple[bytes, bytes, bool]]) -> None:
+        """Many puts under ONE lock acquisition and one waiter pass — the
+        delivery end of the batched completion queue (ISSUE 18): a frame
+        of task replies resolving together costs one scan of the waiter
+        list instead of one per return."""
+        if not entries:
+            return
+        if len(entries) == 1:
+            oid, data, is_exc = entries[0]
+            self.put(oid, data, is_exc)
+            return
+        wake: List[_Waiter] = []
+        with self._lock:
+            objects = self._objects
+            for oid, data, is_exc in entries:
+                objects[oid] = _Entry(data, is_exc)
+            listeners = tuple(self._put_listeners)
+            if self._waiters:
+                ids = {e[0] for e in entries}
+                still = []
+                for w in self._waiters:
+                    hit = w.missing & ids
+                    if hit:
+                        w.missing -= hit
+                        w.need_more -= len(hit)
+                        if w.need_more <= 0:
+                            wake.append(w)
+                            continue
+                    still.append(w)
+                self._waiters = still
+        for w in wake:
+            w.event.set()
+        for cb in listeners:
+            try:
+                cb()
+            except Exception:
+                pass
+
     def contains(self, object_id: bytes) -> bool:
         with self._lock:
             return object_id in self._objects

@@ -50,6 +50,8 @@ _WIRE_FIELDS = (
 )
 
 
+_WIRE_FIELD_SET = frozenset(_WIRE_FIELDS)
+
 # non-None __init__ defaults, used when a wire dict omits a field
 _WIRE_DEFAULTS = {
     "max_retries": 0, "retry_exceptions": False, "actor_method": "",
@@ -129,6 +131,22 @@ class TaskSpec:
             self._wire = w = {s: getattr(self, s) for s in _WIRE_FIELDS}
         return w
 
+    def __getattr__(self, name):
+        # Lazy wire-backed spec (ISSUE 18): SpecTemplate.instantiate sets
+        # ONLY ``_wire`` — each slot fills on first read from the wire
+        # dict, so the submit hot loop pays a dict copy instead of 26
+        # eager setattrs per task. Fully-initialized specs never enter
+        # here (__getattr__ fires only on unset slots).
+        try:
+            wire = object.__getattribute__(self, "_wire")
+        except AttributeError:
+            raise AttributeError(name) from None
+        if wire is not None and name in _WIRE_FIELD_SET:
+            val = wire.get(name, _WIRE_DEFAULTS.get(name))
+            setattr(self, name, val)
+            return val
+        raise AttributeError(name)
+
     @classmethod
     def from_wire(cls, wire: Dict[str, Any]) -> "TaskSpec":
         # executor-side hot path: fill slots directly, tolerating extra
@@ -153,3 +171,49 @@ class TaskSpec:
             # prefer killing leases whose tasks will be retried
             self.max_retries > 0,
         )
+
+
+class SpecTemplate:
+    """Frozen submission template for one (function, options) signature
+    (ISSUE 18). Everything invariant across repeated calls of the same
+    signature — function identity, resources, retry policy, scheduling
+    strategy, owner address — is resolved ONCE into a base wire dict;
+    per-call work reduces to splicing the task id, args and trace fields
+    into a copy. Keyed by function id + options hash in the worker's
+    template cache: a redefined function hashes to a new function id, so
+    stale templates can never serve the new body.
+    """
+
+    __slots__ = ("base", "sched_key", "has_ref_args")
+
+    def __init__(self, **invariant):
+        base = {s: None for s in _WIRE_FIELDS}
+        base.update(_WIRE_DEFAULTS)
+        base.update(invariant)
+        self.base = base
+        # scheduling_key is invariant too: compute it once here instead of
+        # per spec (it feeds the lease-pool lookup on every submit)
+        probe = TaskSpec.from_wire(base)
+        self.sched_key = probe.scheduling_key()
+
+    def instantiate(self, task_id: bytes, args: List[Tuple],
+                    kwargs: Dict[str, Tuple],
+                    trace_ctx: Optional[Tuple] = None,
+                    replay_seed: Optional[int] = None,
+                    seq: int = 0) -> TaskSpec:
+        """Splice the per-call fields into a copy of the base wire dict
+        and hang it straight on the spec: ``to_wire()`` never rebuilds
+        what the template already resolved, and the spec's slots stay
+        EMPTY until first read (TaskSpec.__getattr__ fills them lazily
+        from the wire), so per-task spec cost is one dict copy."""
+        w = self.base.copy()
+        w["task_id"] = task_id
+        w["args"] = args
+        w["kwargs"] = kwargs
+        w["trace_ctx"] = trace_ctx
+        w["replay_seed"] = replay_seed
+        if seq:
+            w["seq"] = seq
+        spec = TaskSpec.__new__(TaskSpec)
+        spec._wire = w
+        return spec

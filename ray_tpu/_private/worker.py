@@ -53,6 +53,7 @@ from ray_tpu._private.task_spec import (
     ACTOR_CREATION_TASK,
     ACTOR_TASK,
     NORMAL_TASK,
+    SpecTemplate,
     TaskSpec,
 )
 from ray_tpu.exceptions import (
@@ -186,6 +187,49 @@ class ReferenceCounter:
                 self._owned[object_id.binary()] = meta
             return meta
 
+    def register_owned_batch(self, entries: List[Tuple[bytes, str]],
+                             callsite: str = "", creator: str = "") -> None:
+        """Register many return ids under ONE lock acquisition and one
+        timestamp (ISSUE 18) — the owner-ref registration batch behind
+        ``submit_many``. ``entries`` is ``[(object_binary, creator_id)]``;
+        callsite/creator are shared (one submission site)."""
+        now = time.time()
+        with self._lock:
+            owned = self._owned
+            for e in entries:
+                binary = e[0]
+                if binary in owned:
+                    continue  # idempotent, same as register_owned
+                meta = OwnedObjectMeta()
+                meta.created_at = now
+                meta.callsite = callsite
+                # a 3-tuple entry carries its own creator (mixed-method
+                # actor batches); 2-tuples share the batch-level one
+                meta.creator = e[2] if len(e) > 2 else creator
+                meta.creator_id = e[1]
+                owned[binary] = meta
+
+    def set_resolved_batch(self, items: List[Tuple]) -> None:
+        """Many resolutions, one lock pass. ``items`` is
+        ``[(binary, state, size)]`` — inline/error resolutions only (the
+        batched completion drain; plasma returns keep the per-id path for
+        their location bookkeeping). Resolved events fire after the lock
+        drops, same as :meth:`set_resolved`."""
+        events = []
+        with self._lock:
+            owned = self._owned
+            for binary, state, size in items:
+                meta = owned.get(binary)
+                if meta is None:
+                    continue  # never resurrect (see set_resolved)
+                meta.state = state
+                if size is not None:
+                    meta.size = size
+                if meta.resolved_event is not None:
+                    events.append(meta.resolved_event)
+        for ev in events:
+            self.worker._loop_call(ev.set)
+
     def get_owned_meta(self, binary: bytes) -> Optional[OwnedObjectMeta]:
         with self._lock:
             return self._owned.get(binary)
@@ -281,6 +325,16 @@ class ReferenceCounter:
                 self._borrows[binary] = n
         if free:
             self.worker._free_owned(binary)
+
+    def add_local_refs_batch(self, binaries: List[bytes]) -> None:
+        """Local-ref registration for a block of freshly minted refs
+        (ISSUE 18): one lock acquisition for the whole batch. Callers
+        construct the ObjectRefs with ``_register=False`` and flip
+        ``_registered`` after this lands."""
+        with self._lock:
+            local = self._local
+            for b in binaries:
+                local[b] = local.get(b, 0) + 1
 
     def pin_for_task(self, binary: bytes):
         with self._lock:
@@ -676,6 +730,16 @@ class Worker:
         self._inbox_mu = threading.Lock()
         self._inbox_armed = False
         self._direct_addr_cache: Optional[Dict] = None
+        # submission fast path (ISSUE 18): frozen spec templates keyed by
+        # (function id, options hash) — a redefined function gets a new id,
+        # so invalidation is inherent; clear-on-cap bounds growth
+        self._spec_templates: Dict[Tuple, "SpecTemplate"] = {}
+        # batched completion delivery (loop-owned): task replies landing in
+        # one tick drain through one callback, with inline returns
+        # coalesced into one memory-store put_batch
+        self._completion_buf: List = []
+        self._completions_armed = False
+        self._resolve_sink: Optional[List] = None
 
     # ------------------------------------------------------------- lifecycle
     def connect(
@@ -2127,6 +2191,66 @@ class Worker:
             return (t, span, 0)
         return None
 
+    def _task_template(
+        self,
+        function,
+        num_returns: int,
+        resources: Optional[Dict[str, float]],
+        max_retries: int,
+        retry_exceptions: bool,
+        scheduling_strategy,
+        placement_group,
+        placement_group_bundle_index: int,
+        runtime_env: Optional[Dict],
+        name: str,
+    ) -> SpecTemplate:
+        """Frozen spec template for one (function, options) signature
+        (ISSUE 18). The cache key leads with the function id — a
+        redefined function serializes to a different blob and hence a
+        different id, so a stale template can never serve the new body."""
+        from ray_tpu._private.function_table import function_descriptor
+        from ray_tpu._private.task_spec import runtime_env_key
+
+        fid, blob, fname = function_descriptor(function, self)
+        key = (
+            fid, num_returns, max_retries, retry_exceptions, name,
+            None if not resources else tuple(sorted(resources.items())),
+            None if scheduling_strategy is None else repr(scheduling_strategy),
+            None if placement_group is None else
+            (placement_group.id_hex, placement_group_bundle_index),
+            runtime_env_key(runtime_env),
+        )
+        tpl = self._spec_templates.get(key)
+        if tpl is not None:
+            return tpl
+        from ray_tpu._private.resources import ResourceSet
+
+        res = dict(resources or {})
+        res.setdefault("CPU", 1.0)
+        pg = None
+        if placement_group is not None:
+            pg = [placement_group.id_hex, max(placement_group_bundle_index, 0)]
+        tpl = SpecTemplate(
+            job_id=self.job_id.binary(),
+            task_type=NORMAL_TASK,
+            function_id=fid,
+            function_blob=blob,
+            function_name=name or fname,
+            num_returns=num_returns,
+            resources=ResourceSet(res).to_wire(),
+            owner_addr=self.direct_addr(),
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=_strategy_wire(scheduling_strategy),
+            placement_group_id=(pg[0] if pg else None),
+            placement_group_bundle_index=(pg[1] if pg else -1),
+            runtime_env=runtime_env,
+        )
+        if len(self._spec_templates) >= CONFIG.spec_template_cache_max:
+            self._spec_templates.clear()  # clear-on-cap, like the callsite cache
+        self._spec_templates[key] = tpl
+        return tpl
+
     def submit_task(
         self,
         function,
@@ -2142,23 +2266,55 @@ class Worker:
         runtime_env: Optional[Dict] = None,
         name: str = "",
     ) -> List[ObjectRef]:
-        from ray_tpu._private.function_table import function_descriptor
-
         self._n_tasks_submitted = getattr(self, "_n_tasks_submitted", 0) + 1
-        task_id = TaskID.from_random()
-        fid, blob, fname = function_descriptor(function, self)
-        from ray_tpu._private.resources import ResourceSet
-        wire_args = self._build_args(args)
-        wire_kwargs = {k: v for k, v in zip(kwargs.keys(),
-                                            self._build_args(tuple(kwargs.values())))}
         if max_retries < 0:
             max_retries = CONFIG.task_max_retries_default
+        task_id = TaskID.from_random()
+        wire_args = self._build_args(args) if args else []
+        wire_kwargs = ({k: v for k, v in
+                        zip(kwargs.keys(),
+                            self._build_args(tuple(kwargs.values())))}
+                       if kwargs else {})
+        if CONFIG.submit_fastpath_enabled:
+            tpl = self._task_template(
+                function, num_returns, resources, max_retries,
+                retry_exceptions, scheduling_strategy, placement_group,
+                placement_group_bundle_index, runtime_env, name)
+            spec = tpl.instantiate(
+                task_id.binary(), wire_args, wire_kwargs,
+                trace_ctx=self._trace_for_submit(),
+                # stamped at FIRST submission and replayed verbatim, so a
+                # lineage re-execution seeds the task body's RNG
+                # identically and reproduces byte-identical returns
+                # (ISSUE 17)
+                replay_seed=_replay_seed(task_id.binary()))
+        else:
+            spec = self._build_task_spec_slow(
+                function, task_id, wire_args, wire_kwargs, num_returns,
+                resources, max_retries, retry_exceptions,
+                scheduling_strategy, placement_group,
+                placement_group_bundle_index, runtime_env, name)
+        return self._finish_submit(spec, task_id, "task:",
+                                   self._submit_to_pool_sync)
+
+    def _build_task_spec_slow(
+            self, function, task_id, wire_args, wire_kwargs, num_returns,
+            resources, max_retries, retry_exceptions, scheduling_strategy,
+            placement_group, placement_group_bundle_index, runtime_env,
+            name) -> TaskSpec:
+        """Template-free spec construction — the pre-18 per-call path,
+        kept live behind ``submit_fastpath_enabled=0`` (the ray_perf
+        ``--ab`` baseline arm)."""
+        from ray_tpu._private.function_table import function_descriptor
+        from ray_tpu._private.resources import ResourceSet
+
+        fid, blob, fname = function_descriptor(function, self)
         resources = dict(resources or {})
         resources.setdefault("CPU", 1.0)
         pg = None
         if placement_group is not None:
             pg = [placement_group.id_hex, max(placement_group_bundle_index, 0)]
-        spec = TaskSpec(
+        return TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
             task_type=NORMAL_TASK,
@@ -2177,12 +2333,17 @@ class Worker:
             placement_group_bundle_index=(pg[1] if pg else -1),
             runtime_env=runtime_env,
             trace_ctx=self._trace_for_submit(),
-            # stamped at FIRST submission and replayed verbatim, so a
-            # lineage re-execution seeds the task body's RNG identically
-            # and reproduces byte-identical returns (ISSUE 17)
             replay_seed=_replay_seed(task_id.binary()),
         )
+
+    def _finish_submit(self, spec: TaskSpec, task_id: TaskID,
+                       creator_prefix: str, post_target,
+                       *post_lead_args) -> List[ObjectRef]:
+        """Shared submission tail: return-ref registration, record
+        bookkeeping, PENDING event and the loop-thread post. ``post_target``
+        receives ``(*post_lead_args, record)`` on the loop thread."""
         callsite = _user_callsite()
+        num_returns = spec.num_returns
         if num_returns == -1:  # streaming generator
             record = TaskRecord(spec, [], callsite=callsite)
             from ray_tpu._private.streaming import ObjectRefGenerator
@@ -2191,21 +2352,158 @@ class Worker:
             self._tasks[task_id.binary()] = record
             self._pin_args(spec)
             self._record_task_event(spec, "PENDING")
-            self._post(self._submit_to_pool_sync, record)
+            self._post(post_target, *post_lead_args, record)
             return record.streaming_gen
-        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
         refs = []
+        creator = creator_prefix + spec.function_name
         for oid in return_ids:
             self.reference_counter.register_owned(
-                oid, callsite=callsite, creator="task:" + spec.function_name,
+                oid, callsite=callsite, creator=creator,
                 creator_id=task_id.hex())
             refs.append(ObjectRef(oid, self.direct_addr()))
         record = TaskRecord(spec, return_ids, callsite=callsite)
         self._tasks[task_id.binary()] = record
         self._pin_args(spec)
         self._record_task_event(spec, "PENDING")
-        self._post(self._submit_to_pool_sync, record)
+        self._post(post_target, *post_lead_args, record)
         return refs
+
+    def submit_many(
+        self,
+        function,
+        args_list: List[tuple],
+        kwargs_list: Optional[List[dict]] = None,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: int = -1,
+        retry_exceptions: bool = False,
+        scheduling_strategy=None,
+        placement_group=None,
+        placement_group_bundle_index: int = -1,
+        runtime_env: Optional[Dict] = None,
+        name: str = "",
+    ) -> List[List[ObjectRef]]:
+        """Vectorized :meth:`submit_task` (ISSUE 18): N calls of ONE
+        (function, options) signature built in a single pass — one
+        id-allocation block, one owner-ref registration batch, one trace
+        stamp (a ``submit_batch::`` root span carrying ``count`` instead
+        of N roots), one loop-thread post, and one PushTaskBatchStream
+        frame per destination worker downstream. Returns one
+        ``List[ObjectRef]`` per call, in submission order. Semantics are
+        identical to a loop of ``submit_task`` calls — per-entry failure
+        isolation, lineage and ownership included."""
+        n = len(args_list)
+        if n == 0:
+            return []
+        if num_returns < 0:
+            raise ValueError(
+                "submit_many does not support streaming tasks "
+                "(num_returns='streaming')")
+        if max_retries < 0:
+            max_retries = CONFIG.task_max_retries_default
+        if not CONFIG.submit_fastpath_enabled:
+            return [
+                self.submit_task(
+                    function, args, (kwargs_list[i] if kwargs_list else {}),
+                    num_returns=num_returns, resources=resources,
+                    max_retries=max_retries,
+                    retry_exceptions=retry_exceptions,
+                    scheduling_strategy=scheduling_strategy,
+                    placement_group=placement_group,
+                    placement_group_bundle_index=placement_group_bundle_index,
+                    runtime_env=runtime_env, name=name)
+                for i, args in enumerate(args_list)
+            ]
+        self._n_tasks_submitted = \
+            getattr(self, "_n_tasks_submitted", 0) + n
+        tpl = self._task_template(
+            function, num_returns, resources, max_retries, retry_exceptions,
+            scheduling_strategy, placement_group,
+            placement_group_bundle_index, runtime_env, name)
+        t0 = time.time()
+        tc = self._trace_for_submit()  # ONE stamp for the whole batch
+        callsite = _user_callsite()
+        task_ids = TaskID.random_block(n)
+        wire_args_list = self._build_args_many(args_list)
+        owner = self.direct_addr()
+        counter = self.reference_counter
+        tasks = self._tasks
+        instantiate = tpl.instantiate
+        records: List[TaskRecord] = []
+        all_refs: List[List[ObjectRef]] = []
+        reg_entries: List[Tuple[bytes, str]] = []
+        ref_binaries: List[bytes] = []
+        for i in range(n):
+            tid = task_ids[i]
+            tb = tid.binary()
+            spec = instantiate(
+                tb, wire_args_list[i],
+                (self._build_kwargs(kwargs_list[i]) if kwargs_list
+                 and kwargs_list[i] else {}),
+                trace_ctx=None, replay_seed=_replay_seed(tb))
+            tid_hex = tb.hex()
+            refs = []
+            return_ids = []
+            for j in range(num_returns):
+                oid = ObjectID.for_task_return(tid, j)
+                ob = oid.binary()
+                return_ids.append(oid)
+                reg_entries.append((ob, tid_hex))
+                ref_binaries.append(ob)
+                ref = ObjectRef(oid, owner, _register=False)
+                ref._registered = True
+                refs.append(ref)
+            record = TaskRecord(spec, return_ids, callsite=callsite)
+            tasks[tb] = record
+            records.append(record)
+            all_refs.append(refs)
+            if spec.args or spec.kwargs:
+                self._pin_args(spec)
+        fname = tpl.base["function_name"]
+        counter.register_owned_batch(reg_entries, callsite=callsite,
+                                     creator="task:" + fname)
+        counter.add_local_refs_batch(ref_binaries)
+        self._record_task_events_batch(records, "PENDING")
+        if tc is not None:
+            _events.REC.record(
+                "submit_batch::" + fname, "task", t0,
+                max(0.0, time.time() - t0), tc[0], tc[1],
+                tc[2] if len(tc) > 2 else 0, {"count": n})
+        self._post(self._submit_many_to_pool_sync, records)
+        return all_refs
+
+    def _build_kwargs(self, kwargs: dict) -> Dict[str, Tuple]:
+        return {k: v for k, v in zip(kwargs.keys(),
+                                     self._build_args(tuple(kwargs.values())))}
+
+    def _record_task_events_batch(self, records: List[TaskRecord],
+                                  state: str) -> None:
+        """One append loop + one flush check for a submit_many batch —
+        batched specs carry no per-task trace_ctx (the batch root span is
+        recorded by the caller), so no span bookkeeping either."""
+        now = time.time()
+        events = self.task_events
+        for r in records:
+            spec = r.spec
+            events.append((spec.task_id, spec.job_id, spec.function_name,
+                           state, spec.task_type, now))
+        if len(events) >= CONFIG.task_event_flush_batch:
+            self.flush_task_events()
+
+    def _submit_many_to_pool_sync(self, records: List[TaskRecord]) -> None:
+        """Loop-thread landing for a submit_many batch: ONE lease-pool
+        lookup (one signature = one scheduling key) and one deferred pump
+        for the whole batch."""
+        if not records:
+            return
+        key = records[0].spec.scheduling_key()
+        pool = self._lease_pools.get(key)
+        if pool is None:
+            pool = _LeasePool(self, key, records[0].spec)
+            self._lease_pools[key] = pool
+        pool.submit_batch(records)
 
     def submit_xlang_task(
         self,
@@ -2276,6 +2574,35 @@ class Worker:
                 wire.append(("v", sobj.to_bytes()))
         return wire
 
+    def _build_args_many(self, args_list: List[tuple]) -> List[List]:
+        """Batch arg wiring for submit_many: same per-entry semantics as
+        :meth:`_build_args`, plus a per-batch serialization memo so an
+        object shared across the batch's calls serializes once."""
+        from ray_tpu._private.serialization import SerializeMemo
+
+        memo = SerializeMemo()
+        ser_memoized = self.serialization_context.serialize_memoized
+        mget = self.memory_store.get
+        out = []
+        for args in args_list:
+            wire = []
+            for a in args:
+                if isinstance(a, ObjectRef):
+                    entry = mget(a.binary())
+                    if entry is not None and entry[1] == VAL:
+                        wire.append(("iv", entry[0]))
+                    else:
+                        wire.append(("r", a.binary(), a.owner_addr()))
+                else:
+                    ctx = ser.get_reducer_context()
+                    ctx.collected_refs = []
+                    try:
+                        wire.append(("v", ser_memoized(a, memo)))
+                    finally:
+                        ctx.collected_refs = None
+            out.append(wire)
+        return out
+
     def _pin_args(self, spec: TaskSpec) -> None:
         for entry in list(spec.args) + list(spec.kwargs.values()):
             if entry[0] == "r":
@@ -2295,6 +2622,44 @@ class Worker:
         pool.submit(record)
 
     # ----------------------------------------------------- completion paths
+    def _completion_enqueue(self, cb, i, reply) -> None:
+        """Batched completion delivery (ISSUE 18): per-item completions
+        landing on the read-loop side in one burst — BatchItems frames, or
+        several frames draining in one loop pass — buffer here and resolve
+        together in ONE deferred drain, so N inline returns cost one
+        memory-store lock pass and one resolved-state pass instead of N."""
+        self._completion_buf.append((cb, i, reply))
+        if not self._completions_armed:
+            self._completions_armed = True
+            self.loop.call_soon(self._drain_completions)
+
+    def _drain_completions(self) -> None:
+        self._completions_armed = False
+        buf = self._completion_buf
+        if not buf:
+            return
+        self._completion_buf = []
+        # while the sink is armed, _resolve_return diverts inline
+        # resolutions into it instead of writing through per id
+        sink = self._resolve_sink = []
+        try:
+            for cb, i, reply in buf:
+                try:
+                    cb(i, reply)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("ray_tpu").exception(
+                        "error in batched completion delivery")
+        finally:
+            self._resolve_sink = None
+        if not sink:
+            return
+        self.memory_store.put_batch(sink)
+        self.reference_counter.set_resolved_batch(
+            [(b, ("error" if f == EXC else "inline"), len(d))
+             for b, d, f in sink])
+
     def _on_task_reply(self, record: TaskRecord, reply: Dict) -> None:
         if record.completed:
             return  # cancelled or already resolved; late reply is dropped
@@ -2440,6 +2805,14 @@ class Worker:
             return
         if ret.get("inline") is not None:
             flags = EXC if ret.get("is_exception") else VAL
+            sink = self._resolve_sink
+            if sink is not None:
+                # batched completion drain in progress: divert into the
+                # sink; the drain writes the whole batch through in one
+                # put_batch + set_resolved_batch pass (same per-object
+                # ordering — value lands before its resolved state)
+                sink.append((oid.binary(), ret["inline"], flags))
+                return
             self.memory_store.put(oid.binary(), ret["inline"], flags)
             self.reference_counter.set_resolved(
                 oid.binary(), "error" if flags == EXC else "inline",
@@ -2809,49 +3182,148 @@ class Worker:
             # reference semantics: -1 = retry indefinitely
             max_retries = 2 ** 31
         wire_args = self._build_args(args) if args else []
-        wire_kwargs = ({k: v for k, v in zip(kwargs.keys(),
-                                             self._build_args(
-                                                 tuple(kwargs.values())))}
-                       if kwargs else {})
-        spec = TaskSpec(
-            task_id=task_id.binary(),
+        wire_kwargs = self._build_kwargs(kwargs) if kwargs else {}
+        if CONFIG.submit_fastpath_enabled:
+            tpl = self._actor_template(actor_id, method_name, num_returns,
+                                       max_retries)
+            spec = tpl.instantiate(
+                task_id.binary(), wire_args, wire_kwargs,
+                trace_ctx=self._trace_for_submit(), seq=seq)
+        else:
+            spec = TaskSpec(
+                task_id=task_id.binary(),
+                job_id=self.job_id.binary(),
+                task_type=ACTOR_TASK,
+                function_id=b"\x00" * 16,
+                function_name=method_name,
+                args=wire_args,
+                kwargs=wire_kwargs,
+                num_returns=num_returns,
+                resources={},
+                owner_addr=self.direct_addr(),
+                actor_id=actor_id.binary(),
+                actor_method=method_name,
+                seq=seq,
+                max_retries=max_retries,
+                trace_ctx=self._trace_for_submit(),
+            )
+        return self._finish_submit(spec, task_id, "actor:", st.enqueue, self)
+
+    def _actor_template(self, actor_id: ActorID, method_name: str,
+                        num_returns: int, max_retries: int) -> SpecTemplate:
+        """Frozen spec template for one (actor, method, options) signature
+        — the actor-call analog of :meth:`_task_template` (no function
+        blob: the method resolves executor-side from the actor's class)."""
+        key = ("actor", actor_id.binary(), method_name, num_returns,
+               max_retries)
+        tpl = self._spec_templates.get(key)
+        if tpl is not None:
+            return tpl
+        tpl = SpecTemplate(
             job_id=self.job_id.binary(),
             task_type=ACTOR_TASK,
             function_id=b"\x00" * 16,
             function_name=method_name,
-            args=wire_args,
-            kwargs=wire_kwargs,
             num_returns=num_returns,
             resources={},
             owner_addr=self.direct_addr(),
             actor_id=actor_id.binary(),
             actor_method=method_name,
-            seq=seq,
             max_retries=max_retries,
-            trace_ctx=self._trace_for_submit(),
         )
-        callsite = _user_callsite()
-        if num_returns == -1:  # streaming actor method
-            record = TaskRecord(spec, [], callsite=callsite)
-            from ray_tpu._private.streaming import ObjectRefGenerator
+        if len(self._spec_templates) >= CONFIG.spec_template_cache_max:
+            self._spec_templates.clear()
+        self._spec_templates[key] = tpl
+        return tpl
 
-            record.streaming_gen = ObjectRefGenerator(task_id.hex())
-            self._tasks[task_id.binary()] = record
-            self._pin_args(spec)
-            self._post(st.enqueue, self, record)
-            return record.streaming_gen
-        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
-        refs = []
-        for oid in return_ids:
-            self.reference_counter.register_owned(
-                oid, callsite=callsite, creator="actor:" + method_name,
-                creator_id=task_id.hex())
-            refs.append(ObjectRef(oid, self.direct_addr()))
-        record = TaskRecord(spec, return_ids, callsite=callsite)
-        self._tasks[task_id.binary()] = record
-        self._pin_args(spec)
-        self._post(st.enqueue, self, record)
-        return refs
+    def submit_actor_tasks_many(
+        self,
+        calls: List[Tuple],
+        num_returns: int = 1,
+        max_retries: int = 0,
+    ) -> List[List[ObjectRef]]:
+        """Vectorized :meth:`submit_actor_task` (ISSUE 18). ``calls`` is
+        ``[(actor_id, method_name, args, kwargs)]`` — possibly spanning
+        MANY actors (the serve controller's replica fan-outs broadcast one
+        method across every replica). Per-actor seq order follows list
+        order; records land on each actor's queue as one batch, so a
+        same-actor run of calls rides one PushTaskBatchStream frame."""
+        n = len(calls)
+        if n == 0:
+            return []
+        if num_returns < 0:
+            raise ValueError(
+                "submit_actor_tasks_many does not support streaming calls")
+        if max_retries < 0:
+            max_retries = 2 ** 31
+        if not CONFIG.submit_fastpath_enabled:
+            return [
+                self.submit_actor_task(aid, method, args, kwargs,
+                                       num_returns=num_returns,
+                                       max_retries=max_retries)
+                for aid, method, args, kwargs in calls
+            ]
+        self._n_actor_calls = getattr(self, "_n_actor_calls", 0) + n
+        t0 = time.time()
+        tc = self._trace_for_submit()  # ONE stamp for the whole batch
+        callsite = _user_callsite()
+        owner = self.direct_addr()
+        wid = self.worker_id.binary()
+        tasks = self._tasks
+        records: List[TaskRecord] = []
+        all_refs: List[List[ObjectRef]] = []
+        reg_entries: List[Tuple] = []
+        ref_binaries: List[bytes] = []
+        groups: Dict[int, Tuple] = {}  # id(state) -> (state, [records])
+        for actor_id, method_name, args, kwargs in calls:
+            st = self.actor_state_for(actor_id)
+            seq = st.next_seq()
+            task_id = TaskID.for_actor_task(actor_id, seq, wid)
+            tb = task_id.binary()
+            tpl = self._actor_template(actor_id, method_name, num_returns,
+                                       max_retries)
+            spec = tpl.instantiate(
+                tb, self._build_args(args) if args else [],
+                self._build_kwargs(kwargs) if kwargs else {},
+                trace_ctx=None, seq=seq)
+            tid_hex = tb.hex()
+            creator = "actor:" + method_name
+            refs = []
+            return_ids = []
+            for j in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, j)
+                ob = oid.binary()
+                return_ids.append(oid)
+                reg_entries.append((ob, tid_hex, creator))
+                ref_binaries.append(ob)
+                ref = ObjectRef(oid, owner, _register=False)
+                ref._registered = True
+                refs.append(ref)
+            record = TaskRecord(spec, return_ids, callsite=callsite)
+            tasks[tb] = record
+            if spec.args or spec.kwargs:
+                self._pin_args(spec)
+            records.append(record)
+            all_refs.append(refs)
+            grp = groups.get(id(st))
+            if grp is None:
+                groups[id(st)] = grp = (st, [])
+            grp[1].append(record)
+        counter = self.reference_counter
+        counter.register_owned_batch(reg_entries, callsite=callsite)
+        counter.add_local_refs_batch(ref_binaries)
+        self._record_task_events_batch(records, "PENDING")
+        if tc is not None:
+            _events.REC.record(
+                "submit_batch::actor_calls", "task", t0,
+                max(0.0, time.time() - t0), tc[0], tc[1],
+                tc[2] if len(tc) > 2 else 0, {"count": n})
+        self._post(self._enqueue_actor_batches_sync, list(groups.values()))
+        return all_refs
+
+    def _enqueue_actor_batches_sync(self, groups: List[Tuple]) -> None:
+        for st, records in groups:
+            st.enqueue_batch(self, records)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         # order after any queued batched create: the head must know the
@@ -3063,6 +3535,15 @@ class _LeasePool:
         # in the same tick lands in pending TOGETHER and rides batched
         # PushTaskBatch frames (the actor path defers its flush the same
         # way); a lone submit still pumps within the same loop iteration
+        if not self._pump_scheduled:
+            self._pump_scheduled = True
+            asyncio.get_running_loop().call_soon(self._scheduled_pump)
+
+    def submit_batch(self, records: List[TaskRecord]) -> None:
+        """A submit_many batch lands in pending as ONE extend and one
+        deferred pump — the per-record doorbell loop is the exact cost
+        submit_many exists to remove."""
+        self.pending.extend(records)
         if not self._pump_scheduled:
             self._pump_scheduled = True
             asyncio.get_running_loop().call_soon(self._scheduled_pump)
@@ -3364,10 +3845,10 @@ class _LeasePool:
                 continue
             if record.spec.trace_ctx is not None:
                 _span_since(record, "lease_wait")
-            wire = dict(record.spec.to_wire())  # copy: cached base
-            wire["assigned_instances"] = getattr(
-                conn, "assigned_instances", {})
-            wires.append(wire)
+            # no per-item copy: assigned_instances is identical for every
+            # item on one conn, so it rides the frame ONCE as a batch-level
+            # key ("ai") and the executor applies it to each spec
+            wires.append(record.spec.to_wire())
             live.append(record)
         if not live:
             return
@@ -3409,10 +3890,20 @@ class _LeasePool:
                 self.worker._on_task_failure(record, e, retriable=False)
             self._after_stream_item(conn)
 
-        batches[bid] = on_item
+        if CONFIG.completion_batch_enabled:
+            # items from one BatchItems frame (or several frames in one
+            # read pass) resolve together via the worker's completion
+            # queue — one memory-store/ref-counter pass for the burst
+            w = self.worker
+            batches[bid] = lambda i, reply: \
+                w._completion_enqueue(on_item, i, reply)
+        else:
+            batches[bid] = on_item
         try:
-            fut = client.call_future("PushTaskBatchStream",
-                                     {"b": bid, "specs": wires})
+            fut = client.call_future(
+                "PushTaskBatchStream",
+                {"b": bid, "specs": wires,
+                 "ai": getattr(conn, "assigned_instances", {})})
         except Exception:
             batches.pop(bid, None)
             self._on_batch_failed(conn, live)
@@ -3644,6 +4135,21 @@ class _ActorState:
             asyncio.get_running_loop().call_soon(
                 self._scheduled_flush, worker)
 
+    def enqueue_batch(self, worker: Worker, records: List[TaskRecord]) -> None:
+        """A submit_actor_tasks_many group lands as one extend + one
+        deferred flush (vs. a doorbell per call), then leaves as one
+        PushTaskBatchStream frame per BATCH_MAX window."""
+        if self.state == "DEAD":
+            err = self._died_error()
+            for r in records:
+                worker._on_task_failure(r, err, retriable=False)
+            return
+        self.queue.extend(records)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(
+                self._scheduled_flush, worker)
+
     def _scheduled_flush(self, worker: Worker) -> None:
         self._flush_scheduled = False
         self._flush(worker)
@@ -3759,7 +4265,11 @@ class _ActorState:
                     record.spec.function_name)
                 worker._on_task_failure(record, e, retriable=False)
 
-        batches[bid] = on_item
+        if CONFIG.completion_batch_enabled:
+            batches[bid] = lambda i, reply: \
+                worker._completion_enqueue(on_item, i, reply)
+        else:
+            batches[bid] = on_item
         for r in records:
             if r.spec.trace_ctx is not None:
                 _span_since(r, "enqueue_wait")

@@ -12,6 +12,7 @@ a concurrency cap, and writes large returns straight to the node's shm store.
 from __future__ import annotations
 
 import asyncio
+import functools
 import inspect
 import os
 import sys
@@ -119,6 +120,13 @@ class Executor:
         amortized onto one submission frame)."""
         bid = p["b"]
         wires = p["specs"]
+        ai = p.get("ai")
+        if ai:
+            # batch-level accelerator assignment (ISSUE 18): identical for
+            # every item on one leased worker, so it rides the frame once
+            # instead of being copied into each spec by the submitter
+            for w in wires:
+                w.setdefault("assigned_instances", ai)
         # items completing in the same loop tick coalesce into ONE frame
         # (a serial run of sub-ms tasks streams as a few chunky pushes; a
         # slow task's result still leaves the moment it lands)
@@ -133,6 +141,49 @@ class Executor:
                     conn.push_nowait("BatchItems", {"b": bid, "xs": items})
                 except Exception:
                     pass  # owner gone; the final reply will fail too
+
+        # drainer fast lane (ISSUE 18): a frame whose items all execute on
+        # the serial drainer — normal tasks, or sync methods of a
+        # concurrency-1 actor — lands in the exec queue under ONE lock
+        # with plain future callbacks, instead of a coroutine + per-item
+        # enqueue per task. Async/concurrent actors keep the general path
+        # (their ordering runs through chains/semaphores, not the queue).
+        if len(wires) > 1 and not self._actor_has_async \
+                and self._max_concurrency == 1:
+            if not self.worker.ready_event.is_set():
+                await self.worker.ready_event.wait()
+            loop = asyncio.get_running_loop()
+            futs: List[asyncio.Future] = []
+            with self._exec_mu:
+                for w in wires:
+                    fut = loop.create_future()
+                    self._exec_queue.append(
+                        (TaskSpec.from_wire(w),
+                         w.get("assigned_instances") or {}, fut, loop))
+                    futs.append(fut)
+                start_drainer = not self._drainer_active
+                if start_drainer:
+                    self._drainer_active = True
+            if start_drainer:
+                pool = (self._actor_pool if self._actor_pool is not None
+                        else self._task_pool)
+                pool.submit(self._drain_exec)
+
+            def on_done(i: int, fut: "asyncio.Future") -> None:
+                e = fut.exception()
+                out.append((i, {"batch_item_error": repr(e)}
+                            if e is not None else fut.result()))
+                if not armed[0]:
+                    armed[0] = True
+                    loop.call_soon(flush)
+
+            for i, fut in enumerate(futs):
+                fut.add_done_callback(functools.partial(on_done, i))
+            await asyncio.gather(*futs, return_exceptions=True)
+            flush()
+            if _events.REC.enabled:
+                self.worker._maybe_flush_spans()
+            return {"n": len(wires)}
 
         async def run_one(i: int, wire: Dict) -> None:
             try:

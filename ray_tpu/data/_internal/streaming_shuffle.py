@@ -170,6 +170,16 @@ class _ShuffleAlgo:
     def map_submit(self, block_ref, salt: int, n: int) -> List[Any]:
         raise NotImplementedError
 
+    def map_submit_many(self, block_refs: List[Any], salts: List[int],
+                        n: int) -> List[List[Any]]:
+        """Vectorized map dispatch (ISSUE 18): one driver pass for a run
+        of map tasks. Default falls back to per-call map_submit; algos
+        override with ``fn.map`` so the whole run rides one id block /
+        registration batch / wire frame. MUST be byte-identical to the
+        sequential loop — same salts, same seed, same num_returns."""
+        return [self.map_submit(b, s, n)
+                for b, s in zip(block_refs, salts)]
+
     def reduce_submit(self, shard_refs, i: int):
         raise NotImplementedError
 
@@ -196,6 +206,14 @@ class RandomShuffleAlgo(_ShuffleAlgo):
         return ray_tpu.remote(_shuffle_map_shards).options(
             name="Data::ShuffleMap", num_returns=n + 1,
             **self.map_remote_args).remote(block_ref, n, self.seed, salt)
+
+    def map_submit_many(self, block_refs, salts, n):
+        from itertools import repeat
+
+        return ray_tpu.remote(_shuffle_map_shards).options(
+            name="Data::ShuffleMap", num_returns=n + 1,
+            **self.map_remote_args).map(
+                block_refs, repeat(n), repeat(self.seed), salts)
 
     def reduce_submit(self, shard_refs, i: int):
         return ray_tpu.remote(_shuffle_reduce_shards).options(
@@ -233,6 +251,16 @@ class SortAlgo(_ShuffleAlgo):
             name="Data::SortMap", num_returns=n + 1,
             **self.map_remote_args).remote(
                 block_ref, self.key, self.boundaries, n)
+
+    def map_submit_many(self, block_refs, salts, n):
+        # salt does not enter the sort map; arg order matches map_submit
+        from itertools import repeat
+
+        return ray_tpu.remote(_sort_map_shards).options(
+            name="Data::SortMap", num_returns=n + 1,
+            **self.map_remote_args).map(
+                block_refs, repeat(self.key), repeat(self.boundaries),
+                repeat(n))
 
     def reduce_submit(self, shard_refs, i: int):
         return ray_tpu.remote(_sort_reduce_shards).options(
@@ -441,7 +469,10 @@ class StreamingShuffleOperator(PhysicalOperator):
             self._admit_reduce(red)
             return
         if self._map_ready:
-            self._dispatch_map(self._map_ready.popleft())
+            # the plan is fixed by the time _map_ready fills (every map
+            # must launch before any reducer is admitted), so the whole
+            # run rides ONE vectorized submission (ISSUE 18)
+            self._dispatch_map_batch()
             return
         if self.input_queue:
             bundle = self.input_queue.popleft()
@@ -461,6 +492,23 @@ class StreamingShuffleOperator(PhysicalOperator):
             refs = self.algo.map_submit(bundle.block_ref, salt, self._n)
         self.tasks_launched += 1
         self._maps.append(_MapRec(bundle, salt, refs))
+
+    def _dispatch_map_batch(self) -> None:
+        bundles = list(self._map_ready)
+        self._map_ready.clear()
+        if len(bundles) == 1:
+            self._dispatch_map(bundles[0])
+            return
+        # sequential salts in list order — byte-identical to the popleft
+        # loop this replaces (the sha256 asserts in scale_bench hold)
+        base = len(self._maps)
+        salts = [base + i for i in range(len(bundles))]
+        with _ev.trace_parent(self._trace):
+            refs_list = self.algo.map_submit_many(
+                [b.block_ref for b in bundles], salts, self._n)
+        self.tasks_launched += len(bundles)
+        for bundle, salt, refs in zip(bundles, salts, refs_list):
+            self._maps.append(_MapRec(bundle, salt, refs))
 
     def _admit_reduce(self, r: _ReduceRec) -> None:
         shard_refs = [m.shard_refs[r.index] for m in self._maps]

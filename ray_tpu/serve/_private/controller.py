@@ -435,13 +435,24 @@ class ServeController(LongPollHost):
             except Exception:
                 pass
 
+    def _call_replicas(self, replicas: List[_ReplicaState], method: str,
+                       *args) -> List:
+        """Same-method fan-out over every replica as ONE vectorized
+        submission (ISSUE 18): one id block, one ownership batch, one
+        wire frame per actor — instead of N sequential .remote() calls
+        through the driver. Returns one ref per replica, in order."""
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        calls = [(r.handle._actor_id, method, args, {}) for r in replicas]
+        return [refs[0] for refs in w.submit_actor_tasks_many(calls)]
+
     async def _reconfigure_replicas(self, info: _DeploymentInfo):
-        for r in info.replicas:
+        refs = self._call_replicas(
+            info.replicas, "reconfigure", info.spec.get("user_config"))
+        for r, ref in zip(info.replicas, refs):
             try:
-                await asyncio.to_thread(
-                    ray_tpu.get,
-                    r.handle.reconfigure.remote(
-                        info.spec.get("user_config")), timeout=30)
+                await asyncio.to_thread(ray_tpu.get, ref, timeout=30)
             except Exception:
                 r.healthy = False
 
@@ -455,10 +466,11 @@ class ServeController(LongPollHost):
         changed = False
         total_ongoing = 0
         total_queued = 0
-        for r in info.replicas:
+        probe_refs = self._call_replicas(info.replicas, "health_check")
+        for r, probe_ref in zip(info.replicas, probe_refs):
             try:
                 probe = await asyncio.to_thread(
-                    ray_tpu.get, r.handle.health_check.remote(), timeout=5)
+                    ray_tpu.get, probe_ref, timeout=5)
                 if isinstance(probe, dict):
                     r.last_ongoing = int(probe.get("ongoing", 0))
                     r.last_queued = int(probe.get("queued", 0))

@@ -349,6 +349,23 @@ class TestWarmPoolCluster:
         # worker STILL never touched jax (mux/shm_rpc import none)
         assert SHM_STATS["calls_out"] > shm_before, \
             "same-node probe call did not ride the shm lane"
+
+        # the batched fast path (ISSUE 18) keeps the gate contract too:
+        # a map() batch through the warm pool leaves every executing
+        # worker jax-free, and the driver's spec-template cache (the
+        # fast path's signature memo) was actually exercised
+        @ray_tpu.remote(num_cpus=0.001)
+        def jax_loaded(i):
+            import sys
+
+            return "jax" in sys.modules
+
+        assert ray_tpu.get(jax_loaded.map(range(8)),
+                           timeout=120) == [False] * 8
+        import ray_tpu._private.worker as _worker_mod
+
+        assert _worker_mod.global_worker._spec_templates, \
+            "map() batch did not populate the spec-template cache"
         ray_tpu.kill(probe)
 
     def test_kill_warm_then_leased_worker(self, warm_cluster):

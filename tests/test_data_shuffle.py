@@ -99,6 +99,16 @@ def test_shuffle_modules_import_no_jax():
         "ray_tpu.data._internal.shard_codec, "
         "ray_tpu.data._internal.executor, "
         "ray_tpu.data._internal.shuffle; "
+        # the vectorized-submission fast path (ISSUE 18) now sits on the
+        # shuffle dispatch graph: the spec-template machinery must stay
+        # jax-free too, and actually building a template must not pull
+        # anything heavier in
+        "import ray_tpu.remote_function; "
+        "from ray_tpu._private.task_spec import SpecTemplate, NORMAL_TASK; "
+        "t = SpecTemplate(job_id=b'j'*4, task_type=NORMAL_TASK, "
+        "function_id=b'f'*16, function_name='p', num_returns=1, "
+        "resources={}, owner_addr={}); "
+        "t.instantiate(b'i'*16, [], {}); "
         "import sys; assert 'jax' not in sys.modules, 'jax imported'"
     )
     subprocess.run([sys.executable, "-c", code], check=True, timeout=120)
