@@ -849,10 +849,14 @@ class NodeAgent:
                         timeout=CONFIG.control_rpc_timeout_s)
                     last_sent = snapshot
                 else:
-                    await self.head.call(
+                    reply = await self.head.call(
                         "UpdateResources",
                         {"node_id": self.node_id, "hb": True, "v": version},
                         timeout=CONFIG.control_rpc_timeout_s)
+                    if reply and reply.get("resync"):
+                        # the head's applied version disagrees with ours
+                        # (restart / lost report): next tick sends full
+                        last_sent = None
             except Exception:
                 # head unreachable or restarted: resend full on recovery
                 last_sent = None
@@ -1215,6 +1219,10 @@ class NodeAgent:
                 self._starting_workers = max(0, self._starting_workers - 1)
                 self._spawn_slot_freed(handle)
                 self._plain_spawn_done(handle)
+            # raylint: disable=R14 -- the sender is cross-language: C++
+            # workers (cpp/include/ray_tpu/worker.hpp RegisterClient)
+            # self-tag language:cpp via env_key; no Python send site
+            # ships the key, so the linter can't see the producer
             if p.get("env_key"):
                 # self-tagged env affinity (C++ workers tag themselves
                 # language:cpp so only matching leases land on them)
@@ -1965,10 +1973,14 @@ class NodeAgent:
     async def _wait_objects(self, conn: Connection, p: Dict) -> Dict:
         """Wait until num_returns of the ids are local, pulling remotes.
 
-        p: {ids: [hex], owners: {hex: owner_addr}, num_returns, timeout_ms}
+        p: {ids: [hex], owners: {hex: owner_addr}, locations: {hex:
+        [addr]}, num_returns, timeout_ms}. ``locations`` are the
+        caller's last-known holders (owner directory / borrow reply) —
+        used as a routed-fetch fallback when the owner is unreachable.
         """
         ids: List[str] = p["ids"]
         owners: Dict[str, Dict] = p.get("owners", {})
+        hints: Dict[str, List[Dict]] = p.get("locations", {}) or {}
         num_returns = p.get("num_returns", len(ids))
         timeout_ms = p.get("timeout_ms")
         tc = p.get("tc")  # caller's trace context (sampled get)
@@ -1991,7 +2003,8 @@ class NodeAgent:
             owner = owners.get(hex_id)
             if owner and hex_id not in self._pulls_inflight:
                 self._pulls_inflight[hex_id] = asyncio.get_running_loop().create_task(
-                    self._pull_object(hex_id, owner, tc=tc)
+                    self._pull_object(hex_id, owner, tc=tc,
+                                      hint_locs=hints.get(hex_id))
                 )
 
         def ready_count() -> int:
@@ -2087,7 +2100,7 @@ class NodeAgent:
         spawn_tracked(reap(), "agent-orphan-pull-reap")
 
     async def _pull_object(self, hex_id: str, owner: Dict,
-                           tc=None) -> None:
+                           tc=None, hint_locs=None) -> None:
         """Flight-recorder shell around the pull: one ``pull`` span per
         admission, stitched under the caller's get() trace when the
         WaitObjects frame carried one, else its own sampled root."""
@@ -2103,17 +2116,19 @@ class NodeAgent:
                             {"obj": hex_id[:16]})
             try:
                 await self._pull_object_inner(hex_id, owner,
-                                              tc=(trace, span))
+                                              tc=(trace, span),
+                                              hint_locs=hint_locs)
             finally:
                 rec.record("pull", "object", t0, time.time() - t0,
                            trace, span, parent,
                            {"obj": hex_id[:16],
                             "sealed": bool(self.store.contains(hex_id))})
         else:
-            await self._pull_object_inner(hex_id, owner)
+            await self._pull_object_inner(hex_id, owner,
+                                          hint_locs=hint_locs)
 
     async def _pull_object_inner(self, hex_id: str, owner: Dict,
-                                 tc=None) -> None:
+                                 tc=None, hint_locs=None) -> None:
         """Owner-directed pull (reference: pull_manager.h + ownership-based
         object directory): ask the owner where the object lives, then hand
         the holder set to the pull manager — windowed pipeline, multi-
@@ -2146,6 +2161,21 @@ class NodeAgent:
                 except asyncio.CancelledError:
                     raise
                 except Exception:
+                    # Owner unreachable: fall back to the caller's hinted
+                    # holders (borrow-reply locations survive the owner)
+                    # before the blind sleep-retry — a borrower can often
+                    # restore from a live replica while the owner's node
+                    # is mid-recovery.
+                    hinted = [
+                        a for a in (hint_locs or [])
+                        if not (a.get("host") == "127.0.0.1"
+                                and a.get("port") == self.tcp_port)]
+                    if hinted:
+                        st = await self._fetch_routed(hex_id, hinted,
+                                                      tc=tc)
+                        if st == "ok":
+                            self._notify_sealed(hex_id)
+                            return
                     await asyncio.sleep(CONFIG.object_pull_retry_s)
                     continue
                 if loc is None:
@@ -3062,6 +3092,9 @@ def main() -> None:
     import argparse
     import json
 
+    from ray_tpu._private import sanitizer as _sanitizer
+
+    _sanitizer.maybe_install()
     parser = argparse.ArgumentParser()
     parser.add_argument("--node-id", required=True)
     parser.add_argument("--session-dir", required=True)

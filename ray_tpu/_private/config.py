@@ -34,9 +34,7 @@ def _flag(name: str, default: Any) -> None:
 
 # --- scheduling -------------------------------------------------------------
 _flag("scheduler_spread_threshold", 0.5)  # hybrid policy: prefer local below this load
-_flag("scheduler_top_k_fraction", 0.2)
 _flag("max_pending_lease_requests_per_scheduling_category", 10)
-_flag("worker_lease_timeout_ms", 30_000)
 _flag("lease_pipeline_depth", 2)  # tasks in flight per leased worker
 _flag("lease_pipeline_depth_short_task", 48)  # when exec EMA < short ms
 _flag("pipeline_short_task_ms", 2.0)   # exec EMA below => deep pipeline
@@ -53,10 +51,6 @@ _flag("actor_creation_timeout_ms", 120_000)
 
 # --- object store -----------------------------------------------------------
 _flag("object_store_memory_bytes", 0)  # 0 = auto (30% of system memory)
-_flag("object_store_full_delay_ms", 100)
-_flag("object_spilling_threshold", 0.8)
-_flag("object_spilling_dir", "")  # "" = <session dir>/spill
-_flag("min_spilling_size_bytes", 1024 * 1024)
 # Cross-node transfer chunk. 1 MB beat 5 MB consistently in the two-node
 # localhost sweep (0.375 vs 0.149 GB/s at window 8): smaller chunks keep
 # both event loops streaming instead of stalling on multi-MB
@@ -309,8 +303,6 @@ _flag("gossip_period_ms", 100)  # resource-view sync cadence (ray_syncer analog)
 # members, worker<->worker); below it they inline through the rendezvous
 # store (one RPC beats put+get for metadata-sized tensors).
 _flag("collective_inline_max_bytes", 65536)
-_flag("pubsub_poll_timeout_s", 30)
-_flag("kv_namespace_default", "default")
 _flag("metrics_report_interval_ms", 5_000)
 # Prometheus scrape endpoint on the head (ISSUE 14): a minimal asyncio
 # HTTP server answering GET /metrics with the merged cluster exposition
@@ -429,13 +421,15 @@ _flag("conda_failure_cache_s", 60.0)  # failed-env fast-fail window
 
 # --- TPU --------------------------------------------------------------------
 _flag("tpu_chips_per_host_default", 4)
-_flag("tpu_premap_device_buffers", True)
-_flag("xla_collective_timeout_s", 300)
 
 # --- logging / debug --------------------------------------------------------
-_flag("event_stats", False)
 _flag("log_to_driver", True)
-_flag("debug_state_dump_period_ms", 0)  # 0 = disabled
+# RAY_TPU_SANITIZE=1: wrap threading locks to record acquisition order
+# (checked against raylint R12's static lock-order graph) and assert
+# thread-affinity calibration on marked hot-path mutations; see
+# _private/sanitizer.py. Debug builds only — the disabled path is a
+# single module-level bool check (<2% like the flight recorder).
+_flag("sanitize", False)
 
 
 class _Config:

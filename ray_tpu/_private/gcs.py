@@ -108,6 +108,10 @@ class NodeInfo:
         self.recovering = False
         self.labels = resources.labels
         self.pending_demand: List[Dict] = []  # unfulfilled lease requests
+        # version of the last full resource snapshot applied; heartbeats
+        # carrying a different version mean this head's view is stale
+        # (head restart / missed report) and trigger a resync
+        self.resource_version = 0
 
 
 class ActorInfo:
@@ -1172,22 +1176,28 @@ class HeadServer:
         return {"cluster_config": self.cluster_config,
                 "cluster_view": self._cluster_view()}
 
-    async def _update_resources(self, conn: Connection, p: Dict) -> None:
+    async def _update_resources(self, conn: Connection, p: Dict) -> Dict:
         node = self.nodes.get(p["node_id"])
         if node is None:
-            return
+            return {}
         node.last_heartbeat = time.monotonic()
         if p.get("hb"):
             # unchanged-view heartbeat (versioned delta gossip): liveness
-            # only, no payload to apply
+            # only — but if the heartbeat's snapshot version is not the
+            # one we last applied, our view is stale (head restarted, or
+            # a full report was lost) and the agent must resend in full
             self.report_stats["heartbeats"] = \
                 self.report_stats.get("heartbeats", 0) + 1
-            return
+            if p.get("v", 0) != node.resource_version:
+                return {"resync": True}
+            return {}
         self.report_stats["full_reports"] = \
             self.report_stats.get("full_reports", 0) + 1
         node.resources = NodeResources.from_wire(p["resources"])
         node.pending_demand = p.get("pending", [])
+        node.resource_version = p.get("v", 0)
         self._rank_update(node)
+        return {}
 
     async def _get_report_stats(self, conn: Connection, p) -> Dict:
         return dict(self.report_stats)
@@ -1616,6 +1626,15 @@ class HeadServer:
                             and actor.state != ACTOR_DEAD:
                         await self._kill_actor_internal(
                             actor, "owner driver exited")
+                # Non-detached placement groups die with their driver
+                # too — leaked bundles would pin cluster resources until
+                # head restart (reference: GcsPlacementGroupManager::
+                # CleanPlacementGroupIfNeededWhenJobDead).
+                if job_id:
+                    for pg_id, pg in list(self.placement_groups.items()):
+                        if pg.get("job_id") == job_id \
+                                and pg.get("lifetime") != "detached":
+                            await self._remove_pg_internal(pg_id)
         for subs in self.subscribers.values():
             subs.discard(conn)
 
@@ -2059,6 +2078,11 @@ class HeadServer:
             "pg_id": pg_id, "state": "PENDING", "bundles": p["bundles"],
             "strategy": p.get("strategy", "PACK"), "placement": None,
             "name": p.get("name", ""),
+            # ownership: non-detached groups die with their creating
+            # driver (reference: GcsPlacementGroupManager job-death
+            # cleanup); "detached" lifetime opts out
+            "lifetime": p.get("lifetime", ""),
+            "job_id": conn.meta.get("job_id", ""),
         }
         await self._durable("pg", {"pg": dict(self.placement_groups[pg_id])})
         if await self._try_place_pg(pg_id):
@@ -2195,9 +2219,14 @@ class HeadServer:
         return await fut
 
     async def _remove_placement_group(self, conn, p) -> Dict:
-        pg = self.placement_groups.get(p["pg_id"])
-        if not pg:
-            return {"ok": False}
+        return {"ok": await self._remove_pg_internal(p["pg_id"])}
+
+    async def _remove_pg_internal(self, pg_id: str) -> bool:
+        """Tear a PG down: mark REMOVED, return its bundles, persist.
+        Shared by the client RPC and driver-death cleanup."""
+        pg = self.placement_groups.get(pg_id)
+        if not pg or pg["state"] == "REMOVED":
+            return False
         # mark REMOVED before any await: handlers dispatch concurrently,
         # so a Get/Create processed mid-removal must already see the
         # terminal state (and _try_place_pg's state check must abort)
@@ -2208,9 +2237,9 @@ class HeadServer:
                 node = self.nodes.get(node_id)
                 if node and node.alive:
                     await node.conn.push("ReturnPGBundle",
-                                         {"pg_id": p["pg_id"], "bundle_index": idx})
-        await self._durable("pg_remove", {"pg_id": p["pg_id"]})
-        return {"ok": True}
+                                         {"pg_id": pg_id, "bundle_index": idx})
+        await self._durable("pg_remove", {"pg_id": pg_id})
+        return True
 
     async def _get_placement_group(self, conn, p) -> Optional[Dict]:
         return self.placement_groups.get(p["pg_id"])
@@ -2353,6 +2382,9 @@ class HeadServer:
 def main() -> None:
     import argparse
 
+    from ray_tpu._private import sanitizer as _sanitizer
+
+    _sanitizer.maybe_install()
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--port", type=int, default=0)

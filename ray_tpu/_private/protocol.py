@@ -1151,6 +1151,9 @@ class SyncRpcClient:
     def _finish_connect(self, s: socket.socket) -> None:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) if s.family != socket.AF_UNIX else None
         self._sock = s
+        # raylint: disable=R13 -- happens-before via Thread.start(): the
+        # reader thread that later clears this flag is created two lines
+        # down, so this write is published to it by the start() barrier
         self.connected = True
         self._reader_thread = threading.Thread(
             target=self._read_loop, daemon=True, name="rpc-reader"
@@ -1195,6 +1198,9 @@ class SyncRpcClient:
                     except Exception:
                         pass
         except (ConnectionLost, OSError):
+            # raylint: disable=R13 -- monotonic one-way flag: only ever
+            # flipped True->False after connect; GIL-atomic bool store,
+            # and a racy read on another thread just retries the call
             self.connected = False
             with self._lock:
                 for fut in self._pending.values():

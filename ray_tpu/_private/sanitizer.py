@@ -1,0 +1,303 @@
+"""Runtime concurrency sanitizer — the dynamic twin of raylint R12/R13.
+
+``RAY_TPU_SANITIZE=1`` (config knob ``sanitize``) turns three debug
+checks on inside any process that calls :func:`maybe_install` early
+enough (driver ``Worker.connect`` and the worker-process entry do):
+
+- **Lock-order recording**: ``threading.Lock``/``RLock`` factories are
+  monkeypatched so every lock created from ray_tpu source afterwards is
+  wrapped. Identity is the *creation callsite* (``relpath:line``) — the
+  same granularity as raylint's static ``LockDecl``. Each blocking
+  acquire records the (held → acquired) pair per thread; a pair whose
+  reverse was also observed at runtime is a witnessed lock-order cycle.
+- **Static-graph cross-check**: if ``raylint --dump-lock-graph`` wrote
+  ``devtools/lint/lock_graph.json``, runtime pairs are checked against
+  the static edge set — a runtime order whose *reverse* is the only
+  statically-known order means the static analysis and reality disagree
+  (either a resolution gap or an un-analyzed path) and is reported.
+- **Affinity calibration**: hot paths annotated with
+  ``if sanitizer.ENABLED: sanitizer.note_affinity("key")`` assert that
+  the marked mutation only ever runs on one thread per process (the
+  loop-confinement contract R13 checks statically). First touch
+  calibrates the owner; any other thread is a violation.
+
+Violations are collected in :data:`VIOLATIONS` (and logged once each),
+never raised from runtime code paths — a sanitizer that crashes the
+program mid-release corrupts the very state it is checking. Tests call
+:func:`assert_clean` at teardown.
+
+Monkeypatching the factories (instead of wrapping at assignment sites)
+keeps source ``self._mu = threading.Lock()`` shapes intact for the
+static analyzer's ctor indexing, and means stdlib-internal locks
+(created before install or from non-ray_tpu frames) stay native: the
+wrapper only ever sees project locks, so it cannot deadlock the
+interpreter machinery. Recording uses only GIL-atomic dict/list ops and
+``threading.local`` — the sanitizer itself takes no locks.
+
+The disabled path is the flight-recorder contract: one module-level
+bool check per site (asserted ~ns by ``overhead_probe``; see
+tests/test_sanitizer.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger("ray_tpu")
+
+ENABLED = False
+
+# ("order" | "static" | "affinity", human message) — GIL-atomic appends
+VIOLATIONS: List[Tuple[str, str]] = []
+
+_installed = False
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+_pairs: Dict[Tuple[str, str], str] = {}       # (a, b) -> witness text
+_reported: Set[Tuple[str, str, str]] = set()
+_affinity_owner: Dict[str, Tuple[int, str]] = {}
+
+_static_edges: Set[Tuple[str, str]] = set()   # (site_a, site_b)
+_static_sites: Set[str] = set()
+
+_held = threading.local()                     # per-thread [_SanLock...]
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF_FILE = os.path.abspath(__file__)
+
+
+def _creation_site() -> Optional[str]:
+    """relpath:line of the nearest ray_tpu (non-sanitizer, non-stdlib
+    threading) frame constructing the lock; None for foreign locks."""
+    f = sys._getframe(2)
+    for _ in range(8):
+        if f is None:
+            return None
+        path = f.f_code.co_filename
+        if path != _SELF_FILE and not path.endswith("threading.py"):
+            apath = os.path.abspath(path)
+            if apath.startswith(_PKG_ROOT + os.sep):
+                rel = os.path.relpath(apath, os.path.dirname(_PKG_ROOT))
+                return f"{rel.replace(os.sep, '/')}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+def _violation(kind: str, key: Tuple[str, str], msg: str) -> None:
+    dedup = (kind, key[0], key[1])
+    if dedup in _reported:
+        return
+    _reported.add(dedup)
+    VIOLATIONS.append((kind, msg))
+    logger.error("SANITIZE %s: %s", kind, msg)
+
+
+def _record_acquire(lock: "_SanLockBase") -> None:
+    held = getattr(_held, "stack", None)
+    if held is None:
+        held = _held.stack = []
+    for other in held:
+        a, b = other._site, lock._site
+        if a == b:
+            continue  # two instances from one decl: R1/identity land
+        _pairs[(a, b)] = (f"thread {threading.get_ident()} held {a} "
+                          f"while acquiring {b}")
+        rev = _pairs.get((b, a))
+        if rev is not None:
+            _violation(
+                "order", (min(a, b), max(a, b)),
+                f"lock-order cycle witnessed at runtime: {a} -> {b} "
+                f"(this thread) but also {rev}")
+        elif (_static_edges and a in _static_sites
+              and b in _static_sites
+              and (a, b) not in _static_edges
+              and (b, a) in _static_edges):
+            _violation(
+                "static", (a, b),
+                f"runtime acquisition order {a} -> {b} contradicts the "
+                f"static lock-order graph (which only knows {b} -> "
+                f"{a}) — un-analyzed path or analysis gap")
+    held.append(lock)
+
+
+def _record_release(lock: "_SanLockBase") -> None:
+    held = getattr(_held, "stack", None)
+    if held:
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+
+
+class _SanLockBase:
+    _KIND = "Lock"
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        # record only successful *blocking* acquires: a refused
+        # try-lock can't deadlock by ordering
+        if got and blocking:
+            _record_acquire(self)
+        return got
+
+    def release(self) -> None:
+        _record_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<San{self._KIND} {self._site} {self._inner!r}>"
+
+
+class _SanLock(_SanLockBase):
+    pass
+
+
+class _SanRLock(_SanLockBase):
+    _KIND = "RLock"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got and blocking:
+            held = getattr(_held, "stack", None)
+            if held and any(h is self for h in held):
+                held.append(self)   # re-entrant: keep depth, no pairs
+            else:
+                _record_acquire(self)
+        return got
+
+    # Condition(RLock) integration: keep the held stack truthful across
+    # cond.wait()'s release/reacquire cycle
+    def _release_save(self):
+        _record_release(self)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        held = getattr(_held, "stack", None)
+        if held is None:
+            held = _held.stack = []
+        held.append(self)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def _lock_factory():
+    inner = _real_lock()
+    site = _creation_site()
+    return _SanLock(inner, site) if site else inner
+
+
+def _rlock_factory():
+    inner = _real_rlock()
+    site = _creation_site()
+    return _SanRLock(inner, site) if site else inner
+
+
+def _load_static_graph() -> None:
+    path = os.path.join(_PKG_ROOT, "devtools", "lint", "lock_graph.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            graph = json.load(f)
+    except (OSError, ValueError):
+        return
+    decl_to_id = {}
+    for lock_id, meta in graph.get("locks", {}).items():
+        decl_to_id[meta.get("decl")] = lock_id
+    # runtime identity is the decl site itself; keep edges site-keyed
+    id_to_decl = {v: k for k, v in decl_to_id.items()}
+    for a, b, _witness in graph.get("edges", []):
+        da, db = id_to_decl.get(a), id_to_decl.get(b)
+        if da and db:
+            _static_edges.add((da, db))
+            _static_sites.update((da, db))
+
+
+def maybe_install() -> bool:
+    """Install the sanitizer if the ``sanitize`` knob is on. Idempotent;
+    call before constructing runtime objects so their locks get wrapped.
+    """
+    global ENABLED, _installed
+    if _installed:
+        return ENABLED
+    from ray_tpu._private.config import CONFIG
+
+    if not CONFIG.sanitize:
+        return False
+    _installed = True
+    ENABLED = True
+    _load_static_graph()
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    logger.info("ray_tpu sanitizer installed (lock-order + affinity); "
+                "static graph: %d edges", len(_static_edges))
+    return True
+
+
+def note_affinity(key: str, domain: str = "") -> None:
+    """Assert the annotated mutation site only ever runs on one thread
+    per process. ``domain`` is documentation (e.g. "loop") echoed in the
+    violation message."""
+    me = threading.get_ident()
+    owner = _affinity_owner.setdefault(key, (me, threading.current_thread().name))
+    if owner[0] != me:
+        _violation(
+            "affinity", (key, str(me)),
+            f"'{key}' ({domain or 'single-domain'}) touched from thread "
+            f"{threading.current_thread().name} ({me}); calibrated "
+            f"owner is {owner[1]} ({owner[0]}) — cross-thread mutation "
+            f"of a domain-confined attribute")
+
+
+def assert_clean() -> None:
+    if VIOLATIONS:
+        lines = "\n".join(f"  [{k}] {m}" for k, m in VIOLATIONS)
+        raise AssertionError(
+            f"sanitizer recorded {len(VIOLATIONS)} violation(s):\n{lines}")
+
+
+def reset() -> None:
+    """Test helper: drop recorded state (not the installation)."""
+    VIOLATIONS.clear()
+    _pairs.clear()
+    _reported.clear()
+    _affinity_owner.clear()
+
+
+def overhead_probe(n: int = 200_000) -> float:
+    """ns/op of the DISABLED guard every annotated hot-path site pays —
+    the exact site shape (module-bool check, no call). The sanitizer
+    test multiplies by the per-op site count and holds it to the same
+    <2% budget as the flight recorder's."""
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if ENABLED:
+            note_affinity("probe")
+    took = time.perf_counter() - t0
+    return took / n * 1e9

@@ -31,6 +31,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import events as _events
+from ray_tpu._private import sanitizer as _sanitizer
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.async_util import (
     DecorrelatedJitterBackoff, hold_task, spawn_tracked)
@@ -686,6 +687,10 @@ class Worker:
         self.direct_port = 0
         self.store: Optional[StoreClient] = None
         self.agent_tcp_addr: Optional[Dict] = None
+        # borrowed-object plasma locations learned from owner replies
+        # (hex-free: keyed by ObjectID bytes), consulted when pulling a
+        # borrowed object whose meta we don't own
+        self._borrowed_locations: Dict[bytes, List[Dict]] = {}
         # submitter state (loop-owned)
         self._lease_pools: Dict[Tuple, "_LeasePool"] = {}
         self._tasks: Dict[bytes, TaskRecord] = {}
@@ -751,6 +756,9 @@ class Worker:
         self.mode = mode
         if job_id:
             self.job_id = job_id
+        # install BEFORE the loop thread and RPC clients exist so their
+        # locks are created through the wrapping factories
+        _sanitizer.maybe_install()
         self.loop = asyncio.new_event_loop()
         ready = threading.Event()
 
@@ -1092,6 +1100,10 @@ class Worker:
                 # lets a same-node caller select the shm lane without a
                 # probe round trip (mux shm eligibility check)
                 addr["node_id"] = self.node_id
+            # raylint: disable=R13 -- idempotent memo: every writer
+            # computes the same value from the same inputs and the dict
+            # is never mutated after the GIL-atomic reference store, so
+            # a racing rebuild wastes a dict, never corrupts one
             self._direct_addr_cache = addr
         return addr
 
@@ -1738,8 +1750,6 @@ class Worker:
             self.memory_store.put(ref.binary(), reply["data"], flags)
         elif status == "plasma":
             self.memory_store.put(ref.binary(), b"", IN_PLASMA)
-            self._borrowed_locations = getattr(
-                self, "_borrowed_locations", {})
             self._borrowed_locations[ref.binary()] = \
                 reply.get("locations", [])
         return status
@@ -1782,9 +1792,8 @@ class Worker:
         view = self.store.get_view(ref.id())
         if view is None:
             meta = self.reference_counter.get_owned_meta(ref.binary())
-            locations = meta.locations if meta else getattr(
-                self, "_borrowed_locations", {}
-            ).get(ref.binary(), [])
+            locations = (meta.locations if meta
+                         else self._borrowed_locations.get(ref.binary(), []))
             left = self._time_left(deadline)
             timeout_ms = None if left is None else int(left * 1000)
             reply = self._acall(
@@ -2024,7 +2033,7 @@ class Worker:
         status = (reply or {}).get("status")
         if status == "resubmitted":
             self.memory_store.delete(ref.binary())
-            getattr(self, "_borrowed_locations", {}).pop(ref.binary(), None)
+            self._borrowed_locations.pop(ref.binary(), None)
             return True
         if status == "no_lineage":
             raise ObjectReconstructionFailedError(
@@ -2628,12 +2637,16 @@ class Worker:
         several frames draining in one loop pass — buffer here and resolve
         together in ONE deferred drain, so N inline returns cost one
         memory-store lock pass and one resolved-state pass instead of N."""
+        if _sanitizer.ENABLED:
+            _sanitizer.note_affinity("Worker._completion_buf", "loop")
         self._completion_buf.append((cb, i, reply))
         if not self._completions_armed:
             self._completions_armed = True
             self.loop.call_soon(self._drain_completions)
 
     def _drain_completions(self) -> None:
+        if _sanitizer.ENABLED:
+            _sanitizer.note_affinity("Worker._completion_buf", "loop")
         self._completions_armed = False
         buf = self._completion_buf
         if not buf:

@@ -809,7 +809,12 @@ def main() -> None:
     boot_t0 = time.monotonic()
     agent_sock = os.environ["RAY_TPU_AGENT_SOCK"]
     from ray_tpu._private import lifecycle
+    from ray_tpu._private import sanitizer as _sanitizer
     from ray_tpu._private.ids import WorkerID
+
+    # before Worker() so every runtime lock is created through the
+    # wrapping factories (RAY_TPU_SANITIZE=1 debug runs; no-op default)
+    _sanitizer.maybe_install()
 
     # fate-share with the node agent (RAY_TPU_PARENT_PID): the park loop
     # below exits when the agent CONNECTION drops, but a worker stuck in

@@ -13,6 +13,7 @@ import functools
 from typing import Any, Dict, Optional
 
 from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID
 from ray_tpu.remote_function import (
     _resources_from_options, validate_options, _resolve_pg,
@@ -148,7 +149,8 @@ class ActorClass:
             args,
             kwargs,
             resources=_resources_from_options(opts),
-            max_restarts=opts.get("max_restarts", 0),
+            max_restarts=opts.get("max_restarts",
+                                  CONFIG.actor_max_restarts_default),
             max_concurrency=opts.get("max_concurrency", 1),
             name=opts.get("name", ""),
             namespace=opts.get("namespace", "default"),

@@ -41,6 +41,10 @@ class _Batcher:
         n = BlockAccessor(block).num_rows()
         if n:
             self._buffer.append(block)
+            # raylint: disable=R13 -- single-consumer protocol: one
+            # _Batcher instance is only ever driven by the one iterator
+            # that owns it; the two domains the linter sees are distinct
+            # pipelines with distinct instances, never one shared batcher
             self._buffered_rows += n
 
     def next_batches(self, final: bool = False) -> Iterator[Block]:

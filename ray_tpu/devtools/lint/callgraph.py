@@ -54,6 +54,12 @@ GLOBAL_RESOLVE_BLOCKLIST = {
     "run", "stop", "send", "recv", "read", "write", "flush", "append",
     "pop", "update", "items", "keys", "values", "copy", "encode",
     "decode", "format",
+    # more stdlib vocabulary that manufactured cross-module edges once
+    # R12 started chaining EA sets through them: StreamWriter.drain,
+    # str.partition, list.count, json.dump/load, socket.connect
+    "drain", "partition", "count", "dump", "dumps", "load", "loads",
+    "connect", "index", "insert", "extend", "sort", "split", "strip",
+    "seek", "submit", "shutdown",
 }
 
 _LOCK_FACTORIES = {"Lock": "Lock", "RLock": "RLock"}

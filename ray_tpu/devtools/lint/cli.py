@@ -12,12 +12,67 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
 from . import baseline as baseline_mod
 from .engine import default_baseline_path, run_lint
 from .rules import rule_catalog
+
+
+def _changed_files(project_root: str) -> List[str]:
+    """Project-relative .py files touched vs HEAD (staged, unstaged, and
+    untracked) — the ``--changed`` report scope."""
+    out: List[str] = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=project_root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode != 0:
+            continue
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py") and line not in out:
+                out.append(line)
+    return out
+
+
+def _to_sarif(result) -> dict:
+    """SARIF 2.1.0 — one run, one rule descriptor per rule id, one
+    result per failing violation (grandfathered hits are omitted: SARIF
+    consumers treat every result as actionable)."""
+    catalog = {r["id"]: r["summary"] for r in rule_catalog()}
+    rule_ids = sorted({v.rule for v in result.violations} | set(catalog))
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "raylint",
+                "informationUri": "ray_tpu/devtools/lint",
+                "rules": [{"id": rid,
+                           "shortDescription":
+                               {"text": catalog.get(rid, rid)}}
+                          for rid in rule_ids],
+            }},
+            "results": [{
+                "ruleId": v.rule,
+                "level": "error",
+                "message": {"text": v.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line,
+                               "startColumn": v.col + 1},
+                }}],
+                "partialFingerprints": {"raylintKey/v1": v.key()},
+            } for v in result.violations],
+        }],
+    }
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -32,7 +87,17 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="root for relative paths in reports (default: cwd)")
     p.add_argument("--rules", default=None,
                    help="comma-separated subset, e.g. R1,R4 (default: all)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
+    p.add_argument("--changed", action="store_true",
+                   help="report only violations in files changed vs git "
+                        "HEAD (plus untracked); the call-graph index "
+                        "still covers all of `paths`, so cross-module "
+                        "rules keep full precision")
+    p.add_argument("--dump-lock-graph", metavar="PATH", default=None,
+                   help="also write the R12 static lock-order graph as "
+                        "JSON (consumed by the RAY_TPU_SANITIZE=1 "
+                        "runtime sanitizer)")
     p.add_argument("--baseline", default=None,
                    help="baseline JSON (default: the checked-in "
                         "devtools/lint/baseline.json)")
@@ -76,8 +141,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline_path = None if args.no_baseline else (
         args.baseline or default_baseline_path())
 
+    report_only = None
+    if args.changed:
+        root = args.project_root or os.getcwd()
+        report_only = _changed_files(root)
+
     result = run_lint(args.paths, project_root=args.project_root,
-                      rules=rules, baseline_path=baseline_path)
+                      rules=rules, baseline_path=baseline_path,
+                      report_only=report_only)
+
+    if args.dump_lock_graph:
+        from . import concurrency
+        graph = concurrency.get(result._index).static_graph()
+        with open(args.dump_lock_graph, "w", encoding="utf-8") as f:
+            json.dump(graph, f, indent=1, sort_keys=True)
+            f.write("\n")
 
     if args.update_baseline:
         target = args.baseline or default_baseline_path()
@@ -96,6 +174,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(_to_sarif(result), indent=1))
     else:
         for v in result.violations:
             print(v.format())

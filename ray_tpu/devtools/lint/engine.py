@@ -57,18 +57,26 @@ def parse_modules(files: List[str], project_root: str
 def run_lint(paths: Iterable[str],
              project_root: Optional[str] = None,
              rules: Optional[List[str]] = None,
-             baseline_path: Optional[str] = None) -> LintResult:
+             baseline_path: Optional[str] = None,
+             report_only: Optional[Iterable[str]] = None) -> LintResult:
     """Run the analyzer; returns a LintResult with failing /
     grandfathered / suppressed violations split out.
 
     ``baseline_path=None`` means no baseline (every unsuppressed
     violation fails); pass the checked-in file for the tier-1 contract.
+    ``report_only`` restricts *reported* violations to those
+    project-relative paths while the index (and therefore call-graph
+    precision) still covers everything in ``paths`` — the ``--changed``
+    mode: lint the diff against the full-tree index.
     """
     t0 = time.monotonic()
     project_root = project_root or os.getcwd()
     files = discover_files(paths)
     mods, errors = parse_modules(files, project_root)
     index = ProjectIndex(mods)
+    # rules that consult files outside the module set (R8's README knob
+    # tables) anchor themselves here
+    index.project_root = os.path.abspath(project_root)
 
     selected = ALL_RULES if not rules else [
         RULES_BY_ID[r.upper()] for r in rules]
@@ -82,6 +90,10 @@ def run_lint(paths: Iterable[str],
                 raw.extend(rule.check_module(mod, index))
     raw.sort(key=lambda v: (v.path, v.line, v.rule))
 
+    if report_only is not None:
+        keep = {p.replace(os.sep, "/") for p in report_only}
+        raw = [v for v in raw if v.path in keep]
+
     by_mod: Dict[str, ModuleInfo] = {m.relpath: m for m in mods}
     unsuppressed: List[Violation] = []
     suppressed = 0
@@ -94,8 +106,10 @@ def run_lint(paths: Iterable[str],
 
     bl = baseline_mod.load(baseline_path) if baseline_path else {}
     failing, grandfathered, stale = baseline_mod.split(unsuppressed, bl)
+    if report_only is not None:
+        stale = []  # a partial report can't prove baseline entries stale
 
-    return LintResult(
+    result = LintResult(
         violations=failing,
         grandfathered=grandfathered,
         suppressed_count=suppressed,
@@ -104,6 +118,8 @@ def run_lint(paths: Iterable[str],
         parse_errors=errors,
         elapsed_s=time.monotonic() - t0,
     )
+    result._index = index  # CLI extras (--dump-lock-graph) reuse it
+    return result
 
 
 def default_baseline_path() -> str:

@@ -24,6 +24,9 @@ from . import (
     r9_view_escape,
     r10_grow_only,
     r11_loop_stop_strands_client,
+    r12_lock_order,
+    r13_thread_affinity,
+    r14_wire_contract,
 )
 
 ALL_RULES = [
@@ -38,6 +41,9 @@ ALL_RULES = [
     r9_view_escape,
     r10_grow_only,
     r11_loop_stop_strands_client,
+    r12_lock_order,
+    r13_thread_affinity,
+    r14_wire_contract,
 ]
 
 RULES_BY_ID: Dict[str, object] = {m.RULE_ID: m for m in ALL_RULES}

@@ -144,6 +144,9 @@ class Pool:
         self._closed = True
 
     def terminate(self) -> None:
+        # raylint: disable=R13 -- monotonic GIL-atomic bool flip (False
+        # ->True only, mirroring close()); racy readers at worst submit
+        # to a closing pool, which terminate's kill loop handles anyway
         self._closed = True
         for w in self._workers:
             try:

@@ -91,7 +91,15 @@ def list_nodes(filters=None, limit: int = 1000) -> List[Dict]:
 def list_tasks(filters=None, limit: int = 10000) -> List[Dict]:
     w = _worker()
     w.flush_task_events()
-    rows = _call("ListTaskEvents", {"limit": limit * 4})
+    payload: Dict = {"limit": limit * 4}
+    # an equality filter on job_id prefilters server-side — the head
+    # scans its 100k-entry ring once instead of shipping 4x limit rows
+    # for the client to discard
+    for key, op, value in filters or []:
+        if key == "job_id" and op == "=":
+            payload["job_id"] = value
+            break
+    rows = _call("ListTaskEvents", payload)
     return _apply_filters(rows, filters)[:limit]
 
 

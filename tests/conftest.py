@@ -91,6 +91,7 @@ FAST_FILES = {
     "test_actor_scale.py",
     "test_serve_load.py",
     "test_raylint.py",
+    "test_sanitizer.py",
     "test_direct_call.py",
     "test_lineage.py",
     "test_data_shuffle.py",
@@ -130,6 +131,22 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.fast)
         else:
             item.add_marker(pytest.mark.slow)
+
+
+# ---------------------------------------------------------------------------
+# Sanitizer gate (ISSUE 19): when the suite runs under RAY_TPU_SANITIZE=1
+# (test_sanitizer.py re-runs the kill -9 chaos test that way), any
+# lock-order or affinity violation the runtime sanitizer recorded in
+# THIS process fails the run at teardown. Off-knob runs never install
+# the sanitizer, so the gate is a no-op bool check for the normal tier.
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="session", autouse=True)
+def sanitizer_gate():
+    yield
+    from ray_tpu._private import sanitizer
+
+    if sanitizer.ENABLED:
+        sanitizer.assert_clean()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,121 @@
+"""R12 regression fixture: lock-order cycles and the loop/GC Lock split.
+
+The shipped shapes: PR 17's ``LineageLedger`` nests ledger-lock →
+store-lock on the retain path and had to hand-roll evict-outside-the-
+lock discipline so the store → ledger path could never close the cycle;
+PR 5's MemoryStore deadlock was the loop/GC variant (plain Lock reached
+from both an event-loop critical section and a GC-context destructor).
+
+Shapes below:
+
+- ``LedgerShape``/``StoreShape``/``EvictionListenerShape`` — a cycle
+  *through a callback*: the ledger holds ``_lock`` and walks into the
+  store's ``_mu`` (record → delete), while the store holds ``_mu`` and
+  fires a registered eviction callback that walks back into the ledger
+  (put → on_evict → record). Each direction is one ordering edge; both
+  are flagged because together they form a 2-lock SCC.
+- ``CacheShape`` — a plain ``Lock`` acquired in an ``async def`` (loop
+  domain) and in ``__del__`` (GC domain) without the R1 RLock remedy;
+  flagged at the loop-side acquisition.
+- ``SafeCacheShape`` — same split but with the RLock fix: no flag.
+- ``OrderedPairShape`` — two locks always taken in the same order on
+  every path: edges but no cycle, no flag.
+"""
+
+import threading
+
+
+class LedgerShape:
+    """Holds its own lock, then walks into the store (lock → mu)."""
+
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self._store = store
+        self._entries = {}
+
+    def record(self, key):
+        with self._lock:
+            self._entries[key] = True
+            self._store.delete(key)  # expect-R12
+
+
+class EvictionListenerShape:
+    """The registered callback: fired by the store, re-enters the
+    ledger. No locks of its own — just the hop that closes the cycle."""
+
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def on_evict(self, key):
+        self._ledger.record(key)
+
+
+class StoreShape:
+    """Holds its own lock, then fires the callback (mu → lock)."""
+
+    def __init__(self, listener):
+        self._mu = threading.Lock()
+        self._listener = listener
+        self._table = {}
+
+    def put(self, key, val):
+        with self._mu:
+            self._table[key] = val
+            self._listener.on_evict(key)  # expect-R12
+
+    def delete(self, key):
+        with self._mu:
+            self._table.pop(key, None)
+
+
+class CacheShape:
+    """The loop/GC split: plain Lock shared between an async handler
+    and a destructor — the collector can fire ``__del__`` on the loop
+    thread while ``insert`` is mid-critical-section."""
+
+    def __init__(self):
+        self._cache_lock = threading.Lock()
+        self._items = {}
+
+    async def insert(self, key, val):
+        with self._cache_lock:  # expect-R12
+            self._items[key] = val
+
+    def __del__(self):
+        with self._cache_lock:
+            self._items.clear()
+
+
+class SafeCacheShape:
+    """The R1 remedy: RLock makes the loop/GC re-entry safe — no flag."""
+
+    def __init__(self):
+        self._cache_lock = threading.RLock()
+        self._items = {}
+
+    async def insert(self, key, val):
+        with self._cache_lock:
+            self._items[key] = val
+
+    def __del__(self):
+        with self._cache_lock:
+            self._items.clear()
+
+
+class OrderedPairShape:
+    """Two locks, one global order on every path: edges, no cycle."""
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self._n = 0
+
+    def alpha(self):
+        with self._outer:
+            with self._inner:
+                self._n += 1
+
+    def beta(self):
+        with self._outer:
+            with self._inner:
+                self._n -= 1

@@ -1,0 +1,95 @@
+"""R13 regression fixture: cross-domain plain attribute mutation.
+
+The shipped shape (PR 18): the completion queue's ``_completion_buf``/
+``_completions_armed`` are appended by RPC read-loop code and
+read-modify-written by the drain path — the hand-off is correct only
+because every touch is marshalled onto the event loop; PR 12's shm
+feeder thread had the same pattern against the loop. R13 pins that
+discipline: a ``self.<attr>`` plainly mutated from two affinity domains
+(loop / executor thread / GC) with no lock in scope is flagged at every
+unguarded site.
+
+Shapes below:
+
+- ``ProgressShape`` — ``_rows`` bumped by an ``async def`` handler
+  (loop domain) and zeroed by a ``threading.Thread`` drainer (thread
+  domain), no hand-off: both sites flag.
+- ``FinalizerShape`` — ``_handle`` nulled from a loop callback and from
+  ``__del__`` (GC domain): both sites flag.
+- ``GuardedProgressShape`` — the lock fix: same two domains, every
+  mutation under the shared lock, no flag.
+- ``SingleDomainShape`` — loop-confinement (the other valid
+  discipline): all mutation on the loop, no flag.
+- ``CtorPlusLoopShape`` — ``__init__`` writes happen-before
+  publication and are exempt; one runtime domain remains, no flag.
+"""
+
+import threading
+
+
+class ProgressShape:
+    """The bug: loop handler and drainer thread race on ``_rows``."""
+
+    def __init__(self):
+        self._rows = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    async def on_frame(self, n):
+        self._rows += n  # expect-R13
+
+    def _drain(self):
+        self._rows = 0  # expect-R13
+
+
+class FinalizerShape:
+    """The GC variant: a destructor races the loop-side reset."""
+
+    def __init__(self):
+        self._handle = object()
+
+    async def reset(self):
+        self._handle = None  # expect-R13
+
+    def __del__(self):
+        self._handle = None  # expect-R13
+
+
+class GuardedProgressShape:
+    """The fix: both domains mutate under the shared lock — no flag."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    async def on_frame(self, n):
+        with self._lock:
+            self._rows += n
+
+    def _drain(self):
+        with self._lock:
+            self._rows = 0
+
+
+class SingleDomainShape:
+    """Loop-confined: every mutation runs on the event loop — no flag."""
+
+    def __init__(self):
+        self._pending = []
+
+    async def enqueue(self, item):
+        self._pending = self._pending + [item]
+
+    async def reset(self):
+        self._pending = []
+
+
+class CtorPlusLoopShape:
+    """Construction happens-before publication: the ``__init__`` write
+    does not count as a second domain — no flag."""
+
+    def __init__(self):
+        self._state = "new"
+
+    async def activate(self):
+        self._state = "active"

@@ -1,0 +1,97 @@
+"""R14 regression fixture: msgpack wire-frame contract drift.
+
+The shipped shape (PR 11/18): the mux/shm/batch framing contracts —
+single-letter keys like ``"s"``/``"q"``/``"ai"`` riding
+``PushTaskBatchStream`` — hold by convention only; a typo'd key on one
+of several send sites ships silently and surfaces as a hang three
+modules away. R14 joins send-path dict literals with the registered
+handler's payload reads, per RPC method name.
+
+Shapes below (one contract per method name):
+
+- ``UpdateLoad`` — the send-only wire key: ``"hint"`` is built into the
+  frame but the handler never reads it (dead bytes / silently-ignored
+  feature). The second send site omitting the optional ``"load"`` key
+  is fine by design.
+- ``FetchStatus`` — the read-but-never-sent key: the handler defaults
+  ``"deadline_ms"`` but no literal send site ever ships it, so the read
+  can only see the default.
+- ``PushSpans`` — the type-incoherent key: ``"seq"`` is sent as int on
+  one path and str on another; the handler can rely on neither.
+- ``ForwardBlob`` — opaque handler (payload forwarded wholesale):
+  send-only checking is disabled, no flag.
+- ``ListNodes`` — a ``**``-expanded send site: read-never-sent is
+  suppressed because not every send site is a full literal, no flag.
+
+The ``reg = ..._server.add_handler`` alias mirrors the registration
+idiom gcs.py/agent.py actually use.
+"""
+
+
+class RpcServerShape:
+    def add_handler(self, name, fn):
+        pass
+
+
+class RpcClientShape:
+    async def call(self, method, payload):
+        pass
+
+    def push(self, method, payload):
+        pass
+
+
+class AgentShape:
+    def __init__(self, server, client, sink):
+        self._server = server
+        self._client = client
+        self._sink = sink
+        self._server.add_handler("UpdateLoad", self._handle_update_load)
+        reg = self._server.add_handler
+        reg("FetchStatus", self._handle_fetch_status)
+        reg("PushSpans", self._handle_push_spans)
+        self._server.add_handler("ForwardBlob", self._handle_forward_blob)
+        self._server.add_handler("ListNodes", self._handle_list_nodes)
+
+    # -- receive side ---------------------------------------------------
+    def _handle_update_load(self, conn, payload):
+        return payload["node_id"], payload.get("load")
+
+    def _handle_fetch_status(self, conn, payload):
+        return payload["verbose"], payload.get("deadline_ms")  # expect-R14
+
+    def _handle_push_spans(self, conn, payload):
+        return payload["seq"], payload["spans"]
+
+    def _handle_forward_blob(self, conn, payload):
+        self._sink(payload)
+
+    def _handle_list_nodes(self, conn, payload):
+        return payload.get("page_token")
+
+    # -- send side ------------------------------------------------------
+    async def report(self):
+        await self._client.call("UpdateLoad", {
+            "node_id": "n1",
+            "load": 0.5,
+            "hint": "idle",  # expect-R14
+        })
+
+    async def report_minimal(self):
+        # omitting the optional "load" key is fine by design
+        await self._client.call("UpdateLoad", {"node_id": "n2"})
+
+    async def fetch(self):
+        return await self._client.call("FetchStatus", {"verbose": True})
+
+    def push_spans(self, spans):
+        self._client.push("PushSpans", {"seq": 1, "spans": spans})
+
+    def push_spans_retry(self, spans):
+        self._client.push("PushSpans", {"seq": "r1", "spans": spans})  # expect-R14
+
+    async def forward(self, blob):
+        await self._client.call("ForwardBlob", {"anything": blob})
+
+    async def list_nodes(self, extra):
+        return await self._client.call("ListNodes", {**extra})

@@ -99,6 +99,9 @@ def _expect_lines(fixture, rule):
     ("r9_view_escape_shape.py", "R9"),
     ("r10_grow_only_shape.py", "R10"),
     ("r11_loop_stop_shape.py", "R11"),
+    ("r12_lock_order_shape.py", "R12"),
+    ("r13_affinity_shape.py", "R13"),
+    ("r14_frame_drift_shape.py", "R14"),
 ])
 def test_fixture_trips_exactly_on_marked_lines(fixture, rule):
     path, expected = _expect_lines(fixture, rule)
@@ -145,6 +148,46 @@ def test_r9_flags_all_three_escape_shapes():
         "UnpinnedEscapes.serve_later.reply"}
     # every message names the contract's remedy
     assert all("pin" in v.message for v in res.violations)
+
+
+def test_r12_cycle_explains_both_directions():
+    """Each edge of the 2-lock SCC carries its call chain (including the
+    callback hop) and names the reverse-order witness."""
+    path, _ = _expect_lines("r12_lock_order_shape.py", "R12")
+    res = run_lint([path], project_root=FIXTURES, rules=["R12"],
+                   baseline_path=None)
+    cyc = [v for v in res.violations if "lock-order cycle" in v.message]
+    assert len(cyc) == 2
+    assert any("on_evict" in v.message for v in cyc)  # the callback hop
+    assert all("reverse" in v.message for v in cyc)
+    (split,) = [v for v in res.violations if "GC context" in v.message]
+    assert "RLock" in split.message and split.symbol == "CacheShape.insert"
+
+
+def test_r13_violation_names_the_other_domain():
+    path, _ = _expect_lines("r13_affinity_shape.py", "R13")
+    res = run_lint([path], project_root=FIXTURES, rules=["R13"],
+                   baseline_path=None)
+    by_sym = {v.symbol: v.message for v in res.violations}
+    # the loop-side site must point at the thread-side one and vice versa
+    assert "'ProgressShape._drain'" in by_sym["ProgressShape.on_frame"]
+    assert "'ProgressShape.on_frame'" in by_sym["ProgressShape._drain"]
+    assert "['gc']" in by_sym["FinalizerShape.reset"]
+
+
+def test_r14_flags_each_drift_class_once():
+    """Send-only, read-never-sent, and type-incoherent each appear
+    exactly once, against the intended method contract."""
+    path, _ = _expect_lines("r14_frame_drift_shape.py", "R14")
+    res = run_lint([path], project_root=FIXTURES, rules=["R14"],
+                   baseline_path=None)
+    msgs = sorted(v.message for v in res.violations)
+    assert len(msgs) == 3
+    assert sum("sent here but never read" in m for m in msgs) == 1
+    assert sum("none of the" in m and "sends it" in m for m in msgs) == 1
+    assert sum("type-incoherent" in m for m in msgs) == 1
+    # opaque-handler and **-expanded contracts stay silent
+    assert not any("ForwardBlob" in m or "ListNodes" in m for m in msgs)
 
 
 # ---------------------------------------------------------------------------
@@ -243,11 +286,64 @@ def test_cli_json_output_and_exit_codes(tmp_path, capsys):
     assert json.loads(capsys.readouterr().out)["ok"] is True
 
 
-def test_cli_lists_all_eight_rules(capsys):
+def test_cli_lists_all_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8"):
-        assert f"{rule}:" in out
+    for n in range(1, 15):
+        assert f"R{n}:" in out
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    f = tmp_path / "leak.py"
+    f.write_text(_LEAK)
+    rc = lint_main([str(f), "--project-root", str(tmp_path),
+                    "--no-baseline", "--format", "sarif"])
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert sarif["version"] == "2.1.0"
+    (run,) = sarif["runs"]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} >= {
+        "R1", "R12", "R13", "R14"}
+    (result,) = run["results"]
+    assert result["ruleId"] == "R4" and result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "leak.py"
+    assert loc["region"]["startLine"] == 4
+    # the fingerprint is the line-free baseline key: stable across edits
+    assert result["partialFingerprints"]["raylintKey/v1"].startswith(
+        "leak.py::R4::")
+
+
+def test_cli_changed_scopes_the_report(tmp_path, capsys):
+    """--changed lints everything (cross-module rules keep precision)
+    but only *reports* violations in files changed vs git HEAD."""
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *args], cwd=str(tmp_path),
+                       check=True, capture_output=True)
+
+    git("init", "-q")
+    committed = tmp_path / "committed_leak.py"
+    committed.write_text(_LEAK)
+    git("add", "committed_leak.py")
+    git("commit", "-qm", "seed")
+    fresh = tmp_path / "fresh_leak.py"
+    fresh.write_text(_LEAK)
+
+    rc = lint_main([str(tmp_path), "--project-root", str(tmp_path),
+                    "--no-baseline", "--changed", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [v["path"] for v in out["violations"]] == ["fresh_leak.py"]
+
+    # without --changed the committed file's violation reports too
+    rc = lint_main([str(tmp_path), "--project-root", str(tmp_path),
+                    "--no-baseline", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert {v["path"] for v in out["violations"]} == {
+        "committed_leak.py", "fresh_leak.py"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,237 @@
+"""Runtime concurrency sanitizer (ISSUE 19): lock-order recording,
+static-graph cross-check, affinity calibration, and the disabled-path
+overhead budget.
+
+Everything that needs ``maybe_install()`` runs in a SUBPROCESS:
+installation monkeypatches ``threading.Lock``/``RLock`` for the life of
+the process and has (deliberately) no uninstall — wrapping this test
+process would tax every other test in the tier. The parent asserts on
+the child's exit status + captured state.
+
+Factory interception requires the lock's creation frame to sit inside
+the ray_tpu package (foreign locks stay native by design), so the
+in-child scripts compile their lock-creating code with a filename under
+``ray_tpu/`` — same frame shape as real project code, no tree
+pollution.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private import sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_sanitized(body: str) -> subprocess.CompletedProcess:
+    """Run ``body`` in a fresh interpreter with RAY_TPU_SANITIZE=1.
+
+    The prologue installs the sanitizer and provides ``exec_in_pkg``,
+    which executes source as if it lived in a file under ray_tpu/ so
+    the patched factories see a project creation frame.
+    """
+    prologue = """
+import os, threading
+from ray_tpu._private import sanitizer
+assert sanitizer.maybe_install(), "RAY_TPU_SANITIZE=1 must install"
+assert threading.Lock is sanitizer._lock_factory
+
+import ray_tpu
+_PKG = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+def exec_in_pkg(src, filename="_san_probe.py"):
+    g = {"threading": threading}
+    exec(compile(src, os.path.join(_PKG, filename), "exec"), g)
+    return g
+"""
+    env = dict(os.environ)
+    env["RAY_TPU_SANITIZE"] = "1"
+    return subprocess.run(
+        [sys.executable, "-c", prologue + body],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+
+
+def _check(proc: subprocess.CompletedProcess) -> None:
+    assert proc.returncode == 0, (
+        f"sanitized child failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+
+# ---------------------------------------------------------------- disabled path
+def test_disabled_guard_overhead_probe():
+    # The exact per-site shape every annotated hot path pays when the
+    # knob is off: one module-level bool check. Same budget idiom as
+    # test_flight_recorder.py's probe.
+    assert not sanitizer.ENABLED, \
+        "tier must not run pre-sanitized; probe measures the OFF path"
+    ns = sanitizer.overhead_probe(100_000)
+    assert ns < 1500, f"disabled guard costs {ns:.0f}ns/site"
+
+
+def test_not_installed_without_knob():
+    # This process never set RAY_TPU_SANITIZE: factories stay native.
+    import threading
+    assert not sanitizer.ENABLED
+    assert threading.Lock is sanitizer._real_lock
+
+
+# ---------------------------------------------------------------- lock order
+def test_reversed_acquisition_is_a_witnessed_cycle():
+    _check(_run_sanitized("""
+g = exec_in_pkg("a = threading.Lock()\\nb = threading.Lock()\\n")
+a, b = g["a"], g["b"]
+assert type(a) is sanitizer._SanLock, a
+with a:
+    with b:
+        pass
+assert not sanitizer.VIOLATIONS, sanitizer.VIOLATIONS
+with b:
+    with a:
+        pass
+kinds = [k for k, _ in sanitizer.VIOLATIONS]
+assert kinds == ["order"], sanitizer.VIOLATIONS
+msg = sanitizer.VIOLATIONS[0][1]
+assert "lock-order cycle" in msg and "_san_probe.py" in msg, msg
+try:
+    sanitizer.assert_clean()
+except AssertionError:
+    pass
+else:
+    raise SystemExit("assert_clean must raise on violations")
+sanitizer.reset()
+sanitizer.assert_clean()
+"""))
+
+
+def test_consistent_order_and_trylock_stay_clean():
+    _check(_run_sanitized("""
+g = exec_in_pkg("a = threading.Lock()\\nb = threading.Lock()\\n")
+a, b = g["a"], g["b"]
+for _ in range(3):
+    with a:
+        with b:
+            pass
+# a refused try-lock cannot deadlock by ordering: not recorded
+with b:
+    got = a.acquire(blocking=False)
+    assert got
+    a.release()
+assert ("ray_tpu/_san_probe.py:2",
+        "ray_tpu/_san_probe.py:1") not in sanitizer._pairs
+assert not sanitizer.VIOLATIONS, sanitizer.VIOLATIONS
+sanitizer.assert_clean()
+"""))
+
+
+def test_runtime_order_contradicting_static_graph():
+    # Seed the static edge set the way _load_static_graph would from
+    # lock_graph.json, then witness the REVERSE order at runtime.
+    _check(_run_sanitized("""
+sanitizer._static_edges.add(("x.py:1", "y.py:2"))
+sanitizer._static_sites.update(("x.py:1", "y.py:2"))
+a = sanitizer._SanLock(sanitizer._real_lock(), "y.py:2")
+b = sanitizer._SanLock(sanitizer._real_lock(), "x.py:1")
+with a:
+    with b:
+        pass
+kinds = [k for k, _ in sanitizer.VIOLATIONS]
+assert kinds == ["static"], sanitizer.VIOLATIONS
+assert "contradicts the static lock-order graph" in sanitizer.VIOLATIONS[0][1]
+"""))
+
+
+def test_rlock_reentry_and_condition_wait_keep_stack_truthful():
+    _check(_run_sanitized("""
+g = exec_in_pkg(
+    "mu = threading.RLock()\\ncond = threading.Condition(mu)\\n")
+mu, cond = g["mu"], g["cond"]
+assert type(mu) is sanitizer._SanRLock, mu
+with mu:
+    with mu:   # re-entry: depth kept, no self-pair
+        pass
+assert not sanitizer._pairs, sanitizer._pairs
+
+done = []
+def waiter():
+    with cond:
+        cond.wait(timeout=5)
+        done.append(True)
+
+t = threading.Thread(target=waiter)
+t.start()
+import time
+time.sleep(0.2)
+with cond:    # acquirable only if wait() really released via _release_save
+    cond.notify_all()
+t.join(5)
+assert done == [True]
+assert not getattr(sanitizer._held, "stack", None)
+assert not sanitizer.VIOLATIONS, sanitizer.VIOLATIONS
+"""))
+
+
+def test_foreign_locks_stay_native():
+    _check(_run_sanitized("""
+lk = threading.Lock()   # creation frame is this -c script: not ray_tpu
+assert type(lk) is type(sanitizer._real_lock()), lk
+"""))
+
+
+# ---------------------------------------------------------------- affinity
+def test_affinity_calibrates_then_flags_the_second_thread():
+    _check(_run_sanitized("""
+sanitizer.note_affinity("Probe._buf", "loop")   # calibrates owner
+sanitizer.note_affinity("Probe._buf", "loop")   # same thread: clean
+assert not sanitizer.VIOLATIONS
+
+t = threading.Thread(
+    target=lambda: sanitizer.note_affinity("Probe._buf", "loop"))
+t.start(); t.join(5)
+kinds = [k for k, _ in sanitizer.VIOLATIONS]
+assert kinds == ["affinity"], sanitizer.VIOLATIONS
+assert "Probe._buf" in sanitizer.VIOLATIONS[0][1]
+# dedup: the same (key, thread) pair reports once
+t2 = threading.Thread(
+    target=lambda: sanitizer.note_affinity("Probe._buf", "loop"))
+t2.start(); t2.join(5)
+assert len(sanitizer.VIOLATIONS) >= 1
+"""))
+
+
+# ---------------------------------------------------------------- end to end
+def test_kill9_chaos_under_sanitizer():
+    """ISSUE 19 satellite: the kill -9 mid-batch chaos gauntlet must run
+    clean with the sanitizer live in every process (driver, head, agent,
+    workers inherit RAY_TPU_SANITIZE=1). conftest's sanitizer gate calls
+    assert_clean() at that child session's teardown, so a lock-order or
+    affinity violation anywhere in the real submit/kill/recover flow
+    fails this test."""
+    env = dict(os.environ)
+    env["RAY_TPU_SANITIZE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_submit_fastpath.py::"
+         "test_kill9_mid_batch_typed_errors_no_hang"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"chaos test under RAY_TPU_SANITIZE=1 failed "
+        f"(rc={proc.returncode})\n--- stdout ---\n{proc.stdout}\n"
+        f"--- stderr ---\n{proc.stderr}")
+    assert "1 passed" in proc.stdout, proc.stdout
+
+
+def test_sanitizer_actually_live_in_chaos_child():
+    """Guard the guard: a sanitized child must report installation —
+    otherwise the chaos rerun above could silently test nothing."""
+    proc = _run_sanitized("""
+import threading
+assert sanitizer.ENABLED
+assert threading.Lock is sanitizer._lock_factory
+print("SAN-LIVE")
+""")
+    _check(proc)
+    assert "SAN-LIVE" in proc.stdout
