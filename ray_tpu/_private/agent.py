@@ -130,6 +130,20 @@ class WorkerHandle:
             except Exception:
                 pass
 
+    def hard_kill(self) -> None:
+        """SIGKILL — for workers that ignore SIGTERM (e.g. wedged in a
+        native collective holding the GIL, where the Python-level signal
+        handler never gets to run)."""
+        if self.proc is not None:
+            try:
+                kill = getattr(self.proc, "kill", None)
+                if kill is not None:
+                    kill()
+                elif getattr(self.proc, "pid", None):
+                    os.kill(self.proc.pid, 9)
+            except Exception:
+                pass
+
     def mark_failed(self) -> None:
         """A launch that will never produce a process: flips `alive` to
         False so liveness watchers (actor resource release) resolve."""
@@ -1893,11 +1907,29 @@ class NodeAgent:
 
     def _kill_actor_worker(self, actor_id: str) -> None:
         handle = self.workers_by_actor.get(actor_id)
-        if handle is not None:
+        if handle is None:
+            return
+        try:
+            handle.terminate()
+        except Exception:
+            pass
+
+        # SIGTERM is advisory: a worker wedged inside a native collective
+        # (dead-peer jax/gloo rendezvous holds the GIL in C++) never runs
+        # the Python signal handler and only dies at the collective's own
+        # timeout (~100s) — which stalls the killed actor's PG bundle and
+        # wedges the elastic restart behind it. Escalate to SIGKILL after
+        # a bounded grace.
+        async def escalate():
             try:
-                handle.terminate()
-            except Exception:
-                pass
+                await asyncio.wait_for(
+                    handle.exited.wait(),
+                    timeout=float(CONFIG.worker_kill_escalation_s))
+            except asyncio.TimeoutError:
+                if handle.alive:
+                    handle.hard_kill()
+
+        spawn_tracked(escalate(), "agent-kill-escalate")
 
     # ------------------------------------------------------ placement groups
     def _match_pg_bundle(self, pg, request: ResourceSet):
@@ -3084,6 +3116,13 @@ class _ForeignProc:
         if self.pid:
             try:
                 os.kill(self.pid, 15)
+            except OSError:
+                pass
+
+    def kill(self):
+        if self.pid:
+            try:
+                os.kill(self.pid, 9)
             except OSError:
                 pass
 

@@ -156,6 +156,12 @@ _flag("worker_startup_concurrency", 0)  # 0 = max(2, num_cpus); processes
 # between fork and registration at once (reference:
 # maximum_startup_concurrency, worker_pool.h)
 _flag("worker_register_timeout_s", 60)
+# SIGTERM->SIGKILL grace for explicitly killed actor workers. A worker
+# wedged in a native collective (dead-peer rendezvous, GIL held in C++)
+# never runs the Python SIGTERM handler; without escalation it dies only
+# at the collective's own timeout (~100s), pinning its PG bundle and
+# stalling elastic-restart actor placement behind it.
+_flag("worker_kill_escalation_s", 5.0)
 _flag("idle_worker_killing_time_ms", 600_000)
 _flag("prestart_workers", True)
 
@@ -421,6 +427,21 @@ _flag("conda_failure_cache_s", 60.0)  # failed-env fast-fail window
 
 # --- TPU --------------------------------------------------------------------
 _flag("tpu_chips_per_host_default", 4)
+
+# --- elastic training plane -------------------------------------------------
+# write an in-store shard alongside every disk checkpoint so restarts can
+# restore through the broadcast-tree pull path without disk reads
+_flag("train_in_store_checkpoints", True)
+# in-store sharded checkpoints retained (pinned) by the driver; older
+# manifests unpin their shards back to LRU eviction
+_flag("train_in_store_keep", 2)
+# bound on one collective-rendezvous attempt (jax.distributed.initialize
+# + group formation) — the rc-124 hang class becomes a typed retry
+_flag("train_rendezvous_timeout_s", 120.0)
+# bounded rendezvous attempts, fresh coordinator port each (free-port race)
+_flag("train_rendezvous_max_retries", 3)
+# one result round's sync-barrier deadline in BackendExecutor
+_flag("train_result_timeout_s", 3600.0)
 
 # --- logging / debug --------------------------------------------------------
 _flag("log_to_driver", True)

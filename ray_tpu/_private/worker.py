@@ -327,6 +327,21 @@ class ReferenceCounter:
         if free:
             self.worker._free_owned(binary)
 
+    def clear_borrows(self, binary: bytes):
+        """Owner-side forced borrow release. RemoveBorrow rides the
+        borrower's ObjectRef GC, so a SIGKILLed borrower leaves the count
+        stuck forever; the owner may clear it once it knows every
+        borrower is dead or past any use of the object (e.g. retired
+        elastic-train checkpoint shards). A late RemoveBorrow from a
+        surviving borrower lands on an absent entry and is a no-op."""
+        free = False
+        with self._lock:
+            if self._borrows.pop(binary, None) is not None \
+                    and self._ready_to_free(binary):
+                free = True
+        if free:
+            self.worker._free_owned(binary)
+
     def add_local_refs_batch(self, binaries: List[bytes]) -> None:
         """Local-ref registration for a block of freshly minted refs
         (ISSUE 18): one lock acquisition for the whole batch. Callers

@@ -27,6 +27,29 @@ class ScalingConfig:
     # mesh axes for in-worker SPMD: {"data": -1, "fsdp": 1, ...}
     mesh_shape: Optional[Dict[str, int]] = None
     placement_strategy: str = "PACK"
+    # Elastic bounds. When min_workers is set (< num_workers), a worker
+    # death during training shrinks the group to the surviving world size
+    # (floored at min_workers) instead of restarting at full strength —
+    # the preemption-survival mode for slices that can re-shard.
+    # max_workers caps future re-grows (defaults to num_workers).
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_workers is not None and not (
+                1 <= self.min_workers <= self.num_workers):
+            raise ValueError(
+                f"min_workers={self.min_workers} must be in "
+                f"[1, num_workers={self.num_workers}]")
+        if self.max_workers is not None and self.max_workers < self.num_workers:
+            raise ValueError(
+                f"max_workers={self.max_workers} must be >= "
+                f"num_workers={self.num_workers}")
+
+    @property
+    def elastic(self) -> bool:
+        return (self.min_workers is not None
+                and self.min_workers < self.num_workers)
 
     def _resources(self) -> Dict[str, float]:
         if self.resources_per_worker:

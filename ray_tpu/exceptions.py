@@ -385,6 +385,88 @@ def _rebuild_backpressure_error(message, deployment, queue_depths):
     return BackPressureError(message, deployment, queue_depths)
 
 
+class TrainingWorkerError(RayTpuError):
+    """A training worker died (or its user loop raised) mid-round.
+
+    Raised by ``BackendExecutor.get_next_results`` instead of wedging the
+    result barrier behind survivors stuck in a collective. Carries the
+    failed world ranks and a :class:`DeathContext` so the trainer's
+    recovery loop can decide between an in-place restart (user-loop
+    error) and an elastic shrink (host/actor death), and so postmortems
+    see which rank took the group down.
+    """
+
+    def __init__(self, message: str = "",
+                 failed_ranks: Optional[List[int]] = None,
+                 node_id: str = "", incarnation: int = 0,
+                 reason: str = "",
+                 timeline: Optional[List[Tuple[float, str]]] = None):
+        self.failed_ranks = sorted(int(r) for r in (failed_ranks or []))
+        self.context = DeathContext(node_id, incarnation, reason, timeline)
+        if not message:
+            ranks = ",".join(str(r) for r in self.failed_ranks) or "?"
+            message = f"training worker(s) rank [{ranks}] failed"
+            extra = self.context.describe()
+            if extra:
+                message += f" ({extra})"
+        super().__init__(message)
+        self.message = message
+
+    @property
+    def is_user_error(self) -> bool:
+        """True when the user train loop raised (the worker process itself
+        is fine) — recovery must not shrink the world for these."""
+        return self.context.reason == "train_fn_error"
+
+    def __reduce__(self):
+        return (_rebuild_training_worker_error,
+                (type(self), self.message, self.failed_ranks,
+                 self.context.to_dict()))
+
+
+def _rebuild_training_worker_error(cls, message, failed_ranks, ctx_dict):
+    ctx = DeathContext.from_dict(ctx_dict)
+    return cls(message, failed_ranks=failed_ranks, node_id=ctx.node_id,
+               incarnation=ctx.incarnation, reason=ctx.reason,
+               timeline=ctx.timeline)
+
+
+class TrainRendezvousError(RayTpuError):
+    """Collective/backend rendezvous could not form within its budget.
+
+    The bounded replacement for the rc-124 hang class: a peer dying (or a
+    coordinator port being rebound) mid-``jax.distributed.initialize``
+    used to wedge ``on_start`` forever. Carries the coordinator address
+    and how many bounded attempts were burned so the caller can tell an
+    exhausted retry loop from a first-try failure.
+    """
+
+    def __init__(self, message: str = "", coordinator: str = "",
+                 attempts: int = 0, reason: str = ""):
+        self.coordinator = coordinator
+        self.attempts = int(attempts)
+        self.reason = reason
+        if not message:
+            message = "training rendezvous failed"
+            if coordinator:
+                message += f" at {coordinator}"
+            if self.attempts:
+                message += f" after {self.attempts} attempt(s)"
+            if reason:
+                message += f": {reason}"
+        super().__init__(message)
+        self.message = message
+
+    def __reduce__(self):
+        return (_rebuild_rendezvous_error,
+                (type(self), self.message, self.coordinator, self.attempts,
+                 self.reason))
+
+
+def _rebuild_rendezvous_error(cls, message, coordinator, attempts, reason):
+    return cls(message, coordinator, attempts, reason)
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass
 

@@ -633,6 +633,81 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_train_resume(args) -> int:
+    """Elastic-training recovery report (ISSUE 20): every
+    ``train_resume::`` span the flight recorder holds, grouped per
+    restart incarnation — how long teardown, group re-form, restore
+    dispatch, and time-to-first-result each took."""
+    if getattr(args, "session", ""):
+        # post-mortem: parse ring files off the session dir (the driver
+        # that recorded the resume may itself be gone)
+        from ray_tpu._private.events import recover_session
+
+        rings = recover_session(args.session)
+        from ray_tpu._private.events import _span_dict
+
+        spans = []
+        for ring in rings:
+            for sp in ring["spans"]:
+                spans.append(sp if isinstance(sp, dict) else _span_dict(sp))
+    else:
+        _connect()
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        w.flush_task_events(wait=True)
+        spans = w.head_call("ListSpans", {"limit": 20000}, timeout=10) or []
+
+    resumes = [sp for sp in spans
+               if str(sp.get("name", "")).startswith("train_resume::")]
+    if not resumes:
+        print("no train_resume:: spans recorded (no elastic restart "
+              "happened, or task_event_sample_rate is 0)")
+        return 1
+
+    # driver-side spans carry the restart ordinal; teardown is recorded
+    # against the failing incarnation, the rest against the new one —
+    # the ordinal, not the trace id, is the incarnation key. Worker-side
+    # restore spans live in the workers' own traces; shown separately.
+    by_restart: dict = {}
+    worker_restores = []
+    for sp in resumes:
+        ex = sp.get("extra") or {}
+        if sp["name"] == "train_resume::restore":
+            worker_restores.append(sp)
+        else:
+            by_restart.setdefault(ex.get("restart"), []).append(sp)
+
+    print(f"{len(by_restart)} recovery incarnation(s)")
+    for restart in sorted(by_restart, key=lambda r: (r is None, r)):
+        group = by_restart[restart]
+        print(f"\nrestart #{restart if restart is not None else '?'}")
+        for suffix, label in (
+                ("teardown", "tear down failed group"),
+                ("group_start", "re-form worker group"),
+                ("start_training", "dispatch + in-store restore"),
+                ("first_result", "first post-resume result"),
+                ("total", "total time-to-resume")):
+            for sp in group:
+                if sp["name"] != f"train_resume::{suffix}":
+                    continue
+                ex = sp.get("extra") or {}
+                notes = " ".join(f"{k}={v}" for k, v in sorted(ex.items())
+                                 if k not in ("task", "restart"))
+                print(f"  {label:<28} {sp.get('dur_us', 0) / 1e6:>8.3f}s"
+                      f"  {notes}")
+    if worker_restores:
+        print("\nworker-side shard restores")
+        for sp in sorted(worker_restores,
+                         key=lambda s: ((s.get("extra") or {}).get("step", 0),
+                                        (s.get("extra") or {}).get("rank", 0))):
+            ex = sp.get("extra") or {}
+            print(f"  rank {ex.get('rank', '?')} step {ex.get('step', '?')}"
+                  f"  {sp.get('dur_us', 0) / 1e6:>8.3f}s"
+                  f"  {ex.get('nbytes', 0)} B")
+    return 0
+
+
 def cmd_metrics(args) -> int:
     if getattr(args, "scrape", False) or getattr(args, "url", ""):
         # hit the head's HTTP scrape endpoint (metrics_export_port) the
@@ -782,6 +857,16 @@ def main(argv=None) -> int:
     s.add_argument("--limit", type=int, default=20,
                    help="rows per section (default 20)")
     s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser(
+        "train-resume",
+        help="elastic-training recovery report: per-restart "
+             "teardown / re-form / restore / first-result timings "
+             "from the train_resume:: flight-recorder spans")
+    s.add_argument("--session", default="",
+                   help="offline mode: read ring files from this session "
+                        "dir instead of a live head (post-mortem)")
+    s.set_defaults(fn=cmd_train_resume)
 
     s = sub.add_parser("metrics", help="Prometheus metrics dump")
     s.add_argument("--scrape", action="store_true",

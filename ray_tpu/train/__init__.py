@@ -7,8 +7,8 @@ from typing import Dict, Optional
 from ray_tpu.air.config import (
     CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
 from ray_tpu.train._checkpoint import (
-    Checkpoint, load_pytree, load_pytree_orbax, save_pytree,
-    save_pytree_orbax)
+    Checkpoint, InStoreCheckpoint, load_pytree, load_pytree_orbax,
+    save_pytree, save_pytree_orbax)
 from ray_tpu.train._internal.session import TrainContext, get_session, in_session
 from ray_tpu.train.base_trainer import BaseTrainer, Result, TrainingFailedError
 from ray_tpu.train.accelerate import AccelerateTrainer, LightningTrainer
@@ -40,7 +40,8 @@ def get_dataset_shard(name: str = "train"):
 
 __all__ = [
     "BaseTrainer", "Checkpoint", "CheckpointConfig", "DataParallelTrainer",
-    "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
+    "FailureConfig", "InStoreCheckpoint", "JaxConfig", "JaxTrainer",
+    "Result", "RunConfig",
     "ScalingConfig", "TrainContext", "TrainingFailedError", "get_checkpoint",
     "get_context", "get_dataset_shard", "report", "save_pytree",
     "load_pytree", "save_pytree_orbax", "load_pytree_orbax",

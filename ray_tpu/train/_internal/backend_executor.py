@@ -5,15 +5,20 @@ get_next_results :552)."""
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu.exceptions import (
+    ActorUnavailableError, NodeDiedError, RayActorError,
+    TrainingWorkerError, WorkerCrashedError)
 from ray_tpu.train._internal.session import TrainingResult
 from ray_tpu.train._internal.worker_group import WorkerGroup
 
-
-class TrainingWorkerError(RuntimeError):
-    pass
+# a worker's pending result ref resolving to one of these = the worker
+# process (or its host) is gone, not the user loop
+_DEATH_ERRORS = (RayActorError, ActorUnavailableError, WorkerCrashedError,
+                 NodeDiedError)
 
 
 class Backend:
@@ -41,28 +46,38 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
         self._ranks: List[Dict] = []
         self._done_workers: set = set()
+        # newest in-store checkpoint step the trainer has re-owned; acked
+        # to workers on the next result round so they release keepalives
+        self._acked_shard_step: Optional[int] = None
 
-    def start(self) -> None:
-        self.worker_group = WorkerGroup(
-            self._num_workers, self._resources, self._pg)
-        metas = self.worker_group.node_metas()
-        # rank assignment: stable by (node, order) — local ranks group by node
+    @staticmethod
+    def assign_ranks(metas: List[Dict]) -> List[Dict]:
+        """Stable rank assignment by (node, order): world_rank follows the
+        actor creation order, local ranks group by node, node_rank by
+        first-seen node order, local_world_size per node."""
         per_node: Dict[str, int] = defaultdict(int)
         node_order: Dict[str, int] = {}
-        self._ranks = []
+        ranks: List[Dict] = []
         for world_rank, meta in enumerate(metas):
             node = meta["node_id"]
             if node not in node_order:
                 node_order[node] = len(node_order)
-            self._ranks.append({
+            ranks.append({
                 "world_rank": world_rank,
                 "local_rank": per_node[node],
                 "node_rank": node_order[node],
                 "node_id": node,
             })
             per_node[node] += 1
-        for r in self._ranks:
+        for r in ranks:
             r["local_world_size"] = per_node[r["node_id"]]
+        return ranks
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self._num_workers, self._resources, self._pg)
+        metas = self.worker_group.node_metas()
+        self._ranks = self.assign_ranks(metas)
         self._backend.on_start(self.worker_group, self._backend_config)
 
     @property
@@ -78,6 +93,8 @@ class BackendExecutor:
         trial_dir: str,
         checkpoint_path: Optional[str] = None,
         dataset_shards: Optional[List[Dict[str, Any]]] = None,
+        checkpoint_shards: Optional[Dict] = None,
+        start_iteration: int = 0,
     ) -> None:
         from ray_tpu._private import serialization as ser
 
@@ -99,6 +116,8 @@ class BackendExecutor:
                 config=config,
                 checkpoint_path=checkpoint_path,
                 dataset_shards=shards,
+                checkpoint_shards=checkpoint_shards,
+                start_iteration=start_iteration,
             ))
         ray_tpu.get(inits)
         self._done_workers = set()
@@ -106,31 +125,85 @@ class BackendExecutor:
         ray_tpu.get([w.start_training.remote(blob)
                      for w in self.worker_group.workers])
 
-    def get_next_results(self, timeout: float = 3600.0) -> Optional[List[TrainingResult]]:
+    def ack_in_store(self, step: int) -> None:
+        """Record that in-store shards up to ``step`` are re-owned and
+        pinned driver-side (CheckpointManager.register_in_store done)."""
+        if self._acked_shard_step is None or step > self._acked_shard_step:
+            self._acked_shard_step = step
+
+    def get_next_results(self, timeout: Optional[float] = None
+                         ) -> Optional[List[TrainingResult]]:
         """One result from every still-running worker — a sync barrier per
         report round. Returns None once all workers are DONE. Workers that
         already returned DONE are not re-polled (their queues are empty;
-        uneven report counts across ranks must not wedge the round)."""
-        import ray_tpu
+        uneven report counts across ranks must not wedge the round).
 
+        Failure detection: instead of one bulk ``get`` that would block
+        behind survivors wedged in a collective, each worker's ref is
+        polled independently — the FIRST detected death converts the
+        round into a typed :class:`TrainingWorkerError` carrying every
+        failed rank seen so far plus the victim's ``DeathContext``, so
+        the trainer's recovery loop can tear the group down immediately.
+        """
+        import ray_tpu
+        from ray_tpu._private.config import CONFIG
+
+        if timeout is None:
+            timeout = CONFIG.train_result_timeout_s
         live = [i for i in range(len(self.worker_group.workers))
                 if i not in self._done_workers]
         if not live:
             return None
-        wire = ray_tpu.get(
-            [self.worker_group.workers[i].get_next.remote(timeout)
-             for i in live],
-            timeout=timeout)
-        results = [TrainingResult.from_wire(d) for d in wire]
-        for i, r in zip(live, results):
+        pending = {
+            i: self.worker_group.workers[i].get_next.remote(
+                timeout, release_upto=self._acked_shard_step)
+            for i in live
+        }
+        deadline = time.monotonic() + timeout
+        results: Dict[int, TrainingResult] = {}
+        failed: Dict[int, Exception] = {}
+        while pending and not failed:
+            ready, _ = ray_tpu.wait(
+                list(pending.values()), num_returns=1,
+                timeout=min(1.0, max(0.05, deadline - time.monotonic())))
+            for ref in ready:
+                idx = next(i for i, r in pending.items() if r is ref)
+                del pending[idx]
+                try:
+                    results[idx] = TrainingResult.from_wire(ray_tpu.get(ref))
+                except _DEATH_ERRORS as e:
+                    failed[idx] = e
+            if not ready and time.monotonic() >= deadline:
+                ranks = sorted(self._ranks[i]["world_rank"] for i in pending)
+                raise TrainingWorkerError(
+                    failed_ranks=ranks, reason="result round timed out",
+                    message=(f"no result from rank(s) {ranks} within "
+                             f"{timeout:.0f}s"))
+        if failed:
+            first = failed[min(failed)]
+            ctx = getattr(first, "context", None)
+            raise TrainingWorkerError(
+                failed_ranks=sorted(self._ranks[i]["world_rank"]
+                                    for i in failed),
+                node_id=getattr(ctx, "node_id", ""),
+                incarnation=getattr(ctx, "incarnation", 0),
+                reason=getattr(ctx, "reason", "") or "worker died",
+                timeline=getattr(ctx, "timeline", None)) from first
+        out = []
+        for i in sorted(results):
+            r = results[i]
             r.world_rank = self._ranks[i]["world_rank"]
-        errors = [r for r in results if r.kind == TrainingResult.ERROR]
+            out.append(r)
+        errors = [r for r in out if r.kind == TrainingResult.ERROR]
         if errors:
-            raise TrainingWorkerError(errors[0].error)
-        for i, r in zip(live, results):
+            raise TrainingWorkerError(
+                errors[0].error,
+                failed_ranks=[r.world_rank for r in errors],
+                reason="train_fn_error")
+        for i, r in zip(sorted(results), out):
             if r.kind == TrainingResult.DONE:
                 self._done_workers.add(i)
-        reports = [r for r in results if r.kind == TrainingResult.REPORT]
+        reports = [r for r in out if r.kind == TrainingResult.REPORT]
         if not reports and len(self._done_workers) == len(self.worker_group.workers):
             return None
         return reports or None

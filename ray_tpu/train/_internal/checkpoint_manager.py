@@ -18,11 +18,28 @@ class _TrackedCheckpoint:
         self.index = index
 
 
+class _InStoreManifest:
+    """One in-store sharded checkpoint: {world_rank: driver-owned ref}."""
+
+    def __init__(self, step: int, world_size: int, shards: Dict,
+                 metrics: Dict, nbytes: int):
+        self.step = step
+        self.world_size = world_size
+        self.shards = shards  # {int rank: ObjectRef}
+        self.metrics = metrics
+        self.nbytes = nbytes
+
+    def to_wire(self) -> Dict:
+        return {"step": self.step, "world_size": self.world_size,
+                "shards": dict(self.shards)}
+
+
 class CheckpointManager:
     def __init__(self, config: Optional[CheckpointConfig] = None):
         self.config = config or CheckpointConfig()
         self._checkpoints: List[_TrackedCheckpoint] = []
         self._counter = 0
+        self._in_store: List[_InStoreManifest] = []
 
     def register_checkpoint(self, checkpoint: Checkpoint, metrics: Dict) -> None:
         self._counter += 1
@@ -57,6 +74,104 @@ class CheckpointManager:
                         dropped.checkpoint.path, e)
             else:
                 shutil.rmtree(dropped.checkpoint.path, ignore_errors=True)
+
+    # ------------------------------------------------- in-store manifests
+    def register_in_store(self, step: int, shards: Dict, metrics: Dict
+                          ) -> bool:
+        """Register one sharded in-store checkpoint.
+
+        ``shards`` maps world_rank -> the worker-put ObjectRef of that
+        rank's packed state. Worker-owned objects die with their owner —
+        exactly the process the elastic path expects to lose — so the
+        driver RE-OWNS each shard here (get the zero-copy view, put a
+        driver-owned copy, pin it against eviction for the retention
+        window). One get+put per shard per report; restore never touches
+        disk.
+
+        A worker can die BETWEEN reporting step N and the driver landing
+        here — then its shard's ownership record is already gone. That is
+        not a failure of the training round (the death will surface as a
+        typed error on the next result round): abandon this step's
+        manifest, keep the previous one, return False.
+        """
+        import ray_tpu
+        from ray_tpu._private.config import CONFIG
+
+        owned: Dict[int, object] = {}
+        nbytes = 0
+        for rank, ref in sorted(shards.items()):
+            try:
+                data = ray_tpu.get(ref)
+                mine = ray_tpu.put(data)
+            except Exception:
+                for kept in owned.values():
+                    self._unpin(kept)
+                return False
+            self._pin(mine)
+            owned[int(rank)] = mine
+            try:
+                nbytes += len(memoryview(data).cast("B"))
+            except TypeError:
+                pass
+        self._in_store.append(_InStoreManifest(
+            int(step), len(owned), owned, dict(metrics or {}), nbytes))
+        keep = max(1, int(CONFIG.train_in_store_keep))
+        while len(self._in_store) > keep:
+            dropped = self._in_store.pop(0)
+            for ref in dropped.shards.values():
+                self._retire(ref)
+        return True
+
+    @staticmethod
+    def _pin(ref) -> None:
+        from ray_tpu._private.worker import global_worker
+
+        try:
+            global_worker.store.pin(ref.hex())
+        except Exception:
+            # inline objects live in the memory store; nothing to pin
+            pass
+
+    @staticmethod
+    def _unpin(ref) -> None:
+        from ray_tpu._private.worker import global_worker
+
+        try:
+            global_worker.store.unpin(ref.hex())
+        except Exception:
+            pass
+
+    @classmethod
+    def _retire(cls, ref) -> None:
+        """A retired shard is never restored from again, and its only
+        borrowers are train workers (possibly SIGKILLed ones whose
+        RemoveBorrow can never arrive) — unpin AND force-clear stale
+        borrows so the driver-owned bytes actually free."""
+        from ray_tpu._private.worker import global_worker
+
+        cls._unpin(ref)
+        try:
+            global_worker.reference_counter.clear_borrows(ref.binary())
+        except Exception:
+            pass
+
+    def latest_in_store_manifest(self) -> Optional[Dict]:
+        """Wire form of the newest in-store checkpoint ({step, world_size,
+        shards}) for ``init_train_session(checkpoint_shards=...)``."""
+        if not self._in_store:
+            return None
+        return self._in_store[-1].to_wire()
+
+    @property
+    def latest_in_store_step(self) -> Optional[int]:
+        return self._in_store[-1].step if self._in_store else None
+
+    def release_in_store(self) -> None:
+        """Retire every tracked shard (trainer exit)."""
+        for m in self._in_store:
+            for ref in m.shards.values():
+                self._retire(ref)
+        self._in_store = []
 
     def _score(self, t: _TrackedCheckpoint) -> Tuple:
         """Rank key, higher = better. A checkpoint missing the score

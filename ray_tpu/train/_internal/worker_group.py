@@ -26,9 +26,12 @@ class RayTrainWorker:
         return fn(*args, **kwargs)
 
     def node_meta(self) -> Dict:
+        import os
+
         ctx = ray_tpu.get_runtime_context()
         return {"node_id": ctx.get_node_id(), "hostname": socket.gethostname(),
-                "accelerators": ctx.get_accelerator_ids()}
+                "accelerators": ctx.get_accelerator_ids(),
+                "pid": os.getpid()}
 
     def init_train_session(self, **kwargs) -> None:
         ckpt = kwargs.pop("checkpoint_path", None)
@@ -44,10 +47,12 @@ class RayTrainWorker:
         def run():
             try:
                 train_fn(session.config)
+                self._drop_session_refs(session)
                 session.result_queue.put(TrainingResult(TrainingResult.DONE))
             except BaseException as e:  # noqa: BLE001 — shipped to driver
                 import traceback
 
+                self._drop_session_refs(session)
                 session.result_queue.put(TrainingResult(
                     TrainingResult.ERROR,
                     error=f"{e!r}\n{traceback.format_exc()}"))
@@ -56,9 +61,24 @@ class RayTrainWorker:
                                               name="train-loop")
         self._train_thread.start()
 
-    def get_next(self, timeout: float = 3600.0) -> Dict:
-        """Block for the worker's next result (report/done/error)."""
+    def get_next(self, timeout: float = 3600.0,
+                 release_upto: Optional[int] = None) -> Dict:
+        """Block for the worker's next result (report/done/error).
+        ``release_upto`` acks in-store checkpoint shards the driver has
+        re-owned, releasing this worker's keepalive handles on them."""
+        if release_upto is not None:
+            self._session.release_shards(release_upto)
         return self._session.result_queue.get(timeout=timeout).to_wire()
+
+    @staticmethod
+    def _drop_session_refs(session) -> None:
+        # release borrowed/held store refs before signaling DONE/ERROR:
+        # the driver may kill this actor moments after consuming the
+        # result, and RemoveBorrow only fires from a live process
+        try:
+            session.drop_object_refs()
+        except Exception:
+            pass
 
     def end_session(self) -> None:
         shutdown_session()

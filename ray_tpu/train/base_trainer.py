@@ -34,6 +34,9 @@ class Result:
     best_checkpoints: Optional[list] = None
     # the trial's hyperparameter config (reference: Result.config)
     config: Optional[Dict[str, Any]] = None
+    # worker-group restarts the elastic recovery loop performed; 0 on a
+    # clean run (mirrors ray_tpu_train_restarts_total for this trial)
+    restarts: int = 0
 
 
 class BaseTrainer:

@@ -4,7 +4,6 @@ WorkerGroup; SURVEY §3.4 call stack)."""
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -12,13 +11,26 @@ from ray_tpu.air.config import (
     CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
 from ray_tpu.exceptions import (
     ActorDiedError, ActorUnavailableError, NodeDiedError, RayActorError,
-    WorkerCrashedError)
+    TrainingWorkerError, TrainRendezvousError, WorkerCrashedError)
 from ray_tpu.train._checkpoint import Checkpoint
-from ray_tpu.train._internal.backend_executor import (
-    BackendExecutor, TrainingWorkerError)
+from ray_tpu.train._internal.backend_executor import BackendExecutor
 from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
 from ray_tpu.train.base_trainer import (
     BaseTrainer, Result, TrainingFailedError)
+
+_restart_counter = None
+
+
+def _restarts_total():
+    global _restart_counter
+    if _restart_counter is None:
+        from ray_tpu.util.metrics import Counter
+
+        _restart_counter = Counter(
+            "ray_tpu_train_restarts_total",
+            "training worker-group restarts (elastic recovery loop)",
+            tag_keys=("experiment",))
+    return _restart_counter
 
 
 class DataParallelTrainer(BaseTrainer):
@@ -49,40 +61,69 @@ class DataParallelTrainer(BaseTrainer):
         self.backend_config = backend_config
 
     # Worker-group failures that warrant a full (slice-granular) restart:
-    # the user loop raising is a TrainingWorkerError; an actor/host death
-    # surfaces as a runtime actor error from ray_tpu.get.
-    _RESTARTABLE = (TrainingWorkerError, RayActorError, ActorDiedError,
-                    ActorUnavailableError, WorkerCrashedError, NodeDiedError)
+    # the user loop raising or a worker death is a typed
+    # TrainingWorkerError from get_next_results; an actor/host death during
+    # setup surfaces as a runtime actor error from ray_tpu.get; an
+    # exhausted rendezvous is a TrainRendezvousError (a fresh group gets a
+    # fresh coordinator, so retrying the whole attempt can succeed).
+    _RESTARTABLE = (TrainingWorkerError, TrainRendezvousError, RayActorError,
+                    ActorDiedError, ActorUnavailableError, WorkerCrashedError,
+                    NodeDiedError)
 
     # ------------------------------------------------------------------ run
     def training_loop(self) -> Result:
         failure_config = self.run_config.failure_config or FailureConfig()
         ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
-        latest_metrics: Optional[Dict] = None
         checkpoint_path: Optional[str] = (
             self.resume_from_checkpoint.path
             if self.resume_from_checkpoint else None)
-        failures = 0
-        error: Optional[Exception] = None
         pg = self._reserve_placement_group()
         try:
             return self._run_with_pg(
-                pg, failure_config, ckpt_manager, latest_metrics,
-                checkpoint_path, failures, error)
+                pg, failure_config, ckpt_manager, checkpoint_path)
         finally:
+            ckpt_manager.release_in_store()
             self._release_placement_group(pg)
 
-    def _run_with_pg(self, pg, failure_config, ckpt_manager, latest_metrics,
-                     checkpoint_path, failures, error) -> Result:
+    def _run_with_pg(self, pg, failure_config, ckpt_manager,
+                     checkpoint_path) -> Result:
+        """The elastic recovery loop. Each pass is one worker-group
+        incarnation; a restartable failure tears the group down and
+        relaunches — at the surviving world size when the ScalingConfig is
+        elastic and the failure was a death (not a user-loop error) —
+        resuming from the newest in-store sharded checkpoint (broadcast-
+        tree restore, zero disk reads) with the disk checkpoint as
+        fallback."""
+        from ray_tpu._private.events import REC
+
+        latest_metrics: Optional[Dict] = None
+        failures = 0
+        restarts = 0
+        error: Optional[Exception] = None
+        world_size = self.scaling_config.num_workers
         while True:
+            resume_trace = None
+            if restarts and REC.sample():
+                resume_trace = REC.new_trace()
             executor = BackendExecutor(
                 self.backend_config,
-                self.scaling_config.num_workers,
+                world_size,
                 self.scaling_config._resources(),
-                placement_group=pg,
+                # a shrunken group must not pin itself to the full-strength
+                # gang reservation: the dead worker's bundle may sit on a
+                # dead node and never re-place
+                placement_group=(
+                    pg if world_size == self.scaling_config.num_workers
+                    else None),
             )
             try:
+                manifest = ckpt_manager.latest_in_store_manifest()
+                start_iter = 0
+                if manifest is not None:
+                    start_iter = int(manifest["step"]) + 1
+                t0 = time.time()
                 executor.start()
+                t1 = time.time()
                 executor.start_training(
                     self.train_loop_per_worker,
                     self.train_loop_config,
@@ -90,10 +131,33 @@ class DataParallelTrainer(BaseTrainer):
                     storage_path=self._storage_path,
                     trial_dir=self._trial_dir,
                     checkpoint_path=checkpoint_path,
-                    dataset_shards=self._split_datasets(),
+                    dataset_shards=self._split_datasets(world_size),
+                    checkpoint_shards=manifest,
+                    start_iteration=start_iter,
                 )
+                t2 = time.time()
+                first_round = True
                 while True:
                     results = executor.get_next_results()
+                    if first_round and resume_trace is not None:
+                        tid, root = resume_trace
+                        now = time.time()
+                        REC.record("train_resume::group_start", "train",
+                                   t0, t1 - t0, tid, REC.next_id(), root,
+                                   extra={"restart": restarts,
+                                          "world_size": world_size})
+                        REC.record("train_resume::start_training", "train",
+                                   t1, t2 - t1, tid, REC.next_id(), root,
+                                   extra={"restart": restarts,
+                                          "from_step": start_iter})
+                        REC.record("train_resume::first_result", "train",
+                                   t2, now - t2, tid, REC.next_id(), root,
+                                   extra={"restart": restarts})
+                        REC.record("train_resume::total", "train",
+                                   t0, now - t0, tid, root,
+                                   extra={"restart": restarts,
+                                          "world_size": world_size})
+                    first_round = False
                     if results is None:
                         break
                     # rank-0's metrics are canonical (reference consolidates
@@ -106,6 +170,16 @@ class DataParallelTrainer(BaseTrainer):
                     latest_metrics = canonical.metrics
                     ckpt_dirs = [r.checkpoint_dir for r in results
                                  if r.checkpoint_dir]
+                    shards = {r.world_rank: r.shard_ref for r in results
+                              if r.shard_ref is not None}
+                    if shards:
+                        step = (canonical.shard_step
+                                if canonical.shard_step is not None
+                                else max(r.shard_step for r in results
+                                         if r.shard_step is not None))
+                        if ckpt_manager.register_in_store(
+                                step, shards, latest_metrics or {}):
+                            executor.ack_in_store(step)
                     report_fn = getattr(self, "_tune_report_fn", None)
                     if report_fn is not None:
                         # stream per-iteration results to Tune (reference
@@ -125,14 +199,46 @@ class DataParallelTrainer(BaseTrainer):
                 break
             except self._RESTARTABLE as e:
                 failures += 1
+                import logging
+
+                # strings only: a captured LogRecord holding the live
+                # exception would retain its traceback frames (and every
+                # object ref in their locals) for the handler's lifetime
+                logging.getLogger(__name__).warning(
+                    "training incarnation failed (failure %d, %s: %s)",
+                    failures, type(e).__name__, str(e))
                 error = TrainingFailedError(str(e))
+                error.__cause__ = e
                 if failure_config.fail_fast or \
                         failures > failure_config.max_failures >= 0:
                     break
                 # Slice-granular restart: tear the whole group down and
-                # relaunch from the latest checkpoint (SURVEY §7 hard part 4).
+                # relaunch from the latest checkpoint (SURVEY §7 hard part
+                # 4). With elastic bounds, a DEATH (not a user-loop error)
+                # shrinks to the surviving world size instead.
+                world_size = self._next_world_size(world_size, e)
+                restarts += 1
+                _restarts_total().inc(
+                    tags={"experiment": self._experiment_name or "default"})
             finally:
+                td0 = time.time()
                 executor.shutdown()
+                if error is not None and restarts and REC.sample():
+                    tid, sid = REC.new_trace()
+                    REC.record("train_resume::teardown", "train", td0,
+                               time.time() - td0, tid, sid,
+                               extra={"restart": restarts})
+
+        if error is not None:
+            # the stored error outlives the trainer; its traceback frames
+            # would retain the failed round's locals (in-flight result
+            # refs, the restore manifest's shard refs) as phantom object
+            # references — keep the chain's types/messages, drop frames
+            exc, seen = error, set()
+            while exc is not None and id(exc) not in seen:
+                seen.add(id(exc))
+                exc.__traceback__ = None
+                exc = exc.__cause__ or exc.__context__
 
         return Result(
             metrics=latest_metrics,
@@ -141,7 +247,21 @@ class DataParallelTrainer(BaseTrainer):
             path=self._trial_dir,
             error=error,
             best_checkpoints=ckpt_manager.best_checkpoints(),
+            restarts=restarts,
         )
+
+    def _next_world_size(self, world_size: int, e: Exception) -> int:
+        """Elastic policy: a worker/host death shrinks the group to the
+        survivors (floored at min_workers) when the ScalingConfig allows
+        it; user-loop errors and non-elastic configs restart at the same
+        strength."""
+        if not self.scaling_config.elastic:
+            return world_size
+        if isinstance(e, TrainingWorkerError) and e.is_user_error:
+            return world_size
+        lost = (len(e.failed_ranks) or 1) \
+            if isinstance(e, TrainingWorkerError) else 1
+        return max(self.scaling_config.min_workers, world_size - lost)
 
     # ------------------------------------------------------ placement group
     def _reserve_placement_group(self):
@@ -173,11 +293,15 @@ class DataParallelTrainer(BaseTrainer):
             pass
 
     # ------------------------------------------------------------- datasets
-    def _split_datasets(self):
+    def _split_datasets(self, num_workers: Optional[int] = None):
         """Per-worker dataset shards via DataConfig (reference:
         train/_internal/data_config.py — train dataset split, others
-        replicated)."""
+        replicated). ``num_workers`` overrides the configured count when
+        an elastic restart re-shards to a smaller world."""
         from ray_tpu.train._internal.data_config import DataConfig
 
         cfg = getattr(self, "dataset_config", None) or DataConfig()
-        return cfg.configure(self.datasets, self.scaling_config.num_workers)
+        return cfg.configure(
+            self.datasets,
+            num_workers
+            if num_workers is not None else self.scaling_config.num_workers)

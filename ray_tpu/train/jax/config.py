@@ -62,8 +62,27 @@ def _setup_worker(rank: int, world_size: int, coordinator: str,
         if cfg_wire.get("jax_platform"):
             jax.config.update("jax_platforms", cfg_wire["jax_platform"])
         if cfg_wire.get("num_local_devices"):
-            jax.config.update("jax_num_cpu_devices",
-                              cfg_wire["num_local_devices"])
+            try:
+                jax.config.update("jax_num_cpu_devices",
+                                  cfg_wire["num_local_devices"])
+            except AttributeError:
+                # older jax: the config option doesn't exist yet — the
+                # XLA flag does the same thing if it lands before the
+                # first backend touch (we are before it by construction).
+                # XLA's parser honors the FIRST occurrence, so an
+                # inherited setting (e.g. the test harness's =8) must be
+                # stripped, not shadowed.
+                from ray_tpu._private.xla_flags import normalize_xla_flags
+
+                kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                        if not f.startswith(
+                            "--xla_force_host_platform_device_count")]
+                kept.append("--xla_force_host_platform_device_count="
+                            f"{cfg_wire['num_local_devices']}")
+                # normalize: a bare token (e.g. intra_op_parallelism_
+                # threads=1) left LEADING reads as a flags-file name and
+                # FATALs the worker (parse_flags_from_env.cc:169)
+                os.environ["XLA_FLAGS"] = normalize_xla_flags(" ".join(kept))
         if cfg_wire.get("cpu_collectives"):
             jax.config.update("jax_cpu_collectives_implementation",
                               cfg_wire["cpu_collectives"])
@@ -71,10 +90,16 @@ def _setup_worker(rank: int, world_size: int, coordinator: str,
 
         if _jax_dist.global_state.client is not None:
             jax.distributed.shutdown()
+        # Bounded rendezvous (the rc-124 hang class): a peer dying between
+        # actor creation and its initialize() call used to park everyone
+        # else on the coordination-service barrier forever. The timeout
+        # turns that into a typed, retryable failure.
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=world_size,
             process_id=rank,
+            initialization_timeout=int(
+                cfg_wire.get("rendezvous_timeout_s") or 300),
         )
         expected = cfg_wire.get("num_local_devices")
         if expected and jax.local_device_count() != expected:
@@ -96,32 +121,124 @@ class JaxBackend(Backend):
         self._store_key: Optional[str] = None
 
     def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
-        metas = worker_group.node_metas()
-        from ray_tpu.train._internal.util import find_free_port
+        """Form the collective group with a bounded, retrying rendezvous.
 
-        port = worker_group.execute_single(0, find_free_port)
-        coordinator = f"{metas[0]['hostname']}:{port}"
+        Two historical failure classes die here: (1) the free-port race —
+        the port rank-0 probed can be rebound by another process before
+        ``jax.distributed.initialize`` binds it, so each attempt probes a
+        FRESH port instead of failing the whole start; (2) the rc-124
+        hang — a peer dying mid-rendezvous parked everyone on the
+        coordination barrier forever, so every attempt is bounded by
+        ``train_rendezvous_timeout_s`` and peer death surfaces as a typed
+        (restartable) :class:`TrainingWorkerError`. Attempts pace with
+        decorrelated jitter; exhaustion raises
+        :class:`TrainRendezvousError`.
+        """
+        import time as _time
         import uuid
 
-        cfg_wire = {
-            "use_jax_distributed": backend_config.use_jax_distributed,
-            "collective_backend": backend_config.collective_backend,
-            "group_name": backend_config.group_name,
-            "jax_platform": backend_config.jax_platform,
-            "num_local_devices": backend_config.num_local_devices,
-            "cpu_collectives": backend_config.cpu_collectives,
-            # per-incarnation store: a restarted group must not inherit a
-            # dead predecessor's staged contributions
-            "store_key": f"{backend_config.group_name}:{uuid.uuid4().hex[:8]}",
-        }
-        self._store_key = cfg_wire["store_key"]
+        import ray_tpu
+        from ray_tpu._private.async_util import DecorrelatedJitterBackoff
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.exceptions import (
+            ActorUnavailableError, GetTimeoutError, NodeDiedError,
+            RayActorError, TrainingWorkerError, TrainRendezvousError,
+            WorkerCrashedError)
+        from ray_tpu.train._internal.util import find_free_port
+
+        metas = worker_group.node_metas()
+        timeout_s = float(CONFIG.train_rendezvous_timeout_s)
+        attempts = max(1, int(CONFIG.train_rendezvous_max_retries))
+        backoff = DecorrelatedJitterBackoff(base_s=0.2, cap_s=2.0)
+        coordinator = ""
+        last: Optional[BaseException] = None
+        for attempt in range(1, attempts + 1):
+            port = worker_group.execute_single(0, find_free_port)
+            coordinator = f"{metas[0]['hostname']}:{port}"
+            cfg_wire = {
+                "use_jax_distributed": backend_config.use_jax_distributed,
+                "collective_backend": backend_config.collective_backend,
+                "group_name": backend_config.group_name,
+                "jax_platform": backend_config.jax_platform,
+                "num_local_devices": backend_config.num_local_devices,
+                "cpu_collectives": backend_config.cpu_collectives,
+                "rendezvous_timeout_s": timeout_s,
+                # per-incarnation store: a restarted group must not inherit
+                # a dead predecessor's staged contributions
+                "store_key":
+                    f"{backend_config.group_name}:{uuid.uuid4().hex[:8]}",
+            }
+            self._store_key = cfg_wire["store_key"]
+            try:
+                ray_tpu.get([
+                    w.execute.remote(_setup_worker, i, len(worker_group),
+                                     coordinator, cfg_wire)
+                    for i, w in enumerate(worker_group.workers)
+                ], timeout=timeout_s + 30.0)
+                return
+            except (RayActorError, ActorUnavailableError, WorkerCrashedError,
+                    NodeDiedError) as e:
+                # a peer died mid-rendezvous: no point retrying at this
+                # world size — hand the typed error to the recovery loop
+                ctx = getattr(e, "context", None)
+                self._cleanup_partial(worker_group,
+                                      backend_config.group_name)
+                raise TrainingWorkerError(
+                    node_id=getattr(ctx, "node_id", ""),
+                    incarnation=getattr(ctx, "incarnation", 0),
+                    reason="peer died during rendezvous",
+                    timeline=getattr(ctx, "timeline", None)) from e
+            except GetTimeoutError as e:
+                last = e
+                self._cleanup_partial(worker_group,
+                                      backend_config.group_name)
+            except Exception as e:  # bind race, stale client, task error
+                last = e
+                self._cleanup_partial(worker_group,
+                                      backend_config.group_name)
+            if attempt < attempts:
+                _time.sleep(backoff.next_delay())
+        raise TrainRendezvousError(
+            coordinator=coordinator, attempts=attempts,
+            reason=str(last)[:300] if last else "unknown") from last
+
+    def _cleanup_partial(self, worker_group: WorkerGroup,
+                         group_name: str = "train_default") -> None:
+        """Best-effort teardown of a half-formed incarnation so the next
+        attempt starts clean: drop worker-side jax clients / group state,
+        kill the staging store actor (unblocks peers parked on it)."""
+        def reset(group_name: str):
+            try:
+                from ray_tpu.util import collective as col
+
+                col.destroy_collective_group(group_name)
+            except Exception:
+                pass
+            try:
+                from jax._src import distributed as _jax_dist
+
+                if _jax_dist.global_state.client is not None:
+                    import jax
+
+                    jax.distributed.shutdown()
+            except Exception:
+                pass
+
         import ray_tpu
 
-        ray_tpu.get([
-            w.execute.remote(_setup_worker, i, len(worker_group), coordinator,
-                             cfg_wire)
-            for i, w in enumerate(worker_group.workers)
-        ])
+        if self._store_key:
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(
+                    f"_collective_store:{self._store_key}"))
+            except Exception:
+                pass
+        try:
+            ray_tpu.get(
+                [w.execute.remote(reset, group_name)
+                 for w in worker_group.workers],
+                timeout=10.0)
+        except Exception:
+            pass
 
     def on_shutdown(self, worker_group: WorkerGroup, backend_config: JaxConfig):
         def teardown(group_name: str):
@@ -141,8 +258,16 @@ class JaxBackend(Backend):
             except Exception:
                 pass
 
+        import ray_tpu as _ray
+
         try:
-            worker_group.execute(teardown, backend_config.group_name)
+            # BOUNDED: a worker wedged in a collective with a dead peer
+            # only unblocks at jax's coordination heartbeat timeout
+            # (~100s); waiting for it delays the elastic restart past the
+            # next incarnation's actor-creation deadline. The group is
+            # being torn down anyway — force-kill is the backstop.
+            _ray.get([w.execute.remote(teardown, backend_config.group_name)
+                      for w in worker_group.workers], timeout=10.0)
         except Exception:
             pass
         # Driver-side backstop: dead workers can't deregister, which would

@@ -47,20 +47,41 @@ def _setup_torch_process_group(rank: int, world_size: int, master_addr: str,
 class TorchBackend(Backend):
     def on_start(self, worker_group: WorkerGroup,
                  backend_config: TorchConfig) -> None:
+        """Same bounded-retry rendezvous contract as JaxBackend: a fresh
+        master port per attempt (free-port race), decorrelated-jitter
+        pacing, typed exhaustion."""
+        import time as _time
+
         import ray_tpu
+        from ray_tpu._private.async_util import DecorrelatedJitterBackoff
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.exceptions import TrainRendezvousError
+        from ray_tpu.train._internal.util import find_free_port
 
         metas = worker_group.node_metas()
         master_addr = metas[0]["hostname"]
-        from ray_tpu.train._internal.util import find_free_port
-
-        master_port = worker_group.execute_single(0, find_free_port)
-        ray_tpu.get([
-            w.execute.remote(_setup_torch_process_group, i,
-                             len(worker_group), master_addr, master_port,
-                             backend_config.backend,
-                             backend_config.timeout_s)
-            for i, w in enumerate(worker_group.workers)
-        ])
+        attempts = max(1, int(CONFIG.train_rendezvous_max_retries))
+        backoff = DecorrelatedJitterBackoff(base_s=0.2, cap_s=2.0)
+        last: Optional[BaseException] = None
+        master_port = 0
+        for attempt in range(1, attempts + 1):
+            master_port = worker_group.execute_single(0, find_free_port)
+            try:
+                ray_tpu.get([
+                    w.execute.remote(_setup_torch_process_group, i,
+                                     len(worker_group), master_addr,
+                                     master_port, backend_config.backend,
+                                     backend_config.timeout_s)
+                    for i, w in enumerate(worker_group.workers)
+                ], timeout=float(CONFIG.train_rendezvous_timeout_s) + 30.0)
+                return
+            except Exception as e:
+                last = e
+            if attempt < attempts:
+                _time.sleep(backoff.next_delay())
+        raise TrainRendezvousError(
+            coordinator=f"{master_addr}:{master_port}", attempts=attempts,
+            reason=str(last)[:300] if last else "unknown") from last
 
     def on_shutdown(self, worker_group: WorkerGroup,
                     backend_config: TorchConfig) -> None:
@@ -73,7 +94,12 @@ class TorchBackend(Backend):
             except Exception:
                 pass
 
+        import ray_tpu as _ray
+
         try:
-            worker_group.execute(teardown)
+            # bounded for the same reason as the jax backend: a worker
+            # wedged on a dead peer's collective must not stall teardown
+            _ray.get([w.execute.remote(teardown)
+                      for w in worker_group.workers], timeout=10.0)
         except Exception:
             pass

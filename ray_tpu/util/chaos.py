@@ -138,10 +138,13 @@ class DaemonKiller(ResourceKiller):
 
     def __init__(self, session_dir: str, roles=("agent",),
                  interval_s: float = 2.0, max_kills: Optional[int] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, filter_fn=None):
         super().__init__(interval_s, max_kills, seed)
         self.session_dir = session_dir
         self.roles = tuple(roles)
+        # optional registry-record predicate to pin the victim further
+        # than role alone (e.g. "the worker hosting train rank 0")
+        self.filter_fn = filter_fn
 
     def find_target(self):
         from ray_tpu._private import lifecycle
@@ -149,6 +152,7 @@ class DaemonKiller(ResourceKiller):
         candidates = [
             r for r in lifecycle.live_registered(self.session_dir)
             if r.get("role") in self.roles and r["pid"] != os.getpid()
+            and (self.filter_fn is None or self.filter_fn(r))
         ]
         return self.rng.choice(candidates) if candidates else None
 

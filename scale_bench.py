@@ -1549,6 +1549,153 @@ def bench_data_shuffle(quick: bool = False) -> dict:
     return out
 
 
+def bench_train_elastic(quick: bool = False) -> dict:
+    """Elastic-training recovery trajectory (ISSUE 20, tracked like a
+    perf number): steady steps/s of a paced data-parallel run, then a
+    DaemonKiller SIGKILLs one train worker mid-epoch — time-to-resume
+    (kill → first post-resume result, from the ``train_resume::total``
+    span) and post-resume steps/s ride the artifact next to the steady
+    rate, so a detection or restart regression shows up as a diff."""
+    import os
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.train import (
+        FailureConfig, InStoreCheckpoint, JaxTrainer, RunConfig,
+        ScalingConfig)
+    from ray_tpu.util.chaos import DaemonKiller
+
+    steps = 80 if quick else 200
+    pace_s = 0.02
+
+    def loop(config):
+        import pickle as _pickle
+
+        import numpy as np
+        from ray_tpu import train as _train
+
+        ctx = _train.get_context()
+        rank = ctx.get_world_rank()
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 8)
+        y = X @ rng.randn(8)
+        w = np.zeros(8)
+        start = 0
+        ckpt = _train.get_checkpoint()
+        if isinstance(ckpt, InStoreCheckpoint):
+            st = _pickle.loads(bytes(ckpt.get_file("state.pkl")))
+            start, w = st["step"] + 1, st["w"]
+        for step in range(start, config["steps"]):
+            w = w - 0.05 * (2.0 * X.T @ (X @ w - y) / len(y))
+            if config.get("pid_file") and rank == 1 and step >= 10 \
+                    and not os.path.exists(config["pid_file"]):
+                with open(config["pid_file"], "w") as f:
+                    f.write(str(os.getpid()))
+            time.sleep(config["pace_s"])
+            _train.report(
+                {"step": step, "resumed_from": start},
+                checkpoint=InStoreCheckpoint.from_state(
+                    {"state.pkl": _pickle.dumps(
+                        {"step": step, "w": w})}, step=step))
+
+    def fit(name, tmp, pid_file=None):
+        return JaxTrainer(
+            loop,
+            train_loop_config={"steps": steps, "pace_s": pace_s,
+                               "pid_file": pid_file},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(
+                name=name, storage_path=tmp,
+                failure_config=FailureConfig(max_failures=3)),
+        ).fit()
+
+    out = {"steps": steps, "pace_s": pace_s, "world_size": 2}
+    # the recovery breakdown rides train_resume:: flight-recorder spans,
+    # which the default sample_rate=0 would drop
+    saved_rate = os.environ.get("RAY_TPU_TASK_EVENT_SAMPLE_RATE")
+    os.environ["RAY_TPU_TASK_EVENT_SAMPLE_RATE"] = "1.0"
+    ray_tpu.init(num_cpus=4)
+    killer = None
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            t0 = time.perf_counter()
+            clean = fit("steady", tmp)
+            steady_wall = time.perf_counter() - t0
+            assert clean.error is None, clean.error
+            out["steady_steps_per_s"] = round(steps / steady_wall, 2)
+
+            pid_file = os.path.join(tmp, "victim_pid")
+            kill_at = {}
+
+            def victim(rec):
+                try:
+                    with open(pid_file) as f:
+                        hit = rec["pid"] == int(f.read())
+                except (OSError, ValueError):
+                    return False
+                if hit:
+                    kill_at["t"] = time.perf_counter()
+                return hit
+
+            from ray_tpu._private.worker import global_worker
+
+            killer = DaemonKiller(global_worker.session_dir,
+                                  roles=("worker",), interval_s=0.1,
+                                  max_kills=1, filter_fn=victim)
+            killer.run()
+            t1 = time.perf_counter()
+            chaos = fit("chaos", tmp, pid_file=pid_file)
+            chaos_wall = time.perf_counter() - t1
+            assert chaos.error is None, chaos.error
+            assert killer.kills, "chaos kill never fired"
+            out["restarts"] = chaos.restarts
+            out["kills"] = list(killer.kills)
+            out["resumed_from_step"] = chaos.metrics.get("resumed_from")
+
+            # recovery breakdown from the flight recorder
+            w = global_worker
+            w.flush_task_events(wait=True)
+            spans = w.head_call("ListSpans", {"limit": 20000},
+                                timeout=10) or []
+            resume = {}
+            for sp in spans:
+                name = str(sp.get("name", ""))
+                if name.startswith("train_resume::"):
+                    part = name.split("::", 1)[1]
+                    resume[part] = round(
+                        max(resume.get(part, 0.0),
+                            (sp.get("dur_us") or 0) / 1e6), 3)
+            out["resume_spans_s"] = resume
+            out["time_to_resume_s"] = resume.get("total")
+            # steps the restarted incarnation ran, over the wall time it
+            # had after the kill + resume window
+            if kill_at and resume.get("total") is not None:
+                resumed_from = chaos.metrics.get("resumed_from") or 0
+                post_wall = (t1 + chaos_wall) - kill_at["t"] \
+                    - resume["total"]
+                if post_wall > 0:
+                    out["post_resume_steps_per_s"] = round(
+                        (steps - resumed_from) / post_wall, 2)
+            from ray_tpu.util import metrics as _metrics
+
+            m = _metrics._REGISTRY.get("ray_tpu_train_restarts_total")
+            out["restarts_counter"] = (
+                sum(v for _, v in m.snapshot()["values"]) if m else 0)
+    finally:
+        if killer is not None:
+            killer.stop()
+        if saved_rate is None:
+            os.environ.pop("RAY_TPU_TASK_EVENT_SAMPLE_RATE", None)
+        else:
+            os.environ["RAY_TPU_TASK_EVENT_SAMPLE_RATE"] = saved_rate
+        ray_tpu.shutdown()
+        from ray_tpu._private import lifecycle
+
+        lifecycle.gc_stale_sessions()
+    return out
+
+
 def main(quick: bool = False) -> dict:
     import ray_tpu
 
@@ -1649,6 +1796,24 @@ def main(quick: bool = False) -> dict:
                                  "DATA_SHUFFLE_latest.json")
             with open(art, "w") as f:
                 json.dump(results["data_shuffle"], f, indent=2,
+                          sort_keys=True)
+    except Exception:
+        pass
+    # elastic-training phase (ISSUE 20): kill -9 a train worker
+    # mid-epoch; steady vs time-to-resume vs post-resume rates, written
+    # standalone so the recovery trajectory diffs across rounds
+    try:
+        results["train_elastic"] = bench_train_elastic(quick)
+    except Exception as e:  # noqa: BLE001
+        results["train_elastic"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        import os
+
+        if "error" not in results["train_elastic"]:
+            art = os.environ.get("RAY_TPU_TRAINELASTIC_OUT",
+                                 "TRAIN_ELASTIC_latest.json")
+            with open(art, "w") as f:
+                json.dump(results["train_elastic"], f, indent=2,
                           sort_keys=True)
     except Exception:
         pass

@@ -97,6 +97,11 @@ FAST_FILES = {
     "test_data_shuffle.py",
     "test_flight_recorder.py",
     "test_memory_debugger.py",
+    "test_checkpoint_manager.py",
+    # elastic-training chaos suite: kill -9 mid-epoch + in-store resume
+    # must stay on the smoke path (the rc-124 hang class it guards is
+    # exactly the kind of regression that hides in the slow tier)
+    "test_train_elastic.py",
     # in FAST so tier-1 exercises the gate (its standalone failure used
     # to hide behind the `-m 'not slow'` deselection — ISSUE 11)
     "test_dryrun_gate.py",

@@ -371,6 +371,8 @@ _SAMPLES = {
     "queue_depths": {"replica-a": 3, "replica-b": 0},
     "incarnation": 7,
     "cause": None,
+    # TrainingWorkerError: which ranks died
+    "failed_ranks": [0, 3],
     # ObjectReconstructionFailedError: the attempted lineage chain
     "chain": [{"object_id": "aa" * 18, "task": "f", "why": "replayed"}],
 }

@@ -1,0 +1,207 @@
+"""Elastic training plane under chaos (ISSUE 20 tentpole coverage).
+
+A pure-numpy deterministic SGD loop reports in-store sharded checkpoints;
+``util.chaos.DaemonKiller`` SIGKILLs one train worker mid-epoch. The
+recovery loop must surface the death as a typed restart (not a hang, not
+a user-facing error), resume from the newest in-store checkpoint without
+touching disk, and converge to a final state BYTE-equivalent to an
+uninterrupted run. The numpy-only loop doubles as the "jax stays
+unimported in workers" probe: nothing on the worker-side report/restore
+path may drag the jax runtime in.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    FailureConfig, InStoreCheckpoint, JaxTrainer, RunConfig, ScalingConfig)
+from ray_tpu.util.chaos import DaemonKiller
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sgd_loop(config):
+    """Deterministic full-batch SGD; every worker holds the replicated
+    problem so any surviving subset continues the identical trajectory."""
+    import hashlib
+    import sys
+
+    import numpy as np
+
+    ctx = train.get_context()
+    rank = ctx.get_world_rank()
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 4)
+    w_true = rng.randn(4)
+    y = X @ w_true
+
+    start = 0
+    in_store_restore = False
+    w = np.zeros(4)
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        in_store_restore = isinstance(ckpt, InStoreCheckpoint)
+        state = pickle.loads(bytes(ckpt.get_file("state.pkl"))) \
+            if in_store_restore else None
+        if state is not None:
+            start = state["step"] + 1
+            w = state["w"]
+
+    pid_file = config.get("pid_file")
+    slow_gate = config.get("slow_gate")
+    for step in range(start, config["steps"]):
+        grad = 2.0 * X.T @ (X @ w - y) / len(y)
+        w = w - 0.05 * grad
+        loss = float(np.mean((X @ w - y) ** 2))
+        if pid_file and rank == 1 and step >= 5 and \
+                not os.path.exists(pid_file):
+            with open(pid_file + ".tmp", "w") as f:
+                f.write(str(os.getpid()))
+            os.replace(pid_file + ".tmp", pid_file)
+        if slow_gate and not os.path.exists(slow_gate):
+            time.sleep(0.05)
+        train.report(
+            {"step": step, "loss": loss,
+             "w_digest": hashlib.sha256(w.tobytes()).hexdigest(),
+             "resumed_from": start,
+             "in_store_restore": in_store_restore,
+             "world_size": ctx.get_world_size(),
+             "jax_loaded": "jax" in sys.modules},
+            checkpoint=InStoreCheckpoint.from_state(
+                {"state.pkl": pickle.dumps({"step": step, "w": w})},
+                step=step))
+
+
+def _fit(tmp_path, name, steps=40, num_workers=2, min_workers=None,
+         pid_file=None, slow_gate=None, max_failures=3):
+    trainer = JaxTrainer(
+        _sgd_loop,
+        train_loop_config={"steps": steps, "pid_file": pid_file,
+                           "slow_gate": slow_gate},
+        scaling_config=ScalingConfig(num_workers=num_workers,
+                                     min_workers=min_workers,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name=name, storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=max_failures)),
+    )
+    return trainer.fit()
+
+
+def _restarts_metric_total() -> float:
+    from ray_tpu.util import metrics
+
+    m = metrics._REGISTRY.get("ray_tpu_train_restarts_total")
+    if m is None:
+        return 0.0
+    return float(sum(v for _, v in m.snapshot().get("values", [])))
+
+
+def _run_with_killer(tmp_path, name, **kw):
+    """fit() with a DaemonKiller SIGKILLing the worker whose pid the
+    rank-1 loop published — kill -9 mid-epoch, exactly once."""
+    pid_file = str(tmp_path / f"{name}_victim_pid")
+    slow_gate = str(tmp_path / f"{name}_go_fast")
+
+    def victim(rec):
+        try:
+            with open(pid_file) as f:
+                return rec["pid"] == int(f.read())
+        except (OSError, ValueError):
+            return False
+
+    from ray_tpu._private.worker import global_worker
+
+    killer = DaemonKiller(global_worker.session_dir, roles=("worker",),
+                          interval_s=0.1, max_kills=1, filter_fn=victim)
+    killer.run()
+
+    def open_gate():
+        while not killer.kills:
+            time.sleep(0.1)
+        open(slow_gate, "w").close()  # kill landed: sprint to the end
+
+    gate = threading.Thread(target=open_gate, daemon=True)
+    gate.start()
+    try:
+        result = _fit(tmp_path, name, pid_file=pid_file,
+                      slow_gate=slow_gate, **kw)
+    finally:
+        killer.stop()
+    gate.join(timeout=10)
+    assert killer.kills, "the chaos kill never fired"
+    return result
+
+
+def test_worker_kill_resumes_byte_equivalent(ray4, tmp_path):
+    clean = _fit(tmp_path, "clean",
+                 slow_gate=str(tmp_path / "clean_go_fast"))
+    open(str(tmp_path / "clean_go_fast"), "w").close()
+    assert clean.error is None and clean.restarts == 0
+
+    before = _restarts_metric_total()
+    result = _run_with_killer(tmp_path, "chaos")
+
+    # typed recovery, not a wedge and not a user-facing failure
+    assert result.error is None, result.error
+    assert result.restarts >= 1
+    assert _restarts_metric_total() > before
+
+    m = result.metrics
+    assert m["step"] == 39
+    # the restarted incarnation resumed from the in-store checkpoint,
+    # not from scratch and not from a disk materialization
+    assert m["resumed_from"] >= 1
+    assert m["in_store_restore"] is True
+    # byte-equivalent trajectory across the crash boundary
+    assert m["w_digest"] == clean.metrics["w_digest"]
+    assert m["loss"] == clean.metrics["loss"]
+    # the numpy-only train path must not have dragged jax into workers
+    assert m["jax_loaded"] is False
+    assert clean.metrics["jax_loaded"] is False
+
+
+def test_worker_kill_elastic_shrinks_world(ray4, tmp_path):
+    """With elastic bounds, a death restarts at the surviving world size
+    instead of re-demanding the dead worker's slot."""
+    result = _run_with_killer(tmp_path, "elastic", min_workers=1)
+    assert result.error is None, result.error
+    assert result.restarts >= 1
+    m = result.metrics
+    assert m["step"] == 39
+    assert m["world_size"] == 1  # shrank from 2 to the survivor
+    assert m["resumed_from"] >= 1
+    assert m["in_store_restore"] is True
+
+
+def test_user_error_is_not_retried_forever(ray4, tmp_path):
+    """A deterministic user-loop raise must burn through max_failures and
+    surface, never loop forever (restart policy must distinguish
+    train_fn_error from worker death)."""
+
+    def bad_loop(config):
+        raise RuntimeError("always fails")
+
+    trainer = JaxTrainer(
+        bad_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="bad", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "always fails" in str(result.error)
